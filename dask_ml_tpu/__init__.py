@@ -1,0 +1,27 @@
+"""dask-ml-tpu: TPU-native scalable machine learning.
+
+A ground-up re-design of the capabilities of the reference library
+(stsievert/dask-ml) for TPU hardware.  Where the reference builds dask task
+graphs over chunked arrays and hands them to the distributed scheduler, this
+framework shards ``jax.Array`` rows over a ``jax.sharding.Mesh`` and compiles
+each algorithm into a single XLA program per step (``jax.jit`` +
+``shard_map``), with collectives (``psum`` / ``all_gather``) riding ICI
+instead of TCP shuffles.
+
+Two execution planes (mirroring the reference's two styles — see SURVEY.md §1):
+
+* **Lazy graph style** (most estimators in the reference) → jitted SPMD steps
+  over sharded arrays.
+* **Dynamic futures style** (``model_selection._incremental`` et al.) → a
+  host-side asyncio orchestrator multiplexing many small models over devices.
+
+Reference parity citations use the convention
+``dask_ml/<path>.py :: <symbol>`` (the reference mount was empty at build
+time; see SURVEY.md header for provenance).
+"""
+
+__version__ = "0.1.0"
+
+from . import core  # noqa: F401
+
+__all__ = ["core", "__version__"]

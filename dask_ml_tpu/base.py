@@ -1,0 +1,36 @@
+"""Estimator base classes.
+
+The whole framework keeps the sklearn estimator contract the reference keeps
+(`fit`/`predict`/`transform`/`get_params`/`set_params`, trailing-underscore
+fitted attributes — SURVEY.md §0), so we subclass sklearn's ``BaseEstimator``
+directly for params plumbing and add TPU ingest helpers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sklearn.base import BaseEstimator as _SkBase
+from sklearn.base import ClassifierMixin, RegressorMixin, TransformerMixin, clone  # noqa: F401
+
+from .core.mesh import get_mesh
+from .core.sharded import ShardedRows, shard_rows, unshard
+
+
+class TPUEstimator(_SkBase):
+    """Base for all estimators: sklearn params contract + sharded ingest."""
+
+    def _ingest(self, X, dtype=None) -> ShardedRows:
+        return shard_rows(X, get_mesh(), dtype=dtype)
+
+    def _ingest_pair(self, X, y, dtype=None):
+        from .utils import check_consistent_length
+
+        check_consistent_length(X, y)
+        Xs = shard_rows(X, get_mesh(), dtype=dtype)
+        ys = shard_rows(y, get_mesh()) if y is not None else None
+        return Xs, ys
+
+    @staticmethod
+    def _to_host(x) -> np.ndarray:
+        return unshard(x)

@@ -1,0 +1,45 @@
+"""Core runtime: mesh management, sharded data ingest, per-shard PRNG.
+
+This layer replaces the reference's external L1/L2 stack (dask.array chunking
++ the distributed scheduler — SURVEY.md §1): a row-chunked dask array becomes
+a row-**sharded** ``jax.Array`` on a device mesh, and the task graph becomes
+an XLA program.
+"""
+
+from .mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    device_mesh,
+    get_mesh,
+    set_mesh,
+    use_mesh,
+    data_axis_size,
+)
+from .sharded import (  # noqa: F401
+    ShardedRows,
+    shard_rows,
+    replicate,
+    unshard,
+    pad_rows,
+)
+from .prng import fold_in_shard, per_shard_keys, as_key  # noqa: F401
+from .compat import shard_map  # noqa: F401
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "device_mesh",
+    "get_mesh",
+    "set_mesh",
+    "use_mesh",
+    "data_axis_size",
+    "ShardedRows",
+    "shard_rows",
+    "replicate",
+    "unshard",
+    "pad_rows",
+    "fold_in_shard",
+    "per_shard_keys",
+    "as_key",
+    "shard_map",
+]

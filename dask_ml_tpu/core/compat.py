@@ -1,0 +1,12 @@
+"""Version shims (twin of ``dask_ml/_compat.py``, reduced to what we need)."""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+JAX_VERSION = jax.__version__

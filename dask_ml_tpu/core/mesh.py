@@ -1,0 +1,79 @@
+"""Device-mesh management.
+
+The reference delegates placement to the distributed scheduler
+(``distributed.Client`` — SURVEY.md §2.3).  Here placement is static: one
+global ``jax.sharding.Mesh`` with a ``data`` axis (batch/data parallelism —
+the reference's core strategy, SURVEY.md §2.2) and an optional ``model`` axis
+reserved for multi-model packing (hyperparameter search) and wide-feature
+tensor parallelism.
+
+The default mesh is 1-D over all visible devices.  Tests build an 8-device
+CPU mesh via ``XLA_FLAGS=--xla_force_host_platform_device_count=8``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_state = threading.local()
+
+
+def device_mesh(n_devices: int | None = None, *, model_axis: int = 1) -> Mesh:
+    """Build a mesh of ``n_devices`` (default: all) as ('data', 'model').
+
+    ``model_axis`` > 1 carves devices into a 2-D grid for multi-model
+    parallelism; the default collapses to pure data parallelism.
+    """
+    devices = jax.devices()
+    n = len(devices) if n_devices is None else n_devices
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices, only {len(devices)} visible")
+    if n % model_axis:
+        raise ValueError(f"n_devices={n} not divisible by model_axis={model_axis}")
+    grid = np.array(devices[:n]).reshape(n // model_axis, model_axis)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def get_mesh() -> Mesh:
+    """The active mesh: the innermost ``use_mesh`` context, else a cached
+    default over all devices."""
+    override = getattr(_state, "mesh_stack", None)
+    if override:
+        return override[-1]
+    mesh = getattr(_state, "default_mesh", None)
+    if mesh is None:
+        mesh = device_mesh()
+        _state.default_mesh = mesh
+    return mesh
+
+
+def set_mesh(mesh: Mesh | None) -> None:
+    """Replace the process-default mesh (None resets to all-devices)."""
+    _state.default_mesh = mesh
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Scope a mesh for the duration of a ``with`` block."""
+    stack = getattr(_state, "mesh_stack", None)
+    if stack is None:
+        stack = _state.mesh_stack = []
+    stack.append(mesh)
+    try:
+        yield mesh
+    finally:
+        stack.pop()
+
+
+def data_axis_size(mesh: Mesh | None = None) -> int:
+    mesh = mesh or get_mesh()
+    return mesh.shape[DATA_AXIS]

@@ -1,0 +1,48 @@
+"""Per-shard PRNG.
+
+The reference draws per-block seeds on the host (``dask_ml/utils.py ::
+draw_seed``; ``datasets.py`` seeds each block).  The TPU-native equivalent is
+``jax.random.fold_in(key, shard_index)`` inside SPMD code — deterministic,
+device-resident, and independent of mesh size ordering.
+"""
+
+from __future__ import annotations
+
+import numbers
+
+import jax
+import jax.numpy as jnp
+
+
+def as_key(random_state) -> jax.Array:
+    """Normalize ``random_state`` (None | int | RandomState | PRNG key)."""
+    if random_state is None:
+        # Deterministic default, like sklearn's check_random_state(None)
+        # except reproducible: estimators that need fresh entropy should
+        # require an explicit seed.
+        return jax.random.PRNGKey(0)
+    if isinstance(random_state, numbers.Integral):
+        return jax.random.PRNGKey(int(random_state))
+    import numpy as np
+
+    if isinstance(random_state, np.random.RandomState):
+        return jax.random.PRNGKey(int(random_state.randint(0, 2**31 - 1)))
+    if isinstance(random_state, jax.Array) and (
+        jax.dtypes.issubdtype(random_state.dtype, jax.dtypes.prng_key)
+        or random_state.dtype == jnp.uint32
+    ):
+        return random_state
+    raise ValueError(
+        f"Cannot interpret {type(random_state).__name__!r} as a PRNG key; "
+        "pass None, an int seed, a numpy RandomState, or a jax PRNG key."
+    )
+
+
+def fold_in_shard(key: jax.Array, axis_name: str) -> jax.Array:
+    """Inside shard_map/pmap: a distinct key per shard along ``axis_name``."""
+    return jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+
+
+def per_shard_keys(key: jax.Array, n_shards: int) -> jax.Array:
+    """Host-side: stacked keys, one per shard (for vmap-style dispatch)."""
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(n_shards))

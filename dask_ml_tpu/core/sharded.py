@@ -1,0 +1,137 @@
+"""Row-sharded arrays: the ``dask.array`` replacement.
+
+The reference chunks the sample axis into blocks and builds per-block tasks
+(``da.blockwise`` / ``map_blocks`` — SURVEY.md §1 L2).  Here the sample axis
+is sharded over the mesh's ``data`` axis.  Because XLA wants static,
+divisible shapes, rows are **padded** up to a multiple of the data-axis size
+and a float mask marks real rows; every reduction in the framework is
+mask-weighted, and outputs are sliced back to the true row count at the API
+boundary.  This pad+mask discipline is what lets every fit step compile to a
+single fused XLA program with no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, get_mesh
+
+
+def pad_rows(x: np.ndarray, multiple: int):
+    """Pad axis 0 of ``x`` up to a multiple; returns (padded, n_real)."""
+    n = x.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x, n
+    pad_width = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(np.asarray(x), pad_width), n
+
+
+def row_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """NamedSharding that splits axis 0 over the data axis, replicates rest."""
+    spec = P(DATA_AXIS, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicate(x, mesh: Mesh | None = None):
+    """Place ``x`` replicated across the mesh."""
+    mesh = mesh or get_mesh()
+    return jax.device_put(jnp.asarray(x), NamedSharding(mesh, P()))
+
+
+@dataclass(frozen=True)
+class ShardedRows:
+    """A 1- or 2-D array sharded by rows over the mesh data axis.
+
+    Attributes:
+      data: padded jax.Array, axis 0 divisible by the data-axis size.
+      mask: float (padded_n,) — 1.0 for real rows, 0.0 for padding.
+      n_samples: true row count.
+    """
+
+    data: jax.Array
+    mask: jax.Array
+    n_samples: int
+
+    @property
+    def shape(self):
+        return (self.n_samples,) + self.data.shape[1:]
+
+    @property
+    def padded(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def unpad(self, x=None):
+        """Slice a padded-rows result back to the true row count."""
+        x = self.data if x is None else x
+        return x[: self.n_samples]
+
+
+def shard_rows(
+    x,
+    mesh: Mesh | None = None,
+    *,
+    dtype=None,
+) -> ShardedRows:
+    """Ingest a host array as a row-sharded, padded ``ShardedRows``.
+
+    Already-sharded inputs pass through; the mask is rebuilt only if absent.
+    """
+    if isinstance(x, ShardedRows):
+        return x
+    mesh = mesh or get_mesh()
+    n_shards = mesh.shape[DATA_AXIS]
+    x = np.asarray(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    padded, n = pad_rows(x, n_shards)
+    mask_np = np.zeros(padded.shape[0], dtype=np.float32)
+    mask_np[:n] = 1.0
+    sharding = row_sharding(mesh, padded.ndim)
+    data = jax.device_put(jnp.asarray(padded), sharding)
+    mask = jax.device_put(jnp.asarray(mask_np), row_sharding(mesh, 1))
+    return ShardedRows(data=data, mask=mask, n_samples=n)
+
+
+def unshard(x) -> np.ndarray:
+    """Bring a (possibly sharded) array back to host memory."""
+    if isinstance(x, ShardedRows):
+        x = x.unpad()
+    return np.asarray(jax.device_get(x))
+
+
+# The masked reductions reduce over the (padded, sharded) row axis only —
+# that is the axis the mask lives on.
+
+
+@jax.jit
+def masked_sum(x, mask):
+    """Sum over rows counting only real (mask==1) rows."""
+    m = mask.reshape(mask.shape + (1,) * (x.ndim - 1)).astype(x.dtype)
+    return jnp.sum(x * m, axis=0)
+
+
+@jax.jit
+def masked_mean(x, mask):
+    m = mask.reshape(mask.shape + (1,) * (x.ndim - 1)).astype(x.dtype)
+    return jnp.sum(x * m, axis=0) / jnp.sum(m, axis=0)
+
+
+@partial(jax.jit, static_argnames=("ddof",))
+def masked_var(x, mask, ddof=0):
+    m = mask.reshape(mask.shape + (1,) * (x.ndim - 1)).astype(x.dtype)
+    count = jnp.sum(m, axis=0)
+    mean = jnp.sum(x * m, axis=0) / count
+    sq = jnp.sum((x - mean) ** 2 * m, axis=0)
+    return sq / (count - ddof)

@@ -1,0 +1,173 @@
+"""Shared utilities — twin of ``dask_ml/utils.py`` (reference symbols:
+``check_array``, ``handle_zeros_in_scale``, ``svd_flip``, ``draw_seed``,
+``_timer``, ``assert_estimator_equal``), re-done for jax arrays.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import numbers
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .core.sharded import ShardedRows, unshard
+
+logger = logging.getLogger(__name__)
+
+
+def check_array(
+    array,
+    *,
+    accept_sharded: bool = True,
+    ensure_2d: bool = True,
+    allow_nd: bool = False,
+    dtype="numeric",
+    copy: bool = False,
+):
+    """Validate input like the reference's dask-aware ``check_array``.
+
+    Accepts numpy arrays, jax arrays, and :class:`ShardedRows`.  Returns the
+    input unchanged structurally (no premature host transfer), after shape /
+    dtype validation.
+    """
+    if isinstance(array, ShardedRows):
+        inner = array.data
+        if ensure_2d and inner.ndim != 2:
+            raise ValueError(f"Expected 2D input, got ndim={inner.ndim}")
+        if array.n_samples == 0:
+            raise ValueError("Found array with 0 samples")
+        return array
+    if hasattr(array, "to_numpy"):  # pandas
+        array = array.to_numpy()
+    arr = jnp.asarray(array) if isinstance(array, jax.Array) else np.asarray(array)
+    if dtype == "numeric" and not np.issubdtype(arr.dtype, np.number):
+        raise ValueError(f"Expected numeric dtype, got {arr.dtype}")
+    if arr.ndim == 0:
+        raise ValueError("Expected an array, got a scalar")
+    if ensure_2d and arr.ndim != 2:
+        if arr.ndim == 1 or not allow_nd:
+            raise ValueError(
+                f"Expected 2D array, got ndim={arr.ndim}. "
+                "Reshape your data with .reshape(-1, 1) for a single feature."
+            )
+    if not allow_nd and arr.ndim > 2:
+        raise ValueError(f"Expected <=2 dims, got ndim={arr.ndim}")
+    if arr.shape[0] == 0:
+        raise ValueError("Found array with 0 samples")
+    if copy and isinstance(arr, np.ndarray):
+        arr = arr.copy()
+    return arr
+
+
+def check_consistent_length(*arrays):
+    lengths = set()
+    for a in arrays:
+        if a is None:
+            continue
+        if isinstance(a, ShardedRows):
+            n = a.n_samples
+        else:
+            shape = getattr(a, "shape", None)
+            n = shape[0] if shape else len(a)
+        lengths.add(int(n))
+    if len(lengths) > 1:
+        raise ValueError(f"Inconsistent sample counts: {sorted(lengths)}")
+
+
+def handle_zeros_in_scale(scale):
+    """Avoid division by ~0 when scaling (constant features scale by 1).
+
+    Reference: ``dask_ml/utils.py :: handle_zeros_in_scale``.
+    """
+    scale = jnp.asarray(scale)
+    if scale.ndim == 0:
+        return jnp.where(scale == 0.0, 1.0, scale)
+    eps = 10 * jnp.finfo(scale.dtype).eps
+    return jnp.where(jnp.abs(scale) < eps, 1.0, scale)
+
+
+def svd_flip(u, v, u_based_decision: bool = True):
+    """Deterministic SVD sign convention (reference: ``utils.py :: svd_flip``)."""
+    if u_based_decision:
+        max_abs = jnp.argmax(jnp.abs(u), axis=0)
+        signs = jnp.sign(u[max_abs, jnp.arange(u.shape[1])])
+    else:
+        max_abs = jnp.argmax(jnp.abs(v), axis=1)
+        signs = jnp.sign(v[jnp.arange(v.shape[0]), max_abs])
+    u = u * signs[jnp.newaxis, :]
+    v = v * signs[:, jnp.newaxis]
+    return u, v
+
+
+def draw_seed(random_state, low=0, high=2**31 - 1, size=None):
+    """Draw integer seed(s) from a numpy RandomState-compatible source.
+
+    Reference: ``dask_ml/utils.py :: draw_seed``.
+    """
+    rng = check_random_state(random_state)
+    return rng.randint(low, high, size=size)
+
+
+def check_random_state(random_state) -> np.random.RandomState:
+    if random_state is None or isinstance(random_state, numbers.Integral):
+        return np.random.RandomState(random_state)
+    if isinstance(random_state, np.random.RandomState):
+        return random_state
+    raise ValueError(f"Cannot make RandomState from {random_state!r}")
+
+
+@contextlib.contextmanager
+def _timer(name: str, _logger=None, level=logging.INFO):
+    """Log phase durations (reference: ``utils.py :: _timer``)."""
+    _logger = _logger or logger
+    start = time.perf_counter()
+    _logger.log(level, "Starting %s", name)
+    try:
+        yield
+    finally:
+        _logger.log(level, "Finished %s in %.4fs", name, time.perf_counter() - start)
+
+
+def copy_learned_attributes(from_estimator, to_estimator):
+    """Copy fitted (trailing-underscore) attributes between estimators.
+
+    Reference: ``dask_ml/_utils.py :: copy_learned_attributes``.
+    """
+    for name, value in vars(from_estimator).items():
+        if name.endswith("_") and not name.startswith("_"):
+            setattr(to_estimator, name, value)
+    return to_estimator
+
+
+def assert_estimator_equal(left, right, exclude=(), **kwargs):
+    """Assert two fitted estimators carry (approximately) equal fitted attrs.
+
+    Reference: ``dask_ml/utils.py :: assert_estimator_equal``.
+    """
+    left_attrs = {k for k in vars(left) if k.endswith("_") and not k.startswith("_")}
+    right_attrs = {k for k in vars(right) if k.endswith("_") and not k.startswith("_")}
+    if isinstance(exclude, str):
+        exclude = {exclude}
+    attrs = (left_attrs & right_attrs) - set(exclude)
+    assert attrs, "no common fitted attributes"
+    for attr in attrs:
+        l, r = getattr(left, attr), getattr(right, attr)
+        _assert_eq(l, r, name=attr, **kwargs)
+
+
+def _assert_eq(l, r, name="", **kwargs):
+    if isinstance(l, (ShardedRows, jax.Array)):
+        l = unshard(l)
+    if isinstance(r, (ShardedRows, jax.Array)):
+        r = unshard(r)
+    if isinstance(l, np.ndarray) or isinstance(r, np.ndarray):
+        np.testing.assert_allclose(np.asarray(l), np.asarray(r), err_msg=name, **kwargs)
+    elif isinstance(l, numbers.Number):
+        np.testing.assert_allclose(l, r, err_msg=name, **kwargs)
+    else:
+        assert l == r, f"{name}: {l!r} != {r!r}"

@@ -1,0 +1,59 @@
+"""Test harness: an 8-device virtual CPU mesh.
+
+This is the direct analogue of the reference's ``distributed.utils_test.
+gen_cluster`` (in-process scheduler + workers — SURVEY.md §4.3): the same
+SPMD code paths that run on a TPU pod run here on 8 virtual CPU devices, so
+multi-device sharding and collectives are exercised on every test run.
+
+Must set XLA flags BEFORE jax initializes — hence the top of conftest.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the image presets JAX_PLATFORMS=axon (TPU)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The image's sitecustomize imports jax at interpreter start, so jax.config
+# captured JAX_PLATFORMS=axon before this file ran — override via config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh():
+    from dask_ml_tpu.core import get_mesh
+
+    return get_mesh()
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)
+
+
+@pytest.fixture
+def xy_classification(rng):
+    """Small dense classification problem (reference conftest pattern)."""
+    n, d = 100, 5
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d)
+    y = (X @ w + 0.1 * rng.normal(size=n) > 0).astype(np.int32)
+    return X, y
+
+
+@pytest.fixture
+def xy_regression(rng):
+    n, d = 120, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d)
+    y = (X @ w + 0.05 * rng.normal(size=n)).astype(np.float32)
+    return X, y

@@ -1,0 +1,99 @@
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dask_ml_tpu.core import (
+    DATA_AXIS,
+    ShardedRows,
+    data_axis_size,
+    device_mesh,
+    get_mesh,
+    shard_rows,
+    unshard,
+    use_mesh,
+)
+from dask_ml_tpu.core.sharded import masked_mean, masked_sum, masked_var
+from dask_ml_tpu.utils import handle_zeros_in_scale, svd_flip
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_default_mesh_covers_devices():
+    mesh = get_mesh()
+    assert data_axis_size(mesh) * mesh.shape["model"] == 8
+
+
+def test_use_mesh_scoping():
+    small = device_mesh(4)
+    with use_mesh(small):
+        assert get_mesh() is small
+    assert get_mesh() is not small
+
+
+@pytest.mark.parametrize("n", [16, 17, 23, 8])
+def test_shard_rows_pads_and_masks(n):
+    x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+    s = shard_rows(x)
+    assert s.n_samples == n
+    assert s.padded % data_axis_size() == 0
+    assert float(jnp.sum(s.mask)) == n
+    np.testing.assert_array_equal(unshard(s), x)
+
+
+def test_sharding_is_row_partitioned():
+    x = np.ones((16, 4), dtype=np.float32)
+    s = shard_rows(x)
+    spec = s.data.sharding.spec
+    assert spec[0] == DATA_AXIS
+
+
+def test_masked_reductions_match_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(37, 5)).astype(np.float32)
+    s = shard_rows(x)
+    np.testing.assert_allclose(
+        np.asarray(masked_sum(s.data, s.mask)), x.sum(0), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(masked_mean(s.data, s.mask)), x.mean(0), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(masked_var(s.data, s.mask)), x.var(0), rtol=1e-4
+    )
+
+
+def test_masked_reduction_compiles_once_under_jit():
+    x = np.ones((24, 2), dtype=np.float32)
+    s = shard_rows(x)
+    out = jax.jit(masked_sum)(s.data, s.mask)
+    np.testing.assert_allclose(np.asarray(out), [24.0, 24.0])
+
+
+def test_handle_zeros_in_scale():
+    scale = jnp.array([1.0, 0.0, 2.0])
+    out = np.asarray(handle_zeros_in_scale(scale))
+    np.testing.assert_array_equal(out, [1.0, 1.0, 2.0])
+
+
+def test_svd_flip_deterministic_signs():
+    rng = np.random.RandomState(1)
+    a = rng.normal(size=(20, 4))
+    u, s, vt = np.linalg.svd(a, full_matrices=False)
+    u1, v1 = svd_flip(jnp.asarray(u), jnp.asarray(vt))
+    u2, v2 = svd_flip(jnp.asarray(-u), jnp.asarray(-vt))
+    np.testing.assert_allclose(np.asarray(u1), np.asarray(u2), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(u1) * s @ np.asarray(v1), a, atol=1e-5
+    )
+
+
+def test_sharded_rows_is_frozen():
+    s = shard_rows(np.ones((8, 2), dtype=np.float32))
+    assert isinstance(s, ShardedRows)
+    with pytest.raises(Exception):
+        s.n_samples = 5
