@@ -23,5 +23,7 @@ time; see SURVEY.md header for provenance).
 __version__ = "0.1.0"
 
 from . import core  # noqa: F401
+from . import metrics  # noqa: F401
+from . import preprocessing  # noqa: F401
 
-__all__ = ["core", "__version__"]
+__all__ = ["core", "metrics", "preprocessing", "__version__"]

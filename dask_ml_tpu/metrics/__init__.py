@@ -1,0 +1,44 @@
+"""Metrics — twin of ``dask_ml/metrics/`` (SURVEY.md §2 component #12).
+
+Lazy dask reductions become jitted masked reductions; blockwise pairwise
+distances become sharded gemms on the MXU.
+"""
+
+from .pairwise import (  # noqa: F401
+    euclidean_distances,
+    pairwise_distances,
+    pairwise_distances_argmin_min,
+    linear_kernel,
+    polynomial_kernel,
+    rbf_kernel,
+    sigmoid_kernel,
+    PAIRWISE_KERNEL_FUNCTIONS,
+)
+from .classification import accuracy_score, log_loss  # noqa: F401
+from .regression import (  # noqa: F401
+    mean_absolute_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    r2_score,
+)
+from .scorer import SCORERS, check_scoring, get_scorer  # noqa: F401
+
+__all__ = [
+    "euclidean_distances",
+    "pairwise_distances",
+    "pairwise_distances_argmin_min",
+    "linear_kernel",
+    "polynomial_kernel",
+    "rbf_kernel",
+    "sigmoid_kernel",
+    "PAIRWISE_KERNEL_FUNCTIONS",
+    "accuracy_score",
+    "log_loss",
+    "mean_absolute_error",
+    "mean_squared_error",
+    "mean_squared_log_error",
+    "r2_score",
+    "SCORERS",
+    "check_scoring",
+    "get_scorer",
+]

@@ -1,0 +1,98 @@
+"""Classification metrics (reference: ``dask_ml/metrics/classification.py``).
+
+Each metric is a single masked reduction over the sharded sample axis; with
+sharded inputs XLA inserts the cross-device psum.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.sharded import ShardedRows
+
+
+def _lengths(a):
+    return (a.n_samples, a.padded) if isinstance(a, ShardedRows) else (a.shape[0], a.shape[0])
+
+
+def _align(y_true, y_pred):
+    """Return (true, pred, mask) as padded device arrays of equal length.
+
+    Mixed sharded/plain inputs of the same logical length are aligned by
+    zero-padding the plain side up to the sharded side's padded length (the
+    padded tail is masked out anyway).
+    """
+    n_t, pad_t = _lengths(y_true)
+    n_p, pad_p = _lengths(y_pred)
+    if n_t != n_p:
+        raise ValueError(
+            f"y_true and y_pred have different lengths: {n_t} vs {n_p}"
+        )
+    padded = max(pad_t, pad_p)
+
+    def to_padded(a):
+        x = a.data if isinstance(a, ShardedRows) else jnp.asarray(a)
+        if x.shape[0] < padded:
+            x = jnp.pad(x, [(0, padded - x.shape[0])] + [(0, 0)] * (x.ndim - 1))
+        return x
+
+    if isinstance(y_true, ShardedRows) and pad_t == padded:
+        mask = y_true.mask
+    elif isinstance(y_pred, ShardedRows) and pad_p == padded:
+        mask = y_pred.mask
+    else:
+        mask = jnp.ones(padded, dtype=jnp.float32)
+    return to_padded(y_true), to_padded(y_pred), mask
+
+
+def _apply_weight(mask, sample_weight):
+    if sample_weight is None:
+        return mask
+    w = sample_weight.data if isinstance(sample_weight, ShardedRows) else jnp.asarray(sample_weight)
+    if w.shape[0] < mask.shape[0]:
+        # host-side weights for a padded device array: pad with zeros
+        w = jnp.pad(w, (0, mask.shape[0] - w.shape[0]))
+    elif w.shape[0] > mask.shape[0]:
+        # sharded (padded) weights for plain arrays: padded tail is zeros
+        w = w[: mask.shape[0]]
+    return mask * w
+
+
+def accuracy_score(y_true, y_pred, normalize: bool = True, sample_weight=None, compute=True):
+    """Fraction (or count) of correct predictions."""
+    t, p, mask = _align(y_true, y_pred)
+    w = _apply_weight(mask, sample_weight)
+    correct = (t == p).astype(jnp.float32)
+    hits = jnp.sum(correct * w)
+    result = hits / jnp.sum(w) if normalize else hits
+    return float(result) if compute else result
+
+
+def log_loss(y_true, y_pred, eps: float = 1e-15, normalize: bool = True, sample_weight=None, labels=None):
+    """Negative log-likelihood of a classifier's probabilistic predictions.
+
+    ``y_pred`` may be (n, k) probabilities or (n,) positive-class probability.
+    """
+    t, p, mask = _align(y_true, y_pred)
+    w = _apply_weight(mask, sample_weight)
+    p = jnp.clip(p, eps, 1.0 - eps)
+    if p.ndim == 1:
+        per = -(t * jnp.log(p) + (1.0 - t) * jnp.log(1.0 - p))
+    else:
+        n_classes = p.shape[1]
+        if labels is not None:
+            labels = np.sort(np.asarray(labels))
+            t_host = np.asarray(t).astype(np.int64)
+            unseen = np.setdiff1d(np.unique(t_host), labels)
+            if unseen.size:
+                raise ValueError(
+                    f"y_true contains labels not in `labels`: {unseen.tolist()}"
+                )
+            t = jnp.asarray(np.searchsorted(labels, t_host))
+        onehot = jax.nn.one_hot(t.astype(jnp.int32), n_classes, dtype=p.dtype)
+        p = p / jnp.sum(p, axis=1, keepdims=True)
+        per = -jnp.sum(onehot * jnp.log(p), axis=1)
+    total = jnp.sum(per * w)
+    return float(total / jnp.sum(w)) if normalize else float(total)

@@ -1,0 +1,54 @@
+"""Regression metrics (reference: ``dask_ml/metrics/regression.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .classification import _align, _apply_weight
+
+
+def mean_squared_error(y_true, y_pred, sample_weight=None, squared: bool = True, compute=True):
+    t, p, mask = _align(y_true, y_pred)
+    w = _apply_weight(mask, sample_weight)
+    if t.ndim > 1 or p.ndim > 1:
+        per = jnp.mean((t.reshape(t.shape[0], -1) - p.reshape(p.shape[0], -1)) ** 2, axis=1)
+    else:
+        per = (t - p) ** 2
+    out = jnp.sum(per * w) / jnp.sum(w)
+    if not squared:
+        out = jnp.sqrt(out)
+    return float(out) if compute else out
+
+
+def mean_absolute_error(y_true, y_pred, sample_weight=None, compute=True):
+    t, p, mask = _align(y_true, y_pred)
+    w = _apply_weight(mask, sample_weight)
+    if t.ndim > 1 or p.ndim > 1:
+        per = jnp.mean(jnp.abs(t.reshape(t.shape[0], -1) - p.reshape(p.shape[0], -1)), axis=1)
+    else:
+        per = jnp.abs(t - p)
+    return float(jnp.sum(per * w) / jnp.sum(w)) if compute else jnp.sum(per * w) / jnp.sum(w)
+
+
+def mean_squared_log_error(y_true, y_pred, sample_weight=None, compute=True):
+    t, p, mask = _align(y_true, y_pred)
+    w = _apply_weight(mask, sample_weight)
+    per = (jnp.log1p(t) - jnp.log1p(p)) ** 2
+    return float(jnp.sum(per * w) / jnp.sum(w)) if compute else jnp.sum(per * w) / jnp.sum(w)
+
+
+def r2_score(y_true, y_pred, sample_weight=None, compute=True):
+    t, p, mask = _align(y_true, y_pred)
+    w = _apply_weight(mask, sample_weight)
+    wsum = jnp.sum(w)
+    mean_t = jnp.sum(t * w) / wsum
+    ss_res = jnp.sum((t - p) ** 2 * w)
+    ss_tot = jnp.sum((t - mean_t) ** 2 * w)
+    # Constant y_true: sklearn defines 1.0 for a perfect fit, else 0.0.
+    eps = jnp.finfo(ss_tot.dtype).tiny
+    out = jnp.where(
+        ss_tot > eps,
+        1.0 - ss_res / jnp.where(ss_tot > eps, ss_tot, 1.0),
+        jnp.where(ss_res > eps, 0.0, 1.0),
+    )
+    return float(out) if compute else out

@@ -1,0 +1,63 @@
+"""Scorer registry (reference: ``dask_ml/metrics/scorer.py`` — ``get_scorer``,
+``check_scoring``, ``SCORERS``), sklearn-compatible signatures.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from .classification import accuracy_score, log_loss
+from .regression import mean_absolute_error, mean_squared_error, r2_score
+
+
+def _passthrough_scorer(estimator, X, y=None, **kwargs):
+    return estimator.score(X, y, **kwargs)
+
+
+def make_scorer(score_func, greater_is_better: bool = True, **kwargs):
+    sign = 1.0 if greater_is_better else -1.0
+
+    def scorer(estimator, X, y):
+        y_pred = estimator.predict(X)
+        return sign * score_func(y, y_pred, **kwargs)
+
+    scorer._score_func = score_func
+    scorer._sign = sign
+    return scorer
+
+
+def _neg_log_loss_scorer(estimator, X, y):
+    proba = estimator.predict_proba(X)
+    return -log_loss(y, proba)
+
+
+SCORERS = {
+    "accuracy": make_scorer(accuracy_score),
+    "neg_mean_squared_error": make_scorer(mean_squared_error, greater_is_better=False),
+    "neg_root_mean_squared_error": make_scorer(
+        partial(mean_squared_error, squared=False), greater_is_better=False
+    ),
+    "neg_mean_absolute_error": make_scorer(mean_absolute_error, greater_is_better=False),
+    "r2": make_scorer(r2_score),
+    "neg_log_loss": _neg_log_loss_scorer,
+}
+
+
+def get_scorer(scoring):
+    """Resolve a scoring name or callable to a scorer(estimator, X, y)."""
+    if callable(scoring):
+        return scoring
+    try:
+        return SCORERS[scoring]
+    except KeyError:
+        raise ValueError(
+            f"{scoring!r} is not a valid scoring value. Valid options: {sorted(SCORERS)}"
+        )
+
+
+def check_scoring(estimator, scoring=None):
+    if scoring is None:
+        if hasattr(estimator, "score"):
+            return _passthrough_scorer
+        raise TypeError(f"{estimator!r} has no score method; pass scoring explicitly")
+    return get_scorer(scoring)

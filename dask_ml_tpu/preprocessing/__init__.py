@@ -1,0 +1,24 @@
+"""Preprocessing — twin of ``dask_ml/preprocessing/`` (SURVEY.md §2 #13).
+
+Scalers are fitted by single-pass masked reductions compiled into one XLA
+program; transforms are elementwise device ops that XLA fuses into whatever
+consumes them.
+"""
+
+from .data import (  # noqa: F401
+    MinMaxScaler,
+    QuantileTransformer,
+    RobustScaler,
+    StandardScaler,
+)
+from .label import LabelEncoder  # noqa: F401
+from ._block_transformer import BlockTransformer  # noqa: F401
+
+__all__ = [
+    "StandardScaler",
+    "MinMaxScaler",
+    "RobustScaler",
+    "QuantileTransformer",
+    "LabelEncoder",
+    "BlockTransformer",
+]

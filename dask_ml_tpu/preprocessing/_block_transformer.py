@@ -1,0 +1,36 @@
+"""BlockTransformer (reference: ``dask_ml/preprocessing/_block_transformer.py``).
+
+The reference applies a user function per dask block; here the function is
+applied to the device array (per-shard under the hood — the function must be
+elementwise/row-local, same contract as the reference's per-block function).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import TPUEstimator, TransformerMixin
+from ..core.sharded import ShardedRows
+
+
+class BlockTransformer(TransformerMixin, TPUEstimator):
+    def __init__(self, func, *, validate=False, **kw_args):
+        self.func = func
+        self.validate = validate
+        self.kw_args = kw_args
+
+    def fit(self, X, y=None):
+        return self
+
+    def transform(self, X, y=None):
+        kwargs = self.kw_args or {}
+        if self.validate:
+            from ..utils import check_array
+
+            X = check_array(X)
+        if isinstance(X, ShardedRows):
+            out = self.func(X.data, **kwargs)
+            if out.shape[0] != X.data.shape[0]:
+                raise ValueError("BlockTransformer func must preserve row count")
+            return ShardedRows(data=out, mask=X.mask, n_samples=X.n_samples)
+        return self.func(jnp.asarray(X), **kwargs)

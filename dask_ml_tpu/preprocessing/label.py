@@ -1,0 +1,49 @@
+"""LabelEncoder (reference: ``dask_ml/preprocessing/label.py``).
+
+The reference leans on pandas categoricals for distributed uniques; here the
+class inventory is computed host-side (labels are small) and the encode /
+decode maps run on device via searchsorted/take.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import TPUEstimator, TransformerMixin
+from ..core.sharded import ShardedRows, unshard
+
+
+class LabelEncoder(TransformerMixin, TPUEstimator):
+    """``use_categorical`` is accepted for reference API compatibility but
+    inert — it toggles a pandas-categorical fast path in the reference; here
+    the class inventory is always computed from the label values."""
+
+    def __init__(self, use_categorical: bool = True):
+        self.use_categorical = use_categorical
+
+    def fit(self, y):
+        vals = unshard(y) if isinstance(y, (ShardedRows,)) else np.asarray(y)
+        if vals.ndim != 1:
+            raise ValueError("y should be a 1d array")
+        self.classes_ = np.unique(vals)
+        self.dtype_ = vals.dtype
+        return self
+
+    def fit_transform(self, y):
+        return self.fit(y).transform(y)
+
+    def transform(self, y):
+        vals = unshard(y) if isinstance(y, ShardedRows) else np.asarray(y)
+        diff = np.setdiff1d(vals, self.classes_)
+        if diff.size:
+            raise ValueError(f"y contains previously unseen labels: {diff.tolist()}")
+        if np.issubdtype(self.classes_.dtype, np.number):
+            return jnp.searchsorted(jnp.asarray(self.classes_), jnp.asarray(vals))
+        return jnp.asarray(np.searchsorted(self.classes_, vals))
+
+    def inverse_transform(self, y):
+        idx = np.asarray(unshard(y) if isinstance(y, ShardedRows) else y)
+        if idx.size and (idx.min() < 0 or idx.max() >= len(self.classes_)):
+            raise ValueError("y contains out-of-range encoded labels")
+        return self.classes_[idx]

@@ -1,0 +1,194 @@
+import numpy as np
+import pytest
+import sklearn.metrics as sm
+import sklearn.metrics.pairwise as smp
+
+import dask_ml_tpu.metrics as dmm
+from dask_ml_tpu.core import shard_rows
+
+
+@pytest.fixture
+def XY(rng):
+    X = rng.normal(size=(33, 6)).astype(np.float32)
+    Y = rng.normal(size=(7, 6)).astype(np.float32)
+    return X, Y
+
+
+class TestPairwise:
+    def test_euclidean_parity(self, XY):
+        X, Y = XY
+        got = np.asarray(dmm.euclidean_distances(X, Y))
+        np.testing.assert_allclose(got, smp.euclidean_distances(X, Y), atol=1e-4)
+
+    def test_euclidean_sharded_rows(self, XY):
+        X, Y = XY
+        s = shard_rows(X)
+        got = np.asarray(dmm.euclidean_distances(s, Y))[: s.n_samples]
+        np.testing.assert_allclose(got, smp.euclidean_distances(X, Y), atol=1e-4)
+
+    def test_argmin_min(self, XY):
+        X, Y = XY
+        idx, dist = dmm.pairwise_distances_argmin_min(X, Y)
+        eidx, edist = smp.pairwise_distances_argmin_min(X, Y)
+        np.testing.assert_array_equal(np.asarray(idx), eidx)
+        np.testing.assert_allclose(np.asarray(dist), edist, atol=1e-4)
+
+    @pytest.mark.parametrize("name", ["linear", "polynomial", "rbf", "sigmoid"])
+    def test_kernels_parity(self, XY, name):
+        X, Y = XY
+        ours = dmm.PAIRWISE_KERNEL_FUNCTIONS[name]
+        theirs = {
+            "linear": smp.linear_kernel,
+            "polynomial": smp.polynomial_kernel,
+            "rbf": smp.rbf_kernel,
+            "sigmoid": smp.sigmoid_kernel,
+        }[name]
+        np.testing.assert_allclose(
+            np.asarray(ours(X, Y)), theirs(X, Y), atol=1e-4, rtol=1e-4
+        )
+
+    def test_cosine_metric(self, XY):
+        X, Y = XY
+        got = np.asarray(dmm.pairwise_distances(X, Y, metric="cosine"))
+        np.testing.assert_allclose(got, smp.cosine_distances(X, Y), atol=1e-4)
+
+    def test_bad_metric_raises(self, XY):
+        with pytest.raises(ValueError, match="Unsupported metric"):
+            dmm.pairwise_distances(*XY, metric="mahalanobis")
+
+
+class TestClassification:
+    def test_accuracy_parity(self, rng):
+        y = rng.randint(0, 2, size=51)
+        p = rng.randint(0, 2, size=51)
+        assert dmm.accuracy_score(y, p) == pytest.approx(sm.accuracy_score(y, p))
+
+    def test_accuracy_unnormalized(self, rng):
+        y = rng.randint(0, 2, size=51)
+        p = rng.randint(0, 2, size=51)
+        assert dmm.accuracy_score(y, p, normalize=False) == pytest.approx(
+            sm.accuracy_score(y, p, normalize=False)
+        )
+
+    def test_accuracy_sharded_mask_excludes_padding(self, rng):
+        y = rng.randint(0, 2, size=51)
+        p = y.copy()
+        s_y, s_p = shard_rows(y), shard_rows(p)
+        assert dmm.accuracy_score(s_y, s_p) == pytest.approx(1.0)
+
+    def test_accuracy_sample_weight(self, rng):
+        y = rng.randint(0, 2, size=40)
+        p = rng.randint(0, 2, size=40)
+        w = rng.uniform(size=40)
+        assert dmm.accuracy_score(y, p, sample_weight=w) == pytest.approx(
+            sm.accuracy_score(y, p, sample_weight=w), abs=1e-6
+        )
+
+    def test_log_loss_binary_proba_matrix(self, rng):
+        y = rng.randint(0, 2, size=60)
+        proba = rng.uniform(0.01, 0.99, size=(60, 2)).astype(np.float64)
+        proba /= proba.sum(1, keepdims=True)
+        assert dmm.log_loss(y, proba) == pytest.approx(sm.log_loss(y, proba), rel=1e-5)
+
+    def test_log_loss_multiclass(self, rng):
+        y = rng.randint(0, 3, size=60)
+        proba = rng.uniform(0.01, 0.99, size=(60, 3)).astype(np.float64)
+        proba /= proba.sum(1, keepdims=True)
+        assert dmm.log_loss(y, proba) == pytest.approx(sm.log_loss(y, proba), rel=1e-5)
+
+    def test_length_mismatch_raises(self):
+        with pytest.raises(ValueError, match="different lengths"):
+            dmm.accuracy_score(np.ones(5), np.ones(6))
+
+
+class TestRegression:
+    @pytest.mark.parametrize(
+        "ours,theirs",
+        [
+            (dmm.mean_squared_error, sm.mean_squared_error),
+            (dmm.mean_absolute_error, sm.mean_absolute_error),
+            (dmm.r2_score, sm.r2_score),
+        ],
+    )
+    def test_parity(self, rng, ours, theirs):
+        y = rng.normal(size=45).astype(np.float64)
+        p = y + 0.3 * rng.normal(size=45)
+        assert ours(y, p) == pytest.approx(theirs(y, p), rel=1e-4)
+
+    def test_msle_parity(self, rng):
+        y = rng.uniform(0.1, 5.0, size=45)
+        p = rng.uniform(0.1, 5.0, size=45)
+        assert dmm.mean_squared_log_error(y, p) == pytest.approx(
+            sm.mean_squared_log_error(y, p), rel=1e-4
+        )
+
+    def test_rmse(self, rng):
+        y = rng.normal(size=45)
+        p = y + 0.3 * rng.normal(size=45)
+        assert dmm.mean_squared_error(y, p, squared=False) == pytest.approx(
+            np.sqrt(sm.mean_squared_error(y, p)), rel=1e-4
+        )
+
+    def test_sample_weight(self, rng):
+        y = rng.normal(size=45)
+        p = y + 0.3 * rng.normal(size=45)
+        w = rng.uniform(size=45)
+        assert dmm.mean_squared_error(y, p, sample_weight=w) == pytest.approx(
+            sm.mean_squared_error(y, p, sample_weight=w), rel=1e-4
+        )
+
+
+class TestScorer:
+    def test_get_scorer_known(self):
+        assert callable(dmm.get_scorer("accuracy"))
+
+    def test_get_scorer_unknown(self):
+        with pytest.raises(ValueError, match="not a valid scoring"):
+            dmm.get_scorer("nope")
+
+    def test_scorer_applies_sign(self, rng):
+        class Dummy:
+            def predict(self, X):
+                return np.zeros(len(X))
+
+        y = np.ones(10)
+        score = dmm.SCORERS["neg_mean_squared_error"](Dummy(), np.zeros((10, 2)), y)
+        assert score == pytest.approx(-1.0)
+
+
+class TestReviewRegressions:
+    """Cases from code review: mixed sharded/plain inputs, constant y, labels=."""
+
+    def test_mixed_sharded_plain_accuracy(self, rng):
+        y = rng.randint(0, 2, size=33)
+        s = shard_rows(y)
+        assert dmm.accuracy_score(y, s) == pytest.approx(1.0)
+        assert dmm.accuracy_score(s, y) == pytest.approx(1.0)
+
+    def test_sharded_weights_plain_y(self, rng):
+        y = rng.randint(0, 2, size=33)
+        p = rng.randint(0, 2, size=33)
+        w = rng.uniform(size=33)
+        import sklearn.metrics as sm
+        assert dmm.accuracy_score(y, p, sample_weight=shard_rows(w)) == pytest.approx(
+            sm.accuracy_score(y, p, sample_weight=w), abs=1e-6
+        )
+
+    def test_r2_constant_y(self):
+        assert dmm.r2_score(np.ones(10), np.zeros(10)) == 0.0
+        assert dmm.r2_score(np.ones(10), np.ones(10)) == 1.0
+
+    def test_log_loss_unseen_label_raises(self, rng):
+        proba = np.full((3, 2), 0.5)
+        with pytest.raises(ValueError, match="not in `labels`"):
+            dmm.log_loss(np.array([0, 1, 5]), proba, labels=[0, 1])
+
+    def test_pairwise_sharded_output_unpadded(self, rng):
+        X = rng.normal(size=(33, 4)).astype(np.float32)
+        s = shard_rows(X)
+        D = dmm.euclidean_distances(s)
+        assert D.shape == (33, 33)
+        K = dmm.rbf_kernel(s)
+        assert K.shape == (33, 33)
+        idx, dist = dmm.pairwise_distances_argmin_min(s, X[:5])
+        assert idx.shape == (33,)

@@ -1,0 +1,167 @@
+import numpy as np
+import pytest
+import sklearn.preprocessing as sp
+
+import dask_ml_tpu.preprocessing as dp
+from dask_ml_tpu.core import shard_rows, unshard
+from dask_ml_tpu.core.sharded import ShardedRows
+
+
+@pytest.fixture
+def X(rng):
+    return rng.normal(loc=2.0, scale=3.0, size=(41, 5)).astype(np.float64)
+
+
+class TestStandardScaler:
+    def test_parity(self, X):
+        ours = dp.StandardScaler().fit(X)
+        theirs = sp.StandardScaler().fit(X)
+        np.testing.assert_allclose(np.asarray(ours.mean_), theirs.mean_, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(ours.scale_), theirs.scale_, rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(ours.transform(X)), theirs.transform(X), atol=1e-4
+        )
+
+    def test_sharded_in_sharded_out(self, X):
+        s = shard_rows(X)
+        ours = dp.StandardScaler().fit(s)
+        out = ours.transform(s)
+        assert isinstance(out, ShardedRows)
+        theirs = sp.StandardScaler().fit(X)
+        np.testing.assert_allclose(unshard(out), theirs.transform(X), atol=1e-4)
+
+    def test_inverse_roundtrip(self, X):
+        scaler = dp.StandardScaler().fit(X)
+        np.testing.assert_allclose(
+            np.asarray(scaler.inverse_transform(scaler.transform(X))), X, atol=1e-4
+        )
+
+    def test_constant_feature_no_nan(self):
+        X = np.ones((20, 2), dtype=np.float32)
+        out = np.asarray(dp.StandardScaler().fit(X).transform(X))
+        assert np.isfinite(out).all()
+
+    def test_with_mean_false(self, X):
+        ours = dp.StandardScaler(with_mean=False).fit(X)
+        theirs = sp.StandardScaler(with_mean=False).fit(X)
+        np.testing.assert_allclose(
+            np.asarray(ours.transform(X)), theirs.transform(X), atol=1e-4
+        )
+
+
+class TestMinMaxScaler:
+    def test_parity(self, X):
+        ours = dp.MinMaxScaler().fit(X)
+        theirs = sp.MinMaxScaler().fit(X)
+        np.testing.assert_allclose(np.asarray(ours.data_min_), theirs.data_min_, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(ours.data_max_), theirs.data_max_, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(ours.transform(X)), theirs.transform(X), atol=1e-5
+        )
+
+    def test_feature_range(self, X):
+        ours = dp.MinMaxScaler(feature_range=(-1, 1)).fit(X)
+        out = np.asarray(ours.transform(X))
+        assert out.min() >= -1 - 1e-5 and out.max() <= 1 + 1e-5
+
+    def test_padding_does_not_leak_into_minmax(self, X):
+        # padded rows are zeros; min must come from real rows only
+        Xpos = np.abs(X) + 5.0  # all real values > 5, padding zeros would corrupt min
+        s = shard_rows(Xpos)
+        ours = dp.MinMaxScaler().fit(s)
+        np.testing.assert_allclose(np.asarray(ours.data_min_), Xpos.min(0), rtol=1e-5)
+
+    def test_inverse_roundtrip(self, X):
+        scaler = dp.MinMaxScaler().fit(X)
+        np.testing.assert_allclose(
+            np.asarray(scaler.inverse_transform(scaler.transform(X))), X, atol=1e-4
+        )
+
+
+class TestRobustScaler:
+    def test_parity(self, X):
+        ours = dp.RobustScaler().fit(X)
+        theirs = sp.RobustScaler().fit(X)
+        np.testing.assert_allclose(np.asarray(ours.center_), theirs.center_, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(ours.scale_), theirs.scale_, rtol=1e-3)
+        np.testing.assert_allclose(
+            np.asarray(ours.transform(X)), theirs.transform(X), atol=1e-3
+        )
+
+    def test_bad_quantile_range(self, X):
+        with pytest.raises(ValueError, match="Invalid quantile_range"):
+            dp.RobustScaler(quantile_range=(80, 20)).fit(X)
+
+
+class TestQuantileTransformer:
+    def test_uniform_output(self, X):
+        ours = dp.QuantileTransformer(n_quantiles=41).fit(X)
+        out = np.asarray(ours.transform(X))
+        assert out.min() >= 0 and out.max() <= 1
+        theirs = sp.QuantileTransformer(n_quantiles=41).fit(X)
+        np.testing.assert_allclose(out, theirs.transform(X), atol=5e-2)
+
+    def test_normal_output(self, X):
+        ours = dp.QuantileTransformer(n_quantiles=41, output_distribution="normal").fit(X)
+        out = np.asarray(ours.transform(X))
+        assert np.isfinite(out).all()
+
+    def test_inverse_roundtrip(self, X):
+        qt = dp.QuantileTransformer(n_quantiles=41).fit(X)
+        np.testing.assert_allclose(
+            np.asarray(qt.inverse_transform(qt.transform(X))), X, atol=1e-2
+        )
+
+    def test_bad_distribution(self, X):
+        with pytest.raises(ValueError, match="output_distribution"):
+            dp.QuantileTransformer(output_distribution="cauchy").fit(X)
+
+
+class TestLabelEncoder:
+    def test_parity(self):
+        y = np.array([3, 1, 3, 7, 1])
+        ours = dp.LabelEncoder().fit(y)
+        theirs = sp.LabelEncoder().fit(y)
+        np.testing.assert_array_equal(ours.classes_, theirs.classes_)
+        np.testing.assert_array_equal(np.asarray(ours.transform(y)), theirs.transform(y))
+
+    def test_string_labels(self):
+        y = np.array(["b", "a", "b", "c"])
+        enc = dp.LabelEncoder().fit(y)
+        np.testing.assert_array_equal(np.asarray(enc.transform(y)), [1, 0, 1, 2])
+        np.testing.assert_array_equal(enc.inverse_transform([1, 0, 2]), ["b", "a", "c"])
+
+    def test_unseen_label_raises(self):
+        enc = dp.LabelEncoder().fit(np.array([0, 1]))
+        with pytest.raises(ValueError, match="unseen"):
+            enc.transform(np.array([2]))
+
+    def test_2d_raises(self):
+        with pytest.raises(ValueError, match="1d"):
+            dp.LabelEncoder().fit(np.ones((3, 2)))
+
+
+class TestBlockTransformer:
+    def test_applies_function(self, X):
+        bt = dp.BlockTransformer(lambda a: a * 2.0)
+        np.testing.assert_allclose(np.asarray(bt.fit(X).transform(X)), X * 2.0, rtol=1e-6)
+
+    def test_sharded(self, X):
+        s = shard_rows(X)
+        out = dp.BlockTransformer(lambda a: a + 1.0).fit_transform(s)
+        assert isinstance(out, ShardedRows)
+        np.testing.assert_allclose(unshard(out), X + 1.0, rtol=1e-6)
+
+
+class TestReviewRegressions:
+    def test_minmax_integer_input(self):
+        X = np.arange(10).reshape(5, 2)
+        import sklearn.preprocessing as sp
+        ours = dp.MinMaxScaler().fit(X)
+        theirs = sp.MinMaxScaler().fit(X)
+        np.testing.assert_allclose(np.asarray(ours.transform(X)), theirs.transform(X), atol=1e-6)
+
+    def test_block_transformer_validate(self):
+        bt = dp.BlockTransformer(lambda a: a, validate=True)
+        with pytest.raises(ValueError):
+            bt.transform(np.arange(5.0))  # 1-D rejected when validate=True
