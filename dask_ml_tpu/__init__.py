@@ -23,7 +23,16 @@ time; see SURVEY.md header for provenance).
 __version__ = "0.1.0"
 
 from . import core  # noqa: F401
+from . import linalg  # noqa: F401
 from . import metrics  # noqa: F401
 from . import preprocessing  # noqa: F401
+from . import decomposition  # noqa: F401
 
-__all__ = ["core", "metrics", "preprocessing", "__version__"]
+__all__ = [
+    "core",
+    "linalg",
+    "metrics",
+    "preprocessing",
+    "decomposition",
+    "__version__",
+]

@@ -10,3 +10,16 @@ except AttributeError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 JAX_VERSION = jax.__version__
+
+
+def shard_map_unchecked(fn, mesh, in_specs, out_specs):
+    """shard_map with the replication check disabled, handling the kwarg
+    rename (check_rep → check_vma) across jax versions.  Needed when an
+    out_spec is P() for a value that is replicated by construction (e.g. the
+    R factor of a TSQR) but not provably so to the checker."""
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_vma=False)
+    except TypeError:  # older jax spells it check_rep
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_rep=False)

@@ -1,0 +1,7 @@
+"""Decomposition — twin of ``dask_ml/decomposition/`` (SURVEY.md §2 #8–#10)."""
+
+from .pca import PCA  # noqa: F401
+from .truncated_svd import TruncatedSVD  # noqa: F401
+from .incremental_pca import IncrementalPCA  # noqa: F401
+
+__all__ = ["PCA", "TruncatedSVD", "IncrementalPCA"]

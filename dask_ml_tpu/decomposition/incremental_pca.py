@@ -1,0 +1,141 @@
+"""IncrementalPCA — streaming PCA over row batches.
+
+Reference: ``dask_ml/decomposition/incremental_pca.py`` — sklearn's
+incremental rank-update walked sequentially over dask blocks (SURVEY.md §2
+#10).  TPU design: the model state (components, singular values, running
+mean/var) lives on device; the host streams batches into one jitted update
+step — the reference's "model hops between workers" chain becomes
+device-resident state with data streaming in (SURVEY.md §3.5 note).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import TPUEstimator, TransformerMixin
+from ..core.sharded import ShardedRows, unshard
+from ..preprocessing.data import _like_input, _masked_or_plain
+from ..utils import check_array, svd_flip
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _update(components, singular_values, mean, var, n_seen, batch, *, k):
+    """One incremental rank-update (Ross et al. 2008, as in sklearn)."""
+    n_batch = batch.shape[0]
+    n_total = n_seen + n_batch
+    batch_mean = jnp.mean(batch, axis=0)
+    batch_var = jnp.var(batch, axis=0)
+
+    new_mean = (n_seen * mean + n_batch * batch_mean) / n_total
+    new_var = (
+        n_seen * var
+        + n_batch * batch_var
+        + (n_seen * n_batch / n_total) * (mean - batch_mean) ** 2
+    ) / n_total
+
+    centered = batch - batch_mean
+    correction = jnp.sqrt((n_seen * n_batch) / n_total) * (mean - batch_mean)
+    stacked = jnp.vstack(
+        [
+            singular_values[:, None] * components,
+            centered,
+            correction[None, :],
+        ]
+    )
+    u, s, vt = jnp.linalg.svd(stacked, full_matrices=False)
+    u, vt = svd_flip(u, vt, u_based_decision=False)
+    return vt[:k], s[:k], new_mean, new_var, n_total
+
+
+class IncrementalPCA(TransformerMixin, TPUEstimator):
+    def __init__(self, n_components=None, whiten=False, copy=True, batch_size=None):
+        self.n_components = n_components
+        self.whiten = whiten
+        self.copy = copy
+        self.batch_size = batch_size
+
+    def _init_state(self, d, k, dtype):
+        self.components_ = jnp.zeros((k, d), dtype=dtype)
+        self.singular_values_ = jnp.zeros((k,), dtype=dtype)
+        self.mean_ = jnp.zeros((d,), dtype=dtype)
+        self.var_ = jnp.zeros((d,), dtype=dtype)
+        self.n_samples_seen_ = 0
+
+    def partial_fit(self, X, y=None, check_input=True):
+        if check_input:
+            X = check_array(X)
+        x = jnp.asarray(unshard(X) if isinstance(X, ShardedRows) else X)
+        if not jnp.issubdtype(x.dtype, jnp.inexact):
+            x = x.astype(jnp.float32)
+        d = x.shape[1]
+        k = self.n_components or min(x.shape[0], d)
+        if not hasattr(self, "components_"):
+            self._init_state(d, k, x.dtype)
+            self.n_components_ = k
+        if x.shape[0] < self.n_components_:
+            raise ValueError(
+                f"batch of {x.shape[0]} rows < n_components={self.n_components_}"
+            )
+        (
+            self.components_,
+            self.singular_values_,
+            self.mean_,
+            self.var_,
+            self.n_samples_seen_,
+        ) = _update(
+            self.components_,
+            self.singular_values_,
+            self.mean_,
+            self.var_,
+            self.n_samples_seen_,
+            x,
+            k=self.n_components_,
+        )
+        self.n_samples_seen_ = int(self.n_samples_seen_)
+        n = self.n_samples_seen_
+        self.explained_variance_ = self.singular_values_ ** 2 / (n - 1)
+        total = jnp.sum(self.var_) * n / (n - 1)
+        self.explained_variance_ratio_ = self.explained_variance_ / total
+        self.n_features_in_ = d
+        if self.n_components_ < min(n, d):
+            self.noise_variance_ = (total - jnp.sum(self.explained_variance_)) / (
+                min(n, d) - self.n_components_
+            )
+        else:
+            self.noise_variance_ = jnp.asarray(0.0, dtype=x.dtype)
+        return self
+
+    def fit(self, X, y=None):
+        """Stream X through partial_fit in row batches (reference walks dask
+        blocks in sequence)."""
+        if hasattr(self, "components_"):
+            del self.components_  # refit from scratch, sklearn semantics
+        x = unshard(X) if isinstance(X, ShardedRows) else np.asarray(X)
+        n, d = x.shape
+        batch = self.batch_size or 5 * d
+        # resolved rank: explicit, else inferred from the first batch as
+        # partial_fit will (sklearn drops tails < rank via gen_batches)
+        k = self.n_components or min(batch, n, d)
+        for start in range(0, n, batch):
+            stop = min(start + batch, n)
+            if stop - start < k:
+                break
+            self.partial_fit(x[start:stop], check_input=False)
+        return self
+
+    def transform(self, X):
+        x, _ = _masked_or_plain(X)
+        out = (x - self.mean_) @ self.components_.T
+        if self.whiten:
+            out = out / jnp.sqrt(self.explained_variance_)
+        return _like_input(X, out)
+
+    def inverse_transform(self, X):
+        x, _ = _masked_or_plain(X)
+        if self.whiten:
+            x = x * jnp.sqrt(self.explained_variance_)
+        return _like_input(X, x @ self.components_ + self.mean_)

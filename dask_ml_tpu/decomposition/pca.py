@@ -1,0 +1,148 @@
+"""PCA for tall-skinny row-sharded matrices.
+
+Reference: ``dask_ml/decomposition/pca.py :: PCA`` — requires a single
+column block (tall-skinny), ``svd_solver ∈ {auto, full, tsqr, randomized}``,
+fitted attrs ``components_``, ``explained_variance_(ratio_)``,
+``singular_values_``, ``mean_``, ``noise_variance_`` (SURVEY.md §3.4).
+
+TPU design: masked mean-centering zeroes the padded rows, then TSQR (exact)
+or Halko (randomized) runs as one shard_map program; every fitted statistic
+comes out of the same compiled computation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import TPUEstimator, TransformerMixin
+from ..core.sharded import ShardedRows, masked_mean
+from ..linalg import randomized_svd, tsqr_svd
+from ..preprocessing.data import _ingest_float, _like_input, _masked_or_plain
+from ..utils import svd_flip
+
+
+class PCA(TransformerMixin, TPUEstimator):
+    def __init__(self, n_components=None, copy=True, whiten=False,
+                 svd_solver="auto", tol=0.0, iterated_power=4, random_state=None):
+        self.n_components = n_components
+        self.copy = copy
+        self.whiten = whiten
+        self.svd_solver = svd_solver
+        self.tol = tol
+        self.iterated_power = iterated_power
+        self.random_state = random_state
+
+    # -- solver selection (mirrors reference `_fit` policy) ------------
+    def _resolve(self, n_samples, n_features):
+        n_components = self.n_components
+        if n_components is None:
+            n_components = min(n_samples, n_features)
+        solver = self.svd_solver
+        if solver == "auto":
+            if isinstance(n_components, float):
+                solver = "full"
+            elif n_components < 0.8 * min(n_samples, n_features) and n_features > 50:
+                solver = "randomized"
+            else:
+                solver = "full"
+        if solver == "tsqr":
+            solver = "full"
+        return n_components, solver
+
+    def _center(self, X: ShardedRows):
+        mean = masked_mean(X.data, X.mask)
+        centered = (X.data - mean) * X.mask[:, None]
+        return centered, mean
+
+    def fit(self, X, y=None):
+        self._fit(X)
+        return self
+
+    def _fit(self, X):
+        X = _ingest_float(self, X)
+        n, d = X.n_samples, X.data.shape[1]
+        if n < d:
+            raise ValueError(
+                f"n_samples ({n}) must be >= n_features ({d}) for tall-skinny PCA"
+            )
+        n_components, solver = self._resolve(n, d)
+        if isinstance(n_components, float):
+            if not 0 < n_components <= 1.0:
+                raise ValueError(f"Invalid n_components: {n_components}")
+            k_request = d
+        else:
+            if n_components > d:
+                raise ValueError(
+                    f"n_components={n_components} must be <= n_features={d}"
+                )
+            k_request = n_components
+
+        centered, mean = self._center(X)
+        if solver == "randomized":
+            u, s, vt = randomized_svd(
+                centered, k_request, n_iter=self.iterated_power,
+                random_state=self.random_state,
+            )
+        else:
+            u, s, vt = tsqr_svd(centered)
+        # sklearn >= 1.5 flips on V (deterministic regardless of row order /
+        # padding); match it so components_ agree elementwise.
+        u, vt = svd_flip(u, vt, u_based_decision=False)
+
+        # Full spectrum statistics (s has k_request entries; total variance
+        # needs all d — with full solver s covers everything, with randomized
+        # we fall back to the masked total variance).
+        explained = (s ** 2) / (n - 1)
+        if solver == "randomized":
+            from ..core.sharded import masked_var
+
+            total_var = jnp.sum(masked_var(X.data, X.mask, ddof=1))
+        else:
+            total_var = jnp.sum(explained)
+        ratio = explained / total_var
+
+        if isinstance(n_components, float):
+            cum = jnp.cumsum(ratio)
+            k = min(int(jnp.searchsorted(cum, n_components, side="left")) + 1, len(s))
+        else:
+            k = n_components
+
+        self.n_components_ = k
+        self.components_ = vt[:k]
+        self.explained_variance_ = explained[:k]
+        self.explained_variance_ratio_ = ratio[:k]
+        self.singular_values_ = s[:k]
+        self.mean_ = mean
+        self.n_samples_ = n
+        self.n_features_in_ = d
+        if k < min(n, d):
+            self.noise_variance_ = (total_var - jnp.sum(explained[:k])) / (
+                min(n, d) - k
+            )
+        else:
+            self.noise_variance_ = jnp.asarray(0.0, dtype=s.dtype)
+        return u, s, vt
+
+    def transform(self, X):
+        x, _ = _masked_or_plain(X)
+        out = (x - self.mean_) @ self.components_.T
+        if self.whiten:
+            out = out / jnp.sqrt(self.explained_variance_)
+        return _like_input(X, out)
+
+    def fit_transform(self, X, y=None):
+        u, s, vt = self._fit(X)
+        out = u[:, : self.n_components_] * s[: self.n_components_]
+        if self.whiten:
+            import math
+
+            out = out * math.sqrt(self.n_samples_ - 1) / s[: self.n_components_]
+        if isinstance(X, ShardedRows):
+            return ShardedRows(data=out, mask=X.mask, n_samples=X.n_samples)
+        return out[: self.n_samples_]
+
+    def inverse_transform(self, X):
+        x, _ = _masked_or_plain(X)
+        if self.whiten:
+            x = x * jnp.sqrt(self.explained_variance_)
+        return _like_input(X, x @ self.components_ + self.mean_)

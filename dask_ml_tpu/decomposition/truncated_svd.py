@@ -1,0 +1,76 @@
+"""TruncatedSVD — PCA without mean-centering.
+
+Reference: ``dask_ml/decomposition/truncated_svd.py :: TruncatedSVD``
+(``algorithm='tsqr'`` exact / ``'randomized'``; fitted attrs
+``components_``, ``explained_variance_(ratio_)``, ``singular_values_``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import TPUEstimator, TransformerMixin
+from ..core.sharded import ShardedRows, masked_mean, masked_var
+from ..linalg import randomized_svd, tsqr_svd
+from ..preprocessing.data import _ingest_float, _like_input, _masked_or_plain
+from ..utils import svd_flip
+
+
+class TruncatedSVD(TransformerMixin, TPUEstimator):
+    def __init__(self, n_components=2, algorithm="tsqr", n_iter=5,
+                 random_state=None, tol=0.0, compute=True):
+        self.n_components = n_components
+        self.algorithm = algorithm
+        self.n_iter = n_iter
+        self.random_state = random_state
+        self.tol = tol
+        self.compute = compute
+
+    def fit(self, X, y=None):
+        self.fit_transform(X)
+        return self
+
+    def fit_transform(self, X, y=None):
+        X_in = X
+        X = _ingest_float(self, X)
+        d = X.data.shape[1]
+        k = self.n_components
+        if not 0 < k < d:
+            raise ValueError(
+                f"n_components must be in (0, n_features={d}); got {k}"
+            )
+        # Zero the padded rows: unlike PCA there is no centering step to do
+        # it, and sharded inputs from upstream transforms (e.g. a scaler)
+        # carry nonzero pad rows.
+        data = X.data * X.mask[:, None]
+        if self.algorithm in ("tsqr", "full"):
+            u, s, vt = tsqr_svd(data)
+            u, s, vt = u[:, :k], s[:k], vt[:k]
+        elif self.algorithm == "randomized":
+            u, s, vt = randomized_svd(
+                data, k, n_iter=self.n_iter, random_state=self.random_state
+            )
+        else:
+            raise ValueError(f"Unknown algorithm: {self.algorithm!r}")
+        u, vt = svd_flip(u, vt, u_based_decision=False)
+
+        transformed = u * s
+        n = X.n_samples
+        self.components_ = vt
+        exp_var = masked_var(transformed, X.mask)
+        full_var = jnp.sum(masked_var(X.data, X.mask))
+        self.explained_variance_ = exp_var
+        self.explained_variance_ratio_ = exp_var / full_var
+        self.singular_values_ = s
+        self.n_features_in_ = d
+        if isinstance(X_in, ShardedRows):
+            return ShardedRows(data=transformed, mask=X.mask, n_samples=n)
+        return transformed[:n]
+
+    def transform(self, X):
+        x, _ = _masked_or_plain(X)
+        return _like_input(X, x @ self.components_.T)
+
+    def inverse_transform(self, X):
+        x, _ = _masked_or_plain(X)
+        return _like_input(X, x @ self.components_)

@@ -1,0 +1,41 @@
+"""Randomized (Halko) SVD on row-sharded matrices.
+
+Reference path: ``da.linalg.svd_compressed`` (Halko et al. 2011 power
+iterations).  TPU-native: the range-finder is a pair of sharded gemms per
+power iteration with TSQR re-orthonormalization; B = QᵀX is a psum-reduced
+gemm.  All device-side, one XLA program per phase.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.prng import as_key
+from ..core.sharded import ShardedRows
+from .tsqr import tsqr
+
+
+def randomized_svd(x, n_components: int, *, n_oversamples: int = 10,
+                   n_iter: int = 4, random_state=None, mesh=None):
+    """Approximate truncated SVD: returns (U sharded, S, Vt), rank k.
+
+    ``n_iter`` power iterations sharpen the spectrum for slowly-decaying
+    singular values (same semantics as the reference's ``power_iteration_normalizer='QR'``).
+    """
+    if isinstance(x, ShardedRows):
+        x = x.data
+    n, d = x.shape
+    k = min(n_components + n_oversamples, d)
+    key = as_key(random_state)
+    g = jax.random.normal(key, (d, k), dtype=x.dtype)
+
+    y = x @ g  # (n, k) sharded rows
+    q, _ = tsqr(y, mesh)
+    for _ in range(n_iter):
+        z = x.T @ q  # (d, k) replicated (psum over shards, inserted by XLA)
+        q, _ = tsqr(x @ z, mesh)
+    b = q.T @ x  # (k, d) replicated
+    u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = q @ u_b
+    return u[:, :n_components], s[:n_components], vt[:n_components]

@@ -1,0 +1,84 @@
+"""Tall-skinny QR (TSQR) and SVD on row-sharded matrices.
+
+Reference path: ``da.linalg.tsqr`` — blockwise QR per chunk, stack the R
+factors, recurse (SURVEY.md §3.4).  TPU-native version: one ``shard_map``
+program — local QR per shard on the MXU, ``all_gather`` of the small (d×d)
+R factors over ICI, replicated second-stage QR, local Q correction.  Zero
+host round-trips; the whole factorization is a single XLA program.
+
+Padding note: zero rows contribute nothing to R and produce zero rows of Q,
+so the pad+mask ingest discipline composes transparently (provided padded
+rows are zeroed — masked centering does this).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.compat import shard_map_unchecked as _shard_map
+from ..core.mesh import DATA_AXIS, get_mesh
+from ..core.sharded import ShardedRows
+
+
+@partial(jax.jit, static_argnames=("mesh_holder",))
+def _tsqr_impl(x, *, mesh_holder):
+    mesh = mesh_holder.mesh
+    d = x.shape[1]
+
+    def local(xs):
+        q1, r1 = jnp.linalg.qr(xs, mode="reduced")  # (m_i, d), (d, d)
+        r_all = jax.lax.all_gather(r1, DATA_AXIS)  # (P, d, d)
+        q2, r = jnp.linalg.qr(r_all.reshape(-1, d), mode="reduced")  # (P·d, d), (d, d)
+        i = jax.lax.axis_index(DATA_AXIS)
+        q2_i = jax.lax.dynamic_slice_in_dim(q2, i * d, d)
+        return q1 @ q2_i, r
+
+    return _shard_map(
+        local, mesh, in_specs=P(DATA_AXIS, None), out_specs=(P(DATA_AXIS, None), P())
+    )(x)
+
+
+class _MeshHolder:
+    """Hashable wrapper so the mesh can be a static jit argument."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __hash__(self):
+        return hash(self.mesh)
+
+    def __eq__(self, other):
+        return isinstance(other, _MeshHolder) and self.mesh == other.mesh
+
+
+def tsqr(x, mesh=None):
+    """Reduced QR of a row-sharded tall-skinny matrix: X = Q R.
+
+    Q comes back row-sharded like X; R is (d, d) replicated.
+    """
+    if isinstance(x, ShardedRows):
+        x = x.data
+    mesh = mesh or get_mesh()
+    if x.shape[1] > x.shape[0] // max(1, mesh.shape[DATA_AXIS]):
+        # Each shard must be at least square for reduced local QR to keep
+        # full column information.
+        raise ValueError(
+            f"tsqr requires tall-skinny shards: shape {x.shape} over "
+            f"{mesh.shape[DATA_AXIS]} shards leaves per-shard rows < {x.shape[1]} cols"
+        )
+    return _tsqr_impl(x, mesh_holder=_MeshHolder(mesh))
+
+
+def tsqr_svd(x, mesh=None):
+    """SVD of a row-sharded tall-skinny matrix via TSQR.
+
+    X = Q R; R = U_r S Vt (small, replicated)  ⇒  U = Q U_r (sharded).
+    Twin of ``da.linalg.svd`` (SURVEY.md §3.4).
+    """
+    q, r = tsqr(x, mesh)
+    u_r, s, vt = jnp.linalg.svd(r, full_matrices=False)
+    return q @ u_r, s, vt

@@ -1,0 +1,231 @@
+import numpy as np
+import pytest
+import sklearn.decomposition as sd
+
+import dask_ml_tpu.decomposition as dd
+from dask_ml_tpu.core import shard_rows, unshard
+from dask_ml_tpu.core.sharded import ShardedRows
+from dask_ml_tpu.linalg import randomized_svd, tsqr, tsqr_svd
+
+
+@pytest.fixture
+def X(rng):
+    # tall-skinny with decaying spectrum
+    base = rng.normal(size=(200, 10)).astype(np.float64)
+    scale = np.linspace(3.0, 0.1, 10)
+    return (base * scale).astype(np.float64)
+
+
+class TestTSQR:
+    def test_qr_reconstruction(self, X):
+        s = shard_rows(X)
+        q, r = tsqr(s)
+        np.testing.assert_allclose(np.asarray(q @ r), unshard(s.data), atol=1e-3)
+
+    def test_q_orthonormal(self, X):
+        q, r = tsqr(shard_rows(X))
+        qtq = np.asarray(q.T @ q)
+        np.testing.assert_allclose(qtq, np.eye(X.shape[1]), atol=1e-3)
+
+    def test_r_upper_triangular(self, X):
+        _, r = tsqr(shard_rows(X))
+        r = np.asarray(r)
+        np.testing.assert_allclose(r, np.triu(r), atol=1e-5)
+
+    def test_svd_singular_values_parity(self, X):
+        _, s, _ = tsqr_svd(shard_rows(X))
+        expected = np.linalg.svd(X, compute_uv=False)
+        np.testing.assert_allclose(np.asarray(s), expected, rtol=1e-3)
+
+    def test_too_wide_raises(self):
+        with pytest.raises(ValueError, match="tall-skinny"):
+            tsqr(shard_rows(np.ones((16, 10), dtype=np.float32)))
+
+    def test_padding_zero_rows_safe(self, rng):
+        # 37 rows over 8 shards -> 3 zero pad rows; R must match unpadded
+        X = rng.normal(size=(370, 4)).astype(np.float64)
+        s = shard_rows(X)
+        _, r = tsqr(s)
+        sv_padded = np.linalg.svd(np.asarray(r), compute_uv=False)
+        sv_true = np.linalg.svd(X, compute_uv=False)
+        np.testing.assert_allclose(sv_padded, sv_true, rtol=1e-4)
+
+
+class TestRandomizedSVD:
+    def test_topk_parity(self, X):
+        u, s, vt = randomized_svd(shard_rows(X), 3, random_state=0)
+        expected = np.linalg.svd(X, compute_uv=False)[:3]
+        np.testing.assert_allclose(np.asarray(s), expected, rtol=1e-2)
+
+    def test_low_rank_reconstruction(self, rng):
+        # exactly rank-3 matrix is recovered to numerical precision
+        A = rng.normal(size=(100, 3)) @ rng.normal(size=(3, 8))
+        A = A.astype(np.float64)
+        u, s, vt = randomized_svd(shard_rows(A), 3, random_state=0)
+        approx = np.asarray(u * s @ vt)[:100]
+        np.testing.assert_allclose(approx, A, atol=1e-3)
+
+
+class TestPCA:
+    def test_parity_full(self, X):
+        ours = dd.PCA(n_components=4, svd_solver="full").fit(shard_rows(X))
+        theirs = sd.PCA(n_components=4, svd_solver="full").fit(X)
+        np.testing.assert_allclose(np.asarray(ours.mean_), theirs.mean_, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(ours.singular_values_), theirs.singular_values_, rtol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.abs(np.asarray(ours.components_)), np.abs(theirs.components_), atol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(ours.explained_variance_ratio_),
+            theirs.explained_variance_ratio_,
+            rtol=1e-3,
+        )
+
+    def test_signs_deterministic_match_sklearn(self, X):
+        ours = dd.PCA(n_components=3).fit(X)
+        theirs = sd.PCA(n_components=3).fit(X)
+        np.testing.assert_allclose(
+            np.asarray(ours.components_), theirs.components_, atol=1e-3
+        )
+
+    def test_transform_parity(self, X):
+        ours = dd.PCA(n_components=3).fit(X)
+        theirs = sd.PCA(n_components=3).fit(X)
+        np.testing.assert_allclose(
+            np.asarray(ours.transform(X)), theirs.transform(X), atol=1e-3
+        )
+
+    def test_fit_transform_equals_transform(self, X):
+        p = dd.PCA(n_components=3)
+        ft = np.asarray(p.fit_transform(X))
+        t = np.asarray(p.transform(X))
+        np.testing.assert_allclose(ft, t, atol=1e-3)
+
+    def test_randomized_solver(self, X):
+        ours = dd.PCA(n_components=3, svd_solver="randomized", random_state=0).fit(X)
+        theirs = sd.PCA(n_components=3).fit(X)
+        np.testing.assert_allclose(
+            np.asarray(ours.singular_values_), theirs.singular_values_, rtol=1e-2
+        )
+
+    def test_fraction_n_components(self, X):
+        ours = dd.PCA(n_components=0.9, svd_solver="full").fit(X)
+        theirs = sd.PCA(n_components=0.9, svd_solver="full").fit(X)
+        assert ours.n_components_ == theirs.n_components_
+
+    def test_inverse_transform_roundtrip(self, X):
+        p = dd.PCA(n_components=10).fit(X)  # full rank
+        np.testing.assert_allclose(
+            np.asarray(p.inverse_transform(p.transform(X))), X, atol=1e-3
+        )
+
+    def test_wide_raises(self):
+        with pytest.raises(ValueError, match="tall-skinny|n_samples"):
+            dd.PCA(n_components=2).fit(np.ones((5, 50), dtype=np.float32))
+
+    def test_whiten(self, X):
+        ours = dd.PCA(n_components=3, whiten=True).fit(X)
+        out = np.asarray(ours.transform(X))
+        np.testing.assert_allclose(out.std(axis=0, ddof=1), np.ones(3), rtol=1e-2)
+
+
+class TestTruncatedSVD:
+    def test_parity_attrs(self, X):
+        ours = dd.TruncatedSVD(n_components=3).fit(shard_rows(X))
+        theirs = sd.TruncatedSVD(n_components=3, algorithm="arpack").fit(X)
+        np.testing.assert_allclose(
+            np.asarray(ours.singular_values_), theirs.singular_values_, rtol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.abs(np.asarray(ours.components_)), np.abs(theirs.components_), atol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(ours.explained_variance_), theirs.explained_variance_, rtol=1e-2
+        )
+
+    def test_fit_transform_sharded(self, X):
+        s = shard_rows(X)
+        out = dd.TruncatedSVD(n_components=3).fit_transform(s)
+        assert isinstance(out, ShardedRows)
+        assert unshard(out).shape == (200, 3)
+
+    def test_transform_then_inverse(self, X):
+        t = dd.TruncatedSVD(n_components=9).fit(X)
+        recon = np.asarray(t.inverse_transform(t.transform(X)))
+        assert np.linalg.norm(recon - X) / np.linalg.norm(X) < 0.1
+
+    def test_bad_n_components(self, X):
+        with pytest.raises(ValueError, match="n_components"):
+            dd.TruncatedSVD(n_components=10).fit(X)  # == n_features
+
+    def test_randomized(self, X):
+        ours = dd.TruncatedSVD(n_components=3, algorithm="randomized", random_state=0).fit(X)
+        theirs = sd.TruncatedSVD(n_components=3, algorithm="arpack").fit(X)
+        np.testing.assert_allclose(
+            np.asarray(ours.singular_values_), theirs.singular_values_, rtol=1e-2
+        )
+
+
+class TestIncrementalPCA:
+    def test_parity_with_sklearn(self, X):
+        ours = dd.IncrementalPCA(n_components=3, batch_size=50).fit(X)
+        theirs = sd.IncrementalPCA(n_components=3, batch_size=50).fit(X)
+        np.testing.assert_allclose(
+            np.asarray(ours.singular_values_), theirs.singular_values_, rtol=1e-2
+        )
+        np.testing.assert_allclose(np.asarray(ours.mean_), theirs.mean_, atol=1e-4)
+        np.testing.assert_allclose(
+            np.abs(np.asarray(ours.components_)), np.abs(theirs.components_), atol=5e-2
+        )
+
+    def test_partial_fit_accumulates(self, X):
+        ipca = dd.IncrementalPCA(n_components=3)
+        ipca.partial_fit(X[:100])
+        ipca.partial_fit(X[100:])
+        assert ipca.n_samples_seen_ == 200
+
+    def test_small_batch_raises(self, X):
+        ipca = dd.IncrementalPCA(n_components=5)
+        with pytest.raises(ValueError, match="n_components"):
+            ipca.partial_fit(X[:3])
+
+    def test_transform_shape(self, X):
+        ipca = dd.IncrementalPCA(n_components=3, batch_size=50).fit(X)
+        assert np.asarray(ipca.transform(X)).shape == (200, 3)
+
+
+class TestReviewRegressions:
+    def test_tsvd_nonzero_padded_rows(self, rng):
+        # sharded input whose pad rows are nonzero (e.g. from a scaler)
+        import dask_ml_tpu.preprocessing as dp
+        X = rng.normal(loc=5.0, size=(83, 6)).astype(np.float64)  # pads to 88
+        s = shard_rows(X)
+        scaled = dp.StandardScaler().fit(s).transform(s)  # pad rows = -mean/scale != 0
+        ours = dd.TruncatedSVD(n_components=3).fit(scaled)
+        X_scaled = (X - X.mean(0)) / X.std(0)
+        expected = np.linalg.svd(X_scaled, compute_uv=False)[:3]
+        np.testing.assert_allclose(
+            np.asarray(ours.singular_values_), expected, rtol=1e-2
+        )
+
+    def test_tsvd_fit_transform_plain_in_plain_out(self):
+        out = dd.TruncatedSVD(n_components=2).fit_transform(np.random.RandomState(0).normal(size=(37, 5)))
+        assert not isinstance(out, ShardedRows)
+        assert np.asarray(out).shape == (37, 2)
+
+    def test_ipca_default_components_small_tail(self, rng):
+        X = rng.normal(size=(105, 10)).astype(np.float32)
+        ipca = dd.IncrementalPCA(batch_size=50).fit(X)  # tail of 5 rows must be dropped
+        assert ipca.n_samples_seen_ == 100
+
+    def test_ipca_noise_variance_finite(self, rng):
+        X = rng.normal(size=(5, 10)).astype(np.float32)
+        ipca = dd.IncrementalPCA().partial_fit(X)
+        assert np.isfinite(float(ipca.noise_variance_))
+
+    def test_pca_fraction_one(self, rng):
+        X = rng.normal(size=(50, 6)).astype(np.float64)
+        p = dd.PCA(n_components=1.0, svd_solver="full").fit(X)
+        assert p.n_components_ == p.components_.shape[0] <= 6
