@@ -27,6 +27,8 @@ from . import linalg  # noqa: F401
 from . import metrics  # noqa: F401
 from . import preprocessing  # noqa: F401
 from . import decomposition  # noqa: F401
+from . import cluster  # noqa: F401
+from . import datasets  # noqa: F401
 
 __all__ = [
     "core",
@@ -34,5 +36,7 @@ __all__ = [
     "metrics",
     "preprocessing",
     "decomposition",
+    "cluster",
+    "datasets",
     "__version__",
 ]

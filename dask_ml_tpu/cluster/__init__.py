@@ -1,0 +1,6 @@
+"""Clustering — twin of ``dask_ml/cluster/`` (SURVEY.md §2 #6, #7)."""
+
+from .k_means import KMeans  # noqa: F401
+from .spectral import SpectralClustering  # noqa: F401
+
+__all__ = ["KMeans", "SpectralClustering"]

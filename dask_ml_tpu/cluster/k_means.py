@@ -1,0 +1,257 @@
+"""Scalable KMeans: k-means‖ initialization + Lloyd iterations.
+
+Reference: ``dask_ml/cluster/k_means.py :: KMeans`` — k-means‖ init
+(Bahmani et al. 2012, ``init_scalable``) and blockwise Lloyd rounds with
+tree-reduced center updates (``_kmeans_single_lloyd``); SURVEY.md §3.2.
+
+TPU design: one jitted SPMD step per Lloyd round — the pairwise-distance
+gemm rides the MXU, per-cluster sums are a one-hot matmul (another gemm),
+and the k×d/k reductions are psums over ICI inserted by XLA.  The k-means‖
+rounds reuse the same distance kernel with a per-shard PRNG for candidate
+sampling; only the (tiny) candidate set ever reaches the host, where the
+final weighted k-means++ runs exactly as the reference does it.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import TPUEstimator, TransformerMixin
+from ..core.prng import as_key
+from ..core.sharded import ShardedRows, unshard
+from ..preprocessing.data import _ingest_float
+from ..utils import _timer
+
+logger = logging.getLogger(__name__)
+
+
+@jax.jit
+def _sq_dists(x, centers):
+    x_norm = jnp.sum(x * x, axis=1, keepdims=True)
+    c_norm = jnp.sum(centers * centers, axis=1)[None, :]
+    return jnp.maximum(x_norm + c_norm - 2.0 * (x @ centers.T), 0.0)
+
+
+@jax.jit
+def _lloyd_step(x, mask, centers):
+    """One Lloyd round: assign, reduce per-cluster sums/counts, update.
+
+    Returns (new_centers, inertia, shift).  Everything is gemm-shaped; with
+    sharded x the per-cluster reductions become ICI psums.
+    """
+    d2 = _sq_dists(x, centers)
+    labels = jnp.argmin(d2, axis=1)
+    min_d2 = jnp.take_along_axis(d2, labels[:, None], axis=1)[:, 0]
+    inertia = jnp.sum(min_d2 * mask)
+    onehot = jax.nn.one_hot(labels, centers.shape[0], dtype=x.dtype) * mask[:, None]
+    sums = onehot.T @ x  # (k, d) gemm
+    counts = jnp.sum(onehot, axis=0)  # (k,)
+    new_centers = jnp.where(
+        counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centers
+    )
+    shift = jnp.sum((new_centers - centers) ** 2)
+    return new_centers, inertia, shift
+
+
+@jax.jit
+def _assign(x, mask, centers):
+    d2 = _sq_dists(x, centers)
+    labels = jnp.argmin(d2, axis=1)
+    min_d2 = jnp.take_along_axis(d2, labels[:, None], axis=1)[:, 0]
+    return labels, jnp.sum(min_d2 * mask)
+
+
+@jax.jit
+def _phi_and_mind2(x, mask, centers):
+    d2 = _sq_dists(x, centers)
+    min_d2 = jnp.min(d2, axis=1) * mask
+    return jnp.sum(min_d2), min_d2
+
+
+def init_scalable(X: ShardedRows, n_clusters: int, key, oversampling_factor=2,
+                  init_max_iter=None):
+    """k-means‖ (Bahmani et al. 2012) — reference ``k_means.py :: init_scalable``.
+
+    Device side: distance/φ reductions + per-row Bernoulli sampling.  Host
+    side: only the O(k·log n) candidate set and the final weighted
+    k-means++ (exactly the reference's division of labor, minus the
+    scheduler round-trips).
+    """
+    x, mask = X.data, X.mask
+    n = X.n_samples
+    ell = oversampling_factor * n_clusters
+
+    # 1. one uniformly-random real point
+    key, sub = jax.random.split(key)
+    idx = jax.random.choice(sub, x.shape[0], p=mask / jnp.sum(mask))
+    centers = x[idx][None, :]
+
+    phi, _ = _phi_and_mind2(x, mask, centers)
+    n_rounds = int(np.ceil(np.log(max(float(phi), 2.0))))
+    if init_max_iter is not None:
+        n_rounds = min(n_rounds, int(init_max_iter))
+    n_rounds = max(n_rounds, 1)
+
+    for r in range(n_rounds):
+        phi, min_d2 = _phi_and_mind2(x, mask, centers)
+        if float(phi) == 0.0:
+            break
+        key, sub = jax.random.split(key)
+        u = jax.random.uniform(sub, (x.shape[0],))
+        p = jnp.minimum(ell * min_d2 / phi, 1.0)
+        # only the O(ell) chosen rows leave the device: transfer the boolean
+        # vector, gather the rows device-side, then pull the small block
+        chosen_idx = np.flatnonzero(np.asarray((u < p) & (mask > 0)))
+        if chosen_idx.size:
+            new = jnp.take(x, jnp.asarray(chosen_idx), axis=0)
+            centers = jnp.concatenate([centers, new], axis=0)
+        logger.debug("k-means|| round %d: %d candidates", r, centers.shape[0])
+
+    # weight candidates by how many points they are closest to
+    d2 = _sq_dists(x, centers)
+    closest = jnp.argmin(d2, axis=1)
+    weights = np.asarray(
+        jnp.sum(jax.nn.one_hot(closest, centers.shape[0], dtype=x.dtype) * mask[:, None], axis=0)
+    )
+    cand = np.asarray(centers, dtype=np.float64)
+
+    if cand.shape[0] <= n_clusters:
+        # degenerate: fewer candidates than clusters — pad with random real
+        # rows gathered device-side
+        key, sub = jax.random.split(key)
+        n_extra = n_clusters - cand.shape[0] + 1
+        extra_idx = jax.random.choice(sub, n, (n_extra,), replace=n_extra > n)
+        extra = np.asarray(jnp.take(x, extra_idx, axis=0), dtype=np.float64)
+        cand = np.vstack([cand, extra])
+        weights = np.concatenate([weights, np.ones(n_extra)])
+
+    # final: weighted k-means++ + a few Lloyd steps on the candidate set
+    # (host-local, candidate set is ~k·oversampling·rounds points)
+    from sklearn.cluster import KMeans as SKKMeans
+
+    local = SKKMeans(n_clusters=n_clusters, init="k-means++", n_init=1,
+                     max_iter=10, random_state=0)
+    local.fit(cand, sample_weight=np.maximum(weights[: cand.shape[0]], 1e-12))
+    return jnp.asarray(local.cluster_centers_, dtype=x.dtype)
+
+
+class KMeans(TransformerMixin, TPUEstimator):
+    """Parameters mirror the reference (``n_clusters``, ``init='k-means||'``,
+    ``oversampling_factor``, ``max_iter``, ``tol``, ``init_max_iter``,
+    ``random_state``, ``n_jobs`` accepted-inert)."""
+
+    def __init__(self, n_clusters=8, init="k-means||", oversampling_factor=2,
+                 max_iter=300, tol=1e-4, precompute_distances="auto",
+                 random_state=None, copy_x=True, n_jobs=1, algorithm="full",
+                 init_max_iter=None):
+        self.n_clusters = n_clusters
+        self.init = init
+        self.oversampling_factor = oversampling_factor
+        self.max_iter = max_iter
+        self.tol = tol
+        self.precompute_distances = precompute_distances
+        self.random_state = random_state
+        self.copy_x = copy_x
+        self.n_jobs = n_jobs
+        self.algorithm = algorithm
+        self.init_max_iter = init_max_iter
+
+    def _init_centers(self, X: ShardedRows, key):
+        init = self.init
+        if isinstance(init, (np.ndarray, jnp.ndarray)):
+            centers = jnp.asarray(init, dtype=X.data.dtype)
+            if centers.shape != (self.n_clusters, X.data.shape[1]):
+                raise ValueError(
+                    f"init array must be ({self.n_clusters}, {X.data.shape[1]}), "
+                    f"got {centers.shape}"
+                )
+            return centers
+        if init == "k-means||":
+            with _timer("k-means|| initialization", logger, logging.DEBUG):
+                return init_scalable(
+                    X, self.n_clusters, key, self.oversampling_factor,
+                    self.init_max_iter,
+                )
+        if init == "random":
+            p = X.mask / jnp.sum(X.mask)
+            idx = jax.random.choice(
+                key, X.data.shape[0], (self.n_clusters,), replace=False, p=p
+            )
+            return X.data[idx]
+        if init == "k-means++":
+            # host-side k-means++ on a small device-gathered sample, like the
+            # reference's fallback path
+            from sklearn.cluster import kmeans_plusplus
+
+            from ..utils import draw_seed
+
+            n_sample = min(X.n_samples, max(1000, 50 * self.n_clusters))
+            key, sub = jax.random.split(key)
+            idx = jax.random.choice(
+                sub, X.n_samples, (n_sample,),
+                replace=n_sample > X.n_samples,
+            )
+            sample = np.asarray(jnp.take(X.data, idx, axis=0), dtype=np.float64)
+            seed = int(draw_seed(int(jax.random.randint(key, (), 0, 2**31 - 1))))
+            centers, _ = kmeans_plusplus(
+                sample, self.n_clusters, random_state=seed
+            )
+            return jnp.asarray(centers, dtype=X.data.dtype)
+        raise ValueError(f"Unknown init: {init!r}")
+
+    def fit(self, X, y=None):
+        if self.n_clusters <= 0:
+            raise ValueError("n_clusters must be positive")
+        X = _ingest_float(self, X)
+        if X.n_samples < self.n_clusters:
+            raise ValueError(
+                f"n_samples={X.n_samples} < n_clusters={self.n_clusters}"
+            )
+        key = as_key(self.random_state)
+        centers = self._init_centers(X, key)
+
+        x, mask = X.data, X.mask
+        n_iter = 0
+        # sklearn-style tol scaling: mean of per-feature variances, masked so
+        # pad rows don't inflate the threshold
+        from ..core.sharded import masked_var
+
+        tol = self.tol * float(jnp.mean(masked_var(x, mask)))
+        with _timer("Lloyd loop", logger, logging.DEBUG):
+            for i in range(self.max_iter):
+                centers, inertia, shift = _lloyd_step(x, mask, centers)
+                n_iter = i + 1
+                if float(shift) <= tol:
+                    break
+        labels, inertia = _assign(x, mask, centers)
+
+        self.cluster_centers_ = centers
+        self.labels_ = labels[: X.n_samples]
+        self.inertia_ = float(inertia)
+        self.n_iter_ = n_iter
+        self.n_features_in_ = x.shape[1]
+        return self
+
+    def predict(self, X):
+        X = _ingest_float(self, X)
+        labels, _ = _assign(X.data, X.mask, self.cluster_centers_)
+        return labels[: X.n_samples]
+
+    def fit_predict(self, X, y=None):
+        return self.fit(X).labels_
+
+    def transform(self, X):
+        """Distances to each center (reference semantic)."""
+        X = _ingest_float(self, X)
+        d = jnp.sqrt(_sq_dists(X.data, self.cluster_centers_))
+        return d[: X.n_samples]
+
+    def score(self, X, y=None):
+        X = _ingest_float(self, X)
+        _, inertia = _assign(X.data, X.mask, self.cluster_centers_)
+        return -float(inertia)

@@ -1,0 +1,104 @@
+"""Synthetic datasets — twin of ``dask_ml/datasets.py`` (SURVEY.md §2 #19:
+``make_classification``, ``make_regression``, ``make_blobs``,
+``make_counts``, ``make_classification_df``).
+
+The reference calls sklearn's generators once per dask block with per-block
+seeds; here each chunk is generated the same way on the host and the result
+is ingested as one row-sharded device array (``chunks`` keeps the reference
+signature and controls generation batch size / seeding granularity).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import sklearn.datasets as skd
+
+from .core.mesh import get_mesh
+from .core.sharded import shard_rows
+from .utils import draw_seed
+
+
+def _chunk_sizes(n_samples, chunks):
+    if chunks is None:
+        return [n_samples]
+    if isinstance(chunks, (int, np.integer)):
+        sizes = [int(chunks)] * (n_samples // int(chunks))
+        if n_samples % int(chunks):
+            sizes.append(n_samples % int(chunks))
+        return sizes
+    return list(chunks)
+
+
+def _generate(gen, n_samples, chunks, random_state, **kwargs):
+    sizes = _chunk_sizes(n_samples, chunks)
+    seeds = draw_seed(random_state, size=len(sizes))
+    Xs, ys = [], []
+    for size, seed in zip(sizes, seeds):
+        X, y = gen(n_samples=int(size), random_state=int(seed), **kwargs)
+        Xs.append(X)
+        ys.append(y)
+    X = np.concatenate(Xs).astype(np.float32)
+    y = np.concatenate(ys)
+    mesh = get_mesh()
+    return shard_rows(X, mesh), shard_rows(y, mesh)
+
+
+def make_classification(n_samples=100, n_features=20, n_informative=2,
+                        n_classes=2, chunks=None, random_state=None, **kwargs):
+    return _generate(
+        skd.make_classification, n_samples, chunks, random_state,
+        n_features=n_features, n_informative=n_informative,
+        n_classes=n_classes, **kwargs,
+    )
+
+
+def make_regression(n_samples=100, n_features=100, n_informative=10,
+                    chunks=None, random_state=None, **kwargs):
+    return _generate(
+        skd.make_regression, n_samples, chunks, random_state,
+        n_features=n_features, n_informative=n_informative, **kwargs,
+    )
+
+
+def make_blobs(n_samples=100, n_features=2, centers=None, cluster_std=1.0,
+               chunks=None, random_state=None, **kwargs):
+    if centers is None:
+        centers = 3
+    if isinstance(centers, (int, np.integer)):
+        # fix the centers across chunks (reference does the same: sample
+        # centers once, then generate per block) — seed drawn from the
+        # caller's random_state so different seeds give different geometry
+        rng = np.random.RandomState(int(draw_seed(random_state)))
+        centers = rng.uniform(-10, 10, size=(int(centers), n_features))
+    return _generate(
+        skd.make_blobs, n_samples, chunks, random_state,
+        n_features=n_features, centers=centers, cluster_std=cluster_std,
+        **kwargs,
+    )
+
+
+def make_counts(n_samples=100, n_features=20, n_informative=10, scale=1.0,
+                chunks=None, random_state=None):
+    """Poisson-count regression targets (reference ``make_counts``).
+
+    The coefficient vector is drawn once; X and the Poisson draws are
+    generated per chunk with per-chunk seeds like the other generators.
+    """
+    n_informative = min(n_informative, n_features)
+    coef_rng = np.random.RandomState(int(draw_seed(random_state)))
+    coef = np.zeros(n_features)
+    coef[:n_informative] = coef_rng.normal(0, 1, size=n_informative)
+
+    sizes = _chunk_sizes(n_samples, chunks)
+    seeds = draw_seed(random_state, size=len(sizes))
+    Xs, ys = [], []
+    for size, seed in zip(sizes, seeds):
+        rng = np.random.RandomState(int(seed))
+        Xc = rng.normal(0, 1, size=(int(size), n_features)).astype(np.float32)
+        rate = np.exp(np.clip(Xc @ coef * scale, -20, 20))
+        Xs.append(Xc)
+        ys.append(rng.poisson(rate))
+    X = np.concatenate(Xs)
+    y = np.concatenate(ys)
+    mesh = get_mesh()
+    return shard_rows(X, mesh), shard_rows(y.astype(np.float32), mesh)

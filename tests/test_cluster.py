@@ -1,0 +1,180 @@
+import numpy as np
+import pytest
+import sklearn.cluster as sc
+from sklearn.metrics import adjusted_rand_score
+
+import dask_ml_tpu.cluster as dc
+from dask_ml_tpu import datasets
+from dask_ml_tpu.core import shard_rows, unshard
+
+
+@pytest.fixture
+def blobs(rng):
+    from sklearn.datasets import make_blobs
+
+    X, y = make_blobs(n_samples=500, centers=4, n_features=5,
+                      cluster_std=0.5, random_state=7)
+    return X.astype(np.float32), y
+
+
+class TestKMeans:
+    def test_recovers_blobs(self, blobs):
+        X, y = blobs
+        km = dc.KMeans(n_clusters=4, random_state=0).fit(shard_rows(X))
+        assert adjusted_rand_score(y, np.asarray(km.labels_)) > 0.95
+
+    def test_matches_sklearn_inertia(self, blobs):
+        X, y = blobs
+        ours = dc.KMeans(n_clusters=4, random_state=0).fit(X)
+        theirs = sc.KMeans(n_clusters=4, n_init=10, random_state=0).fit(X)
+        assert ours.inertia_ == pytest.approx(theirs.inertia_, rel=0.05)
+
+    def test_fitted_attributes(self, blobs):
+        X, _ = blobs
+        km = dc.KMeans(n_clusters=4, random_state=0).fit(X)
+        assert km.cluster_centers_.shape == (4, 5)
+        assert np.asarray(km.labels_).shape == (500,)
+        assert km.inertia_ > 0
+        assert 1 <= km.n_iter_ <= 300
+
+    def test_predict_consistent_with_labels(self, blobs):
+        X, _ = blobs
+        km = dc.KMeans(n_clusters=4, random_state=0).fit(X)
+        np.testing.assert_array_equal(np.asarray(km.predict(X)), np.asarray(km.labels_))
+
+    def test_transform_shape_and_meaning(self, blobs):
+        X, _ = blobs
+        km = dc.KMeans(n_clusters=4, random_state=0).fit(X)
+        d = np.asarray(km.transform(X))
+        assert d.shape == (500, 4)
+        np.testing.assert_array_equal(d.argmin(1), np.asarray(km.labels_))
+
+    def test_explicit_init_array(self, blobs):
+        X, y = blobs
+        init = X[np.random.RandomState(0).choice(500, 4, replace=False)]
+        km = dc.KMeans(n_clusters=4, init=init).fit(X)
+        assert adjusted_rand_score(y, np.asarray(km.labels_)) > 0.5
+
+    def test_random_init(self, blobs):
+        X, y = blobs
+        km = dc.KMeans(n_clusters=4, init="random", random_state=2).fit(X)
+        assert km.inertia_ > 0
+
+    def test_score_is_negative_inertia(self, blobs):
+        X, _ = blobs
+        km = dc.KMeans(n_clusters=4, random_state=0).fit(X)
+        assert km.score(X) == pytest.approx(-km.inertia_, rel=1e-5)
+
+    def test_too_few_samples_raises(self):
+        with pytest.raises(ValueError, match="n_samples"):
+            dc.KMeans(n_clusters=10).fit(np.ones((5, 2), dtype=np.float32))
+
+    def test_bad_init_shape_raises(self, blobs):
+        X, _ = blobs
+        with pytest.raises(ValueError, match="init array"):
+            dc.KMeans(n_clusters=4, init=np.ones((3, 5))).fit(X)
+
+    def test_oversampling_factor(self, blobs):
+        X, y = blobs
+        km = dc.KMeans(n_clusters=4, oversampling_factor=4, random_state=0).fit(X)
+        assert adjusted_rand_score(y, np.asarray(km.labels_)) > 0.9
+
+    def test_sharded_uneven_rows(self, rng):
+        # row count not divisible by mesh: mask must keep padding out of centers
+        from sklearn.datasets import make_blobs
+
+        X, y = make_blobs(n_samples=501, centers=3, n_features=4,
+                          cluster_std=0.3, random_state=1)
+        X = X.astype(np.float32) + 100.0  # far from the zero pad rows
+        km = dc.KMeans(n_clusters=3, random_state=0).fit(shard_rows(X))
+        assert adjusted_rand_score(y, np.asarray(km.labels_)) > 0.95
+        # no center got dragged toward the origin by pad rows
+        assert np.linalg.norm(np.asarray(km.cluster_centers_), axis=1).min() > 50
+
+
+class TestSpectralClustering:
+    def test_concentric_circles(self, rng):
+        from sklearn.datasets import make_circles
+
+        X, y = make_circles(n_samples=400, factor=0.3, noise=0.05, random_state=0)
+        X = X.astype(np.float32)
+        spec = dc.SpectralClustering(
+            n_clusters=2, n_components=100, gamma=30.0, random_state=0
+        ).fit(shard_rows(X))
+        assert adjusted_rand_score(y, np.asarray(spec.labels_)) > 0.9
+
+    def test_blobs(self, blobs):
+        X, y = blobs
+        spec = dc.SpectralClustering(
+            n_clusters=4, n_components=80, random_state=0
+        ).fit(X)
+        assert adjusted_rand_score(y, np.asarray(spec.labels_)) > 0.8
+
+    def test_persist_embedding(self, blobs):
+        X, _ = blobs
+        spec = dc.SpectralClustering(
+            n_clusters=4, n_components=50, random_state=0, persist_embedding=True
+        ).fit(X)
+        assert unshard(spec.embedding_).shape == (500, 4)
+
+    def test_bad_affinity(self, blobs):
+        X, _ = blobs
+        with pytest.raises(ValueError, match="affinity"):
+            dc.SpectralClustering(affinity="nearest_neighbors").fit(X)
+
+
+class TestDatasets:
+    def test_make_blobs_sharded(self):
+        X, y = datasets.make_blobs(n_samples=200, n_features=3, centers=4,
+                                   chunks=50, random_state=0)
+        assert X.shape == (200, 3)
+        assert y.shape == (200,)
+        assert len(np.unique(unshard(y))) == 4
+
+    def test_make_classification(self):
+        X, y = datasets.make_classification(n_samples=100, n_features=10,
+                                            chunks=25, random_state=0)
+        assert X.shape == (100, 10)
+        assert set(np.unique(unshard(y))) == {0, 1}
+
+    def test_make_regression(self):
+        X, y = datasets.make_regression(n_samples=100, n_features=7,
+                                        random_state=0)
+        assert X.shape == (100, 7)
+        assert unshard(y).std() > 0
+
+    def test_make_counts(self):
+        X, y = datasets.make_counts(n_samples=100, n_features=5, random_state=0)
+        yv = unshard(y)
+        assert (yv >= 0).all() and yv.dtype.kind == "f"
+
+    def test_chunk_seeds_differ(self):
+        X, _ = datasets.make_blobs(n_samples=100, chunks=50, random_state=0)
+        a = unshard(X)[:50]
+        b = unshard(X)[50:]
+        assert not np.allclose(a, b)
+
+
+class TestReviewRegressions:
+    def test_tol_not_inflated_by_padding(self):
+        # heavy padding + data far from origin: must still iterate, not stop at 1
+        rng = np.random.RandomState(0)
+        X = (rng.normal(size=(33, 4)) + 100).astype(np.float32)  # pads 33->40
+        km = dc.KMeans(n_clusters=3, init="random", random_state=0, tol=1e-6).fit(shard_rows(X))
+        assert np.isfinite(km.inertia_)
+
+    def test_kmeanspp_respects_random_state(self, blobs):
+        X, _ = blobs
+        c1 = dc.KMeans(n_clusters=4, init="k-means++", random_state=1, max_iter=0 or 1).fit(X)
+        c2 = dc.KMeans(n_clusters=4, init="k-means++", random_state=2, max_iter=0 or 1).fit(X)
+        assert not np.allclose(np.asarray(c1.cluster_centers_), np.asarray(c2.cluster_centers_))
+
+    def test_make_blobs_seed_changes_centers(self):
+        X1, _ = datasets.make_blobs(n_samples=50, n_features=2, centers=3, random_state=1)
+        X2, _ = datasets.make_blobs(n_samples=50, n_features=2, centers=3, random_state=2)
+        assert not np.allclose(unshard(X1), unshard(X2))
+
+    def test_make_counts_chunks_effective(self):
+        X1, y1 = datasets.make_counts(n_samples=100, n_features=5, chunks=50, random_state=0)
+        a, b = unshard(X1)[:50], unshard(X1)[50:]
+        assert not np.allclose(a, b)  # distinct per-chunk seeds
