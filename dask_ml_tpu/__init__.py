@@ -29,6 +29,8 @@ from . import preprocessing  # noqa: F401
 from . import decomposition  # noqa: F401
 from . import cluster  # noqa: F401
 from . import datasets  # noqa: F401
+from . import solvers  # noqa: F401
+from . import linear_model  # noqa: F401
 
 __all__ = [
     "core",
@@ -38,5 +40,7 @@ __all__ = [
     "decomposition",
     "cluster",
     "datasets",
+    "solvers",
+    "linear_model",
     "__version__",
 ]

@@ -30,11 +30,8 @@ from ..utils import _timer
 logger = logging.getLogger(__name__)
 
 
-@jax.jit
-def _sq_dists(x, centers):
-    x_norm = jnp.sum(x * x, axis=1, keepdims=True)
-    c_norm = jnp.sum(centers * centers, axis=1)[None, :]
-    return jnp.maximum(x_norm + c_norm - 2.0 * (x @ centers.T), 0.0)
+# the one squared-distance kernel, shared with metrics.pairwise
+from ..metrics.pairwise import _sq_euclidean as _sq_dists  # noqa: E402
 
 
 @jax.jit

@@ -114,10 +114,9 @@ class SpectralClustering(TPUEstimator):
         V = V / jnp.where(norms > 1e-12, norms, 1.0)
 
         emb = ShardedRows(data=V, mask=X.mask, n_samples=n)
-        km = KMeans(
-            n_clusters=self.n_clusters, random_state=self.random_state,
-            **(self.kmeans_params or {}),
-        )
+        km_params = {"n_clusters": self.n_clusters, "random_state": self.random_state}
+        km_params.update(self.kmeans_params or {})
+        km = KMeans(**km_params)
         km.fit(emb)
         self.assign_labels_ = km
         self.labels_ = km.labels_

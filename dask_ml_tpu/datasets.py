@@ -29,9 +29,17 @@ def _chunk_sizes(n_samples, chunks):
     return list(chunks)
 
 
-def _generate(gen, n_samples, chunks, random_state, **kwargs):
+def _seeds(random_state, n_chunks):
+    """n_chunks chunk seeds + one extra seed for global structure (centers /
+    coefficients), all from one stream so nothing aliases."""
+    all_seeds = draw_seed(random_state, size=n_chunks + 1)
+    return all_seeds[:-1], int(all_seeds[-1])
+
+
+def _generate(gen, n_samples, chunks, random_state, seeds=None, **kwargs):
     sizes = _chunk_sizes(n_samples, chunks)
-    seeds = draw_seed(random_state, size=len(sizes))
+    if seeds is None:
+        seeds, _ = _seeds(random_state, len(sizes))
     Xs, ys = [], []
     for size, seed in zip(sizes, seeds):
         X, y = gen(n_samples=int(size), random_state=int(seed), **kwargs)
@@ -64,14 +72,15 @@ def make_blobs(n_samples=100, n_features=2, centers=None, cluster_std=1.0,
                chunks=None, random_state=None, **kwargs):
     if centers is None:
         centers = 3
+    chunk_seeds, center_seed = _seeds(random_state, len(_chunk_sizes(n_samples, chunks)))
     if isinstance(centers, (int, np.integer)):
         # fix the centers across chunks (reference does the same: sample
-        # centers once, then generate per block) — seed drawn from the
-        # caller's random_state so different seeds give different geometry
-        rng = np.random.RandomState(int(draw_seed(random_state)))
+        # centers once, then generate per block) — the centers seed comes
+        # from the same stream as chunk seeds so nothing aliases
+        rng = np.random.RandomState(center_seed)
         centers = rng.uniform(-10, 10, size=(int(centers), n_features))
     return _generate(
-        skd.make_blobs, n_samples, chunks, random_state,
+        skd.make_blobs, n_samples, chunks, random_state, seeds=chunk_seeds,
         n_features=n_features, centers=centers, cluster_std=cluster_std,
         **kwargs,
     )
@@ -85,12 +94,11 @@ def make_counts(n_samples=100, n_features=20, n_informative=10, scale=1.0,
     generated per chunk with per-chunk seeds like the other generators.
     """
     n_informative = min(n_informative, n_features)
-    coef_rng = np.random.RandomState(int(draw_seed(random_state)))
+    sizes = _chunk_sizes(n_samples, chunks)
+    seeds, coef_seed = _seeds(random_state, len(sizes))
+    coef_rng = np.random.RandomState(coef_seed)
     coef = np.zeros(n_features)
     coef[:n_informative] = coef_rng.normal(0, 1, size=n_informative)
-
-    sizes = _chunk_sizes(n_samples, chunks)
-    seeds = draw_seed(random_state, size=len(sizes))
     Xs, ys = [], []
     for size, seed in zip(sizes, seeds):
         rng = np.random.RandomState(int(seed))

@@ -1,0 +1,5 @@
+"""Linear models — twin of ``dask_ml/linear_model/`` (SURVEY.md §2 #11)."""
+
+from .glm import LinearRegression, LogisticRegression, PoissonRegression  # noqa: F401
+
+__all__ = ["LogisticRegression", "LinearRegression", "PoissonRegression"]

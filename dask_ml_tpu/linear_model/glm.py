@@ -1,0 +1,163 @@
+"""GLM estimators — twin of ``dask_ml/linear_model/glm.py``
+(``LogisticRegression``, ``LinearRegression``, ``PoissonRegression``, base
+``_GLM``): an sklearn facade that maps ``C``/``penalty``/``solver`` onto the
+solver library (``lamduh = 1/C``, reference convention), adds the intercept
+column, and exposes ``coef_``/``intercept_``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import TPUEstimator
+from ..core.sharded import ShardedRows
+from ..preprocessing.data import _ingest_float
+from ..solvers import (
+    Logistic,
+    Normal,
+    Poisson,
+    admm,
+    get_regularizer,
+    gradient_descent,
+    lbfgs,
+    newton,
+    proximal_grad,
+)
+from .utils import add_intercept
+
+_SOLVERS = {
+    "admm": admm,
+    "lbfgs": lbfgs,
+    "newton": newton,
+    "gradient_descent": gradient_descent,
+    "proximal_grad": proximal_grad,
+}
+
+
+class _GLM(TPUEstimator):
+    family: type = None
+
+    def __init__(self, penalty="l2", dual=False, tol=1e-4, C=1.0,
+                 fit_intercept=True, intercept_scaling=1.0, class_weight=None,
+                 random_state=None, solver="admm", max_iter=100,
+                 multi_class="ovr", verbose=0, warm_start=False, n_jobs=1,
+                 solver_kwargs=None):
+        self.penalty = penalty
+        self.dual = dual
+        self.tol = tol
+        self.C = C
+        self.fit_intercept = fit_intercept
+        self.intercept_scaling = intercept_scaling
+        self.class_weight = class_weight
+        self.random_state = random_state
+        self.solver = solver
+        self.max_iter = max_iter
+        self.multi_class = multi_class
+        self.verbose = verbose
+        self.warm_start = warm_start
+        self.n_jobs = n_jobs
+        self.solver_kwargs = solver_kwargs
+
+    def _solve(self, X: ShardedRows, y):
+        if self.solver not in _SOLVERS:
+            raise ValueError(
+                f"Unknown solver {self.solver!r}; valid: {sorted(_SOLVERS)}"
+            )
+        reg = get_regularizer(self.penalty)
+        lamduh = 1.0 / self.C
+        solve = _SOLVERS[self.solver]
+        kwargs = dict(
+            family=self.family,
+            regularizer=reg,
+            lamduh=lamduh,
+            max_iter=self.max_iter,
+            **(self.solver_kwargs or {}),
+        )
+        if self.solver in ("lbfgs", "newton", "gradient_descent", "proximal_grad"):
+            kwargs["tol"] = self.tol
+        else:  # admm
+            kwargs["abstol"] = self.tol
+        return solve(X, y, **kwargs)
+
+    def fit(self, X, y=None):
+        X = _ingest_float(self, X)
+        self.n_features_in_ = X.data.shape[1]
+        Xi = add_intercept(X) if self.fit_intercept else X
+        beta = self._solve(Xi, y)
+        if self.fit_intercept:
+            self.coef_ = beta[:-1]
+            self.intercept_ = float(beta[-1])
+        else:
+            self.coef_ = beta
+            self.intercept_ = 0.0
+        self._coef = beta
+        return self
+
+    def _eta(self, X):
+        X = _ingest_float(self, X)
+        eta = X.data @ self.coef_ + self.intercept_
+        return X, eta
+
+    def predict(self, X):
+        raise NotImplementedError
+
+    def score(self, X, y):
+        raise NotImplementedError
+
+
+class LogisticRegression(_GLM):
+    family = Logistic
+
+    def predict(self, X):
+        return self.predict_proba(X)[:, 1] > 0.5
+
+    def predict_proba(self, X):
+        X, eta = self._eta(X)
+        p1 = Logistic.predict(eta)[: X.n_samples]
+        return jnp.stack([1.0 - p1, p1], axis=1)
+
+    def decision_function(self, X):
+        X, eta = self._eta(X)
+        return eta[: X.n_samples]
+
+    def score(self, X, y):
+        """Mean accuracy (reference forwards to dask accuracy_score);
+        accepts plain or ShardedRows y."""
+        from ..metrics import accuracy_score
+
+        pred = jnp.asarray(self.predict(X)).astype(jnp.float32)
+        return accuracy_score(y, pred)
+
+
+class LinearRegression(_GLM):
+    family = Normal
+
+    def predict(self, X):
+        X, eta = self._eta(X)
+        return eta[: X.n_samples]
+
+    def score(self, X, y):
+        from ..metrics import r2_score
+
+        return r2_score(y, self.predict(X))
+
+
+class PoissonRegression(_GLM):
+    family = Poisson
+
+    def predict(self, X):
+        X, eta = self._eta(X)
+        return jnp.exp(eta)[: X.n_samples]
+
+    def get_deviance(self, X, y):
+        from ..core.sharded import unshard
+
+        mu = np.asarray(self.predict(X))
+        yv = unshard(y) if isinstance(y, ShardedRows) else np.asarray(y)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            term = np.where(yv > 0, yv * np.log(yv / mu), 0.0)
+        return 2 * np.sum(term - (yv - mu))
+
+    def score(self, X, y):
+        return -self.get_deviance(X, y)

@@ -1,0 +1,17 @@
+"""Reference: ``dask_ml/linear_model/utils.py :: add_intercept``."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.sharded import ShardedRows
+
+
+def add_intercept(X: ShardedRows) -> ShardedRows:
+    """Append a ones column (zeroed on padded rows so solvers stay exact)."""
+    ones = X.mask[:, None].astype(X.data.dtype)
+    return ShardedRows(
+        data=jnp.concatenate([X.data, ones], axis=1),
+        mask=X.mask,
+        n_samples=X.n_samples,
+    )

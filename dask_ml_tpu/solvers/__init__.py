@@ -1,0 +1,37 @@
+"""Solver library — twin of the external ``dask_glm`` package (SURVEY.md §2
+#20): iterative convex solvers over row-sharded arrays.
+
+Where dask_glm drives scipy optimizers from the host with one
+scatter/gather round per iteration, every solver here is a device-native
+XLA program: gradients come from ``jax.value_and_grad`` over the masked
+loss (the cross-shard reduction is a psum inserted by XLA), line searches
+are ``lax.while_loop``s, and ADMM's per-chunk local L-BFGS runs inside
+``shard_map`` with a single psum per consensus round.
+"""
+
+from .families import Logistic, Normal, Poisson  # noqa: F401
+from .regularizers import L1, L2, ElasticNet, get_regularizer  # noqa: F401
+from .algorithms import (  # noqa: F401
+    admm,
+    gradient_descent,
+    lbfgs,
+    newton,
+    proximal_grad,
+)
+from .lbfgs_core import lbfgs_minimize  # noqa: F401
+
+__all__ = [
+    "Logistic",
+    "Normal",
+    "Poisson",
+    "L1",
+    "L2",
+    "ElasticNet",
+    "get_regularizer",
+    "admm",
+    "gradient_descent",
+    "lbfgs",
+    "newton",
+    "proximal_grad",
+    "lbfgs_minimize",
+]

@@ -1,0 +1,284 @@
+"""Solver algorithms — twin of ``dask_glm/algorithms.py`` (``admm``,
+``lbfgs``, ``gradient_descent``, ``newton``, ``proximal_grad``).
+
+Every solver consumes a row-sharded design matrix and returns the
+coefficient vector.  The gradient of the masked total loss is computed by
+autodiff under ``jit``; with sharded inputs XLA turns the loss reduction
+into an ICI psum — the reference's per-iteration scatter/gather through the
+scheduler disappears (SURVEY.md §3.1 "TPU mapping").
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.compat import shard_map_unchecked
+from ..core.mesh import DATA_AXIS, get_mesh
+from ..core.sharded import ShardedRows, shard_rows
+from .families import Family, Logistic
+from .lbfgs_core import _backtrack, lbfgs_minimize
+from .regularizers import L2, Regularizer, get_regularizer
+
+logger = logging.getLogger(__name__)
+
+
+def _prep(X, y):
+    """Normalize inputs to (x, y, mask) padded device arrays."""
+    Xs = X if isinstance(X, ShardedRows) else shard_rows(np.asarray(X, dtype=np.float32))
+    x, mask = Xs.data, Xs.mask
+    if isinstance(y, ShardedRows):
+        yv = y.data
+    else:
+        yv = jnp.asarray(np.asarray(y))
+        if yv.shape[0] != x.shape[0]:
+            yv = jnp.pad(yv, (0, x.shape[0] - yv.shape[0]))
+    return x, yv.astype(x.dtype), mask
+
+
+def _objective(family, reg, lam, x, y, mask, smooth_only=False):
+    if lam == 0 or (smooth_only and not reg.smooth):
+        return lambda b: family.loss(b, x, y, mask)
+    return lambda b: family.loss(b, x, y, mask) + reg.penalty(b, lam)
+
+
+# ---------------------------------------------------------------- lbfgs --
+
+
+def lbfgs(X, y, *, family: type[Family] = Logistic, regularizer=L2,
+          lamduh: float = 0.0, max_iter: int = 100, tol: float = 1e-5):
+    """Full-gradient L-BFGS on the total (smooth) objective.
+
+    Reference: ``dask_glm/algorithms.py :: lbfgs`` (scipy driver with
+    distributed gradient); here the whole optimizer is one XLA program.
+    """
+    reg = get_regularizer(regularizer)
+    if lamduh and not reg.smooth:
+        raise ValueError(
+            f"lbfgs requires a smooth penalty; got {reg.__name__}. "
+            "Use proximal_grad or admm for l1/elastic_net."
+        )
+    x, yv, mask = _prep(X, y)
+    beta0 = jnp.zeros(x.shape[1], dtype=x.dtype)
+    obj = _objective(family, reg, lamduh, x, yv, mask)
+
+    @jax.jit
+    def run(b0):
+        return lbfgs_minimize(obj, b0, max_iter=max_iter, tol=tol)[0]
+
+    return run(beta0)
+
+
+# ---------------------------------------------------- gradient descent --
+
+
+def gradient_descent(X, y, *, family: type[Family] = Logistic,
+                     regularizer=L2, lamduh: float = 0.0,
+                     max_iter: int = 100, tol: float = 1e-7):
+    """Armijo-backtracking gradient descent (reference ``gradient_descent``)."""
+    reg = get_regularizer(regularizer)
+    if lamduh and not reg.smooth:
+        raise ValueError("gradient_descent requires a smooth penalty; use proximal_grad")
+    x, yv, mask = _prep(X, y)
+    obj = _objective(family, reg, lamduh, x, yv, mask)
+    vg = jax.value_and_grad(obj)
+
+    @jax.jit
+    def step(beta, stepsize):
+        f, g = vg(beta)
+        t, f_new, failed = _backtrack(
+            obj, beta, f, g, -stepsize * g, 1e-4, 30
+        )
+        beta_new = beta - t * stepsize * g
+        return beta_new, f, f_new, t
+
+    beta = jnp.zeros(x.shape[1], dtype=x.dtype)
+    stepsize = 1.0
+    f_prev = None
+    for i in range(max_iter):
+        beta, f, f_new, t = step(beta, stepsize)
+        t = float(t)
+        stepsize = stepsize * t * 2.0 if t > 0 else stepsize * 0.5
+        f_new = float(f_new)
+        if f_prev is not None and abs(f_prev - f_new) <= tol * max(abs(f_prev), 1.0):
+            break
+        f_prev = f_new
+    return beta
+
+
+# ------------------------------------------------------ proximal grad --
+
+
+def proximal_grad(X, y, *, family: type[Family] = Logistic, regularizer=L2,
+                  lamduh: float = 0.0, max_iter: int = 100, tol: float = 1e-7):
+    """Proximal gradient with backtracking on the smooth part (reference
+    ``proximal_grad``): z = prox_{tλ}(β − t∇f(β))."""
+    reg = get_regularizer(regularizer)
+    x, yv, mask = _prep(X, y)
+    f_smooth = lambda b: family.loss(b, x, yv, mask)  # noqa: E731
+    vg = jax.value_and_grad(f_smooth)
+
+    @jax.jit
+    def step(beta, t0):
+        f, g = vg(beta)
+
+        def cond(carry):
+            t, j = carry
+            z = reg.prox(beta - t * g, t * lamduh)
+            diff = z - beta
+            ub = f + jnp.dot(g, diff) + jnp.sum(diff ** 2) / (2 * t)
+            return (f_smooth(z) > ub) & (j < 30)
+
+        def body(carry):
+            t, j = carry
+            return 0.5 * t, j + 1
+
+        t, _ = lax.while_loop(cond, body, (t0, 0))
+        z = reg.prox(beta - t * g, t * lamduh)
+        return z, t, f
+
+    beta = jnp.zeros(x.shape[1], dtype=x.dtype)
+    t = 1.0
+    f_prev = None
+    for i in range(max_iter):
+        beta, t_used, f = step(beta, t)
+        t = float(t_used) * 2.0
+        f = float(f)
+        if f_prev is not None and abs(f_prev - f) <= tol * max(abs(f_prev), 1.0):
+            break
+        f_prev = f
+    return beta
+
+
+# ------------------------------------------------------------- newton --
+
+
+def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
+           lamduh: float = 0.0, max_iter: int = 50, tol: float = 1e-8):
+    """Damped Newton: distributed Hessian XᵀWX (one psum-reduced gemm),
+    replicated (d×d) solve (reference ``newton``)."""
+    reg = get_regularizer(regularizer)
+    if lamduh and not reg.smooth:
+        raise ValueError("newton requires a smooth penalty")
+    x, yv, mask = _prep(X, y)
+    obj = _objective(family, reg, lamduh, x, yv, mask)
+    vg = jax.value_and_grad(obj)
+    d = x.shape[1]
+
+    @jax.jit
+    def step(beta):
+        f, g = vg(beta)
+        eta = x @ beta
+        w = family.hessian_weights(eta) * mask
+        H = (x * w[:, None]).T @ x  # (d, d) psum-reduced gemm
+        if reg.smooth:
+            H = H + lamduh * jnp.eye(d, dtype=x.dtype)
+        H = H + 1e-8 * jnp.eye(d, dtype=x.dtype)
+        p = -jnp.linalg.solve(H, g)
+        t, f_new, failed = _backtrack(obj, beta, f, g, p, 1e-4, 30)
+        return beta + t * p, f, f_new
+
+    beta = jnp.zeros(d, dtype=x.dtype)
+    f_prev = None
+    for i in range(max_iter):
+        beta, f, f_new = step(beta)
+        f_new = float(f_new)
+        if f_prev is not None and abs(f_prev - f_new) <= tol * max(abs(f_prev), 1.0):
+            break
+        f_prev = f_new
+    return beta
+
+
+# --------------------------------------------------------------- admm --
+
+
+def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
+         lamduh: float = 0.0, rho: float = 1.0, max_iter: int = 100,
+         abstol: float = 1e-4, reltol: float = 1e-2,
+         inner_iter: int = 50, inner_tol: float = 1e-6, mesh=None):
+    """Consensus ADMM (Boyd et al. §8): per-shard local subproblems solved by
+    the jit-safe L-BFGS inside ``shard_map``, consensus z through the
+    regularizer's prox, scaled dual updates.
+
+    Reference: ``dask_glm/algorithms.py :: admm`` — one scatter/gather round
+    per iteration through the scheduler, scipy L-BFGS per chunk on workers
+    (SURVEY.md §3.1).  Here one iteration = one XLA program: P parallel
+    local L-BFGS runs + a single psum for the consensus mean.
+    """
+    reg = get_regularizer(regularizer)
+    mesh = mesh or get_mesh()
+    n_shards = mesh.shape[DATA_AXIS]
+    x, yv, mask = _prep(X, y)
+    d = x.shape[1]
+
+    beta_l = jnp.zeros((n_shards, d), dtype=x.dtype)
+    u_l = jnp.zeros((n_shards, d), dtype=x.dtype)
+    z = jnp.zeros(d, dtype=x.dtype)
+
+    def one_shard(xb, yb, mb, z_rep, beta_b, u_b):
+        u0, b0 = u_b[0], beta_b[0]
+
+        def local_obj(b):
+            return family.loss(b, xb, yb, mb) + 0.5 * rho * jnp.sum(
+                (b - z_rep + u0) ** 2
+            )
+
+        b_new, _ = lbfgs_minimize(
+            local_obj, b0, max_iter=inner_iter, tol=inner_tol
+        )
+        b_bar = lax.psum(b_new, DATA_AXIS) / n_shards
+        u_bar = lax.psum(u0, DATA_AXIS) / n_shards
+        z_new = reg.prox(b_bar + u_bar, lamduh / (rho * n_shards))
+        u_new = u0 + b_new - z_new
+        # residual pieces
+        primal_sq = lax.psum(jnp.sum((b_new - z_new) ** 2), DATA_AXIS)
+        beta_norm_sq = lax.psum(jnp.sum(b_new ** 2), DATA_AXIS)
+        u_norm_sq = lax.psum(jnp.sum(u_new ** 2), DATA_AXIS)
+        return b_new[None], u_new[None], z_new, primal_sq, beta_norm_sq, u_norm_sq
+
+    step = jax.jit(
+        shard_map_unchecked(
+            one_shard,
+            mesh,
+            in_specs=(
+                P(DATA_AXIS, None),  # x
+                P(DATA_AXIS),  # y
+                P(DATA_AXIS),  # mask
+                P(),  # z
+                P(DATA_AXIS, None),  # beta per shard
+                P(DATA_AXIS, None),  # u per shard
+            ),
+            out_specs=(
+                P(DATA_AXIS, None),
+                P(DATA_AXIS, None),
+                P(),
+                P(),
+                P(),
+                P(),
+            ),
+        )
+    )
+
+    sqrt_d = float(np.sqrt(d))
+    for i in range(max_iter):
+        z_old = z
+        beta_l, u_l, z, primal_sq, beta_sq, u_sq = step(
+            x, yv, mask, z, beta_l, u_l
+        )
+        primal = float(jnp.sqrt(primal_sq))
+        dual = float(rho * jnp.sqrt(n_shards * jnp.sum((z - z_old) ** 2)))
+        eps_pri = sqrt_d * abstol + reltol * max(
+            float(jnp.sqrt(beta_sq)), float(jnp.sqrt(n_shards) * jnp.linalg.norm(z))
+        )
+        eps_dual = sqrt_d * abstol + reltol * float(rho * jnp.sqrt(u_sq))
+        logger.debug("admm iter %d: primal %.3e dual %.3e", i, primal, dual)
+        if primal < eps_pri and dual < eps_dual:
+            break
+    return z

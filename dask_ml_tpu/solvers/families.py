@@ -1,0 +1,78 @@
+"""GLM families — twin of ``dask_glm/families.py`` (``Logistic``, ``Normal``,
+``Poisson``: ``pointwise_loss`` / ``pointwise_gradient`` / hessian weights).
+
+TPU-first twist: families only define the masked scalar loss; gradients are
+``jax.grad`` of it (no hand-derived gradient code to keep in sync), and the
+Newton solver asks for per-sample hessian weights only.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Family:
+    @staticmethod
+    def loss(beta, X, y, mask):  # total masked negative log-likelihood
+        raise NotImplementedError
+
+    @staticmethod
+    def hessian_weights(eta):  # per-sample d²loss/deta² at linear predictor eta
+        raise NotImplementedError
+
+    @staticmethod
+    def predict(eta):  # mean response from linear predictor
+        raise NotImplementedError
+
+
+class Logistic(Family):
+    """y ∈ {0,1}; loss = Σ log(1+exp(Xβ)) − y·Xβ."""
+
+    @staticmethod
+    def loss(beta, X, y, mask):
+        eta = X @ beta
+        # log(1+e^eta) computed stably
+        return jnp.sum(mask * (jnp.logaddexp(0.0, eta) - y * eta))
+
+    @staticmethod
+    def hessian_weights(eta):
+        p = 1.0 / (1.0 + jnp.exp(-eta))
+        return p * (1.0 - p)
+
+    @staticmethod
+    def predict(eta):
+        return 1.0 / (1.0 + jnp.exp(-eta))
+
+
+class Normal(Family):
+    """Gaussian: loss = ½ Σ (y − Xβ)²."""
+
+    @staticmethod
+    def loss(beta, X, y, mask):
+        eta = X @ beta
+        return 0.5 * jnp.sum(mask * (y - eta) ** 2)
+
+    @staticmethod
+    def hessian_weights(eta):
+        return jnp.ones_like(eta)
+
+    @staticmethod
+    def predict(eta):
+        return eta
+
+
+class Poisson(Family):
+    """Counts: loss = Σ exp(Xβ) − y·Xβ."""
+
+    @staticmethod
+    def loss(beta, X, y, mask):
+        eta = X @ beta
+        return jnp.sum(mask * (jnp.exp(eta) - y * eta))
+
+    @staticmethod
+    def hessian_weights(eta):
+        return jnp.exp(eta)
+
+    @staticmethod
+    def predict(eta):
+        return jnp.exp(eta)

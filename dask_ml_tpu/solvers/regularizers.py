@@ -1,0 +1,87 @@
+"""Regularizers — twin of ``dask_glm/regularizers.py`` (``L1``, ``L2``,
+``ElasticNet``: penalty value + proximal operator)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _soft_threshold(x, t):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)
+
+
+class Regularizer:
+    #: penalty is smooth (has a gradient everywhere) — gates which solvers apply
+    smooth = False
+
+    @staticmethod
+    def penalty(beta, lam):
+        raise NotImplementedError
+
+    @staticmethod
+    def prox(beta, t):
+        """Proximal operator of t·penalty(·, 1)."""
+        raise NotImplementedError
+
+
+class L2(Regularizer):
+    smooth = True
+
+    @staticmethod
+    def penalty(beta, lam):
+        return 0.5 * lam * jnp.sum(beta ** 2)
+
+    @staticmethod
+    def prox(beta, t):
+        return beta / (1.0 + t)
+
+
+class L1(Regularizer):
+    smooth = False
+
+    @staticmethod
+    def penalty(beta, lam):
+        return lam * jnp.sum(jnp.abs(beta))
+
+    @staticmethod
+    def prox(beta, t):
+        return _soft_threshold(beta, t)
+
+
+class ElasticNet(Regularizer):
+    """penalty = λ·(α‖β‖₁ + (1−α)/2·‖β‖²), α = 0.5 (dask_glm default mix)."""
+
+    smooth = False
+    alpha = 0.5
+
+    @classmethod
+    def penalty(cls, beta, lam):
+        return lam * (
+            cls.alpha * jnp.sum(jnp.abs(beta))
+            + 0.5 * (1 - cls.alpha) * jnp.sum(beta ** 2)
+        )
+
+    @classmethod
+    def prox(cls, beta, t):
+        return _soft_threshold(beta, t * cls.alpha) / (1.0 + t * (1 - cls.alpha))
+
+
+_REGULARIZERS = {
+    "l1": L1,
+    "l2": L2,
+    "elastic_net": ElasticNet,
+    "elasticnet": ElasticNet,
+}
+
+
+def get_regularizer(spec):
+    if isinstance(spec, type) and issubclass(spec, Regularizer):
+        return spec
+    if isinstance(spec, Regularizer):
+        return type(spec)
+    try:
+        return _REGULARIZERS[spec]
+    except KeyError:
+        raise ValueError(
+            f"Unknown regularizer {spec!r}; valid: {sorted(set(_REGULARIZERS))}"
+        )

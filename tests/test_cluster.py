@@ -178,3 +178,11 @@ class TestReviewRegressions:
         X1, y1 = datasets.make_counts(n_samples=100, n_features=5, chunks=50, random_state=0)
         a, b = unshard(X1)[:50], unshard(X1)[50:]
         assert not np.allclose(a, b)  # distinct per-chunk seeds
+
+    def test_spectral_kmeans_params_random_state(self, blobs):
+        X, _ = blobs
+        spec = dc.SpectralClustering(
+            n_clusters=4, n_components=40, random_state=0,
+            kmeans_params={"random_state": 5, "max_iter": 50},
+        ).fit(X)
+        assert np.asarray(spec.labels_).shape == (500,)

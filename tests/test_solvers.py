@@ -1,0 +1,152 @@
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import dask_ml_tpu.solvers as solvers
+from dask_ml_tpu.core import shard_rows
+from dask_ml_tpu.solvers import (
+    L1,
+    L2,
+    ElasticNet,
+    Logistic,
+    Normal,
+    Poisson,
+    lbfgs_minimize,
+)
+
+
+@pytest.fixture
+def logistic_data(rng):
+    n, d = 300, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d)
+    p = 1 / (1 + np.exp(-(X @ w)))
+    y = (rng.uniform(size=n) < p).astype(np.float32)
+    return X, y, w
+
+
+@pytest.fixture
+def normal_data(rng):
+    n, d = 300, 5
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d)
+    y = (X @ w + 0.01 * rng.normal(size=n)).astype(np.float32)
+    return X, y, w
+
+
+class TestLBFGSCore:
+    def test_quadratic_exact(self):
+        A = jnp.asarray(np.diag([1.0, 10.0, 100.0]), dtype=jnp.float32)
+        b = jnp.asarray([1.0, -2.0, 3.0])
+
+        def f(x):
+            return 0.5 * x @ A @ x - b @ x
+
+        x, state = lbfgs_minimize(f, jnp.zeros(3), max_iter=100, tol=1e-6)
+        np.testing.assert_allclose(np.asarray(x), np.linalg.solve(np.asarray(A), b), atol=1e-3)
+        assert bool(state.converged)
+
+    def test_rosenbrock(self):
+        def f(z):
+            return (1 - z[0]) ** 2 + 100 * (z[1] - z[0] ** 2) ** 2
+
+        x, state = lbfgs_minimize(f, jnp.asarray([-1.2, 1.0]), max_iter=400, tol=1e-6)
+        np.testing.assert_allclose(np.asarray(x), [1.0, 1.0], atol=1e-2)
+
+    def test_inside_jit_and_vmap(self):
+        import jax
+
+        def f(x):
+            return jnp.sum((x - 1.5) ** 2)
+
+        solve = jax.jit(jax.vmap(lambda x0: lbfgs_minimize(f, x0, max_iter=50)[0]))
+        out = solve(jnp.zeros((4, 3)))
+        np.testing.assert_allclose(np.asarray(out), 1.5 * np.ones((4, 3)), atol=1e-4)
+
+
+def _sklearn_logistic(X, y, C=1e5):
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    return SkLR(C=C, fit_intercept=False, tol=1e-8).fit(X, y).coef_[0]
+
+
+class TestSolverParity:
+    """All solvers minimize the same objective -> same optimum."""
+
+    @pytest.mark.parametrize("name", ["lbfgs", "newton", "gradient_descent", "proximal_grad", "admm"])
+    def test_logistic_unregularized(self, logistic_data, name):
+        X, y, _ = logistic_data
+        fn = getattr(solvers, name)
+        kwargs = {"family": Logistic, "lamduh": 1e-5, "max_iter": 200}
+        beta = fn(shard_rows(X), shard_rows(y), **kwargs)
+        expected = _sklearn_logistic(X, y)
+        np.testing.assert_allclose(np.asarray(beta), expected, atol=5e-2)
+
+    @pytest.mark.parametrize("name", ["lbfgs", "newton", "admm"])
+    def test_normal_family(self, normal_data, name):
+        X, y, w = normal_data
+        fn = getattr(solvers, name)
+        beta = fn(shard_rows(X), shard_rows(y), family=Normal, lamduh=1e-6, max_iter=200)
+        expected = np.linalg.lstsq(X, y, rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(beta), expected, atol=2e-2)
+
+    def test_poisson_family(self, rng):
+        n, d = 400, 4
+        X = rng.normal(size=(n, d)).astype(np.float32) * 0.5
+        w = rng.normal(size=d) * 0.5
+        y = rng.poisson(np.exp(X @ w)).astype(np.float32)
+        beta = solvers.lbfgs(shard_rows(X), shard_rows(y), family=Poisson, lamduh=1e-6, max_iter=300)
+        from sklearn.linear_model import PoissonRegressor
+
+        sk = PoissonRegressor(alpha=0, fit_intercept=False, tol=1e-8, max_iter=1000).fit(X, y)
+        np.testing.assert_allclose(np.asarray(beta), sk.coef_, atol=5e-2)
+
+    def test_l1_sparsity(self, normal_data):
+        X, y, w = normal_data
+        beta = solvers.admm(
+            shard_rows(X), shard_rows(y), family=Normal, regularizer=L1,
+            lamduh=300.0, max_iter=200,
+        )
+        # strong l1 must zero out some coordinates exactly
+        assert np.sum(np.abs(np.asarray(beta)) < 1e-6) > 0
+
+    def test_l1_proximal_grad_matches_admm(self, normal_data):
+        X, y, _ = normal_data
+        kw = dict(family=Normal, regularizer=L1, lamduh=50.0, max_iter=400)
+        b1 = solvers.admm(shard_rows(X), shard_rows(y), **kw)
+        b2 = solvers.proximal_grad(shard_rows(X), shard_rows(y), **kw)
+        np.testing.assert_allclose(np.asarray(b1), np.asarray(b2), atol=2e-2)
+
+    def test_lbfgs_rejects_l1(self, normal_data):
+        X, y, _ = normal_data
+        with pytest.raises(ValueError, match="smooth"):
+            solvers.lbfgs(shard_rows(X), shard_rows(y), regularizer=L1, lamduh=1.0)
+
+    def test_l2_regularization_shrinks(self, normal_data):
+        X, y, _ = normal_data
+        b_weak = solvers.lbfgs(shard_rows(X), shard_rows(y), family=Normal, lamduh=1e-6)
+        b_strong = solvers.lbfgs(shard_rows(X), shard_rows(y), family=Normal, regularizer=L2, lamduh=1e3)
+        assert np.linalg.norm(np.asarray(b_strong)) < np.linalg.norm(np.asarray(b_weak))
+
+
+class TestRegularizers:
+    def test_l1_prox_soft_threshold(self):
+        b = jnp.asarray([3.0, -0.5, 0.2])
+        out = np.asarray(L1.prox(b, 1.0))
+        np.testing.assert_allclose(out, [2.0, 0.0, 0.0])
+
+    def test_l2_prox_shrinks(self):
+        out = np.asarray(L2.prox(jnp.asarray([2.0]), 1.0))
+        np.testing.assert_allclose(out, [1.0])
+
+    def test_elastic_net_between(self):
+        b = jnp.asarray([2.0])
+        en = float(ElasticNet.prox(b, 1.0)[0])
+        assert float(L1.prox(b, 1.0)[0]) >= 0 and en > 0
+
+    def test_get_regularizer_names(self):
+        assert solvers.get_regularizer("l1") is L1
+        assert solvers.get_regularizer("elastic_net") is ElasticNet
+        with pytest.raises(ValueError, match="Unknown regularizer"):
+            solvers.get_regularizer("l7")
