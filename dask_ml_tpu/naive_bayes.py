@@ -1,0 +1,91 @@
+"""GaussianNB — twin of ``dask_ml/naive_bayes.py`` (SURVEY.md §2 #18):
+per-class blockwise moments, here one jitted masked reduction over the
+sharded sample axis (the per-class sums are a one-hot gemm like KMeans').
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import ClassifierMixin, TPUEstimator
+from .core.sharded import ShardedRows, unshard
+from .preprocessing.data import _ingest_float, _masked_or_plain
+
+
+@jax.jit
+def _class_moments(x, mask, onehot):
+    w = onehot * mask[:, None]  # (n, k)
+    counts = jnp.sum(w, axis=0)  # (k,)
+    sums = w.T @ x  # (k, d)
+    means = sums / counts[:, None]
+    # two-pass variance: deviations from the per-class mean (E[x²]−E[x]²
+    # catastrophically cancels in fp32 for data with large means)
+    dev = x - w @ means  # rows of the wrong class contribute 0 via w below
+    var = (w.T @ (dev ** 2)) / counts[:, None]
+    return counts, means, var
+
+
+class GaussianNB(ClassifierMixin, TPUEstimator):
+    def __init__(self, priors=None, var_smoothing=1e-9):
+        self.priors = priors
+        self.var_smoothing = var_smoothing
+
+    def fit(self, X, y=None):
+        X = _ingest_float(self, X)
+        yv = unshard(y) if isinstance(y, ShardedRows) else np.asarray(y)
+        if yv.shape[0] != X.n_samples:
+            raise ValueError("X and y have different lengths")
+        classes = np.unique(yv)
+        idx = np.searchsorted(classes, yv)
+        idx_padded = np.zeros(X.padded, dtype=np.int64)
+        idx_padded[: X.n_samples] = idx
+        onehot = jax.nn.one_hot(jnp.asarray(idx_padded), len(classes), dtype=X.data.dtype)
+
+        counts, means, var = _class_moments(X.data, X.mask, onehot)
+        from .core.sharded import masked_var
+
+        eps = self.var_smoothing * float(jnp.max(masked_var(X.data, X.mask)))
+        self.classes_ = classes
+        self.class_count_ = counts
+        self.theta_ = means
+        self.var_ = var + eps
+        if self.priors is not None:
+            self.class_prior_ = jnp.asarray(self.priors)
+        else:
+            self.class_prior_ = counts / jnp.sum(counts)
+        self.n_features_in_ = X.data.shape[1]
+        return self
+
+    def _joint_log_likelihood(self, x):
+        # (n, k): log P(c) + sum_d log N(x_d | theta, var)
+        log_prior = jnp.log(self.class_prior_)[None, :]
+        xc = x[:, None, :] - self.theta_[None, :, :]  # (n, k, d)
+        ll = -0.5 * jnp.sum(
+            jnp.log(2 * jnp.pi * self.var_)[None, :, :] + xc ** 2 / self.var_[None, :, :],
+            axis=2,
+        )
+        return log_prior + ll
+
+    def predict(self, X):
+        x, _ = _masked_or_plain(X)
+        jll = self._joint_log_likelihood(x)
+        idx = jnp.argmax(jll, axis=1)
+        n = X.n_samples if isinstance(X, ShardedRows) else x.shape[0]
+        return jnp.asarray(self.classes_)[idx][:n]
+
+    def predict_proba(self, X):
+        x, _ = _masked_or_plain(X)
+        jll = self._joint_log_likelihood(x)
+        n = X.n_samples if isinstance(X, ShardedRows) else x.shape[0]
+        return jax.nn.softmax(jll, axis=1)[:n]
+
+    def predict_log_proba(self, X):
+        return jnp.log(self.predict_proba(X))
+
+    def score(self, X, y):
+        from .metrics import accuracy_score
+
+        pred = jnp.asarray(self.predict(X)).astype(jnp.float32)
+        return accuracy_score(y, pred)

@@ -1,0 +1,132 @@
+"""Meta-estimators — twin of ``dask_ml/wrappers.py`` (``ParallelPostFit``,
+``Incremental``; SURVEY.md §2 #26).
+
+``ParallelPostFit``: fit an arbitrary estimator once (often on a sample),
+then run inference over large data in row chunks.  With a device-native
+(our) estimator the chunking is bypassed — inference is already one sharded
+XLA program.  ``Incremental``: stream blocks through ``partial_fit``
+(``_partial.fit`` chain in the reference).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import _partial
+from .base import TPUEstimator, clone
+from .core.sharded import ShardedRows, unshard
+from .utils import copy_learned_attributes
+
+_FIT_KWARG_ERR = "postfit_estimator has not been fit; call fit first"
+
+
+class ParallelPostFit(TPUEstimator):
+    def __init__(self, estimator=None, scoring=None, predict_meta=None,
+                 predict_proba_meta=None, transform_meta=None):
+        self.estimator = estimator
+        self.scoring = scoring
+        self.predict_meta = predict_meta
+        self.predict_proba_meta = predict_proba_meta
+        self.transform_meta = transform_meta
+
+    # -- fitting ------------------------------------------------------
+    def fit(self, X, y=None, **kwargs):
+        est = clone(self.estimator)
+        Xh = unshard(X) if isinstance(X, ShardedRows) else X
+        yh = unshard(y) if isinstance(y, ShardedRows) else y
+        est.fit(Xh, yh, **kwargs) if yh is not None else est.fit(Xh, **kwargs)
+        self.estimator_ = est
+        copy_learned_attributes(est, self)
+        return self
+
+    @property
+    def _postfit_estimator(self):
+        if hasattr(self, "estimator_"):
+            return self.estimator_
+        # pre-fitted estimator passed in (reference allows this)
+        from sklearn.utils.validation import check_is_fitted
+
+        check_is_fitted(self.estimator)
+        return self.estimator
+
+    # -- chunked inference --------------------------------------------
+    def _apply(self, method, X, chunk_size=100_000):
+        est = self._postfit_estimator
+        fn = getattr(est, method)
+        if isinstance(est, TPUEstimator) and isinstance(X, ShardedRows):
+            # device-native estimator + sharded input: inference is already
+            # one sharded XLA program — no host round-trip, no chunking
+            return fn(X)
+        if isinstance(X, ShardedRows):
+            X = unshard(X)
+        X = np.asarray(X)
+        outs = [
+            np.asarray(fn(X[lo:hi]))
+            for lo, hi in _partial._row_chunks(X.shape[0], chunk_size)
+        ]
+        return np.concatenate(outs)
+
+    def predict(self, X):
+        return self._apply("predict", X)
+
+    def predict_proba(self, X):
+        return self._apply("predict_proba", X)
+
+    def predict_log_proba(self, X):
+        return self._apply("predict_log_proba", X)
+
+    def transform(self, X):
+        return self._apply("transform", X)
+
+    def score(self, X, y, compute=True):
+        from .metrics.scorer import check_scoring
+
+        scorer = check_scoring(self._postfit_estimator, self.scoring)
+        if self.scoring:
+            return scorer(self, X, y)
+        Xh = unshard(X) if isinstance(X, ShardedRows) else X
+        yh = unshard(y) if isinstance(y, ShardedRows) else y
+        return self._postfit_estimator.score(Xh, yh)
+
+
+class Incremental(ParallelPostFit):
+    """Fit via sequential ``partial_fit`` over row chunks.
+
+    Reference: ``wrappers.py :: Incremental`` (``shuffle_blocks``,
+    ``random_state``, ``assume_equal_chunks``); the chain of
+    ``dask_ml/_partial.py :: fit`` becomes a host stream into a resident
+    model (SURVEY.md §3.5).
+    """
+
+    def __init__(self, estimator=None, scoring=None, shuffle_blocks=True,
+                 random_state=None, assume_equal_chunks=True,
+                 predict_meta=None, predict_proba_meta=None,
+                 transform_meta=None, chunk_size=10_000):
+        self.shuffle_blocks = shuffle_blocks
+        self.random_state = random_state
+        self.assume_equal_chunks = assume_equal_chunks
+        self.chunk_size = chunk_size
+        super().__init__(
+            estimator=estimator, scoring=scoring, predict_meta=predict_meta,
+            predict_proba_meta=predict_proba_meta, transform_meta=transform_meta,
+        )
+
+    def _fit_for_estimator(self, estimator, X, y, **fit_kwargs):
+        _partial.fit(
+            estimator, X, y,
+            chunk_size=self.chunk_size,
+            shuffle_blocks=self.shuffle_blocks,
+            random_state=self.random_state,
+            **fit_kwargs,
+        )
+        self.estimator_ = estimator
+        copy_learned_attributes(estimator, self)
+        return self
+
+    def fit(self, X, y=None, **fit_kwargs):
+        return self._fit_for_estimator(clone(self.estimator), X, y, **fit_kwargs)
+
+    def partial_fit(self, X, y=None, **fit_kwargs):
+        """One more pass over (X, y) without re-initializing the model."""
+        est = getattr(self, "estimator_", None) or clone(self.estimator)
+        return self._fit_for_estimator(est, X, y, **fit_kwargs)

@@ -1,0 +1,287 @@
+"""Tests for wrappers, impute, naive_bayes, ensemble, compose."""
+
+import numpy as np
+import pytest
+from sklearn.linear_model import SGDClassifier, SGDRegressor
+from sklearn.tree import DecisionTreeClassifier, DecisionTreeRegressor
+
+import dask_ml_tpu as dmt
+from dask_ml_tpu.core import shard_rows, unshard
+from dask_ml_tpu.ensemble import BlockwiseVotingClassifier, BlockwiseVotingRegressor
+from dask_ml_tpu.impute import SimpleImputer
+from dask_ml_tpu.naive_bayes import GaussianNB
+from dask_ml_tpu.wrappers import Incremental, ParallelPostFit
+
+
+@pytest.fixture
+def clf_data(rng):
+    n, d = 400, 5
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d)
+    y = (X @ w > 0).astype(np.int64)
+    return X, y
+
+
+class TestParallelPostFit:
+    def test_fit_and_predict(self, clf_data):
+        X, y = clf_data
+        ppf = ParallelPostFit(DecisionTreeClassifier(max_depth=4)).fit(X, y)
+        pred = ppf.predict(shard_rows(X))
+        assert pred.shape == (400,)
+        assert (pred == ppf.estimator_.predict(X)).all()
+
+    def test_predict_proba(self, clf_data):
+        X, y = clf_data
+        ppf = ParallelPostFit(DecisionTreeClassifier(max_depth=4)).fit(X, y)
+        proba = ppf.predict_proba(X)
+        np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-6)
+
+    def test_prefitted_estimator(self, clf_data):
+        X, y = clf_data
+        inner = DecisionTreeClassifier(max_depth=3).fit(X, y)
+        ppf = ParallelPostFit(inner)
+        np.testing.assert_array_equal(ppf.predict(X), inner.predict(X))
+
+    def test_score(self, clf_data):
+        X, y = clf_data
+        ppf = ParallelPostFit(DecisionTreeClassifier(max_depth=8)).fit(X, y)
+        assert ppf.score(X, y) > 0.9
+
+    def test_copies_learned_attributes(self, clf_data):
+        X, y = clf_data
+        ppf = ParallelPostFit(DecisionTreeClassifier(max_depth=3)).fit(X, y)
+        assert hasattr(ppf, "classes_")
+
+
+class TestIncremental:
+    def test_streams_partial_fit(self, clf_data):
+        X, y = clf_data
+        inc = Incremental(
+            SGDClassifier(loss="log_loss", random_state=0, tol=None),
+            shuffle_blocks=False, chunk_size=50,
+        )
+        inc.fit(shard_rows(X), shard_rows(y), classes=[0, 1])
+        assert inc.score(X, y) > 0.8
+        assert hasattr(inc, "coef_")
+
+    def test_partial_fit_continues(self, clf_data):
+        X, y = clf_data
+        inc = Incremental(
+            SGDClassifier(loss="log_loss", random_state=0, tol=None),
+            shuffle_blocks=False, chunk_size=100,
+        )
+        inc.fit(X, y, classes=[0, 1])
+        c1 = inc.estimator_.t_
+        inc.partial_fit(X, y)
+        assert inc.estimator_.t_ > c1  # SGD iteration counter advanced
+
+    def test_shuffle_blocks_deterministic(self, clf_data):
+        X, y = clf_data
+        kw = dict(shuffle_blocks=True, random_state=3, chunk_size=50)
+        a = Incremental(SGDClassifier(random_state=0, tol=None), **kw).fit(X, y, classes=[0, 1])
+        b = Incremental(SGDClassifier(random_state=0, tol=None), **kw).fit(X, y, classes=[0, 1])
+        np.testing.assert_array_equal(np.asarray(a.coef_), np.asarray(b.coef_))
+
+    def test_regressor(self, rng):
+        X = rng.normal(size=(300, 4)).astype(np.float32)
+        y = X @ rng.normal(size=4) + 0.01 * rng.normal(size=300)
+        inc = Incremental(SGDRegressor(random_state=0, tol=None), chunk_size=100)
+        inc.fit(X, y.astype(np.float32))
+        assert inc.score(X, y) > 0.8
+
+    def test_length_mismatch_raises(self, clf_data):
+        X, y = clf_data
+        inc = Incremental(SGDClassifier(tol=None))
+        with pytest.raises(ValueError, match="different lengths"):
+            inc.fit(X, y[:-5], classes=[0, 1])
+
+
+class TestSimpleImputer:
+    @pytest.mark.parametrize("strategy", ["mean", "median", "most_frequent"])
+    def test_parity_with_sklearn(self, rng, strategy):
+        from sklearn.impute import SimpleImputer as SkImputer
+
+        X = rng.normal(size=(60, 4)).astype(np.float64)
+        X[rng.uniform(size=X.shape) < 0.2] = np.nan
+        X[:, 2] = np.round(X[:, 2])  # give most_frequent real ties structure
+        ours = SimpleImputer(strategy=strategy).fit(X)
+        theirs = SkImputer(strategy=strategy).fit(X)
+        np.testing.assert_allclose(
+            np.asarray(ours.statistics_), theirs.statistics_, atol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(ours.transform(X)), theirs.transform(X), atol=1e-3
+        )
+
+    def test_constant(self, rng):
+        X = rng.normal(size=(20, 3)).astype(np.float32)
+        X[0, 0] = np.nan
+        out = np.asarray(SimpleImputer(strategy="constant", fill_value=-1.0).fit_transform(X))
+        assert out[0, 0] == -1.0
+
+    def test_constant_requires_fill_value(self, rng):
+        with pytest.raises(ValueError, match="fill_value"):
+            SimpleImputer(strategy="constant").fit(np.ones((5, 2), dtype=np.float32))
+
+    def test_bad_strategy(self):
+        with pytest.raises(ValueError, match="strategy"):
+            SimpleImputer(strategy="mode").fit(np.ones((5, 2), dtype=np.float32))
+
+    def test_sharded_input(self, rng):
+        X = rng.normal(size=(37, 3)).astype(np.float32)
+        X[5, 1] = np.nan
+        s = shard_rows(X)
+        imp = SimpleImputer().fit(s)
+        out = unshard(imp.transform(s))
+        assert np.isfinite(out).all()
+
+    def test_all_missing_column_raises(self):
+        X = np.ones((10, 2), dtype=np.float32)
+        X[:, 1] = np.nan
+        with pytest.raises(ValueError, match="no observed values"):
+            SimpleImputer().fit(X)
+
+
+class TestGaussianNB:
+    def test_parity_with_sklearn(self, rng):
+        from sklearn.naive_bayes import GaussianNB as SkGNB
+
+        from sklearn.datasets import make_blobs
+
+        X, y = make_blobs(n_samples=300, centers=3, n_features=4, random_state=0)
+        X = X.astype(np.float32)
+        ours = GaussianNB().fit(shard_rows(X), y)
+        theirs = SkGNB().fit(X, y)
+        np.testing.assert_allclose(np.asarray(ours.theta_), theirs.theta_, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(ours.var_), theirs.var_, rtol=1e-2)
+        np.testing.assert_array_equal(np.asarray(ours.predict(X)), theirs.predict(X))
+        assert ours.score(X, y.astype(np.float32)) == pytest.approx(theirs.score(X, y))
+
+    def test_predict_proba_normalized(self, rng):
+        X = rng.normal(size=(50, 3)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int64)
+        nb = GaussianNB().fit(X, y)
+        proba = np.asarray(nb.predict_proba(X))
+        np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-5)
+
+    def test_priors(self, rng):
+        X = rng.normal(size=(50, 3)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int64)
+        nb = GaussianNB(priors=[0.9, 0.1]).fit(X, y)
+        np.testing.assert_allclose(np.asarray(nb.class_prior_), [0.9, 0.1])
+
+
+class TestBlockwiseEnsembles:
+    def test_classifier_hard_vote(self, clf_data):
+        X, y = clf_data
+        ens = BlockwiseVotingClassifier(
+            DecisionTreeClassifier(max_depth=4), n_blocks=5
+        ).fit(shard_rows(X), y)
+        assert len(ens.estimators_) == 5
+        assert ens.score(X, y) > 0.8
+
+    def test_classifier_soft_vote(self, clf_data):
+        X, y = clf_data
+        ens = BlockwiseVotingClassifier(
+            DecisionTreeClassifier(max_depth=4), voting="soft", n_blocks=4
+        ).fit(X, y)
+        proba = ens.predict_proba(X)
+        np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-6)
+        assert ens.score(X, y) > 0.8
+
+    def test_hard_vote_no_predict_proba(self, clf_data):
+        X, y = clf_data
+        ens = BlockwiseVotingClassifier(DecisionTreeClassifier(), voting="hard").fit(X, y)
+        with pytest.raises(AttributeError, match="soft"):
+            ens.predict_proba(X)
+
+    def test_regressor_mean(self, rng):
+        X = rng.normal(size=(300, 4)).astype(np.float32)
+        y = (X @ rng.normal(size=4)).astype(np.float32)
+        ens = BlockwiseVotingRegressor(DecisionTreeRegressor(max_depth=6), n_blocks=4).fit(X, y)
+        assert ens.score(X, y) > 0.7
+
+    def test_bad_voting(self, clf_data):
+        X, y = clf_data
+        with pytest.raises(ValueError, match="voting"):
+            BlockwiseVotingClassifier(DecisionTreeClassifier(), voting="mean").fit(X, y)
+
+
+class TestColumnTransformer:
+    def test_basic_columns(self, rng):
+        import pandas as pd
+        from dask_ml_tpu.compose import ColumnTransformer
+        from dask_ml_tpu.preprocessing import StandardScaler as OurScaler
+        from sklearn.preprocessing import StandardScaler
+
+        df = pd.DataFrame({"a": rng.normal(size=30), "b": rng.normal(size=30) * 5})
+        ct = ColumnTransformer([("s", StandardScaler(), ["a", "b"])])
+        out = ct.fit_transform(df)
+        np.testing.assert_allclose(np.asarray(out).std(0), 1.0, rtol=1e-2)
+
+    def test_make_column_transformer(self, rng):
+        from dask_ml_tpu.compose import make_column_transformer
+        from sklearn.preprocessing import StandardScaler
+
+        ct = make_column_transformer((StandardScaler(), [0, 1]))
+        out = ct.fit_transform(rng.normal(size=(30, 3)))
+        assert np.asarray(out).shape == (30, 2)
+
+
+class TestReviewRegressions:
+    def test_gaussian_nb_large_mean_variance(self, rng):
+        from sklearn.naive_bayes import GaussianNB as SkGNB
+
+        X = (rng.normal(size=(2000, 3)) + 5000).astype(np.float32)
+        y = (X[:, 0] > 5000).astype(np.int64)
+        ours = GaussianNB().fit(X, y)
+        theirs = SkGNB().fit(X, y)
+        np.testing.assert_allclose(np.asarray(ours.var_), theirs.var_, rtol=0.05)
+        assert float(ours.score(X, y.astype(np.float32))) > 0.95
+
+    def test_soft_vote_aligns_partial_classes(self, rng):
+        # each block sees only a subset of the 3 classes
+        X = rng.normal(size=(90, 2)).astype(np.float32)
+        y = np.repeat([0, 1, 2], 30)
+        ens = BlockwiseVotingClassifier(
+            DecisionTreeClassifier(), voting="soft", n_blocks=3
+        ).fit(X, y)
+        proba = ens.predict_proba(X)
+        assert proba.shape == (90, 3)
+        np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-6)
+
+    def test_hard_vote_unsorted_classes_param(self, rng):
+        X = rng.normal(size=(60, 2)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int64)
+        ens = BlockwiseVotingClassifier(
+            DecisionTreeClassifier(max_depth=3), classes=[1, 0], n_blocks=3
+        ).fit(X, y)
+        pred = ens.predict(X)
+        assert set(np.unique(pred)) <= {0, 1}
+
+    def test_imputer_add_indicator(self, rng):
+        from sklearn.impute import SimpleImputer as SkImputer
+
+        X = rng.normal(size=(30, 3)).astype(np.float64)
+        X[::5, 1] = np.nan
+        ours = np.asarray(SimpleImputer(add_indicator=True).fit_transform(X))
+        theirs = SkImputer(add_indicator=True).fit_transform(X)
+        assert ours.shape == theirs.shape == (30, 4)
+        np.testing.assert_allclose(ours, theirs, atol=1e-3)
+
+    def test_ppf_device_native_passthrough(self, rng):
+        from dask_ml_tpu.cluster import KMeans
+        from dask_ml_tpu.core.sharded import ShardedRows
+
+        X = rng.normal(size=(64, 3)).astype(np.float32)
+        s = shard_rows(X)
+        ppf = ParallelPostFit(KMeans(n_clusters=2, random_state=0)).fit(s)
+        out = ppf.predict(s)
+        assert np.asarray(out).shape == (64,)
+
+    def test_make_column_transformer_sparse_threshold(self, rng):
+        from dask_ml_tpu.compose import make_column_transformer
+        from sklearn.preprocessing import StandardScaler
+
+        ct = make_column_transformer((StandardScaler(), [0]), sparse_threshold=0.5)
+        assert ct.sparse_threshold == 0.5
