@@ -37,6 +37,7 @@ from . import ensemble  # noqa: F401
 from . import compose  # noqa: F401
 from . import wrappers  # noqa: F401
 from . import _partial  # noqa: F401
+from . import model_selection  # noqa: F401
 
 __all__ = [
     "core",
@@ -53,5 +54,6 @@ __all__ = [
     "ensemble",
     "compose",
     "wrappers",
+    "model_selection",
     "__version__",
 ]

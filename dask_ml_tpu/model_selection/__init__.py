@@ -1,0 +1,25 @@
+"""Model selection — twin of ``dask_ml/model_selection/`` (SURVEY.md §2
+#21–#25)."""
+
+from ._split import KFold, ShuffleSplit, train_test_split  # noqa: F401
+from ._search import GridSearchCV, RandomizedSearchCV  # noqa: F401
+from ._incremental import (  # noqa: F401
+    BaseIncrementalSearchCV,
+    IncrementalSearchCV,
+    InverseDecaySearchCV,
+)
+from ._successive_halving import SuccessiveHalvingSearchCV  # noqa: F401
+from ._hyperband import HyperbandSearchCV  # noqa: F401
+
+__all__ = [
+    "train_test_split",
+    "ShuffleSplit",
+    "KFold",
+    "GridSearchCV",
+    "RandomizedSearchCV",
+    "BaseIncrementalSearchCV",
+    "IncrementalSearchCV",
+    "InverseDecaySearchCV",
+    "SuccessiveHalvingSearchCV",
+    "HyperbandSearchCV",
+]

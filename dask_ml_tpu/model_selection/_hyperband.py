@@ -1,0 +1,158 @@
+"""HyperbandSearchCV.
+
+Reference: ``dask_ml/model_selection/_hyperband.py`` — computes the
+Hyperband bracket schedule from ``max_iter`` (+``aggressiveness``),
+instantiates one SuccessiveHalvingSearchCV per bracket, runs ALL brackets
+concurrently on one event loop, and exposes ``metadata``/``metadata_``
+(``n_models``, ``partial_fit_calls`` per bracket) — SURVEY.md §3.3.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+
+import numpy as np
+
+from ._incremental import BaseIncrementalSearchCV
+from ._successive_halving import SuccessiveHalvingSearchCV
+
+logger = logging.getLogger(__name__)
+
+
+def _get_hyperband_params(R, eta=3):
+    """Bracket schedule (Li et al. 2016, alg. 1): list of (bracket, n, r).
+
+    Reference symbol: ``_hyperband.py :: _get_hyperband_params``.
+    """
+    s_max = int(math.floor(math.log(R) / math.log(eta)))
+    B = (s_max + 1) * R
+    out = []
+    for s in range(s_max, -1, -1):
+        n = int(math.ceil(B / R * eta ** s / (s + 1)))
+        r = int(R * eta ** -s)
+        out.append((s, n, max(r, 1)))
+    return out
+
+
+def _simulate_sha_calls(n, r, R, eta):
+    """Total partial_fit calls an (n, r) SHA bracket will make, mirroring
+    SuccessiveHalvingSearchCV's policy (initial 1-call round + adapt loop)."""
+    calls = {i: 1 for i in range(n)}  # initial round: one call each
+    total = n
+    steps = 0
+    while True:
+        n_i = int(math.floor(n * eta ** -steps))
+        raw_target = int(round(r * eta ** steps))
+        r_i = min(raw_target, R)
+        steps += 1
+        survivors = sorted(calls)[: max(n_i, 1)]
+        if len(survivors) in (0, 1) and steps > 1:
+            for ident in survivors:  # final survivor's remaining budget
+                total += max(0, r_i - calls[ident])
+            break
+        added = 0
+        for ident in survivors:
+            more = max(0, r_i - calls[ident])
+            calls[ident] += more
+            added += more
+        total += added
+        if added == 0 and raw_target >= R:
+            break  # every survivor at the max_iter budget
+        calls = {i: calls[i] for i in survivors}
+    return total
+
+
+class HyperbandSearchCV(BaseIncrementalSearchCV):
+    def __init__(self, estimator, parameters, max_iter=81, aggressiveness=3,
+                 test_size=None, random_state=None, scoring=None,
+                 patience=False, tol=1e-3, verbose=False, prefix="",
+                 chunk_size=None):
+        self.max_iter = max_iter
+        self.aggressiveness = aggressiveness
+        super().__init__(
+            estimator, parameters, test_size=test_size,
+            random_state=random_state, scoring=scoring, max_iter=max_iter,
+            patience=patience, tol=tol, verbose=verbose, prefix=prefix,
+            chunk_size=chunk_size,
+        )
+
+    # -- schedule ------------------------------------------------------
+    @property
+    def metadata(self):
+        """Theoretical budget before fitting (reference ``metadata``)."""
+        brackets = []
+        n_models = 0
+        total_calls = 0
+        for s, n, r in _get_hyperband_params(self.max_iter, self.aggressiveness):
+            calls = _simulate_sha_calls(n, r, self.max_iter, self.aggressiveness)
+            brackets.append(
+                {"bracket": s, "n_models": n, "partial_fit_calls": calls}
+            )
+            n_models += n
+            total_calls += calls
+        return {
+            "n_models": n_models,
+            "partial_fit_calls": total_calls,
+            "brackets": brackets,
+        }
+
+    def _make_brackets(self):
+        brackets = []
+        rng_seed = self.random_state
+        for s, n, r in _get_hyperband_params(self.max_iter, self.aggressiveness):
+            seed = None if rng_seed is None else int(rng_seed) + s
+            sha = SuccessiveHalvingSearchCV(
+                self.estimator, self.parameters,
+                n_initial_parameters=n, n_initial_iter=r,
+                max_iter=self.max_iter, aggressiveness=self.aggressiveness,
+                test_size=self.test_size, random_state=seed,
+                scoring=self.scoring, prefix=f"{self.prefix}bracket={s}",
+                chunk_size=self.chunk_size,
+            )
+            brackets.append((s, sha))
+        return brackets
+
+    def fit(self, X, y=None, **fit_params):
+        X_train, X_test, y_train, y_test = self._split(X, y)
+        brackets = self._make_brackets()
+
+        async def run_all():
+            coros = [
+                sha._fit(X_train, y_train, X_test, y_test, **fit_params)
+                for _, sha in brackets
+            ]
+            return await asyncio.gather(*coros)
+
+        results = asyncio.run(run_all())
+
+        # merge results across brackets with globally unique model ids
+        all_models, all_info = {}, {}
+        meta_observed = []
+        offset = 0
+        for (s, sha), (models, info) in zip(brackets, results):
+            meta_observed.append(
+                {
+                    "bracket": s,
+                    "n_models": len(info),
+                    "partial_fit_calls": sum(
+                        recs[-1]["partial_fit_calls"] for recs in info.values()
+                    ),
+                }
+            )
+            for ident, recs in info.items():
+                new_id = offset + ident
+                all_info[new_id] = [
+                    {**rec, "model_id": new_id, "bracket": s} for rec in recs
+                ]
+                all_models[new_id] = models[ident]
+            offset += len(info)
+
+        self._process_results(all_models, all_info)
+        self.metadata_ = {
+            "n_models": sum(m["n_models"] for m in meta_observed),
+            "partial_fit_calls": sum(m["partial_fit_calls"] for m in meta_observed),
+            "brackets": meta_observed,
+        }
+        return self

@@ -1,0 +1,322 @@
+"""Incremental (adaptive) search core.
+
+Reference: ``dask_ml/model_selection/_incremental.py`` — the dynamic
+futures plane (SURVEY.md §1 style 2, §3.3): an async loop scatters data
+blocks, submits per-model ``partial_fit`` (one block per call — the unit of
+training budget) and ``score`` tasks, and a pluggable
+``additional_calls(info) -> {model_id: n_more_calls}`` policy decides at
+runtime what trains next, until it returns ``{}``.
+
+TPU design: the control plane survives as a host asyncio loop (the policy
+logic is identical); the data plane changes — blocks are row chunks of a
+host/ sharded array, models train in-process (sklearn ``partial_fit`` on
+host, or device-native estimators whose step is a jitted program).  JAX's
+async dispatch pipelines the device models without extra machinery.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import defaultdict
+
+import numpy as np
+
+from ..base import TPUEstimator, clone
+from ..core.sharded import ShardedRows, unshard
+from ..metrics.scorer import check_scoring
+from ..utils import check_random_state
+from ._split import train_test_split
+
+logger = logging.getLogger(__name__)
+
+
+def _partial_fit(model_and_meta, X, y, fit_params):
+    """One unit of budget: partial_fit on ONE block (reference
+    ``_incremental.py :: _partial_fit``)."""
+    model, meta = model_and_meta
+    start = time.time()
+    model.partial_fit(X, y, **(fit_params or {}))
+    meta = dict(meta)
+    meta["partial_fit_calls"] += 1
+    meta["partial_fit_time"] = time.time() - start
+    return model, meta
+
+
+def _score(model_and_meta, X_test, y_test, scorer):
+    model, meta = model_and_meta
+    start = time.time()
+    score = scorer(model, X_test, y_test)
+    meta = dict(meta)
+    meta["score_time"] = time.time() - start
+    meta["score"] = float(score)
+    return meta
+
+
+def _create_model(estimator, params, random_state):
+    model = clone(estimator).set_params(**params)
+    if "random_state" in model.get_params():
+        model.set_params(random_state=random_state)
+    return model
+
+
+class BaseIncrementalSearchCV(TPUEstimator):
+    """Adaptive search over partial_fit estimators.
+
+    Subclasses supply ``_additional_calls(info)``; ``info`` maps model_id →
+    list of records (dicts with ``partial_fit_calls``, ``score``, …).
+    """
+
+    def __init__(self, estimator, parameters, n_initial_parameters=10,
+                 test_size=None, random_state=None, scoring=None,
+                 max_iter=100, patience=False, tol=1e-3, fits_per_score=1,
+                 verbose=False, prefix="", chunk_size=None):
+        self.estimator = estimator
+        self.parameters = parameters
+        self.n_initial_parameters = n_initial_parameters
+        self.test_size = test_size
+        self.random_state = random_state
+        self.scoring = scoring
+        self.max_iter = max_iter
+        self.patience = patience
+        self.tol = tol
+        self.fits_per_score = fits_per_score
+        self.verbose = verbose
+        self.prefix = prefix
+        self.chunk_size = chunk_size
+
+    # -- policy hooks --------------------------------------------------
+    def _additional_calls(self, info):
+        raise NotImplementedError
+
+    def _reset_policy(self):
+        """Clear per-fit mutable policy state (re-fit safety)."""
+
+    # -- parameter sampling -------------------------------------------
+    def _get_params(self):
+        from sklearn.model_selection import ParameterSampler
+
+        rng = check_random_state(self.random_state)
+        if self.n_initial_parameters == "grid":
+            from sklearn.model_selection import ParameterGrid
+
+            return list(ParameterGrid(self.parameters))
+        return list(
+            ParameterSampler(
+                self.parameters, self.n_initial_parameters,
+                random_state=rng,
+            )
+        )
+
+    # -- data plumbing -------------------------------------------------
+    def _to_blocks(self, X, y):
+        Xh = unshard(X) if isinstance(X, ShardedRows) else np.asarray(X)
+        yh = unshard(y) if isinstance(y, ShardedRows) else np.asarray(y)
+        n = Xh.shape[0]
+        chunk = self.chunk_size or max(1, n // 10)
+        blocks = [
+            (Xh[lo: lo + chunk], yh[lo: lo + chunk])
+            for lo in range(0, n, chunk)
+        ]
+        return blocks
+
+    async def _fit(self, X_train, y_train, X_test, y_test, **fit_params):
+        self._reset_policy()
+        scorer = check_scoring(self.estimator, self.scoring)
+        params = self._get_params()
+        rng = check_random_state(self.random_state)
+        seeds = rng.randint(0, 2 ** 31 - 1, size=len(params))
+        blocks = self._to_blocks(X_train, y_train)
+        n_blocks = len(blocks)
+
+        models = {}
+        info = defaultdict(list)
+        start_time = time.time()
+        for ident, (p, seed) in enumerate(zip(params, seeds)):
+            model = _create_model(self.estimator, p, int(seed))
+            meta = {
+                "model_id": ident,
+                "params": p,
+                "partial_fit_calls": 0,
+                "partial_fit_time": 0.0,
+                "score_time": 0.0,
+                "elapsed_wall_time": 0.0,
+            }
+            models[ident] = (model, meta)
+
+        def train_one(ident, n_calls):
+            model, meta = models[ident]
+            for _ in range(n_calls):
+                block_idx = meta["partial_fit_calls"] % n_blocks
+                Xb, yb = blocks[block_idx]
+                model, meta = _partial_fit((model, meta), Xb, yb, fit_params)
+            meta = _score((model, meta), X_test, y_test, scorer)
+            meta["elapsed_wall_time"] = time.time() - start_time
+            models[ident] = (model, meta)
+            info[ident].append(meta)
+            return meta
+
+        # initial round: one call each
+        for ident in list(models):
+            train_one(ident, 1)
+            await asyncio.sleep(0)  # cooperative yield (multi-bracket interleave)
+
+        # adaptive loop — an EMPTY dict stops the search; zero-valued
+        # instructions keep a model alive without training (the policy's
+        # internal step counter advances, reference semantics)
+        while True:
+            instructions = self._additional_calls(dict(info))
+            if not instructions:
+                break
+            for ident, n_calls in instructions.items():
+                if n_calls > 0:
+                    train_one(ident, n_calls)
+                    await asyncio.sleep(0)
+
+        return models, dict(info)
+
+    def _process_results(self, models, info):
+        best_id = max(
+            info, key=lambda ident: info[ident][-1]["score"]
+        )
+        best_model, best_meta = models[best_id]
+        self.best_estimator_ = best_model
+        self.best_index_ = int(best_id)
+        self.best_score_ = best_meta["score"]
+        self.best_params_ = best_meta["params"]
+
+        self.history_ = sorted(
+            (rec for recs in info.values() for rec in recs),
+            key=lambda r: (r["elapsed_wall_time"], r["model_id"]),
+        )
+        self.model_history_ = {k: list(v) for k, v in info.items()}
+
+        cv_results = {
+            "model_id": [], "params": [], "test_score": [],
+            "partial_fit_calls": [],
+        }
+        for ident, recs in sorted(info.items()):
+            last = recs[-1]
+            cv_results["model_id"].append(ident)
+            cv_results["params"].append(last["params"])
+            cv_results["test_score"].append(last["score"])
+            cv_results["partial_fit_calls"].append(last["partial_fit_calls"])
+        keys = {k for rec in cv_results["params"] for k in rec}
+        for k in sorted(keys):
+            cv_results[f"param_{k}"] = [p.get(k) for p in cv_results["params"]]
+        ranks = np.argsort(np.argsort(-np.asarray(cv_results["test_score"]))) + 1
+        cv_results["rank_test_score"] = ranks.tolist()
+        self.cv_results_ = cv_results
+        self.n_models_ = len(info)
+        return self
+
+    def fit(self, X, y=None, **fit_params):
+        X_train, X_test, y_train, y_test = self._split(X, y)
+        models, info = asyncio.run(
+            self._fit(X_train, y_train, X_test, y_test, **fit_params)
+        )
+        return self._process_results(models, info)
+
+    def _split(self, X, y):
+        if y is None:
+            raise ValueError(
+                "y is required: incremental searches score models on a "
+                "held-out (X_test, y_test) split"
+            )
+        test_size = self.test_size if self.test_size is not None else 0.15
+        X_train, X_test, y_train, y_test = train_test_split(
+            X, y, test_size=test_size, random_state=self.random_state
+        )
+        X_test = unshard(X_test) if isinstance(X_test, ShardedRows) else X_test
+        y_test = unshard(y_test) if isinstance(y_test, ShardedRows) else y_test
+        return X_train, X_test, y_train, y_test
+
+    # -- inference forwards to the winner ------------------------------
+    def predict(self, X):
+        return self.best_estimator_.predict(
+            unshard(X) if isinstance(X, ShardedRows) else X
+        )
+
+    def predict_proba(self, X):
+        return self.best_estimator_.predict_proba(
+            unshard(X) if isinstance(X, ShardedRows) else X
+        )
+
+    def transform(self, X):
+        return self.best_estimator_.transform(
+            unshard(X) if isinstance(X, ShardedRows) else X
+        )
+
+    def score(self, X, y=None):
+        scorer = check_scoring(self.estimator, self.scoring)
+        return scorer(
+            self.best_estimator_,
+            unshard(X) if isinstance(X, ShardedRows) else X,
+            unshard(y) if isinstance(y, ShardedRows) else y,
+        )
+
+
+class IncrementalSearchCV(BaseIncrementalSearchCV):
+    """Train many models incrementally; stop each when its score plateaus.
+
+    Reference: ``_incremental.py :: IncrementalSearchCV`` (``patience``,
+    ``tol``, ``max_iter``, ``fits_per_score``); with ``patience`` False the
+    policy trains every model to ``max_iter``.
+    """
+
+    def _additional_calls(self, info):
+        out = {}
+        for ident, recs in info.items():
+            calls = recs[-1]["partial_fit_calls"]
+            if calls >= self.max_iter:
+                continue
+            if self.patience:
+                patience = int(self.patience)
+                scores = [r["score"] for r in recs]
+                back = max(1, patience // max(self.fits_per_score, 1))
+                if len(scores) > back:
+                    old = scores[-back - 1]
+                    if all(s < old + self.tol for s in scores[-back:]):
+                        continue  # plateaued
+            out[ident] = min(self.fits_per_score, self.max_iter - calls)
+        return out
+
+
+class InverseDecaySearchCV(BaseIncrementalSearchCV):
+    """Keep n_models ∝ 1/(1+k) of the initial population each round.
+
+    Reference: ``_incremental.py :: InverseDecaySearchCV`` (decay_rate).
+    """
+
+    def __init__(self, estimator, parameters, n_initial_parameters=10,
+                 test_size=None, random_state=None, scoring=None,
+                 max_iter=100, patience=False, tol=1e-3, fits_per_score=1,
+                 decay_rate=1.0, verbose=False, prefix="", chunk_size=None):
+        self.decay_rate = decay_rate
+        super().__init__(
+            estimator, parameters,
+            n_initial_parameters=n_initial_parameters, test_size=test_size,
+            random_state=random_state, scoring=scoring, max_iter=max_iter,
+            patience=patience, tol=tol, fits_per_score=fits_per_score,
+            verbose=verbose, prefix=prefix, chunk_size=chunk_size,
+        )
+        self._step = 1
+
+    def _reset_policy(self):
+        self._step = 1
+
+    def _additional_calls(self, info):
+        n_initial = len(info)
+        keep = max(1, int(np.ceil(n_initial / (1 + self._step) ** self.decay_rate)))
+        by_score = sorted(
+            info, key=lambda ident: info[ident][-1]["score"], reverse=True
+        )
+        survivors = by_score[:keep]
+        self._step += 1
+        out = {}
+        for ident in survivors:
+            calls = info[ident][-1]["partial_fit_calls"]
+            if calls < self.max_iter:
+                out[ident] = min(self.fits_per_score, self.max_iter - calls)
+        return out

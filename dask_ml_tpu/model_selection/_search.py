@@ -1,0 +1,234 @@
+"""Drop-in CV search — twin of ``dask_ml/model_selection/_search.py``
+(``GridSearchCV``, ``RandomizedSearchCV``; SURVEY.md §2 #21).
+
+The reference's signature trick is a merged task graph keyed by
+``tokenize(est, params, data, split)`` so shared pipeline prefixes are fit
+once.  Here the equivalent is a host-side **fit cache** keyed the same way:
+for ``Pipeline`` candidates, prefix steps whose (step params, data split)
+repeat across candidates are fit/transformed once and reused; the per-
+candidate math itself runs on device through the estimators.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+
+import numpy as np
+
+from ..base import TPUEstimator, clone
+from ..core.sharded import ShardedRows, unshard
+from ..metrics.scorer import check_scoring
+from ..utils import check_random_state
+from ._split import KFold
+
+logger = logging.getLogger(__name__)
+
+
+def _host(a):
+    return unshard(a) if isinstance(a, ShardedRows) else a
+
+
+class _CacheKey:
+    """Token for (estimator-class, params, fold) — the host analogue of the
+    reference's ``tokenize`` dedup key (``_search.py :: build_graph``)."""
+
+    @staticmethod
+    def make(step, params, fold_idx):
+        items = tuple(sorted((k, repr(v)) for k, v in params.items()))
+        return (type(step).__name__, items, fold_idx)
+
+
+class _BaseSearchCV(TPUEstimator):
+    def __init__(self, estimator, scoring=None, cv=None, refit=True,
+                 error_score="raise", return_train_score=False,
+                 scheduler=None, n_jobs=-1, cache_cv=True):
+        self.estimator = estimator
+        self.scoring = scoring
+        self.cv = cv
+        self.refit = refit
+        self.error_score = error_score
+        self.return_train_score = return_train_score
+        self.scheduler = scheduler
+        self.n_jobs = n_jobs
+        self.cache_cv = cache_cv
+
+    def _get_param_iterator(self):
+        raise NotImplementedError
+
+    def _resolve_cv(self):
+        cv = self.cv
+        if cv is None:
+            return KFold(n_splits=5)
+        if isinstance(cv, int):
+            return KFold(n_splits=cv)
+        return cv
+
+    def fit(self, X, y=None, **fit_params):
+        Xh, yh = _host(X), _host(y) if y is not None else None
+        candidates = list(self._get_param_iterator())
+        if not candidates:
+            raise ValueError("No candidate parameters")
+        cv = self._resolve_cv()
+        splits = list(cv.split(Xh, yh))
+        scorer = check_scoring(self.estimator, self.scoring)
+
+        # prefix-transform cache: (pipeline prefix token) -> transformed data
+        prefix_cache = {}
+
+        n_cand = len(candidates)
+        test_scores = np.zeros((n_cand, len(splits)))
+        train_scores = np.zeros((n_cand, len(splits))) if self.return_train_score else None
+        fit_failed = np.zeros(n_cand, dtype=bool)
+
+        for ci, params in enumerate(candidates):
+            for fi, (train_idx, test_idx) in enumerate(splits):
+                Xtr, ytr = Xh[train_idx], (yh[train_idx] if yh is not None else None)
+                Xte, yte = Xh[test_idx], (yh[test_idx] if yh is not None else None)
+                try:
+                    est = self._fit_candidate(
+                        params, Xtr, ytr, fi, prefix_cache, fit_params
+                    )
+                    test_scores[ci, fi] = scorer(est, Xte, yte)
+                    if self.return_train_score:
+                        train_scores[ci, fi] = scorer(est, Xtr, ytr)
+                except Exception:
+                    if self.error_score == "raise":
+                        raise
+                    test_scores[ci, fi] = float(self.error_score)
+                    fit_failed[ci] = True
+
+        self._build_results(candidates, splits, test_scores, train_scores)
+        if self.refit:
+            best = clone(self.estimator).set_params(**self.best_params_)
+            if yh is not None:
+                best.fit(Xh, yh, **fit_params)
+            else:
+                best.fit(Xh, **fit_params)
+            self.best_estimator_ = best
+        return self
+
+    def _fit_candidate(self, params, Xtr, ytr, fold_idx, prefix_cache, fit_params):
+        from sklearn.pipeline import Pipeline
+
+        est = clone(self.estimator).set_params(**params)
+        if not (self.cache_cv and isinstance(est, Pipeline)):
+            if ytr is not None:
+                est.fit(Xtr, ytr, **fit_params)
+            else:
+                est.fit(Xtr, **fit_params)
+            return est
+
+        # pipeline-prefix caching: walk steps; reuse cached fitted
+        # transformers + transformed data while the prefix key matches
+        steps = est.steps
+        data = Xtr
+        fitted_steps = []
+        prefix_tokens = []
+        for name, step in steps[:-1]:
+            step_params = step.get_params()
+            prefix_tokens.append(_CacheKey.make(step, step_params, fold_idx))
+            token = tuple(prefix_tokens)
+            if token in prefix_cache:
+                fitted_step, data = prefix_cache[token]
+            else:
+                fitted_step = clone(step)
+                data = fitted_step.fit_transform(data, ytr)
+                prefix_cache[token] = (fitted_step, data)
+            fitted_steps.append((name, fitted_step))
+        final_name, final = steps[-1]
+        final = clone(final)
+        if ytr is not None:
+            final.fit(data, ytr, **fit_params)
+        else:
+            final.fit(data, **fit_params)
+        fitted_steps.append((final_name, final))
+        est.steps = fitted_steps
+        return est
+
+    def _build_results(self, candidates, splits, test_scores, train_scores):
+        mean_test = test_scores.mean(axis=1)
+        std_test = test_scores.std(axis=1)
+        ranks = np.argsort(np.argsort(-mean_test)) + 1
+        cv_results = {
+            "params": candidates,
+            "mean_test_score": mean_test.tolist(),
+            "std_test_score": std_test.tolist(),
+            "rank_test_score": ranks.tolist(),
+        }
+        for fi in range(len(splits)):
+            cv_results[f"split{fi}_test_score"] = test_scores[:, fi].tolist()
+        if train_scores is not None:
+            cv_results["mean_train_score"] = train_scores.mean(axis=1).tolist()
+            for fi in range(len(splits)):
+                cv_results[f"split{fi}_train_score"] = train_scores[:, fi].tolist()
+        keys = {k for p in candidates for k in p}
+        for k in sorted(keys):
+            cv_results[f"param_{k}"] = [p.get(k) for p in candidates]
+        self.cv_results_ = cv_results
+        self.best_index_ = int(np.argmax(mean_test))
+        self.best_score_ = float(mean_test[self.best_index_])
+        self.best_params_ = candidates[self.best_index_]
+        self.n_splits_ = len(splits)
+
+    # -- post-fit API --------------------------------------------------
+    def _check_refit(self, method):
+        if not self.refit:
+            raise AttributeError(f"{method} requires refit=True")
+
+    def predict(self, X):
+        self._check_refit("predict")
+        return self.best_estimator_.predict(_host(X))
+
+    def predict_proba(self, X):
+        self._check_refit("predict_proba")
+        return self.best_estimator_.predict_proba(_host(X))
+
+    def transform(self, X):
+        self._check_refit("transform")
+        return self.best_estimator_.transform(_host(X))
+
+    def score(self, X, y=None):
+        self._check_refit("score")
+        scorer = check_scoring(self.estimator, self.scoring)
+        return scorer(self.best_estimator_, _host(X), _host(y))
+
+
+class GridSearchCV(_BaseSearchCV):
+    def __init__(self, estimator, param_grid, scoring=None, cv=None,
+                 refit=True, error_score="raise", return_train_score=False,
+                 scheduler=None, n_jobs=-1, cache_cv=True):
+        self.param_grid = param_grid
+        super().__init__(
+            estimator, scoring=scoring, cv=cv, refit=refit,
+            error_score=error_score, return_train_score=return_train_score,
+            scheduler=scheduler, n_jobs=n_jobs, cache_cv=cache_cv,
+        )
+
+    def _get_param_iterator(self):
+        from sklearn.model_selection import ParameterGrid
+
+        return ParameterGrid(self.param_grid)
+
+
+class RandomizedSearchCV(_BaseSearchCV):
+    def __init__(self, estimator, param_distributions, n_iter=10,
+                 random_state=None, scoring=None, cv=None, refit=True,
+                 error_score="raise", return_train_score=False,
+                 scheduler=None, n_jobs=-1, cache_cv=True):
+        self.param_distributions = param_distributions
+        self.n_iter = n_iter
+        self.random_state = random_state
+        super().__init__(
+            estimator, scoring=scoring, cv=cv, refit=refit,
+            error_score=error_score, return_train_score=return_train_score,
+            scheduler=scheduler, n_jobs=n_jobs, cache_cv=cache_cv,
+        )
+
+    def _get_param_iterator(self):
+        from sklearn.model_selection import ParameterSampler
+
+        return ParameterSampler(
+            self.param_distributions, self.n_iter,
+            random_state=check_random_state(self.random_state),
+        )

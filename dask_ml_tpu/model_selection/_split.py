@@ -1,0 +1,134 @@
+"""Splitters — twin of ``dask_ml/model_selection/_split.py``
+(``train_test_split``, ``ShuffleSplit``, ``KFold``; SURVEY.md §2 #25).
+
+The reference splits blockwise (per-chunk shuffles, contiguous slabs).
+Here splits are index-based on the host (indices are O(n) ints) and the
+selected rows are gathered device-side, so a split of a sharded array
+yields sharded arrays without materializing X on the host.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.mesh import get_mesh
+from ..core.sharded import ShardedRows, shard_rows
+from ..utils import check_random_state
+
+
+def _n_samples(a):
+    return a.n_samples if isinstance(a, ShardedRows) else np.asarray(a).shape[0]
+
+
+def _take(a, idx):
+    """Row-subset of an array-like; sharded in → sharded out."""
+    if isinstance(a, ShardedRows):
+        taken = jnp.take(a.data, jnp.asarray(idx), axis=0)
+        return shard_rows(np.asarray(taken), get_mesh())
+    return np.asarray(a)[idx]
+
+
+def _as_count(v, n):
+    """Float in (0, 1] → fraction of n; int → absolute count (sklearn rule)."""
+    if isinstance(v, float) and v <= 1.0:
+        return int(round(v * n))
+    return int(v)
+
+
+def _resolve_sizes(n, train_size, test_size):
+    if train_size is None and test_size is None:
+        test_size = 0.25
+    if test_size is None:
+        n_test = n - _as_count(train_size, n)
+    else:
+        n_test = _as_count(test_size, n)
+    if train_size is None:
+        n_train = n - n_test
+    else:
+        n_train = _as_count(train_size, n)
+    if n_train + n_test > n:
+        raise ValueError(
+            f"train_size + test_size = {n_train + n_test} > n_samples = {n}"
+        )
+    if n_train <= 0 or n_test <= 0:
+        raise ValueError(f"Degenerate split: n_train={n_train}, n_test={n_test}")
+    return n_train, n_test
+
+
+class ShuffleSplit:
+    """Random permutation splits (reference: per-block shuffle)."""
+
+    def __init__(self, n_splits=10, test_size=None, train_size=None,
+                 blockwise=True, random_state=None):
+        self.n_splits = n_splits
+        self.test_size = test_size
+        self.train_size = train_size
+        self.blockwise = blockwise
+        self.random_state = random_state
+
+    def split(self, X, y=None, groups=None):
+        n = _n_samples(X)
+        n_train, n_test = _resolve_sizes(n, self.train_size, self.test_size)
+        rng = check_random_state(self.random_state)
+        for _ in range(self.n_splits):
+            perm = rng.permutation(n)
+            yield np.sort(perm[:n_train]), np.sort(perm[n_train:n_train + n_test])
+
+    def get_n_splits(self, X=None, y=None, groups=None):
+        return self.n_splits
+
+
+class KFold:
+    """Contiguous-slab K folds (reference semantics)."""
+
+    def __init__(self, n_splits=5, shuffle=False, random_state=None):
+        self.n_splits = n_splits
+        self.shuffle = shuffle
+        self.random_state = random_state
+
+    def split(self, X, y=None, groups=None):
+        n = _n_samples(X)
+        if self.n_splits < 2:
+            raise ValueError("n_splits must be >= 2")
+        if self.n_splits > n:
+            raise ValueError(f"n_splits={self.n_splits} > n_samples={n}")
+        idx = np.arange(n)
+        if self.shuffle:
+            check_random_state(self.random_state).shuffle(idx)
+        bounds = np.linspace(0, n, self.n_splits + 1, dtype=int)
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            test = idx[lo:hi]
+            train = np.concatenate([idx[:lo], idx[hi:]])
+            yield np.sort(train), np.sort(test)
+
+    def get_n_splits(self, X=None, y=None, groups=None):
+        return self.n_splits
+
+
+def train_test_split(*arrays, test_size=None, train_size=None, random_state=None,
+                     shuffle=True, blockwise=True, **options):
+    """Split each array into train/test (reference ``train_test_split``)."""
+    if not arrays:
+        raise ValueError("At least one array required")
+    if options:
+        raise TypeError(f"Unexpected kwargs: {sorted(options)}")
+    n = _n_samples(arrays[0])
+    for a in arrays[1:]:
+        if _n_samples(a) != n:
+            raise ValueError("All arrays must have the same length")
+    n_train, n_test = _resolve_sizes(n, train_size, test_size)
+    if shuffle:
+        rng = check_random_state(random_state)
+        perm = rng.permutation(n)
+        train_idx = np.sort(perm[:n_train])
+        test_idx = np.sort(perm[n_train:n_train + n_test])
+    else:
+        train_idx = np.arange(n_train)
+        test_idx = np.arange(n_train, n_train + n_test)
+    out = []
+    for a in arrays:
+        out.append(_take(a, train_idx))
+        out.append(_take(a, test_idx))
+    return out

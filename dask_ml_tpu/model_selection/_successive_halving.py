@@ -1,0 +1,82 @@
+"""SuccessiveHalvingSearchCV.
+
+Reference: ``dask_ml/model_selection/_successive_halving.py`` — a
+``BaseIncrementalSearchCV`` whose policy implements SHA: train n configs r
+steps, keep the top 1/η, grow each survivor's budget ×η.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ._incremental import BaseIncrementalSearchCV
+
+
+class SuccessiveHalvingSearchCV(BaseIncrementalSearchCV):
+    def __init__(self, estimator, parameters, n_initial_parameters=10,
+                 n_initial_iter=None, max_iter=None, aggressiveness=3,
+                 test_size=None, random_state=None, scoring=None,
+                 patience=False, tol=1e-3, verbose=False, prefix="",
+                 chunk_size=None):
+        self.n_initial_iter = n_initial_iter
+        self.aggressiveness = aggressiveness
+        self._steps = 0
+        self._survivors = None
+        super().__init__(
+            estimator, parameters,
+            n_initial_parameters=n_initial_parameters, test_size=test_size,
+            random_state=random_state, scoring=scoring,
+            max_iter=max_iter if max_iter is not None else 100,
+            patience=patience, tol=tol, verbose=verbose, prefix=prefix,
+            chunk_size=chunk_size,
+        )
+
+    def _reset_policy(self):
+        self._steps = 0
+        self._survivors = None
+
+    def _additional_calls(self, info):
+        if self.n_initial_iter is None:
+            raise ValueError("n_initial_iter must be specified")
+        # n = models actually created (supports n_initial_parameters="grid")
+        n, r, eta = len(info), self.n_initial_iter, self.aggressiveness
+        n_i = int(math.floor(n * eta ** -self._steps))
+        r_i = int(round(r * eta ** self._steps))
+        self._steps += 1
+
+        # rank only models still in the running — once halved out, a model
+        # stays out (keeps the schedule deterministic so metadata_ ==
+        # metadata regardless of score trajectories)
+        pool = self._survivors if getattr(self, "_survivors", None) is not None else list(info)
+        best = sorted(
+            pool, key=lambda ident: info[ident][-1]["score"], reverse=True
+        )[: max(n_i, 1)]
+        self._survivors = best
+
+        if len(best) in (0, 1) and self._steps > 1:
+            # final survivor: grant the remaining budget, then stop (an
+            # empty dict) once it is reached
+            out = {}
+            for ident in best:
+                target = min(r_i, self.max_iter) if self.max_iter else r_i
+                more = max(0, target - info[ident][-1]["partial_fit_calls"])
+                if more:
+                    out[ident] = more
+            return out
+        out = {}
+        any_progress = False
+        capped = True
+        for ident in best:
+            calls = info[ident][-1]["partial_fit_calls"]
+            target = r_i
+            if self.max_iter:
+                target = min(target, self.max_iter)
+                capped = capped and target >= self.max_iter
+            else:
+                capped = False
+            more = max(0, target - calls)
+            out[ident] = more
+            any_progress = any_progress or more > 0
+        if not any_progress and capped:
+            return {}  # every survivor already at the max_iter budget
+        return out

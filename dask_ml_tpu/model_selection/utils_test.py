@@ -1,0 +1,54 @@
+"""Deterministic toy models for search-policy tests.
+
+Reference: ``dask_ml/model_selection/utils_test.py`` (``ConstantFunction``
+et al.) — fake estimators whose score is a known function of
+``partial_fit_calls`` so SHA/Hyperband *schedules* can be asserted exactly,
+decoupled from ML stochasticity (SURVEY.md §4.4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sklearn.base import BaseEstimator
+
+
+class ConstantFunction(BaseEstimator):
+    """score == value, forever; partial_fit only counts calls."""
+
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def partial_fit(self, X, y=None, **kwargs):
+        self._pf_calls = getattr(self, "_pf_calls", 0) + 1
+        return self
+
+    def fit(self, X, y=None, **kwargs):
+        return self.partial_fit(X, y)
+
+    def score(self, X, y=None):
+        return self.value
+
+    def predict(self, X):
+        return np.zeros(len(X))
+
+
+class LinearFunction(BaseEstimator):
+    """score = intercept + slope * partial_fit_calls (monotone learner)."""
+
+    def __init__(self, intercept=0.0, slope=1.0):
+        self.intercept = intercept
+        self.slope = slope
+
+    def partial_fit(self, X, y=None, **kwargs):
+        self._pf_calls = getattr(self, "_pf_calls", 0) + 1
+        return self
+
+    def fit(self, X, y=None, **kwargs):
+        return self.partial_fit(X, y)
+
+    def score(self, X, y=None):
+        return self.intercept + self.slope * getattr(self, "_pf_calls", 0)
+
+    def predict(self, X):
+        return np.zeros(len(X))

@@ -1,0 +1,322 @@
+import numpy as np
+import pytest
+from sklearn.linear_model import SGDClassifier
+
+import dask_ml_tpu.model_selection as dms
+from dask_ml_tpu.core import shard_rows, unshard
+from dask_ml_tpu.core.sharded import ShardedRows
+from dask_ml_tpu.model_selection.utils_test import ConstantFunction, LinearFunction
+
+
+@pytest.fixture
+def clf_data(rng):
+    n, d = 300, 5
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d)
+    y = (X @ w > 0).astype(np.int64)
+    return X, y
+
+
+class TestSplit:
+    def test_train_test_split_sizes(self, clf_data):
+        X, y = clf_data
+        Xtr, Xte, ytr, yte = dms.train_test_split(X, y, test_size=0.2, random_state=0)
+        assert Xtr.shape == (240, 5) and Xte.shape == (60, 5)
+        assert ytr.shape == (240,) and yte.shape == (60,)
+
+    def test_split_no_overlap_covers_all(self, clf_data):
+        X, _ = clf_data
+        Xi = np.arange(300)
+        tr, te = dms.train_test_split(Xi, test_size=0.25, random_state=1)
+        assert len(set(tr) & set(te)) == 0
+        assert len(set(tr) | set(te)) == 300
+
+    def test_sharded_in_sharded_out(self, clf_data):
+        X, y = clf_data
+        s = shard_rows(X)
+        Xtr, Xte = dms.train_test_split(s, test_size=0.2, random_state=0)
+        assert isinstance(Xtr, ShardedRows) and isinstance(Xte, ShardedRows)
+        assert Xtr.n_samples == 240 and Xte.n_samples == 60
+
+    def test_no_shuffle_contiguous(self):
+        X = np.arange(100).reshape(100, 1)
+        Xtr, Xte = dms.train_test_split(X, test_size=0.2, shuffle=False)
+        np.testing.assert_array_equal(Xtr[:, 0], np.arange(80))
+        np.testing.assert_array_equal(Xte[:, 0], np.arange(80, 100))
+
+    def test_mismatched_lengths_raise(self):
+        with pytest.raises(ValueError, match="same length"):
+            dms.train_test_split(np.ones(10), np.ones(11))
+
+    def test_kfold_contiguous_slabs(self):
+        X = np.zeros((100, 2))
+        folds = list(dms.KFold(n_splits=5).split(X))
+        assert len(folds) == 5
+        np.testing.assert_array_equal(folds[0][1], np.arange(20))
+        for train, test in folds:
+            assert len(train) == 80 and len(test) == 20
+            assert len(set(train) & set(test)) == 0
+
+    def test_kfold_validates(self):
+        with pytest.raises(ValueError, match="n_splits"):
+            list(dms.KFold(n_splits=1).split(np.zeros((10, 1))))
+
+    def test_shuffle_split_deterministic(self):
+        X = np.zeros((50, 2))
+        a = list(dms.ShuffleSplit(n_splits=3, random_state=7).split(X))
+        b = list(dms.ShuffleSplit(n_splits=3, random_state=7).split(X))
+        for (tr1, te1), (tr2, te2) in zip(a, b):
+            np.testing.assert_array_equal(tr1, tr2)
+            np.testing.assert_array_equal(te1, te2)
+
+
+class TestGridSearchCV:
+    def test_parity_with_sklearn(self, clf_data):
+        import sklearn.model_selection as sms
+
+        X, y = clf_data
+        param_grid = {"alpha": [1e-4, 1e-2, 1.0]}
+        est = SGDClassifier(tol=1e-3, random_state=0)
+        ours = dms.GridSearchCV(est, param_grid, cv=3).fit(X, y)
+        theirs = sms.GridSearchCV(est, param_grid, cv=3).fit(X, y)
+        assert ours.best_params_ == theirs.best_params_
+        assert set(ours.cv_results_["param_alpha"]) == set(
+            theirs.cv_results_["param_alpha"]
+        )
+
+    def test_best_estimator_refit(self, clf_data):
+        X, y = clf_data
+        gs = dms.GridSearchCV(
+            SGDClassifier(tol=1e-3, random_state=0), {"alpha": [1e-4, 1.0]}, cv=3
+        ).fit(X, y)
+        assert hasattr(gs, "best_estimator_")
+        assert gs.predict(X).shape == (300,)
+        assert gs.score(X, y) > 0.5
+
+    def test_refit_false_blocks_predict(self, clf_data):
+        X, y = clf_data
+        gs = dms.GridSearchCV(
+            SGDClassifier(tol=1e-3), {"alpha": [1e-4]}, cv=3, refit=False
+        ).fit(X, y)
+        with pytest.raises(AttributeError, match="refit"):
+            gs.predict(X)
+
+    def test_pipeline_prefix_cache(self, clf_data):
+        from sklearn.pipeline import Pipeline
+        from sklearn.preprocessing import StandardScaler
+
+        X, y = clf_data
+        calls = {"n": 0}
+
+        class CountingScaler(StandardScaler):
+            def fit_transform(self, X, y=None, **kw):
+                calls["n"] += 1
+                return super().fit_transform(X, y, **kw)
+
+        pipe = Pipeline([("sc", CountingScaler()), ("clf", SGDClassifier(tol=1e-3, random_state=0))])
+        gs = dms.GridSearchCV(
+            pipe, {"clf__alpha": [1e-4, 1e-3, 1e-2]}, cv=3, refit=False
+        ).fit(X, y)
+        # shared scaler prefix must be fit once per fold, not per candidate
+        # (3 folds x 3 candidates would be 9 without the cache)
+        assert calls["n"] == 3
+        assert gs.best_score_ > 0.5
+
+    def test_sharded_input(self, clf_data):
+        X, y = clf_data
+        gs = dms.GridSearchCV(
+            SGDClassifier(tol=1e-3, random_state=0), {"alpha": [1e-4, 1.0]}, cv=3
+        ).fit(shard_rows(X), shard_rows(y))
+        assert gs.best_score_ > 0.5
+
+    def test_randomized_search(self, clf_data):
+        X, y = clf_data
+        rs = dms.RandomizedSearchCV(
+            SGDClassifier(tol=1e-3, random_state=0),
+            {"alpha": np.logspace(-5, 0, 20)}, n_iter=4, random_state=0, cv=3,
+        ).fit(X, y)
+        assert len(rs.cv_results_["params"]) == 4
+
+
+class TestIncrementalSearchCV:
+    def test_trains_to_max_iter_without_patience(self, clf_data):
+        X, y = clf_data
+        search = dms.IncrementalSearchCV(
+            ConstantFunction(), {"value": [0.1, 0.5, 0.9]},
+            n_initial_parameters="grid", max_iter=5, chunk_size=50,
+        )
+        search.fit(X, y)
+        assert search.best_score_ == 0.9
+        # every model trained exactly max_iter calls
+        assert all(
+            recs[-1]["partial_fit_calls"] == 5
+            for recs in search.model_history_.values()
+        )
+
+    def test_patience_stops_plateaued_models(self, clf_data):
+        X, y = clf_data
+        search = dms.IncrementalSearchCV(
+            ConstantFunction(), {"value": [0.2, 0.8]},
+            n_initial_parameters="grid", max_iter=50, patience=3, tol=1e-3,
+            chunk_size=50,
+        )
+        search.fit(X, y)
+        # constant scores plateau immediately -> far fewer than max_iter calls
+        assert all(
+            recs[-1]["partial_fit_calls"] < 50
+            for recs in search.model_history_.values()
+        )
+
+    def test_history_records_structure(self, clf_data):
+        X, y = clf_data
+        search = dms.IncrementalSearchCV(
+            LinearFunction(), {"slope": [1.0, 2.0]},
+            n_initial_parameters="grid", max_iter=3, chunk_size=50,
+        ).fit(X, y)
+        rec = search.history_[0]
+        for key in ("model_id", "params", "partial_fit_calls", "score",
+                    "elapsed_wall_time"):
+            assert key in rec
+        assert search.cv_results_["rank_test_score"][search.best_index_] == 1
+
+    def test_real_sgd_improves(self, clf_data):
+        X, y = clf_data
+        search = dms.IncrementalSearchCV(
+            SGDClassifier(tol=None, random_state=0),
+            {"alpha": [1e-4, 1e-3]}, n_initial_parameters="grid",
+            max_iter=10, chunk_size=50,
+        )
+        search.fit(X, y, classes=[0, 1])
+        assert search.best_score_ > 0.7
+
+    def test_inverse_decay(self, clf_data):
+        X, y = clf_data
+        search = dms.InverseDecaySearchCV(
+            LinearFunction(), {"slope": [1.0, 2.0, 3.0, 4.0]},
+            n_initial_parameters="grid", max_iter=8, chunk_size=50,
+        ).fit(X, y)
+        # the best (steepest) model survives to the end
+        assert search.best_params_["slope"] == 4.0
+        calls = [r[-1]["partial_fit_calls"] for r in search.model_history_.values()]
+        assert max(calls) > min(calls)  # losers stopped early
+
+
+class TestSuccessiveHalving:
+    def test_exact_schedule_with_fake_models(self, clf_data):
+        X, y = clf_data
+        # 9 models, eta=3: rounds keep 9 -> 3 -> 1; budgets 1 -> 3 -> 9
+        values = {i: i / 10 for i in range(9)}
+        search = dms.SuccessiveHalvingSearchCV(
+            ConstantFunction(), {"value": [values[i] for i in range(9)]},
+            n_initial_parameters="grid", n_initial_iter=1, aggressiveness=3,
+            max_iter=9, chunk_size=50,
+        ).fit(X, y)
+        hist = search.model_history_
+        final_calls = sorted(
+            recs[-1]["partial_fit_calls"] for recs in hist.values()
+        )
+        # 6 losers stop at 1 call, 2 mid at 3 calls, the winner gets 9
+        assert final_calls == [1, 1, 1, 1, 1, 1, 3, 3, 9]
+        assert search.best_score_ == 0.8
+
+    def test_requires_n_initial_iter(self, clf_data):
+        X, y = clf_data
+        with pytest.raises(ValueError, match="n_initial_iter"):
+            dms.SuccessiveHalvingSearchCV(
+                ConstantFunction(), {"value": [0.1]},
+            ).fit(X, y)
+
+
+class TestHyperband:
+    def test_bracket_params_r81(self):
+        from dask_ml_tpu.model_selection._hyperband import _get_hyperband_params
+
+        # canonical Li et al. example: R=81, eta=3
+        out = _get_hyperband_params(81, 3)
+        assert [(n, r) for _, n, r in out] == [
+            (81, 1), (34, 3), (15, 9), (8, 27), (5, 81)
+        ]
+
+    def test_metadata_counts(self):
+        search = dms.HyperbandSearchCV(
+            ConstantFunction(), {"value": [0.1]}, max_iter=9, aggressiveness=3
+        )
+        meta = search.metadata
+        # R=9, eta=3: brackets (n=9,r=1), (n=5,r=3), (n=3,r=9)
+        assert [b["n_models"] for b in meta["brackets"]] == [9, 5, 3]
+        assert meta["n_models"] == 17
+        assert meta["partial_fit_calls"] == sum(
+            b["partial_fit_calls"] for b in meta["brackets"]
+        )
+
+    def test_fit_finds_best_and_metadata_matches(self, clf_data, rng):
+        X, y = clf_data
+        search = dms.HyperbandSearchCV(
+            LinearFunction(),
+            {"slope": list(rng.uniform(0.1, 2.0, size=30)),
+             "intercept": list(rng.uniform(0, 0.1, size=10))},
+            max_iter=9, aggressiveness=3, random_state=0, chunk_size=50,
+        ).fit(X, y)
+        assert search.metadata_["n_models"] == search.metadata["n_models"]
+        assert search.best_score_ > 0
+        assert hasattr(search, "cv_results_")
+        assert "bracket" in search.history_[0]
+        # model ids globally unique across brackets
+        ids = list(search.model_history_)
+        assert len(ids) == len(set(ids)) == search.metadata_["n_models"]
+
+    def test_real_sgd_hyperband(self, clf_data):
+        X, y = clf_data
+        search = dms.HyperbandSearchCV(
+            SGDClassifier(tol=None, random_state=0),
+            {"alpha": np.logspace(-5, 1, 30)},
+            max_iter=9, random_state=0, chunk_size=50,
+        )
+        search.fit(X, y, classes=[0, 1])
+        assert search.best_score_ > 0.7
+        assert search.predict(X).shape == (300,)
+
+
+class TestReviewRegressions:
+    def test_sha_refit_same_instance(self, clf_data):
+        X, y = clf_data
+        search = dms.SuccessiveHalvingSearchCV(
+            ConstantFunction(), {"value": [i / 10 for i in range(9)]},
+            n_initial_parameters="grid", n_initial_iter=1, aggressiveness=3,
+            max_iter=9, chunk_size=50,
+        )
+        search.fit(X, y)
+        first = sorted(r[-1]["partial_fit_calls"] for r in search.model_history_.values())
+        search.fit(X, y)
+        second = sorted(r[-1]["partial_fit_calls"] for r in search.model_history_.values())
+        assert first == second == [1, 1, 1, 1, 1, 1, 3, 3, 9]
+
+    def test_patience_with_improving_model_keeps_training(self, clf_data):
+        X, y = clf_data
+        search = dms.IncrementalSearchCV(
+            LinearFunction(), {"slope": [1.0]}, n_initial_parameters="grid",
+            max_iter=10, patience=2, tol=1e-3, chunk_size=50,
+        ).fit(X, y)
+        # monotonically improving model must NOT stop after the first score
+        calls = list(search.model_history_.values())[0][-1]["partial_fit_calls"]
+        assert calls == 10
+
+    def test_split_integer_sizes_are_counts(self):
+        X = np.arange(100).reshape(100, 1)
+        Xtr, Xte = dms.train_test_split(X, test_size=1, random_state=0)
+        assert Xte.shape == (1, 1) and Xtr.shape == (99, 1)
+
+    def test_incremental_requires_y(self, clf_data):
+        X, _ = clf_data
+        with pytest.raises(ValueError, match="y is required"):
+            dms.IncrementalSearchCV(
+                ConstantFunction(), {"value": [0.1]}, n_initial_parameters="grid"
+            ).fit(X)
+
+    def test_grid_fit_params_unsupervised(self, rng):
+        from dask_ml_tpu.cluster import KMeans
+
+        X = rng.normal(size=(60, 3)).astype(np.float32)
+        gs = dms.GridSearchCV(KMeans(init="random", random_state=0), {"n_clusters": [2, 3]}, cv=2)
+        gs.fit(X)  # y=None path
+        assert gs.best_params_["n_clusters"] in (2, 3)
