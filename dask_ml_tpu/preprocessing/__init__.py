@@ -2,23 +2,33 @@
 
 Scalers are fitted by single-pass masked reductions compiled into one XLA
 program; transforms are elementwise device ops that XLA fuses into whatever
-consumes them.
+consumes them.  Encoders compute category inventories host-side (they are
+small by definition) and expand rows on device; the pandas-categorical
+transformers (Categorizer/DummyEncoder) stay host-side like the reference.
 """
 
 from .data import (  # noqa: F401
     MinMaxScaler,
+    PolynomialFeatures,
     QuantileTransformer,
     RobustScaler,
     StandardScaler,
 )
 from .label import LabelEncoder  # noqa: F401
 from ._block_transformer import BlockTransformer  # noqa: F401
+from ._encoders import OneHotEncoder, OrdinalEncoder  # noqa: F401
+from .categorical import Categorizer, DummyEncoder  # noqa: F401
 
 __all__ = [
     "StandardScaler",
     "MinMaxScaler",
     "RobustScaler",
     "QuantileTransformer",
+    "PolynomialFeatures",
     "LabelEncoder",
     "BlockTransformer",
+    "OneHotEncoder",
+    "OrdinalEncoder",
+    "Categorizer",
+    "DummyEncoder",
 ]

@@ -1,0 +1,116 @@
+"""DataFrame categorical transformers (reference:
+``dask_ml/preprocessing/data.py`` :: ``Categorizer``, ``DummyEncoder``).
+
+These are the reference's pandas-categorical workhorses.  They are host-side
+by nature — category inventories and dtype metadata live with the dataframe,
+not on the accelerator — and stay pandas here; the device hand-off happens
+when the resulting dense matrix is ingested by a downstream estimator
+(`shard_rows` at the next fit).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from ..base import TPUEstimator, TransformerMixin
+
+
+def _check_frame(X, caller: str) -> pd.DataFrame:
+    if not isinstance(X, pd.DataFrame):
+        raise TypeError(f"{caller} expects a pandas DataFrame, got {type(X).__name__}")
+    return X
+
+
+class Categorizer(TransformerMixin, TPUEstimator):
+    """Convert object/string columns of a DataFrame to categorical dtype.
+
+    Mirrors the reference's semantics: fit records a ``CategoricalDtype`` per
+    selected column (``categories_``); transform casts with those dtypes so
+    unseen frames share the same category inventory.
+    """
+
+    def __init__(self, categories=None, columns=None):
+        self.categories = categories
+        self.columns = columns
+
+    def fit(self, X, y=None):
+        X = _check_frame(X, "Categorizer")
+        if self.categories is not None:
+            self.categories_ = dict(self.categories)
+            self.columns_ = pd.Index(self.categories_)
+            return self
+        columns = pd.Index(self.columns) if self.columns is not None else X.columns
+        categories = {}
+        for c in columns:
+            dt = X[c].dtype
+            if isinstance(dt, pd.CategoricalDtype):
+                categories[c] = dt
+            elif dt == object or pd.api.types.is_string_dtype(dt):
+                categories[c] = pd.CategoricalDtype(pd.unique(X[c].dropna()))
+        self.categories_ = categories
+        self.columns_ = pd.Index(categories)
+        return self
+
+    def transform(self, X, y=None):
+        X = _check_frame(X, "Categorizer").copy()
+        for c, dtype in self.categories_.items():
+            X[c] = X[c].astype(dtype)
+        return X
+
+
+class DummyEncoder(TransformerMixin, TPUEstimator):
+    """One-hot expand the categorical columns of a DataFrame (get_dummies).
+
+    Requires columns to already be categorical (use ``Categorizer`` first),
+    like the reference.  ``inverse_transform`` reassembles the original frame
+    from the dummy block.
+    """
+
+    def __init__(self, columns=None, drop_first=False):
+        self.columns = columns
+        self.drop_first = drop_first
+
+    def fit(self, X, y=None):
+        X = _check_frame(X, "DummyEncoder")
+        if self.columns is None:
+            columns = X.columns[[isinstance(X[c].dtype, pd.CategoricalDtype) for c in X.columns]]
+        else:
+            columns = pd.Index(self.columns)
+            for c in columns:
+                if not isinstance(X[c].dtype, pd.CategoricalDtype):
+                    raise ValueError(
+                        f"Column {c!r} is not categorical; run Categorizer first"
+                    )
+        self.columns_ = X.columns
+        self.categorical_columns_ = columns
+        self.non_categorical_columns_ = X.columns.difference(columns)
+        self.dtypes_ = {c: X[c].dtype for c in columns}
+        self.transformed_columns_ = pd.get_dummies(
+            X.head(1), columns=list(columns), drop_first=self.drop_first
+        ).columns
+        return self
+
+    def transform(self, X, y=None):
+        X = _check_frame(X, "DummyEncoder").copy()
+        for c in self.categorical_columns_:
+            X[c] = X[c].astype(self.dtypes_[c])
+        out = pd.get_dummies(X, columns=list(self.categorical_columns_),
+                             drop_first=self.drop_first)
+        return out.reindex(columns=self.transformed_columns_, fill_value=0)
+
+    def inverse_transform(self, X):
+        X = _check_frame(X, "DummyEncoder")
+        parts = {c: X[c] for c in self.non_categorical_columns_}
+        for c in self.categorical_columns_:
+            cats = list(self.dtypes_[c].categories)
+            dummy_cols = [f"{c}_{cat}" for cat in cats]
+            if self.drop_first:
+                dummy_cols = dummy_cols[1:]
+            block = X.reindex(columns=dummy_cols, fill_value=0).to_numpy()
+            if self.drop_first:
+                first = (block.sum(axis=1) == 0).astype(block.dtype)[:, None]
+                block = np.concatenate([first, block], axis=1)
+            codes = block.argmax(axis=1)
+            parts[c] = pd.Categorical.from_codes(codes, dtype=self.dtypes_[c])
+        return pd.DataFrame(parts, index=X.index).reindex(columns=self.columns_)

@@ -234,3 +234,90 @@ class QuantileTransformer(TransformerMixin, TPUEstimator):
 
             x = norm.cdf(x)
         return _like_input(X, self._map(x, forward=False))
+
+
+class PolynomialFeatures(TransformerMixin, TPUEstimator):
+    """Polynomial feature expansion (reference: ``dask_ml/preprocessing/data.py``
+    :: ``PolynomialFeatures``).
+
+    The combination structure is static (it depends only on ``n_features``
+    and ``degree``), so the expansion compiles to one XLA program: a stack of
+    column products in sklearn's output order.  ``preserve_dataframe`` is
+    honoured for pandas input like the reference.
+    """
+
+    def __init__(self, degree=2, interaction_only=False, include_bias=True,
+                 preserve_dataframe=False):
+        self.degree = degree
+        self.interaction_only = interaction_only
+        self.include_bias = include_bias
+        self.preserve_dataframe = preserve_dataframe
+
+    @staticmethod
+    def _combinations(n_features, degree, interaction_only, include_bias):
+        from itertools import chain, combinations, combinations_with_replacement
+
+        comb = combinations if interaction_only else combinations_with_replacement
+        start = 0 if include_bias else 1
+        return list(chain.from_iterable(
+            comb(range(n_features), d) for d in range(start, degree + 1)
+        ))
+
+    def fit(self, X, y=None):
+        import pandas as pd
+
+        if isinstance(X, pd.DataFrame):
+            n = X.shape[1]
+            self.feature_names_in_ = np.asarray(X.columns, dtype=object)
+        else:
+            x, _ = _masked_or_plain(check_array(X))
+            n = x.shape[1]
+        self.n_features_in_ = n
+        self.combinations_ = self._combinations(
+            n, self.degree, self.interaction_only, self.include_bias
+        )
+        self.n_output_features_ = len(self.combinations_)
+        powers = np.zeros((self.n_output_features_, n), dtype=np.int64)
+        for i, combo in enumerate(self.combinations_):
+            for j in combo:
+                powers[i, j] += 1
+        self.powers_ = powers
+        return self
+
+    def get_feature_names_out(self, input_features=None):
+        if input_features is None:
+            input_features = getattr(
+                self, "feature_names_in_",
+                [f"x{j}" for j in range(self.n_features_in_)],
+            )
+        names = []
+        for row in self.powers_:
+            terms = [
+                (f"{input_features[j]}" if p == 1 else f"{input_features[j]}^{p}")
+                for j, p in enumerate(row) if p > 0
+            ]
+            names.append(" ".join(terms) if terms else "1")
+        return np.asarray(names, dtype=object)
+
+    def transform(self, X, y=None):
+        import pandas as pd
+
+        frame_in = isinstance(X, pd.DataFrame)
+        if frame_in:
+            x, _ = _masked_or_plain(X.to_numpy(dtype=np.float64))
+        else:
+            x, _ = _masked_or_plain(X)
+        if x.shape[1] != self.n_features_in_:
+            raise ValueError(
+                f"X has {x.shape[1]} features; expected {self.n_features_in_}"
+            )
+        cols = [
+            (jnp.ones(x.shape[0], x.dtype) if not combo
+             else jnp.prod(x[:, jnp.asarray(combo)], axis=1))
+            for combo in self.combinations_
+        ]
+        out = jnp.stack(cols, axis=1)
+        if frame_in and self.preserve_dataframe:
+            return pd.DataFrame(np.asarray(out), index=X.index,
+                                columns=self.get_feature_names_out())
+        return _like_input(X, out)

@@ -1,0 +1,269 @@
+"""Encoder suite tests: parity vs sklearn / pandas semantics.
+
+Mirrors the reference tests for ``dask_ml/preprocessing/_encoders.py`` and
+the categorical transformers in ``dask_ml/preprocessing/data.py``.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+import sklearn.preprocessing as sp
+
+import dask_ml_tpu.preprocessing as dp
+from dask_ml_tpu.core import shard_rows
+
+
+@pytest.fixture
+def Xcat(rng):
+    return rng.randint(0, 4, size=(37, 3)).astype(np.float32)
+
+
+@pytest.fixture
+def df():
+    return pd.DataFrame(
+        {
+            "A": pd.Categorical(["a", "b", "c", "a", "b"], categories=["a", "b", "c"]),
+            "B": ["x", "y", "x", "y", "x"],
+            "C": [1.0, 2.0, 3.0, 4.0, 5.0],
+        }
+    )
+
+
+class TestOneHotEncoder:
+    def test_parity_numeric(self, Xcat):
+        ours = dp.OneHotEncoder().fit(Xcat)
+        theirs = sp.OneHotEncoder(sparse_output=False).fit(Xcat)
+        np.testing.assert_allclose(
+            np.asarray(ours.transform(Xcat)), theirs.transform(Xcat)
+        )
+        for a, b in zip(ours.categories_, theirs.categories_):
+            np.testing.assert_array_equal(np.asarray(a), b)
+
+    def test_sharded_input(self, Xcat):
+        from dask_ml_tpu.core import unshard
+
+        s = shard_rows(Xcat)
+        ours = dp.OneHotEncoder().fit(s)
+        theirs = sp.OneHotEncoder(sparse_output=False).fit(Xcat)
+        np.testing.assert_allclose(unshard(ours.transform(s)), theirs.transform(Xcat))
+
+    def test_handle_unknown_error(self, Xcat):
+        enc = dp.OneHotEncoder().fit(Xcat)
+        bad = Xcat.copy()
+        bad[0, 0] = 99.0
+        with pytest.raises(ValueError, match="unknown categories"):
+            enc.transform(bad)
+
+    def test_handle_unknown_ignore(self, Xcat):
+        enc = dp.OneHotEncoder(handle_unknown="ignore").fit(Xcat)
+        bad = Xcat.copy()
+        bad[0, 0] = 99.0
+        out = np.asarray(enc.transform(bad))
+        n0 = len(enc.categories_[0])
+        assert out[0, :n0].sum() == 0.0
+
+    def test_inverse_transform(self, Xcat):
+        enc = dp.OneHotEncoder().fit(Xcat)
+        back = enc.inverse_transform(enc.transform(Xcat))
+        np.testing.assert_allclose(back.astype(np.float32), Xcat)
+
+    def test_strings(self):
+        X = np.array([["a", "x"], ["b", "y"], ["a", "y"]], dtype=object)
+        ours = dp.OneHotEncoder().fit(X)
+        theirs = sp.OneHotEncoder(sparse_output=False).fit(X)
+        np.testing.assert_allclose(np.asarray(ours.transform(X)), theirs.transform(X))
+        np.testing.assert_array_equal(
+            ours.get_feature_names_out(), theirs.get_feature_names_out()
+        )
+
+    def test_dataframe(self, df):
+        enc = dp.OneHotEncoder().fit(df[["A", "B"]])
+        out = enc.transform(df[["A", "B"]])
+        assert isinstance(out, pd.DataFrame)
+        assert list(out.columns) == ["A_a", "A_b", "A_c", "B_x", "B_y"]
+        np.testing.assert_allclose(out["A_a"].to_numpy(), [1, 0, 0, 1, 0])
+
+    def test_user_categories_unsorted_order(self):
+        X = np.array([[0.0], [1.0], [2.0]])
+        enc = dp.OneHotEncoder(categories=[np.array([2.0, 1.0, 0.0])]).fit(X)
+        out = np.asarray(enc.transform(X))
+        np.testing.assert_allclose(out, [[0, 0, 1], [0, 1, 0], [1, 0, 0]])
+
+    def test_frame_column_mismatch_raises(self, df):
+        enc = dp.OneHotEncoder().fit(df[["A", "B"]])
+        with pytest.raises(ValueError, match="Column mismatch"):
+            enc.transform(df[["B", "A"]])
+
+    def test_sharded_in_sharded_out(self, Xcat):
+        from dask_ml_tpu.core.sharded import ShardedRows
+
+        enc = dp.OneHotEncoder().fit(Xcat)
+        out = enc.transform(shard_rows(Xcat))
+        assert isinstance(out, ShardedRows)
+
+    def test_missing_values_fit(self):
+        df = pd.DataFrame({"B": ["x", None, "y", "x"]})
+        enc = dp.OneHotEncoder(handle_unknown="ignore").fit(df)
+        np.testing.assert_array_equal(np.asarray(enc.categories_[0]), ["x", "y"])
+        out = enc.transform(df)
+        np.testing.assert_allclose(out.to_numpy(dtype=float)[1], [0, 0])
+
+    def test_nan_numeric_fit(self):
+        X = np.array([[0.0], [np.nan], [1.0]])
+        enc = dp.OneHotEncoder(handle_unknown="ignore").fit(X)
+        assert len(enc.categories_[0]) == 2
+        out = np.asarray(enc.transform(X))
+        np.testing.assert_allclose(out[1], [0, 0])
+
+    def test_array_fit_frame_transform_raises(self, df):
+        enc = dp.OneHotEncoder().fit(np.array([[0.0], [1.0]]))
+        with pytest.raises(ValueError, match="fitted on an array"):
+            enc.transform(pd.DataFrame({"a": [0.0, 1.0]}))
+
+    def test_sparse_output(self, Xcat):
+        import scipy.sparse
+
+        enc = dp.OneHotEncoder(sparse_output=True).fit(Xcat)
+        out = enc.transform(Xcat)
+        assert scipy.sparse.issparse(out)
+        theirs = sp.OneHotEncoder(sparse_output=False).fit(Xcat)
+        np.testing.assert_allclose(out.toarray(), theirs.transform(Xcat))
+
+
+class TestOrdinalEncoder:
+    def test_parity_array(self, Xcat):
+        ours = dp.OrdinalEncoder().fit(Xcat)
+        theirs = sp.OrdinalEncoder().fit(Xcat)
+        np.testing.assert_allclose(np.asarray(ours.transform(Xcat)), theirs.transform(Xcat))
+
+    def test_inverse_array(self, Xcat):
+        enc = dp.OrdinalEncoder().fit(Xcat)
+        back = enc.inverse_transform(enc.transform(Xcat))
+        np.testing.assert_allclose(back.astype(np.float32), Xcat)
+
+    def test_sharded_in_sharded_out(self, Xcat):
+        from dask_ml_tpu.core import unshard
+        from dask_ml_tpu.core.sharded import ShardedRows
+
+        s = shard_rows(Xcat)
+        enc = dp.OrdinalEncoder().fit(s)
+        out = enc.transform(s)
+        assert isinstance(out, ShardedRows)
+        theirs = sp.OrdinalEncoder().fit(Xcat)
+        np.testing.assert_allclose(unshard(out), theirs.transform(Xcat))
+
+    def test_dataframe_roundtrip(self, df):
+        enc = dp.OrdinalEncoder().fit(df)
+        out = enc.transform(df)
+        assert list(enc.categorical_columns_) == ["A", "B"]
+        assert out["A"].tolist() == [0, 1, 2, 0, 1]
+        assert (out["C"] == df["C"]).all()
+        back = enc.inverse_transform(out)
+        assert back["A"].tolist() == df["A"].tolist()
+        assert back["B"].tolist() == df["B"].tolist()
+
+
+class TestCategorizer:
+    def test_categorizes_object_columns(self, df):
+        cat = dp.Categorizer().fit(df)
+        out = cat.transform(df)
+        assert isinstance(out["B"].dtype, pd.CategoricalDtype)
+        assert isinstance(out["A"].dtype, pd.CategoricalDtype)
+        assert out["C"].dtype == np.float64
+        assert set(cat.categories_) == {"A", "B"}
+
+    def test_transform_uses_fitted_categories(self, df):
+        cat = dp.Categorizer().fit(df)
+        df2 = df.copy()
+        df2["B"] = ["x", "x", "x", "x", "x"]
+        out = cat.transform(df2)
+        assert list(out["B"].dtype.categories) == ["x", "y"]
+
+    def test_columns_subset(self, df):
+        cat = dp.Categorizer(columns=["B"]).fit(df)
+        out = cat.transform(df)
+        assert set(cat.categories_) == {"B"}
+        assert isinstance(out["B"].dtype, pd.CategoricalDtype)
+
+    def test_rejects_array(self, rng):
+        with pytest.raises(TypeError):
+            dp.Categorizer().fit(rng.normal(size=(5, 2)))
+
+
+class TestDummyEncoder:
+    def test_basic(self, df):
+        df = dp.Categorizer().fit_transform(df)
+        enc = dp.DummyEncoder().fit(df)
+        out = enc.transform(df)
+        assert "A_a" in out.columns and "B_x" in out.columns and "C" in out.columns
+        np.testing.assert_allclose(out["A_b"].to_numpy(dtype=float), [0, 1, 0, 0, 1])
+
+    def test_inverse(self, df):
+        df = dp.Categorizer().fit_transform(df)
+        enc = dp.DummyEncoder().fit(df)
+        back = enc.inverse_transform(enc.transform(df))
+        assert back["A"].tolist() == df["A"].tolist()
+        assert back["B"].tolist() == df["B"].tolist()
+        np.testing.assert_allclose(back["C"].to_numpy(), df["C"].to_numpy())
+
+    def test_drop_first(self, df):
+        df = dp.Categorizer().fit_transform(df)
+        enc = dp.DummyEncoder(drop_first=True).fit(df)
+        out = enc.transform(df)
+        assert "A_a" not in out.columns and "A_b" in out.columns
+        back = enc.inverse_transform(out)
+        assert back["A"].tolist() == df["A"].tolist()
+
+    def test_non_categorical_raises(self, df):
+        with pytest.raises(ValueError, match="not categorical"):
+            dp.DummyEncoder(columns=["B"]).fit(df)
+
+
+class TestPolynomialFeatures:
+    @pytest.mark.parametrize("degree", [2, 3])
+    @pytest.mark.parametrize("interaction_only", [False, True])
+    @pytest.mark.parametrize("include_bias", [False, True])
+    def test_parity(self, rng, degree, interaction_only, include_bias):
+        X = rng.normal(size=(23, 4)).astype(np.float64)
+        ours = dp.PolynomialFeatures(
+            degree=degree, interaction_only=interaction_only, include_bias=include_bias
+        ).fit(X)
+        theirs = sp.PolynomialFeatures(
+            degree=degree, interaction_only=interaction_only, include_bias=include_bias
+        ).fit(X)
+        assert ours.n_output_features_ == theirs.n_output_features_
+        np.testing.assert_array_equal(ours.powers_, theirs.powers_)
+        np.testing.assert_allclose(
+            np.asarray(ours.transform(X)), theirs.transform(X), rtol=1e-5
+        )
+
+    def test_feature_names(self, rng):
+        X = rng.normal(size=(5, 3))
+        ours = dp.PolynomialFeatures().fit(X)
+        theirs = sp.PolynomialFeatures().fit(X)
+        np.testing.assert_array_equal(
+            ours.get_feature_names_out(), theirs.get_feature_names_out()
+        )
+
+    def test_sharded_in_sharded_out(self, rng):
+        from dask_ml_tpu.core.sharded import ShardedRows
+
+        X = rng.normal(size=(19, 3)).astype(np.float32)
+        s = shard_rows(X)
+        out = dp.PolynomialFeatures().fit(s).transform(s)
+        assert isinstance(out, ShardedRows)
+        theirs = sp.PolynomialFeatures().fit_transform(X)
+        from dask_ml_tpu.core import unshard
+
+        np.testing.assert_allclose(unshard(out), theirs, rtol=1e-4)
+
+    def test_feature_count_mismatch_raises(self, rng):
+        pf = dp.PolynomialFeatures().fit(rng.normal(size=(4, 3)))
+        with pytest.raises(ValueError, match="features"):
+            pf.transform(rng.normal(size=(4, 2)))
+
+    def test_preserve_dataframe(self, rng):
+        X = pd.DataFrame(rng.normal(size=(7, 2)), columns=["u", "v"])
+        out = dp.PolynomialFeatures(preserve_dataframe=True).fit(X).transform(X)
+        assert isinstance(out, pd.DataFrame)
+        assert list(out.columns) == ["1", "u", "v", "u^2", "u v", "v^2"]
