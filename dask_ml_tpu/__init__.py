@@ -31,6 +31,7 @@ from . import cluster  # noqa: F401
 from . import datasets  # noqa: F401
 from . import solvers  # noqa: F401
 from . import linear_model  # noqa: F401
+from . import feature_extraction  # noqa: F401
 from . import impute  # noqa: F401
 from . import naive_bayes  # noqa: F401
 from . import ensemble  # noqa: F401
@@ -49,6 +50,7 @@ __all__ = [
     "datasets",
     "solvers",
     "linear_model",
+    "feature_extraction",
     "impute",
     "naive_bayes",
     "ensemble",

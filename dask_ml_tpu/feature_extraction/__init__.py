@@ -1,0 +1,16 @@
+"""Feature extraction (reference: ``dask_ml/feature_extraction/``)."""
+
+from .text import (  # noqa: F401
+    CountVectorizer,
+    FeatureHasher,
+    HashingVectorizer,
+    densify_to_device,
+)
+
+__all__ = [
+    "CountVectorizer",
+    "FeatureHasher",
+    "HashingVectorizer",
+    "densify_to_device",
+    "text",
+]

@@ -23,10 +23,17 @@ def randomized_svd(x, n_components: int, *, n_oversamples: int = 10,
     ``n_iter`` power iterations sharpen the spectrum for slowly-decaying
     singular values (same semantics as the reference's ``power_iteration_normalizer='QR'``).
     """
+    true_n = x.shape[0]
     if isinstance(x, ShardedRows):
         x = x.data
     n, d = x.shape
-    k = min(n_components + n_oversamples, d)
+    if n_components > min(true_n, d):
+        raise ValueError(
+            f"n_components={n_components} must be <= min{(true_n, d)}"
+        )
+    # clamp the sketch width so tsqr's tall-skinny requirement (rows >= k)
+    # always holds — oversampling beyond n rows adds nothing anyway
+    k = min(n_components + n_oversamples, d, true_n)
     key = as_key(random_state)
     g = jax.random.normal(key, (d, k), dtype=x.dtype)
 

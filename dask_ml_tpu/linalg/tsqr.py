@@ -30,11 +30,14 @@ def _tsqr_impl(x, *, mesh_holder):
     d = x.shape[1]
 
     def local(xs):
-        q1, r1 = jnp.linalg.qr(xs, mode="reduced")  # (m_i, d), (d, d)
-        r_all = jax.lax.all_gather(r1, DATA_AXIS)  # (P, d, d)
-        q2, r = jnp.linalg.qr(r_all.reshape(-1, d), mode="reduced")  # (P·d, d), (d, d)
+        # Short shards (m < d) are fine: reduced QR then yields q1 (m, k),
+        # r1 (k, d) with k = min(m, d); only the STACKED R must be tall.
+        q1, r1 = jnp.linalg.qr(xs, mode="reduced")  # (m, k), (k, d)
+        k = r1.shape[0]
+        r_all = jax.lax.all_gather(r1, DATA_AXIS)  # (P, k, d)
+        q2, r = jnp.linalg.qr(r_all.reshape(-1, d), mode="reduced")  # (P·k, d), (d, d)
         i = jax.lax.axis_index(DATA_AXIS)
-        q2_i = jax.lax.dynamic_slice_in_dim(q2, i * d, d)
+        q2_i = jax.lax.dynamic_slice_in_dim(q2, i * k, k)
         return q1 @ q2_i, r
 
     return _shard_map(
@@ -60,15 +63,18 @@ def tsqr(x, mesh=None):
 
     Q comes back row-sharded like X; R is (d, d) replicated.
     """
+    # Validate on the TRUE shape: ShardedRows pads rows, and a wide matrix
+    # padded past its column count must still be rejected.
+    true_shape = x.shape
     if isinstance(x, ShardedRows):
         x = x.data
     mesh = mesh or get_mesh()
-    if x.shape[1] > x.shape[0] // max(1, mesh.shape[DATA_AXIS]):
-        # Each shard must be at least square for reduced local QR to keep
-        # full column information.
+    if true_shape[0] < true_shape[1]:
+        # Individual shards may be short (stage 2 recovers rank from the
+        # stacked R factors), but the overall matrix must be tall-skinny.
         raise ValueError(
-            f"tsqr requires tall-skinny shards: shape {x.shape} over "
-            f"{mesh.shape[DATA_AXIS]} shards leaves per-shard rows < {x.shape[1]} cols"
+            f"tsqr requires a tall-skinny matrix: got shape {true_shape} "
+            "(rows < cols); use randomized_svd / svd_compressed instead"
         )
     return _tsqr_impl(x, mesh_holder=_MeshHolder(mesh))
 

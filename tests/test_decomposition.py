@@ -39,7 +39,22 @@ class TestTSQR:
 
     def test_too_wide_raises(self):
         with pytest.raises(ValueError, match="tall-skinny"):
-            tsqr(shard_rows(np.ones((16, 10), dtype=np.float32)))
+            tsqr(shard_rows(np.ones((8, 10), dtype=np.float32)))
+
+    def test_wide_rejected_despite_padding(self):
+        # 9x10 pads to 16 rows on an 8-device mesh; the TRUE shape (9 < 10)
+        # must still be rejected — padding must not mask rank deficiency
+        with pytest.raises(ValueError, match="tall-skinny"):
+            tsqr(shard_rows(np.ones((9, 10), dtype=np.float32)))
+
+    def test_short_shards_ok(self, rng):
+        # 16x10 over 8 shards: each shard is short (2 rows < 10 cols) but the
+        # stacked R (16 rows) recovers full rank — must factor correctly.
+        X = rng.normal(size=(16, 10)).astype(np.float64)
+        q, r = tsqr(shard_rows(X))
+        np.testing.assert_allclose(np.asarray(q) @ np.asarray(r), X, atol=1e-5)
+        sv = np.linalg.svd(np.asarray(r), compute_uv=False)
+        np.testing.assert_allclose(sv, np.linalg.svd(X, compute_uv=False), rtol=1e-5)
 
     def test_padding_zero_rows_safe(self, rng):
         # 37 rows over 8 shards -> 3 zero pad rows; R must match unpadded

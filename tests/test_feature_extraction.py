@@ -1,0 +1,167 @@
+"""Parity tests for feature_extraction.text vs sklearn (SURVEY.md §4.1)."""
+
+import numpy as np
+import pytest
+import scipy.sparse
+
+import sklearn.feature_extraction.text as sk_text
+from sklearn.feature_extraction import FeatureHasher as SkFeatureHasher
+
+from dask_ml_tpu.feature_extraction import (
+    CountVectorizer,
+    FeatureHasher,
+    HashingVectorizer,
+    densify_to_device,
+)
+
+DOCS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the cat sat on the mat",
+    "foxes and dogs and cats",
+    "jax compiles programs for the tpu",
+    "the tpu multiplies matrices quickly",
+    "sparse matrices stay on the host",
+] * 7  # 42 docs; with chunk_size=5 this exercises multi-chunk paths
+
+
+@pytest.fixture
+def small_chunks(monkeypatch):
+    monkeypatch.setattr(HashingVectorizer, "chunk_size", 5)
+    monkeypatch.setattr(CountVectorizer, "chunk_size", 5)
+    monkeypatch.setattr(FeatureHasher, "chunk_size", 5)
+
+
+class TestHashingVectorizer:
+    def test_matches_sklearn(self, small_chunks):
+        ours = HashingVectorizer(n_features=128).fit_transform(DOCS)
+        theirs = sk_text.HashingVectorizer(n_features=128).transform(DOCS)
+        assert scipy.sparse.issparse(ours)
+        np.testing.assert_allclose(ours.toarray(), theirs.toarray())
+
+    def test_params_forward(self):
+        v = HashingVectorizer(n_features=64, norm=None, alternate_sign=False)
+        out = v.transform(DOCS[:3])
+        ref = sk_text.HashingVectorizer(
+            n_features=64, norm=None, alternate_sign=False
+        ).transform(DOCS[:3])
+        np.testing.assert_allclose(out.toarray(), ref.toarray())
+
+    def test_empty_input(self):
+        out = HashingVectorizer(n_features=32).transform([])
+        assert out.shape == (0, 32)
+
+
+class TestFeatureHasher:
+    def test_matches_sklearn(self, small_chunks):
+        samples = [{"a": 1, "b": 2}, {"b": 3, "c": 1}, {"d": 4}] * 6
+        ours = FeatureHasher(n_features=64).transform(samples)
+        theirs = SkFeatureHasher(n_features=64).transform(samples)
+        np.testing.assert_allclose(ours.toarray(), theirs.toarray())
+
+
+class TestCountVectorizer:
+    def test_matches_sklearn(self, small_chunks):
+        ours_vec = CountVectorizer()
+        ours = ours_vec.fit_transform(DOCS)
+        theirs_vec = sk_text.CountVectorizer()
+        theirs = theirs_vec.fit_transform(DOCS)
+        # identical sorted vocabulary → identical matrix
+        assert ours_vec.vocabulary_ == theirs_vec.vocabulary_
+        np.testing.assert_array_equal(ours.toarray(), theirs.toarray())
+
+    def test_transform_after_fit(self, small_chunks):
+        vec = CountVectorizer().fit(DOCS)
+        out = vec.transform(DOCS[:4])
+        ref = sk_text.CountVectorizer().fit(DOCS).transform(DOCS[:4])
+        np.testing.assert_array_equal(out.toarray(), ref.toarray())
+
+    def test_fixed_vocabulary(self):
+        vocab = ["cat", "dog", "fox", "tpu"]
+        vec = CountVectorizer(vocabulary=vocab)
+        out = vec.fit_transform(DOCS)
+        ref = sk_text.CountVectorizer(vocabulary=vocab).fit_transform(DOCS)
+        np.testing.assert_array_equal(out.toarray(), ref.toarray())
+        assert vec.fixed_vocabulary_
+
+    def test_unfitted_raises(self):
+        with pytest.raises(ValueError, match="not fitted"):
+            CountVectorizer().transform(DOCS)
+
+    def test_min_df_global_not_per_chunk(self, small_chunks):
+        # 'rare' appears once in two different chunks: per-chunk df=1 would
+        # drop it under min_df=2, but global df=2 keeps it (sklearn parity)
+        docs = ["rare term here"] + ["common words"] * 6 + ["rare again"] + ["common words"] * 6
+        ours_vec = CountVectorizer(min_df=2)
+        theirs_vec = sk_text.CountVectorizer(min_df=2)
+        ours = ours_vec.fit_transform(docs)
+        theirs = theirs_vec.fit_transform(docs)
+        assert ours_vec.vocabulary_ == theirs_vec.vocabulary_
+        assert "rare" in ours_vec.vocabulary_
+        np.testing.assert_array_equal(ours.toarray(), theirs.toarray())
+
+    def test_max_df_and_max_features(self, small_chunks):
+        ours_vec = CountVectorizer(max_df=0.8, max_features=5)
+        theirs_vec = sk_text.CountVectorizer(max_df=0.8, max_features=5)
+        ours = ours_vec.fit_transform(DOCS)
+        theirs = theirs_vec.fit_transform(DOCS)
+        assert ours_vec.vocabulary_ == theirs_vec.vocabulary_
+        np.testing.assert_array_equal(ours.toarray(), theirs.toarray())
+
+    def test_empty_chunk_tolerated(self, small_chunks):
+        # one whole chunk of stop-word-only docs: global fit must survive
+        docs = ["the a an of"] * 5 + ["real content here"] * 5
+        vec = CountVectorizer(stop_words="english")
+        out = vec.fit_transform(docs)
+        ref = sk_text.CountVectorizer(stop_words="english").fit_transform(docs)
+        np.testing.assert_array_equal(out.toarray(), ref.toarray())
+
+    def test_all_stopwords_raises(self):
+        with pytest.raises(ValueError, match="empty vocabulary"):
+            CountVectorizer(stop_words="english").fit(["the a an", "of and"])
+
+    def test_string_input_rejected(self):
+        with pytest.raises(ValueError, match="string object received"):
+            CountVectorizer().fit("a bare string")
+        with pytest.raises(ValueError, match="string object received"):
+            HashingVectorizer().transform("a bare string")
+
+    def test_numpy_integer_min_df(self, small_chunks):
+        ours = CountVectorizer(min_df=np.int64(2)).fit(DOCS)
+        theirs = sk_text.CountVectorizer(min_df=2).fit(DOCS)
+        assert ours.vocabulary_ == theirs.vocabulary_
+
+    def test_invalid_param_propagates(self):
+        with pytest.raises(ValueError, match="ngram_range"):
+            CountVectorizer(ngram_range=(2, 1)).fit(DOCS)
+
+    def test_fixed_vocab_transform_only(self):
+        vec = CountVectorizer(vocabulary=["cat", "dog"])
+        vec.transform(DOCS[:3])
+        assert vec.fixed_vocabulary_
+
+    def test_ngram_params_forward(self, small_chunks):
+        ours = CountVectorizer(ngram_range=(1, 2), min_df=1).fit_transform(DOCS)
+        theirs = sk_text.CountVectorizer(ngram_range=(1, 2), min_df=1).fit_transform(DOCS)
+        np.testing.assert_array_equal(ours.toarray(), theirs.toarray())
+
+
+class TestDensifyToDevice:
+    def test_sparse_to_sharded(self, mesh):
+        X = sk_text.CountVectorizer().fit_transform(DOCS)
+        s = densify_to_device(X)
+        assert s.shape == X.shape
+        np.testing.assert_allclose(
+            np.asarray(s.unpad()), X.toarray().astype(np.float32)
+        )
+
+    def test_pipeline_into_truncated_svd(self, mesh):
+        from dask_ml_tpu.decomposition import TruncatedSVD
+
+        docs = DOCS * 2
+        X = HashingVectorizer(n_features=8).transform(docs)
+        s = densify_to_device(X)
+        svd = TruncatedSVD(n_components=3, random_state=0)
+        out = svd.fit_transform(s)
+        from dask_ml_tpu.core import unshard
+
+        assert unshard(out).shape == (len(docs), 3)
