@@ -38,6 +38,8 @@ from . import ensemble  # noqa: F401
 from . import compose  # noqa: F401
 from . import wrappers  # noqa: F401
 from . import _partial  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import diagnostics  # noqa: F401
 from . import model_selection  # noqa: F401
 
 __all__ = [
@@ -54,7 +56,9 @@ __all__ = [
     "impute",
     "naive_bayes",
     "ensemble",
+    "checkpoint",
     "compose",
+    "diagnostics",
     "wrappers",
     "model_selection",
     "__version__",

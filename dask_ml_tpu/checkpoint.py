@@ -1,0 +1,207 @@
+"""Checkpoint / resume subsystem.
+
+The reference has NO checkpoint subsystem (SURVEY.md §5: persistence is
+pickle-by-user-convention; incremental searches keep ``history_`` from which
+training can be analyzed but not resumed).  Per the survey's build guidance,
+this framework designs checkpointing in from the start — it doubles as the
+fault-recovery story for long fits: the reference inherits lineage-based
+recompute from dask.distributed, and a TPU pod's analogue is restart from the
+last round snapshot.
+
+Two levels:
+
+* ``save_estimator`` / ``load_estimator`` — persist ANY fitted estimator:
+  constructor params + trailing-underscore fitted attributes, with device
+  arrays (``jax.Array``) pulled to host numpy and ``ShardedRows`` unsharded
+  (re-ingestion re-shards on whatever mesh is active at load time, so a
+  checkpoint written on one mesh shape restores onto another).
+* ``SearchCheckpoint`` — round-granular snapshots of an in-flight
+  incremental search (models, per-model history, policy counters), written
+  atomically (tmp + rename) so a crash mid-write never corrupts the last
+  good snapshot.  ``BaseIncrementalSearchCV(checkpoint=...)`` saves after
+  every adaptive round and resumes from the snapshot if one exists.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import pickle
+import tempfile
+
+import numpy as np
+
+import jax
+
+from .core.sharded import ShardedRows, unshard
+
+__all__ = ["save_estimator", "load_estimator", "SearchCheckpoint"]
+
+_FORMAT_VERSION = 1
+
+
+class _ShardedMarker:
+    """Tags an attr that was a ShardedRows so load re-shards it."""
+
+    def __init__(self, array: np.ndarray):
+        self.array = array
+
+
+def _to_host(value):
+    """Recursively pull device state to host (pickle-safe)."""
+    if isinstance(value, ShardedRows):
+        return _ShardedMarker(unshard(value))
+    if isinstance(value, jax.Array):
+        return np.asarray(jax.device_get(value))
+    if isinstance(value, dict):
+        return {k: _to_host(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        out = [_to_host(v) for v in value]
+        return type(value)(out) if isinstance(value, tuple) else out
+    return value
+
+
+def _from_host(value):
+    if isinstance(value, _ShardedMarker):
+        from .core.sharded import shard_rows
+
+        return shard_rows(value.array)
+    if isinstance(value, dict):
+        return {k: _from_host(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        out = [_from_host(v) for v in value]
+        return type(value)(out) if isinstance(value, tuple) else out
+    return value
+
+
+def _atomic_pickle(obj, path: str):
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(obj, f)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def save_estimator(estimator, path: str) -> None:
+    """Persist a fitted estimator to a directory.
+
+    Layout: ``meta.json`` (class identity + format version) and
+    ``state.pkl`` (constructor params + fitted attrs, host-side).
+    """
+    os.makedirs(path, exist_ok=True)
+    cls = type(estimator)
+    meta = {
+        "format": _FORMAT_VERSION,
+        "module": cls.__module__,
+        "qualname": cls.__qualname__,
+    }
+    fitted = {
+        k: _to_host(v)
+        for k, v in vars(estimator).items()
+        if k.endswith("_") and not k.startswith("__")
+    }
+    state = {"params": estimator.get_params(deep=False), "fitted": fitted}
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    _atomic_pickle(state, os.path.join(path, "state.pkl"))
+
+
+def load_estimator(path: str):
+    """Restore an estimator saved with ``save_estimator``."""
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if meta["format"] > _FORMAT_VERSION:  # pragma: no cover
+        raise ValueError(f"checkpoint format {meta['format']} is newer than {_FORMAT_VERSION}")
+    module = importlib.import_module(meta["module"])
+    cls = module
+    for part in meta["qualname"].split("."):
+        cls = getattr(cls, part)
+    with open(os.path.join(path, "state.pkl"), "rb") as f:
+        state = pickle.load(f)
+    est = cls(**state["params"])
+    for k, v in state["fitted"].items():
+        setattr(est, k, _from_host(v))
+    return est
+
+
+class SearchCheckpoint:
+    """Round-granular snapshot store for incremental searches.
+
+    One pickle file per search; snapshots are whole-state (models + info +
+    policy counters + accumulated wall time), overwritten atomically each
+    round.  A ``fingerprint`` of the search configuration is stored with
+    every snapshot and checked on load: resuming a DIFFERENT search (edited
+    parameter grid, changed schedule) against a stale snapshot would
+    silently corrupt budgets, so a mismatch is rejected and the search
+    starts fresh.  ``complete()`` removes the snapshot so a finished
+    search's next ``fit`` starts fresh.
+    """
+
+    def __init__(self, path: str, fingerprint: str | None = None):
+        self.path = str(path)
+        self.fingerprint = fingerprint
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def save(self, models, info, policy_state, elapsed: float = 0.0) -> None:
+        _atomic_pickle(
+            {
+                "format": _FORMAT_VERSION,
+                "fingerprint": self.fingerprint,
+                "models": models,
+                "info": dict(info),
+                "policy_state": policy_state,
+                "elapsed": elapsed,
+            },
+            self.path,
+        )
+
+    def matches(self) -> bool:
+        """True if the on-disk snapshot belongs to this search config."""
+        if not self.exists():
+            return False
+        with open(self.path, "rb") as f:
+            snap = pickle.load(f)
+        return snap.get("fingerprint") == self.fingerprint
+
+    def load(self):
+        with open(self.path, "rb") as f:
+            snap = pickle.load(f)
+        if snap.get("fingerprint") != self.fingerprint:
+            raise ValueError(
+                f"checkpoint {self.path} belongs to a different search "
+                "configuration; delete it or use a different path"
+            )
+        return snap["models"], snap["info"], snap["policy_state"], snap.get("elapsed", 0.0)
+
+    def complete(self) -> None:
+        if self.exists():
+            os.unlink(self.path)
+
+
+def search_fingerprint(search) -> str:
+    """Stable identity of a search's configuration (class + estimator class
+    + every constructor param that shapes the schedule or model space)."""
+    import hashlib
+
+    payload = repr(
+        (
+            type(search).__qualname__,
+            type(search.estimator).__qualname__,
+            sorted((k, repr(v)) for k, v in search.estimator.get_params(deep=False).items()),
+            sorted(
+                (k, repr(v))
+                for k, v in search.get_params(deep=False).items()
+                if k not in ("estimator", "checkpoint", "verbose")
+            ),
+        )
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]

@@ -1,0 +1,60 @@
+"""Tracing / profiling utilities.
+
+Reference posture (SURVEY.md §5): dask-ml keeps only a ``_timer`` phase
+logger in-repo and delegates real profiling to the external dask dashboard
+and ``dask.diagnostics``.  The TPU equivalents are XProf device traces
+(``jax.profiler``) and a ``block_until_ready`` timing harness — thin, also
+in-repo, so every estimator keeps the reference's pattern of named, timed
+phases with zero heavyweight machinery.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import numpy as np
+
+import jax
+
+from .utils import _timer  # noqa: F401  (re-export: phase logging)
+
+__all__ = ["trace", "benchmark_step", "_timer"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture an XProf/TensorBoard device trace of the enclosed block.
+
+    The TPU analogue of watching the distributed dashboard's task stream:
+    ``with diagnostics.trace('/tmp/prof'): est.fit(X)`` then point
+    TensorBoard (or xprof) at the directory.
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def benchmark_step(fn, *args, warmup: int = 1, iters: int = 10, **kwargs):
+    """Time a jitted step function honestly (async dispatch flushed).
+
+    Returns ``{"mean_s", "std_s", "min_s", "iters"}``.  The first
+    ``warmup`` calls (compilation) are excluded; every timed call blocks on
+    its outputs so XLA's async dispatch cannot hide device time.
+    """
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args, **kwargs))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args, **kwargs))
+        times.append(time.perf_counter() - t0)
+    arr = np.asarray(times)
+    return {
+        "mean_s": float(arr.mean()),
+        "std_s": float(arr.std()),
+        "min_s": float(arr.min()),
+        "iters": iters,
+    }

@@ -230,7 +230,7 @@ class CountVectorizer(sklearn.feature_extraction.text.CountVectorizer):
 
         parts = _map_chunks(local_transform, list(_chunks(raw_documents, self.chunk_size)))
         if not parts:
-            return scipy.sparse.csr_matrix((0, len(vocab)), dtype=self.dtype)
+            return scipy.sparse.csr_matrix((0, len(self.vocabulary_)), dtype=self.dtype)
         return scipy.sparse.vstack(parts).tocsr()
 
     def _sk_params(self):

@@ -68,14 +68,14 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
     def __init__(self, estimator, parameters, max_iter=81, aggressiveness=3,
                  test_size=None, random_state=None, scoring=None,
                  patience=False, tol=1e-3, verbose=False, prefix="",
-                 chunk_size=None):
+                 chunk_size=None, checkpoint=None):
         self.max_iter = max_iter
         self.aggressiveness = aggressiveness
         super().__init__(
             estimator, parameters, test_size=test_size,
             random_state=random_state, scoring=scoring, max_iter=max_iter,
             patience=patience, tol=tol, verbose=verbose, prefix=prefix,
-            chunk_size=chunk_size,
+            chunk_size=chunk_size, checkpoint=checkpoint,
         )
 
     # -- schedule ------------------------------------------------------
@@ -99,17 +99,26 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
         }
 
     def _make_brackets(self):
+        import os
+
         brackets = []
         rng_seed = self.random_state
         for s, n, r in _get_hyperband_params(self.max_iter, self.aggressiveness):
             seed = None if rng_seed is None else int(rng_seed) + s
+            # each bracket checkpoints independently: a restart resumes
+            # every bracket from its own last completed round
+            ckpt = (
+                os.path.join(str(self.checkpoint), f"bracket{s}.pkl")
+                if self.checkpoint
+                else None
+            )
             sha = SuccessiveHalvingSearchCV(
                 self.estimator, self.parameters,
                 n_initial_parameters=n, n_initial_iter=r,
                 max_iter=self.max_iter, aggressiveness=self.aggressiveness,
                 test_size=self.test_size, random_state=seed,
                 scoring=self.scoring, prefix=f"{self.prefix}bracket={s}",
-                chunk_size=self.chunk_size,
+                chunk_size=self.chunk_size, checkpoint=ckpt,
             )
             brackets.append((s, sha))
         return brackets

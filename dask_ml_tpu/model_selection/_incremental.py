@@ -68,16 +68,21 @@ class BaseIncrementalSearchCV(TPUEstimator):
     list of records (dicts with ``partial_fit_calls``, ``score``, …).
     """
 
+    # policy counters a round-granular checkpoint must capture (subclasses
+    # override; see dask_ml_tpu.checkpoint)
+    _policy_state_attrs: tuple = ()
+
     def __init__(self, estimator, parameters, n_initial_parameters=10,
                  test_size=None, random_state=None, scoring=None,
                  max_iter=100, patience=False, tol=1e-3, fits_per_score=1,
-                 verbose=False, prefix="", chunk_size=None):
+                 verbose=False, prefix="", chunk_size=None, checkpoint=None):
         self.estimator = estimator
         self.parameters = parameters
         self.n_initial_parameters = n_initial_parameters
         self.test_size = test_size
         self.random_state = random_state
         self.scoring = scoring
+        self.checkpoint = checkpoint
         self.max_iter = max_iter
         self.patience = patience
         self.tol = tol
@@ -121,6 +126,21 @@ class BaseIncrementalSearchCV(TPUEstimator):
         ]
         return blocks
 
+    # -- checkpoint plumbing (see dask_ml_tpu.checkpoint) ---------------
+    def _checkpointer(self):
+        if not self.checkpoint:
+            return None
+        from ..checkpoint import SearchCheckpoint, search_fingerprint
+
+        return SearchCheckpoint(self.checkpoint, fingerprint=search_fingerprint(self))
+
+    def _capture_policy_state(self):
+        return {a: getattr(self, a) for a in self._policy_state_attrs}
+
+    def _restore_policy_state(self, state):
+        for a, v in state.items():
+            setattr(self, a, v)
+
     async def _fit(self, X_train, y_train, X_test, y_test, **fit_params):
         self._reset_policy()
         scorer = check_scoring(self.estimator, self.scoring)
@@ -130,20 +150,39 @@ class BaseIncrementalSearchCV(TPUEstimator):
         blocks = self._to_blocks(X_train, y_train)
         n_blocks = len(blocks)
 
+        ckpt = self._checkpointer()
+        resumed = False
         models = {}
         info = defaultdict(list)
         start_time = time.time()
-        for ident, (p, seed) in enumerate(zip(params, seeds)):
-            model = _create_model(self.estimator, p, int(seed))
-            meta = {
-                "model_id": ident,
-                "params": p,
-                "partial_fit_calls": 0,
-                "partial_fit_time": 0.0,
-                "score_time": 0.0,
-                "elapsed_wall_time": 0.0,
-            }
-            models[ident] = (model, meta)
+        if ckpt is not None and ckpt.exists() and not ckpt.matches():
+            logger.warning(
+                "checkpoint %s belongs to a different search configuration; "
+                "ignoring it and starting fresh", ckpt.path,
+            )
+        elif ckpt is not None and ckpt.exists():
+            saved_models, saved_info, policy_state, prior_elapsed = ckpt.load()
+            models.update(saved_models)
+            for k, v in saved_info.items():
+                info[k] = list(v)
+            self._restore_policy_state(policy_state)
+            # keep history_'s chronological contract across the restart:
+            # post-resume records continue from the accumulated wall time
+            start_time = time.time() - prior_elapsed
+            resumed = True
+            logger.info("resumed %d models from checkpoint %s", len(models), ckpt.path)
+        if not resumed:
+            for ident, (p, seed) in enumerate(zip(params, seeds)):
+                model = _create_model(self.estimator, p, int(seed))
+                meta = {
+                    "model_id": ident,
+                    "params": p,
+                    "partial_fit_calls": 0,
+                    "partial_fit_time": 0.0,
+                    "score_time": 0.0,
+                    "elapsed_wall_time": 0.0,
+                }
+                models[ident] = (model, meta)
 
         def train_one(ident, n_calls):
             model, meta = models[ident]
@@ -157,10 +196,15 @@ class BaseIncrementalSearchCV(TPUEstimator):
             info[ident].append(meta)
             return meta
 
-        # initial round: one call each
-        for ident in list(models):
-            train_one(ident, 1)
-            await asyncio.sleep(0)  # cooperative yield (multi-bracket interleave)
+        # initial round: one call each (skipped when resuming — the
+        # snapshot already contains at least the initial round)
+        if not resumed:
+            for ident in list(models):
+                train_one(ident, 1)
+                await asyncio.sleep(0)  # cooperative yield (multi-bracket interleave)
+            if ckpt is not None:
+                ckpt.save(models, info, self._capture_policy_state(),
+                          elapsed=time.time() - start_time)
 
         # adaptive loop — an EMPTY dict stops the search; zero-valued
         # instructions keep a model alive without training (the policy's
@@ -173,7 +217,12 @@ class BaseIncrementalSearchCV(TPUEstimator):
                 if n_calls > 0:
                     train_one(ident, n_calls)
                     await asyncio.sleep(0)
+            if ckpt is not None:
+                ckpt.save(models, info, self._capture_policy_state(),
+                          elapsed=time.time() - start_time)
 
+        if ckpt is not None:
+            ckpt.complete()
         return models, dict(info)
 
     def _process_results(self, models, info):
@@ -289,10 +338,13 @@ class InverseDecaySearchCV(BaseIncrementalSearchCV):
     Reference: ``_incremental.py :: InverseDecaySearchCV`` (decay_rate).
     """
 
+    _policy_state_attrs = ("_step",)
+
     def __init__(self, estimator, parameters, n_initial_parameters=10,
                  test_size=None, random_state=None, scoring=None,
                  max_iter=100, patience=False, tol=1e-3, fits_per_score=1,
-                 decay_rate=1.0, verbose=False, prefix="", chunk_size=None):
+                 decay_rate=1.0, verbose=False, prefix="", chunk_size=None,
+                 checkpoint=None):
         self.decay_rate = decay_rate
         super().__init__(
             estimator, parameters,
@@ -300,6 +352,7 @@ class InverseDecaySearchCV(BaseIncrementalSearchCV):
             random_state=random_state, scoring=scoring, max_iter=max_iter,
             patience=patience, tol=tol, fits_per_score=fits_per_score,
             verbose=verbose, prefix=prefix, chunk_size=chunk_size,
+            checkpoint=checkpoint,
         )
         self._step = 1
 

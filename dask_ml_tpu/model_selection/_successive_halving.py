@@ -13,11 +13,13 @@ from ._incremental import BaseIncrementalSearchCV
 
 
 class SuccessiveHalvingSearchCV(BaseIncrementalSearchCV):
+    _policy_state_attrs = ("_steps", "_survivors")
+
     def __init__(self, estimator, parameters, n_initial_parameters=10,
                  n_initial_iter=None, max_iter=None, aggressiveness=3,
                  test_size=None, random_state=None, scoring=None,
                  patience=False, tol=1e-3, verbose=False, prefix="",
-                 chunk_size=None):
+                 chunk_size=None, checkpoint=None):
         self.n_initial_iter = n_initial_iter
         self.aggressiveness = aggressiveness
         self._steps = 0
@@ -28,7 +30,7 @@ class SuccessiveHalvingSearchCV(BaseIncrementalSearchCV):
             random_state=random_state, scoring=scoring,
             max_iter=max_iter if max_iter is not None else 100,
             patience=patience, tol=tol, verbose=verbose, prefix=prefix,
-            chunk_size=chunk_size,
+            chunk_size=chunk_size, checkpoint=checkpoint,
         )
 
     def _reset_policy(self):

@@ -1,0 +1,273 @@
+"""Checkpoint/resume subsystem tests (SURVEY.md §5: absent in the
+reference; designed in here as the fault-recovery story)."""
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu.checkpoint import SearchCheckpoint, load_estimator, save_estimator
+from dask_ml_tpu.core import shard_rows, unshard
+from dask_ml_tpu.model_selection import (
+    HyperbandSearchCV,
+    IncrementalSearchCV,
+    SuccessiveHalvingSearchCV,
+)
+from dask_ml_tpu.model_selection.utils_test import LinearFunction
+
+
+class TestEstimatorSaveLoad:
+    def test_kmeans_roundtrip(self, tmp_path, rng):
+        from dask_ml_tpu.cluster import KMeans
+
+        X = rng.normal(size=(200, 4)).astype(np.float32)
+        X[:100] += 5
+        km = KMeans(n_clusters=2, random_state=0).fit(X)
+        save_estimator(km, str(tmp_path / "km"))
+        restored = load_estimator(str(tmp_path / "km"))
+        np.testing.assert_allclose(
+            np.asarray(km.cluster_centers_),
+            np.asarray(restored.cluster_centers_),
+            rtol=1e-6,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(km.predict(X)), np.asarray(restored.predict(X))
+        )
+        assert restored.get_params() == km.get_params()
+
+    def test_scaler_roundtrip(self, tmp_path, rng):
+        from dask_ml_tpu.preprocessing import StandardScaler
+
+        X = rng.normal(size=(64, 3)).astype(np.float32) * 4 + 2
+        sc = StandardScaler().fit(X)
+        save_estimator(sc, str(tmp_path / "sc"))
+        restored = load_estimator(str(tmp_path / "sc"))
+        np.testing.assert_allclose(
+            unshard(restored.transform(X)), unshard(sc.transform(X)), rtol=1e-6
+        )
+
+    def test_glm_roundtrip(self, tmp_path, rng):
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        X = rng.normal(size=(80, 4)).astype(np.float32)
+        y = (X @ rng.normal(size=4) > 0).astype(np.float32)
+        lr = LogisticRegression(solver="lbfgs", max_iter=20).fit(X, y)
+        save_estimator(lr, str(tmp_path / "lr"))
+        restored = load_estimator(str(tmp_path / "lr"))
+        np.testing.assert_allclose(
+            np.asarray(restored.coef_), np.asarray(lr.coef_), rtol=1e-6
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored.predict(X)), np.asarray(lr.predict(X))
+        )
+
+    def test_sharded_attr_roundtrip(self, tmp_path, rng, mesh):
+        # an estimator holding a ShardedRows fitted attr must restore it
+        # as a re-sharded array on the active mesh
+        from dask_ml_tpu.preprocessing import StandardScaler
+
+        sc = StandardScaler()
+        X = rng.normal(size=(30, 2)).astype(np.float32)
+        sc.fit(X)
+        sc.debug_rows_ = shard_rows(X)
+        save_estimator(sc, str(tmp_path / "s"))
+        restored = load_estimator(str(tmp_path / "s"))
+        from dask_ml_tpu.core.sharded import ShardedRows
+
+        assert isinstance(restored.debug_rows_, ShardedRows)
+        np.testing.assert_allclose(unshard(restored.debug_rows_), X)
+
+
+def _xy(rng, n=64, d=3):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ rng.normal(size=d) > 0).astype(np.float32)
+    return X, y
+
+
+class TestSearchCheckpoint:
+    def test_resume_after_crash(self, tmp_path, rng):
+        """Kill the search mid-flight; a re-fit resumes from the snapshot
+        instead of restarting, and reaches the same result."""
+        X, y = _xy(rng)
+        path = str(tmp_path / "search.pkl")
+        params = {"slope": [0.1, 0.5, 1.0, 2.0]}
+
+        # un-checkpointed reference run
+        ref = IncrementalSearchCV(
+            LinearFunction(), params, n_initial_parameters="grid",
+            max_iter=6, random_state=0,
+        ).fit(X, y)
+
+        crashing = IncrementalSearchCV(
+            LinearFunction(), params, n_initial_parameters="grid",
+            max_iter=6, random_state=0, checkpoint=path,
+        )
+        calls = {"n": 0}
+        orig = type(crashing)._additional_calls
+
+        def boom(self, info):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise RuntimeError("simulated preemption")
+            return orig(self, info)
+
+        import unittest.mock as mock
+
+        with mock.patch.object(type(crashing), "_additional_calls", boom):
+            with pytest.raises(RuntimeError, match="preemption"):
+                crashing.fit(X, y)
+        assert SearchCheckpoint(path).exists()
+
+        # resumed run: models pick up their partial_fit_calls counts
+        resumed = IncrementalSearchCV(
+            LinearFunction(), params, n_initial_parameters="grid",
+            max_iter=6, random_state=0, checkpoint=path,
+        ).fit(X, y)
+        assert resumed.best_params_ == ref.best_params_
+        assert resumed.best_score_ == ref.best_score_
+        # final per-model budgets identical to the uninterrupted run
+        ref_calls = {
+            i: recs[-1]["partial_fit_calls"]
+            for i, recs in ref.model_history_.items()
+        }
+        res_calls = {
+            i: recs[-1]["partial_fit_calls"]
+            for i, recs in resumed.model_history_.items()
+        }
+        assert res_calls == ref_calls
+        # snapshot removed on successful completion
+        assert not SearchCheckpoint(path).exists()
+
+    def test_sha_policy_state_resumes(self, tmp_path, rng):
+        """SHA's _steps/_survivors counters are part of the snapshot: a
+        resume must not restart the halving schedule from step 0."""
+        X, y = _xy(rng)
+        path = str(tmp_path / "sha.pkl")
+        kwargs = dict(
+            parameters={"slope": [0.1, 0.5, 1.0, 2.0, 3.0, 4.0]},
+            n_initial_parameters=6, n_initial_iter=2, max_iter=8,
+            random_state=0,
+        )
+        ref = SuccessiveHalvingSearchCV(LinearFunction(), **kwargs).fit(X, y)
+
+        crashing = SuccessiveHalvingSearchCV(
+            LinearFunction(), checkpoint=path, **kwargs
+        )
+        calls = {"n": 0}
+        orig = SuccessiveHalvingSearchCV._additional_calls
+
+        def boom(self, info):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("simulated preemption")
+            return orig(self, info)
+
+        import unittest.mock as mock
+
+        with mock.patch.object(SuccessiveHalvingSearchCV, "_additional_calls", boom):
+            with pytest.raises(RuntimeError):
+                crashing.fit(X, y)
+
+        resumed = SuccessiveHalvingSearchCV(
+            LinearFunction(), checkpoint=path, **kwargs
+        ).fit(X, y)
+        assert resumed.best_params_ == ref.best_params_
+        ref_calls = {
+            i: recs[-1]["partial_fit_calls"]
+            for i, recs in ref.model_history_.items()
+        }
+        res_calls = {
+            i: recs[-1]["partial_fit_calls"]
+            for i, recs in resumed.model_history_.items()
+        }
+        assert res_calls == ref_calls
+
+    def test_hyperband_bracket_checkpoints(self, tmp_path, rng):
+        X, y = _xy(rng)
+        hb = HyperbandSearchCV(
+            LinearFunction(), {"slope": [0.5, 1.0, 2.0]}, max_iter=9,
+            random_state=0, checkpoint=str(tmp_path / "hb"),
+        ).fit(X, y)
+        assert hasattr(hb, "best_params_")
+        # all bracket snapshots cleaned up after a successful fit
+        assert list((tmp_path / "hb").glob("*.pkl")) == []
+
+    def test_mismatched_config_ignored(self, tmp_path, rng):
+        """A snapshot from a DIFFERENT search config must not be loaded."""
+        X, y = _xy(rng)
+        path = str(tmp_path / "s.pkl")
+
+        crashing = IncrementalSearchCV(
+            LinearFunction(), {"slope": [1.0, 2.0]}, n_initial_parameters="grid",
+            max_iter=6, random_state=0, checkpoint=path,
+        )
+        calls = {"n": 0}
+        orig = type(crashing)._additional_calls
+
+        def boom(self, info):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("simulated preemption")
+            return orig(self, info)
+
+        import unittest.mock as mock
+
+        with mock.patch.object(type(crashing), "_additional_calls", boom):
+            with pytest.raises(RuntimeError):
+                crashing.fit(X, y)
+        assert SearchCheckpoint(path).exists()
+
+        # different max_iter and slope grid: snapshot must be ignored and
+        # the fresh run must reflect the NEW parameter space
+        fresh = IncrementalSearchCV(
+            LinearFunction(), {"slope": [5.0]}, n_initial_parameters="grid",
+            max_iter=3, random_state=0, checkpoint=path,
+        ).fit(X, y)
+        assert fresh.best_params_ == {"slope": 5.0}
+        assert max(
+            recs[-1]["partial_fit_calls"] for recs in fresh.model_history_.values()
+        ) <= 3
+
+    def test_resume_preserves_wall_time_ordering(self, tmp_path, rng):
+        """history_ stays chronological across a resume: post-resume records
+        must carry elapsed_wall_time >= pre-crash records."""
+        X, y = _xy(rng)
+        path = str(tmp_path / "s.pkl")
+        kwargs = dict(
+            parameters={"slope": [0.5, 1.0, 2.0]}, n_initial_parameters="grid",
+            max_iter=6, random_state=0, checkpoint=path,
+        )
+        crashing = IncrementalSearchCV(LinearFunction(), **kwargs)
+        calls = {"n": 0}
+        orig = type(crashing)._additional_calls
+
+        def boom(self, info):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise RuntimeError("simulated preemption")
+            return orig(self, info)
+
+        import unittest.mock as mock
+
+        with mock.patch.object(type(crashing), "_additional_calls", boom):
+            with pytest.raises(RuntimeError):
+                crashing.fit(X, y)
+
+        resumed = IncrementalSearchCV(LinearFunction(), **kwargs).fit(X, y)
+        times = [r["elapsed_wall_time"] for r in resumed.history_]
+        assert times == sorted(times)
+        pf = [r["partial_fit_calls"] for r in resumed.history_]
+        # chronological => per-model call counts never decrease in history_
+        by_model = {}
+        for r in resumed.history_:
+            prev = by_model.get(r["model_id"], 0)
+            assert r["partial_fit_calls"] >= prev
+            by_model[r["model_id"]] = r["partial_fit_calls"]
+        assert max(pf) == 6
+
+    def test_completed_run_leaves_no_snapshot(self, tmp_path, rng):
+        X, y = _xy(rng)
+        path = str(tmp_path / "s.pkl")
+        IncrementalSearchCV(
+            LinearFunction(), {"slope": [1.0, 2.0]}, n_initial_parameters="grid",
+            max_iter=3, random_state=0, checkpoint=path,
+        ).fit(X, y)
+        assert not SearchCheckpoint(path).exists()

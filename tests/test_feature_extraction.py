@@ -83,6 +83,11 @@ class TestCountVectorizer:
         np.testing.assert_array_equal(out.toarray(), ref.toarray())
         assert vec.fixed_vocabulary_
 
+    def test_transform_empty_batch(self):
+        vec = CountVectorizer().fit(DOCS)
+        out = vec.transform([])
+        assert out.shape == (0, len(vec.vocabulary_))
+
     def test_unfitted_raises(self):
         with pytest.raises(ValueError, match="not fitted"):
             CountVectorizer().transform(DOCS)
