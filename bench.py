@@ -3,8 +3,12 @@
 Measures the two BASELINE.md north-star workloads on the available
 hardware, reporting KMeans Lloyd throughput (rows·iters/sec) as the
 primary metric and ADMM logistic fit time as context.  ``vs_baseline``
-is null-equivalent (1.0-normalized) because the reference publishes no
-absolute numbers (BASELINE.json :: published == {}).
+is 1.0-normalized because the reference publishes no absolute numbers
+(BASELINE.json :: published == {}).
+
+Both workloads run their ENTIRE iteration loop as one XLA program
+(lax.while_loop fusion); on TPU the Lloyd round additionally uses the
+fused Pallas assign+reduce kernel (ops.lloyd).
 """
 
 from __future__ import annotations
@@ -17,10 +21,11 @@ import numpy as np
 
 def main():
     import jax
+    import jax.numpy as jnp
 
-    from dask_ml_tpu.cluster import KMeans
-    from dask_ml_tpu.cluster.k_means import _lloyd_step
-    from dask_ml_tpu.core import shard_rows
+    from dask_ml_tpu.cluster.k_means import _lloyd_loop, _pallas_ok
+    from dask_ml_tpu.core import shard_rows, get_mesh
+    from dask_ml_tpu.core.mesh import MeshHolder
     from dask_ml_tpu.linear_model import LogisticRegression
 
     rng = np.random.RandomState(0)
@@ -30,17 +35,20 @@ def main():
     X = rng.normal(size=(n, d)).astype(np.float32)
     s = shard_rows(X)
     centers = s.data[:k]
-    # warmup/compile; the trailing float() pull is the only reliable sync on
-    # the axon relay (block_until_ready returns before the chain finishes)
-    float(_lloyd_step(s.data, s.mask, centers)[1])
+    use_pallas = _pallas_ok(s.data, centers)
+    mh = MeshHolder(get_mesh()) if use_pallas else None
     iters = 40
-    c = centers
+    # the trailing float() pull is the only reliable sync on the axon relay
+    # (block_until_ready returns early); the loop may stop short of `iters`
+    # at an exact fixed point, so throughput uses the ACTUAL round count
+    args = (s.data, s.mask, centers, jnp.float32(0.0), jnp.int32(iters))
+    float(_lloyd_loop(*args, mesh_holder=mh, use_pallas=use_pallas)[1])  # compile
     t0 = time.perf_counter()
-    for _ in range(iters):
-        c, inertia, shift = _lloyd_step(s.data, s.mask, c)
-    float(inertia)  # force the whole chain
+    out = _lloyd_loop(*args, mesh_holder=mh, use_pallas=use_pallas)
+    float(out[1])  # force the whole chain
     dt = time.perf_counter() - t0
-    lloyd_rows_per_sec = n * iters / dt
+    n_rounds = int(out[2])
+    lloyd_rows_per_sec = n * n_rounds / dt
 
     # --- ADMM logistic fit (north-star #1 shape, scaled) ---
     d2 = 28
@@ -64,6 +72,7 @@ def main():
                 "extra": {
                     "platform": jax.devices()[0].platform,
                     "n_devices": len(jax.devices()),
+                    "pallas_lloyd": use_pallas,
                     "admm_logreg_fit_1m_x28_10iter_s": round(admm_fit_s, 3),
                 },
             }

@@ -164,22 +164,15 @@ class SearchCheckpoint:
             self.path,
         )
 
-    def matches(self) -> bool:
-        """True if the on-disk snapshot belongs to this search config."""
+    def load_if_matches(self):
+        """One read: the snapshot tuple, or None if absent / written by a
+        differently-configured search (see class docstring)."""
         if not self.exists():
-            return False
-        with open(self.path, "rb") as f:
-            snap = pickle.load(f)
-        return snap.get("fingerprint") == self.fingerprint
-
-    def load(self):
+            return None
         with open(self.path, "rb") as f:
             snap = pickle.load(f)
         if snap.get("fingerprint") != self.fingerprint:
-            raise ValueError(
-                f"checkpoint {self.path} belongs to a different search "
-                "configuration; delete it or use a different path"
-            )
+            return None
         return snap["models"], snap["info"], snap["policy_state"], snap.get("elapsed", 0.0)
 
     def complete(self) -> None:

@@ -55,6 +55,91 @@ def _lloyd_step(x, mask, centers):
     return new_centers, inertia, shift
 
 
+def _lloyd_step_pallas(x, mask, centers, mesh):
+    """Lloyd round via the fused Pallas kernel (ops.lloyd): X streams
+    through VMEM once; the three tiny reductions psum over the mesh."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..core.compat import shard_map_unchecked
+    from ..core.mesh import DATA_AXIS
+    from ..ops import lloyd_assign_reduce
+
+    def local(xb, mb, c):
+        sums, counts, inertia = lloyd_assign_reduce(xb, mb, c)
+        sums = lax.psum(sums, DATA_AXIS)
+        counts = lax.psum(counts, DATA_AXIS)
+        inertia = lax.psum(inertia, DATA_AXIS)
+        new_centers = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), c
+        )
+        shift = jnp.sum((new_centers - c) ** 2)
+        return new_centers, inertia, shift
+
+    return shard_map_unchecked(
+        local, mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+        out_specs=(P(), P(), P()),
+    )(x, mask, centers)
+
+
+def _pallas_ok(x, centers) -> bool:
+    """Pallas path gate: TPU backend, kernel-friendly shapes, not opted out."""
+    import os
+
+    if os.environ.get("DASK_ML_TPU_NO_PALLAS"):
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    # VMEM budget for the 2048-row tile: x-tile (T·d·4B) plus the
+    # cross/d2/onehot intermediates (3·T·k·4B) must stay well under the
+    # ~16 MB/core VMEM with double buffering — d≤128, k≤64 keeps the
+    # working set ≤ ~2.5 MB
+    return centers.shape[0] <= 64 and x.shape[1] <= 128
+
+
+from ..core.mesh import MeshHolder  # noqa: E402
+from functools import partial as _fpartial  # noqa: E402
+
+
+@_fpartial(jax.jit, static_argnames=("mesh_holder", "use_pallas"))
+def _lloyd_loop(x, mask, centers, tol, max_iter, *, mesh_holder=None,
+                use_pallas=False):
+    """The ENTIRE Lloyd iteration as one XLA program.
+
+    The reference re-enters the scheduler every round (SURVEY.md §3.2); a
+    per-round jitted step would likewise pay one dispatch + one host sync
+    (the ``shift <= tol`` check) per round.  Fusing the loop into
+    ``lax.while_loop`` keeps convergence control on device: one dispatch
+    per fit, no host round-trips.  ``tol``/``max_iter`` are device scalars
+    so different settings don't recompile.  With ``use_pallas`` the round
+    body is the fused ops.lloyd kernel instead of the XLA lowering.
+    """
+
+    def step(x_, m_, c_):
+        if use_pallas:
+            return _lloyd_step_pallas(x_, m_, c_, mesh_holder.mesh)
+        return _lloyd_step(x_, m_, c_)
+
+    def cond(state):
+        i, _, _, shift = state
+        return (i < max_iter) & (shift > tol)
+
+    def body(state):
+        i, centers, _, _ = state
+        new_centers, inertia, shift = step(x, mask, centers)
+        return i + 1, new_centers, inertia, shift
+
+    init = (
+        jnp.int32(0),
+        centers,
+        jnp.asarray(jnp.inf, x.dtype),
+        jnp.asarray(jnp.inf, x.dtype),
+    )
+    i, centers, inertia, shift = jax.lax.while_loop(cond, body, init)
+    return centers, inertia, i
+
+
 @jax.jit
 def _assign(x, mask, centers):
     d2 = _sq_dists(x, centers)
@@ -213,18 +298,21 @@ class KMeans(TransformerMixin, TPUEstimator):
         centers = self._init_centers(X, key)
 
         x, mask = X.data, X.mask
-        n_iter = 0
         # sklearn-style tol scaling: mean of per-feature variances, masked so
         # pad rows don't inflate the threshold
         from ..core.sharded import masked_var
 
-        tol = self.tol * float(jnp.mean(masked_var(x, mask)))
+        tol = self.tol * jnp.mean(masked_var(x, mask))  # stays on device
+        use_pallas = _pallas_ok(x, centers)
         with _timer("Lloyd loop", logger, logging.DEBUG):
-            for i in range(self.max_iter):
-                centers, inertia, shift = _lloyd_step(x, mask, centers)
-                n_iter = i + 1
-                if float(shift) <= tol:
-                    break
+            from ..core.mesh import get_mesh
+
+            centers, _, n_iter_dev = _lloyd_loop(
+                x, mask, centers, tol.astype(x.dtype), jnp.int32(self.max_iter),
+                mesh_holder=MeshHolder(get_mesh()) if use_pallas else None,
+                use_pallas=use_pallas,
+            )
+            n_iter = int(n_iter_dev)
         labels, inertia = _assign(x, mask, centers)
 
         self.cluster_centers_ = centers

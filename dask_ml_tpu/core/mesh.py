@@ -27,6 +27,19 @@ MODEL_AXIS = "model"
 _state = threading.local()
 
 
+class MeshHolder:
+    """Hashable mesh wrapper so a Mesh can be a static jit argument."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def __hash__(self):
+        return hash(self.mesh)
+
+    def __eq__(self, other):
+        return isinstance(other, MeshHolder) and self.mesh == other.mesh
+
+
 def device_mesh(n_devices: int | None = None, *, model_axis: int = 1) -> Mesh:
     """Build a mesh of ``n_devices`` (default: all) as ('data', 'model').
 

@@ -155,13 +155,14 @@ class BaseIncrementalSearchCV(TPUEstimator):
         models = {}
         info = defaultdict(list)
         start_time = time.time()
-        if ckpt is not None and ckpt.exists() and not ckpt.matches():
+        snap = ckpt.load_if_matches() if ckpt is not None else None
+        if ckpt is not None and snap is None and ckpt.exists():
             logger.warning(
                 "checkpoint %s belongs to a different search configuration; "
                 "ignoring it and starting fresh", ckpt.path,
             )
-        elif ckpt is not None and ckpt.exists():
-            saved_models, saved_info, policy_state, prior_elapsed = ckpt.load()
+        if snap is not None:
+            saved_models, saved_info, policy_state, prior_elapsed = snap
             models.update(saved_models)
             for k, v in saved_info.items():
                 info[k] = list(v)

@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The reference has no native kernels (its L0 is NumPy/BLAS via dependencies —
+SURVEY.md §2); here the analogous fast layer is XLA, and where XLA's fusion
+falls short we drop to Pallas.  Kernels ship with an ``interpret`` path so
+the CPU-mesh test suite exercises them without TPU hardware.
+"""
+
+from .lloyd import lloyd_assign_reduce  # noqa: F401
+
+__all__ = ["lloyd_assign_reduce"]

@@ -6,6 +6,20 @@ coefficient vector.  The gradient of the masked total loss is computed by
 autodiff under ``jit``; with sharded inputs XLA turns the loss reduction
 into an ICI psum — the reference's per-iteration scatter/gather through the
 scheduler disappears (SURVEY.md §3.1 "TPU mapping").
+
+Two structural rules, learned the hard way on real TPU hardware:
+
+* **Whole-solve fusion.**  Each solver's outer convergence loop runs
+  device-side in ``lax.while_loop`` (including the stopping rule), so a fit
+  costs ONE dispatch instead of ``max_iter`` dispatches each followed by a
+  host ``float()`` sync.
+* **Data as arguments, never closure constants.**  The jitted runners are
+  module-level and take ``(x, y, mask)`` as arguments with ``(family,
+  regularizer)`` as static args.  Capturing the design matrix in a closure
+  would bake hundreds of MB into the HLO as a constant (breaking remote
+  compilation outright) and force a recompile per ``fit`` — with arguments,
+  one compilation serves every same-shape fit (Hyperband's many-models loop
+  in particular).
 """
 
 from __future__ import annotations
@@ -21,7 +35,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core.compat import shard_map_unchecked
-from ..core.mesh import DATA_AXIS, get_mesh
+from ..core.mesh import DATA_AXIS, MeshHolder, get_mesh
 from ..core.sharded import ShardedRows, shard_rows
 from .families import Family, Logistic
 from .lbfgs_core import _backtrack, lbfgs_minimize
@@ -43,13 +57,34 @@ def _prep(X, y):
     return x, yv.astype(x.dtype), mask
 
 
-def _objective(family, reg, lam, x, y, mask, smooth_only=False):
-    if lam == 0 or (smooth_only and not reg.smooth):
-        return lambda b: family.loss(b, x, y, mask)
-    return lambda b: family.loss(b, x, y, mask) + reg.penalty(b, lam)
+def _make_objective(family, reg, x, y, mask, lamduh):
+    """Total objective as a traceable closure over THIS trace's arrays.
+
+    ``lamduh`` is a traced scalar: zero simply zeroes the penalty term, so
+    one compiled program covers every regularization strength.
+    """
+
+    def obj(b):
+        return family.loss(b, x, y, mask) + reg.penalty(b, lamduh)
+
+    return obj
+
+
+def _converged(f_prev, f_new, tol):
+    # isfinite guard: f_prev starts at inf, and inf <= inf would declare
+    # convergence on the very first iteration
+    return jnp.isfinite(f_prev) & (
+        jnp.abs(f_prev - f_new) <= tol * jnp.maximum(jnp.abs(f_prev), 1.0)
+    )
 
 
 # ---------------------------------------------------------------- lbfgs --
+
+
+@partial(jax.jit, static_argnames=("family", "reg"))
+def _lbfgs_run(x, yv, mask, beta0, lamduh, max_iter, tol, *, family, reg):
+    obj = _make_objective(family, reg, x, yv, mask, lamduh)
+    return lbfgs_minimize(obj, beta0, max_iter=max_iter, tol=tol)[0]
 
 
 def lbfgs(X, y, *, family: type[Family] = Logistic, regularizer=L2,
@@ -67,16 +102,41 @@ def lbfgs(X, y, *, family: type[Family] = Logistic, regularizer=L2,
         )
     x, yv, mask = _prep(X, y)
     beta0 = jnp.zeros(x.shape[1], dtype=x.dtype)
-    obj = _objective(family, reg, lamduh, x, yv, mask)
-
-    @jax.jit
-    def run(b0):
-        return lbfgs_minimize(obj, b0, max_iter=max_iter, tol=tol)[0]
-
-    return run(beta0)
+    return _lbfgs_run(
+        x, yv, mask, beta0, jnp.asarray(lamduh, x.dtype),
+        jnp.int32(max_iter), jnp.asarray(tol, x.dtype),
+        family=family, reg=reg,
+    )
 
 
 # ---------------------------------------------------- gradient descent --
+
+
+@partial(jax.jit, static_argnames=("family", "reg"))
+def _gd_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg):
+    obj = _make_objective(family, reg, x, yv, mask, lamduh)
+    vg = jax.value_and_grad(obj)
+
+    def cond(state):
+        i, _, _, f_prev, converged = state
+        return (i < max_it) & ~converged
+
+    def body(state):
+        i, beta, stepsize, f_prev, _ = state
+        f, g = vg(beta)
+        t, f_new, failed = _backtrack(obj, beta, f, g, -stepsize * g, 1e-4, 30)
+        beta_new = beta - t * stepsize * g
+        stepsize_new = jnp.where(t > 0, stepsize * t * 2.0, stepsize * 0.5)
+        return i + 1, beta_new, stepsize_new, f_new, _converged(f_prev, f_new, tol)
+
+    init = (
+        jnp.int32(0),
+        beta0,
+        jnp.asarray(1.0, beta0.dtype),
+        jnp.asarray(jnp.inf, beta0.dtype),
+        jnp.asarray(False),
+    )
+    return lax.while_loop(cond, body, init)[1]
 
 
 def gradient_descent(X, y, *, family: type[Family] = Logistic,
@@ -87,45 +147,22 @@ def gradient_descent(X, y, *, family: type[Family] = Logistic,
     if lamduh and not reg.smooth:
         raise ValueError("gradient_descent requires a smooth penalty; use proximal_grad")
     x, yv, mask = _prep(X, y)
-    obj = _objective(family, reg, lamduh, x, yv, mask)
-    vg = jax.value_and_grad(obj)
-
-    @jax.jit
-    def step(beta, stepsize):
-        f, g = vg(beta)
-        t, f_new, failed = _backtrack(
-            obj, beta, f, g, -stepsize * g, 1e-4, 30
-        )
-        beta_new = beta - t * stepsize * g
-        return beta_new, f, f_new, t
-
-    beta = jnp.zeros(x.shape[1], dtype=x.dtype)
-    stepsize = 1.0
-    f_prev = None
-    for i in range(max_iter):
-        beta, f, f_new, t = step(beta, stepsize)
-        t = float(t)
-        stepsize = stepsize * t * 2.0 if t > 0 else stepsize * 0.5
-        f_new = float(f_new)
-        if f_prev is not None and abs(f_prev - f_new) <= tol * max(abs(f_prev), 1.0):
-            break
-        f_prev = f_new
-    return beta
+    beta0 = jnp.zeros(x.shape[1], dtype=x.dtype)
+    return _gd_run(
+        x, yv, mask, beta0, jnp.asarray(lamduh, x.dtype),
+        jnp.int32(max_iter), jnp.asarray(tol, x.dtype),
+        family=family, reg=reg,
+    )
 
 
 # ------------------------------------------------------ proximal grad --
 
 
-def proximal_grad(X, y, *, family: type[Family] = Logistic, regularizer=L2,
-                  lamduh: float = 0.0, max_iter: int = 100, tol: float = 1e-7):
-    """Proximal gradient with backtracking on the smooth part (reference
-    ``proximal_grad``): z = prox_{tλ}(β − t∇f(β))."""
-    reg = get_regularizer(regularizer)
-    x, yv, mask = _prep(X, y)
+@partial(jax.jit, static_argnames=("family", "reg"))
+def _pg_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg):
     f_smooth = lambda b: family.loss(b, x, yv, mask)  # noqa: E731
     vg = jax.value_and_grad(f_smooth)
 
-    @jax.jit
     def step(beta, t0):
         f, g = vg(beta)
 
@@ -140,39 +177,52 @@ def proximal_grad(X, y, *, family: type[Family] = Logistic, regularizer=L2,
             t, j = carry
             return 0.5 * t, j + 1
 
-        t, _ = lax.while_loop(cond, body, (t0, 0))
+        t, _ = lax.while_loop(cond, body, (t0, jnp.int32(0)))
         z = reg.prox(beta - t * g, t * lamduh)
         return z, t, f
 
-    beta = jnp.zeros(x.shape[1], dtype=x.dtype)
-    t = 1.0
-    f_prev = None
-    for i in range(max_iter):
-        beta, t_used, f = step(beta, t)
-        t = float(t_used) * 2.0
-        f = float(f)
-        if f_prev is not None and abs(f_prev - f) <= tol * max(abs(f_prev), 1.0):
-            break
-        f_prev = f
-    return beta
+    def cond(state):
+        i, _, _, _, converged = state
+        return (i < max_it) & ~converged
+
+    def body(state):
+        i, beta, t, f_prev, _ = state
+        beta_new, t_used, f = step(beta, t)
+        return i + 1, beta_new, t_used * 2.0, f, _converged(f_prev, f, tol)
+
+    init = (
+        jnp.int32(0),
+        beta0,
+        jnp.asarray(1.0, beta0.dtype),
+        jnp.asarray(jnp.inf, beta0.dtype),
+        jnp.asarray(False),
+    )
+    return lax.while_loop(cond, body, init)[1]
+
+
+def proximal_grad(X, y, *, family: type[Family] = Logistic, regularizer=L2,
+                  lamduh: float = 0.0, max_iter: int = 100, tol: float = 1e-7):
+    """Proximal gradient with backtracking on the smooth part (reference
+    ``proximal_grad``): z = prox_{tλ}(β − t∇f(β))."""
+    reg = get_regularizer(regularizer)
+    x, yv, mask = _prep(X, y)
+    beta0 = jnp.zeros(x.shape[1], dtype=x.dtype)
+    return _pg_run(
+        x, yv, mask, beta0, jnp.asarray(lamduh, x.dtype),
+        jnp.int32(max_iter), jnp.asarray(tol, x.dtype),
+        family=family, reg=reg,
+    )
 
 
 # ------------------------------------------------------------- newton --
 
 
-def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
-           lamduh: float = 0.0, max_iter: int = 50, tol: float = 1e-8):
-    """Damped Newton: distributed Hessian XᵀWX (one psum-reduced gemm),
-    replicated (d×d) solve (reference ``newton``)."""
-    reg = get_regularizer(regularizer)
-    if lamduh and not reg.smooth:
-        raise ValueError("newton requires a smooth penalty")
-    x, yv, mask = _prep(X, y)
-    obj = _objective(family, reg, lamduh, x, yv, mask)
+@partial(jax.jit, static_argnames=("family", "reg"))
+def _newton_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg):
+    obj = _make_objective(family, reg, x, yv, mask, lamduh)
     vg = jax.value_and_grad(obj)
     d = x.shape[1]
 
-    @jax.jit
     def step(beta):
         f, g = vg(beta)
         eta = x @ beta
@@ -185,42 +235,49 @@ def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
         t, f_new, failed = _backtrack(obj, beta, f, g, p, 1e-4, 30)
         return beta + t * p, f, f_new
 
-    beta = jnp.zeros(d, dtype=x.dtype)
-    f_prev = None
-    for i in range(max_iter):
-        beta, f, f_new = step(beta)
-        f_new = float(f_new)
-        if f_prev is not None and abs(f_prev - f_new) <= tol * max(abs(f_prev), 1.0):
-            break
-        f_prev = f_new
-    return beta
+    def cond(state):
+        i, _, _, converged = state
+        return (i < max_it) & ~converged
+
+    def body(state):
+        i, beta, f_prev, _ = state
+        beta_new, f, f_new = step(beta)
+        return i + 1, beta_new, f_new, _converged(f_prev, f_new, tol)
+
+    init = (
+        jnp.int32(0),
+        beta0,
+        jnp.asarray(jnp.inf, beta0.dtype),
+        jnp.asarray(False),
+    )
+    return lax.while_loop(cond, body, init)[1]
+
+
+def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
+           lamduh: float = 0.0, max_iter: int = 50, tol: float = 1e-8):
+    """Damped Newton: distributed Hessian XᵀWX (one psum-reduced gemm),
+    replicated (d×d) solve (reference ``newton``)."""
+    reg = get_regularizer(regularizer)
+    if lamduh and not reg.smooth:
+        raise ValueError("newton requires a smooth penalty")
+    x, yv, mask = _prep(X, y)
+    beta0 = jnp.zeros(x.shape[1], dtype=x.dtype)
+    return _newton_run(
+        x, yv, mask, beta0, jnp.asarray(lamduh, x.dtype),
+        jnp.int32(max_iter), jnp.asarray(tol, x.dtype),
+        family=family, reg=reg,
+    )
 
 
 # --------------------------------------------------------------- admm --
 
 
-def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
-         lamduh: float = 0.0, rho: float = 1.0, max_iter: int = 100,
-         abstol: float = 1e-4, reltol: float = 1e-2,
-         inner_iter: int = 50, inner_tol: float = 1e-6, mesh=None):
-    """Consensus ADMM (Boyd et al. §8): per-shard local subproblems solved by
-    the jit-safe L-BFGS inside ``shard_map``, consensus z through the
-    regularizer's prox, scaled dual updates.
-
-    Reference: ``dask_glm/algorithms.py :: admm`` — one scatter/gather round
-    per iteration through the scheduler, scipy L-BFGS per chunk on workers
-    (SURVEY.md §3.1).  Here one iteration = one XLA program: P parallel
-    local L-BFGS runs + a single psum for the consensus mean.
-    """
-    reg = get_regularizer(regularizer)
-    mesh = mesh or get_mesh()
+@partial(jax.jit, static_argnames=("family", "reg", "mesh_holder", "inner_iter"))
+def _admm_run(x, yv, mask, lamduh, rho, abstol, reltol, inner_tol, max_it,
+              *, family, reg, mesh_holder, inner_iter):
+    mesh = mesh_holder.mesh
     n_shards = mesh.shape[DATA_AXIS]
-    x, yv, mask = _prep(X, y)
     d = x.shape[1]
-
-    beta_l = jnp.zeros((n_shards, d), dtype=x.dtype)
-    u_l = jnp.zeros((n_shards, d), dtype=x.dtype)
-    z = jnp.zeros(d, dtype=x.dtype)
 
     def one_shard(xb, yb, mb, z_rep, beta_b, u_b):
         u0, b0 = u_b[0], beta_b[0]
@@ -243,42 +300,81 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
         u_norm_sq = lax.psum(jnp.sum(u_new ** 2), DATA_AXIS)
         return b_new[None], u_new[None], z_new, primal_sq, beta_norm_sq, u_norm_sq
 
-    step = jax.jit(
-        shard_map_unchecked(
-            one_shard,
-            mesh,
-            in_specs=(
-                P(DATA_AXIS, None),  # x
-                P(DATA_AXIS),  # y
-                P(DATA_AXIS),  # mask
-                P(),  # z
-                P(DATA_AXIS, None),  # beta per shard
-                P(DATA_AXIS, None),  # u per shard
-            ),
-            out_specs=(
-                P(DATA_AXIS, None),
-                P(DATA_AXIS, None),
-                P(),
-                P(),
-                P(),
-                P(),
-            ),
-        )
+    step = shard_map_unchecked(
+        one_shard,
+        mesh,
+        in_specs=(
+            P(DATA_AXIS, None),  # x
+            P(DATA_AXIS),  # y
+            P(DATA_AXIS),  # mask
+            P(),  # z
+            P(DATA_AXIS, None),  # beta per shard
+            P(DATA_AXIS, None),  # u per shard
+        ),
+        out_specs=(
+            P(DATA_AXIS, None),
+            P(DATA_AXIS, None),
+            P(),
+            P(),
+            P(),
+            P(),
+        ),
     )
 
-    sqrt_d = float(np.sqrt(d))
-    for i in range(max_iter):
+    # Boyd residual stopping rule, also on device: the whole solve is one
+    # XLA program regardless of iteration count.
+    sqrt_d = jnp.sqrt(jnp.asarray(d, x.dtype))
+
+    def cond(state):
+        i, _, _, _, primal, dual, eps_pri, eps_dual = state
+        return (i < max_it) & ((primal >= eps_pri) | (dual >= eps_dual))
+
+    def body(state):
+        i, beta_l, u_l, z, *_ = state
         z_old = z
         beta_l, u_l, z, primal_sq, beta_sq, u_sq = step(
             x, yv, mask, z, beta_l, u_l
         )
-        primal = float(jnp.sqrt(primal_sq))
-        dual = float(rho * jnp.sqrt(n_shards * jnp.sum((z - z_old) ** 2)))
-        eps_pri = sqrt_d * abstol + reltol * max(
-            float(jnp.sqrt(beta_sq)), float(jnp.sqrt(n_shards) * jnp.linalg.norm(z))
+        primal = jnp.sqrt(primal_sq)
+        dual = rho * jnp.sqrt(n_shards * jnp.sum((z - z_old) ** 2))
+        eps_pri = sqrt_d * abstol + reltol * jnp.maximum(
+            jnp.sqrt(beta_sq), jnp.sqrt(n_shards * 1.0) * jnp.linalg.norm(z)
         )
-        eps_dual = sqrt_d * abstol + reltol * float(rho * jnp.sqrt(u_sq))
-        logger.debug("admm iter %d: primal %.3e dual %.3e", i, primal, dual)
-        if primal < eps_pri and dual < eps_dual:
-            break
-    return z
+        eps_dual = sqrt_d * abstol + reltol * rho * jnp.sqrt(u_sq)
+        return i + 1, beta_l, u_l, z, primal, dual, eps_pri, eps_dual
+
+    inf = jnp.asarray(jnp.inf, x.dtype)
+    zero = jnp.asarray(0.0, x.dtype)
+    beta_l0 = jnp.zeros((n_shards, d), dtype=x.dtype)
+    u_l0 = jnp.zeros((n_shards, d), dtype=x.dtype)
+    z0 = jnp.zeros(d, dtype=x.dtype)
+    init = (jnp.int32(0), beta_l0, u_l0, z0, inf, inf, zero, zero)
+    return lax.while_loop(cond, body, init)[3]
+
+
+def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
+         lamduh: float = 0.0, rho: float = 1.0, max_iter: int = 100,
+         abstol: float = 1e-4, reltol: float = 1e-2,
+         inner_iter: int = 50, inner_tol: float = 1e-6, mesh=None):
+    """Consensus ADMM (Boyd et al. §8): per-shard local subproblems solved by
+    the jit-safe L-BFGS inside ``shard_map``, consensus z through the
+    regularizer's prox, scaled dual updates.
+
+    Reference: ``dask_glm/algorithms.py :: admm`` — one scatter/gather round
+    per iteration through the scheduler, scipy L-BFGS per chunk on workers
+    (SURVEY.md §3.1).  Here the ENTIRE solve is one XLA program: P parallel
+    local L-BFGS runs + psums for consensus and residuals per round, with
+    the Boyd stopping rule evaluated on device.
+    """
+    reg = get_regularizer(regularizer)
+    mesh = mesh or get_mesh()
+    x, yv, mask = _prep(X, y)
+    dt = x.dtype
+    return _admm_run(
+        x, yv, mask,
+        jnp.asarray(lamduh, dt), jnp.asarray(rho, dt),
+        jnp.asarray(abstol, dt), jnp.asarray(reltol, dt),
+        jnp.asarray(inner_tol, dt), jnp.int32(max_iter),
+        family=family, reg=reg, mesh_holder=MeshHolder(mesh),
+        inner_iter=inner_iter,
+    )
