@@ -33,6 +33,8 @@ from . import solvers  # noqa: F401
 from . import linear_model  # noqa: F401
 from . import feature_extraction  # noqa: F401
 from . import impute  # noqa: F401
+from . import io  # noqa: F401
+from . import ops  # noqa: F401
 from . import naive_bayes  # noqa: F401
 from . import ensemble  # noqa: F401
 from . import compose  # noqa: F401
@@ -54,6 +56,8 @@ __all__ = [
     "linear_model",
     "feature_extraction",
     "impute",
+    "io",
+    "ops",
     "naive_bayes",
     "ensemble",
     "checkpoint",

@@ -1,0 +1,139 @@
+"""Host IO: native multithreaded CSV / raw-float32 ingest.
+
+The reference delegates file ingest to dask.dataframe/array readers
+(external; pandas C parser under the hood).  Here the loader is an in-repo
+C++ shim (``native/loader.cpp``, built on first use with the system g++)
+driven through ctypes — no Python-level tokenization on the ingest path —
+plus generators that stream row blocks straight into ``shard_rows`` /
+``wrappers.Incremental``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["read_csv", "read_binary", "stream_csv_blocks", "read_csv_sharded"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_SRC = os.path.join(_NATIVE_DIR, "loader.cpp")
+_SO = os.path.join(_NATIVE_DIR, "_loader.so")
+
+_lock = threading.Lock()
+_lib = None
+
+
+def _build() -> None:
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        _SRC, "-o", _SO,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except FileNotFoundError as e:  # pragma: no cover
+        raise RuntimeError("native loader needs g++ on PATH") from e
+    except subprocess.CalledProcessError as e:  # pragma: no cover
+        raise RuntimeError(f"native loader build failed:\n{e.stderr}") from e
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_SO)) or (
+            os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        ):
+            _build()
+        lib = ctypes.CDLL(_SO)
+        lib.dmlt_csv_dims.argtypes = [
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.dmlt_csv_dims.restype = ctypes.c_int
+        lib.dmlt_csv_read_f32.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+        ]
+        lib.dmlt_csv_read_f32.restype = ctypes.c_int
+        lib.dmlt_bin_read_f32.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.dmlt_bin_read_f32.restype = ctypes.c_int
+        _lib = lib
+        return lib
+
+
+def _check(rc: int, path: str) -> None:
+    if rc != 0:
+        raise OSError(-rc, os.strerror(-rc) if -rc < 200 else "parse error", path)
+
+
+def csv_dims(path: str, *, has_header: bool = False) -> tuple[int, int]:
+    """(rows, cols) of a numeric CSV, excluding the header if present."""
+    lib = _load()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    rc = lib.dmlt_csv_dims(path.encode(), int(has_header), ctypes.byref(rows), ctypes.byref(cols))
+    _check(rc, path)
+    return rows.value, cols.value
+
+
+def read_csv(path: str, *, has_header: bool = False,
+             n_threads: int | None = None) -> np.ndarray:
+    """Parse a numeric CSV into a float32 (rows, cols) array, one parser
+    thread per row range."""
+    lib = _load()
+    rows, cols = csv_dims(path, has_header=has_header)
+    out = np.empty((rows, cols), dtype=np.float32)
+    n_threads = n_threads or min(32, os.cpu_count() or 1)
+    rc = lib.dmlt_csv_read_f32(
+        path.encode(), int(has_header), 0, rows, cols,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), int(n_threads),
+    )
+    _check(rc, path)
+    return out
+
+
+def read_binary(path: str, shape: tuple[int, ...], *,
+                offset_bytes: int = 0) -> np.ndarray:
+    """Read raw little-endian float32 into the given shape."""
+    lib = _load()
+    out = np.empty(shape, dtype=np.float32)
+    rc = lib.dmlt_bin_read_f32(
+        path.encode(), int(offset_bytes), int(out.size),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    _check(rc, path)
+    return out
+
+
+def stream_csv_blocks(path: str, block_rows: int, *, has_header: bool = False,
+                      n_threads: int | None = None):
+    """Yield float32 row blocks of (at most) ``block_rows`` — the
+    out-of-core ingest feeding ``wrappers.Incremental`` (the reference's
+    sequential block streaming, SURVEY.md §2.2)."""
+    lib = _load()
+    rows, cols = csv_dims(path, has_header=has_header)
+    n_threads = n_threads or min(8, os.cpu_count() or 1)
+    for lo in range(0, rows, block_rows):
+        n = min(block_rows, rows - lo)
+        out = np.empty((n, cols), dtype=np.float32)
+        rc = lib.dmlt_csv_read_f32(
+            path.encode(), int(has_header), lo, n, cols,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), int(n_threads),
+        )
+        _check(rc, path)
+        yield out
+
+
+def read_csv_sharded(path: str, *, has_header: bool = False, mesh=None):
+    """Parse a CSV and place it row-sharded over the mesh (ShardedRows)."""
+    from .core.sharded import shard_rows
+
+    return shard_rows(read_csv(path, has_header=has_header), mesh)

@@ -1,0 +1,163 @@
+// Native data loader: multithreaded CSV / raw-f32 ingest.
+//
+// The reference's ingest layer is dask.dataframe/array readers (external,
+// pure-Python orchestration over pandas C parsers).  This framework's
+// analogue is a small C++ shim that parses numeric CSV and raw float32
+// files into caller-owned row-major buffers with one thread per row range,
+// feeding core.sharded.shard_rows / the Incremental streaming path without
+// the Python-level tokenize-and-box overhead.
+//
+// Contract (all functions return 0 on success, negative errno-style codes
+// on failure; no exceptions cross the C boundary):
+//   dmlt_csv_dims(path, has_header, &rows, &cols)
+//   dmlt_csv_read_f32(path, has_header, row_start, rows, cols, out, n_threads)
+//   dmlt_bin_read_f32(path, offset_bytes, count, out)
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct FileBuf {
+    char* data = nullptr;
+    size_t size = 0;
+    ~FileBuf() { std::free(data); }
+};
+
+// Read the whole file into memory (CSV parse is CPU-bound; one sequential
+// read is the fastest way to feed it).
+int read_file(const char* path, FileBuf& buf) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -errno;
+    std::fseek(f, 0, SEEK_END);
+    long sz = std::ftell(f);
+    if (sz < 0) {
+        std::fclose(f);
+        return -EIO;
+    }
+    std::fseek(f, 0, SEEK_SET);
+    buf.data = static_cast<char*>(std::malloc(sz ? sz : 1));
+    if (!buf.data) {
+        std::fclose(f);
+        return -ENOMEM;
+    }
+    size_t got = std::fread(buf.data, 1, sz, f);
+    std::fclose(f);
+    if (got != static_cast<size_t>(sz)) return -EIO;
+    buf.size = sz;
+    return 0;
+}
+
+// Offsets of line starts for every non-empty line.
+void line_starts(const FileBuf& buf, std::vector<size_t>& starts) {
+    size_t i = 0;
+    const size_t n = buf.size;
+    while (i < n) {
+        starts.push_back(i);
+        while (i < n && buf.data[i] != '\n') i++;
+        i++;  // past '\n'
+        // swallow blank trailing lines
+        while (i < n && (buf.data[i] == '\n' || buf.data[i] == '\r')) i++;
+    }
+}
+
+long count_cols(const char* line, const char* end) {
+    long cols = 1;
+    for (const char* p = line; p < end && *p != '\n'; p++)
+        if (*p == ',') cols++;
+    return cols;
+}
+
+// Parse rows [r0, r1) into out (already offset by caller).
+void parse_rows(const FileBuf& buf, const std::vector<size_t>& starts,
+                size_t r0, size_t r1, long cols, float* out, int* err) {
+    for (size_t r = r0; r < r1; r++) {
+        const char* p = buf.data + starts[r];
+        const char* line_end = buf.data + (r + 1 < starts.size() ? starts[r + 1] : buf.size);
+        float* row = out + (r - r0) * cols;
+        for (long c = 0; c < cols; c++) {
+            char* next = nullptr;
+            row[c] = std::strtof(p, &next);
+            if (next == p) {  // no parse progress: malformed field
+                *err = -EINVAL;
+                return;
+            }
+            p = next;
+            while (p < line_end && (*p == ',' || *p == ' ' || *p == '\r')) p++;
+        }
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+int dmlt_csv_dims(const char* path, int has_header, int64_t* rows, int64_t* cols) {
+    FileBuf buf;
+    int rc = read_file(path, buf);
+    if (rc) return rc;
+    std::vector<size_t> starts;
+    line_starts(buf, starts);
+    size_t n = starts.size();
+    size_t skip = has_header ? 1 : 0;
+    if (n <= skip) {
+        *rows = 0;
+        *cols = 0;
+        return 0;
+    }
+    *rows = static_cast<int64_t>(n - skip);
+    const char* first = buf.data + starts[skip];
+    const char* end = buf.data + (skip + 1 < n ? starts[skip + 1] : buf.size);
+    *cols = count_cols(first, end);
+    return 0;
+}
+
+int dmlt_csv_read_f32(const char* path, int has_header, int64_t row_start,
+                      int64_t rows, int64_t cols, float* out, int n_threads) {
+    FileBuf buf;
+    int rc = read_file(path, buf);
+    if (rc) return rc;
+    std::vector<size_t> starts;
+    line_starts(buf, starts);
+    size_t skip = (has_header ? 1 : 0) + static_cast<size_t>(row_start);
+    if (starts.size() < skip + rows) return -ERANGE;
+
+    if (n_threads < 1) n_threads = 1;
+    if (static_cast<int64_t>(n_threads) > rows) n_threads = rows > 0 ? rows : 1;
+    std::vector<std::thread> threads;
+    std::vector<int> errs(n_threads, 0);
+    int64_t per = (rows + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; t++) {
+        int64_t r0 = t * per;
+        int64_t r1 = std::min(rows, r0 + per);
+        if (r0 >= r1) break;
+        threads.emplace_back([&, t, r0, r1] {
+            parse_rows(buf, starts, skip + r0, skip + r1, cols,
+                       out + r0 * cols, &errs[t]);
+        });
+    }
+    for (auto& th : threads) th.join();
+    for (int e : errs)
+        if (e) return e;
+    return 0;
+}
+
+int dmlt_bin_read_f32(const char* path, int64_t offset_bytes, int64_t count,
+                      float* out) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -errno;
+    if (std::fseek(f, offset_bytes, SEEK_SET)) {
+        std::fclose(f);
+        return -EIO;
+    }
+    size_t got = std::fread(out, sizeof(float), count, f);
+    std::fclose(f);
+    return got == static_cast<size_t>(count) ? 0 : -EIO;
+}
+
+}  // extern "C"

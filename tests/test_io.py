@@ -1,0 +1,105 @@
+"""Native loader tests (C++ shim via ctypes)."""
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import io as dio
+
+
+@pytest.fixture(scope="module")
+def csv_file(tmp_path_factory):
+    rng = np.random.RandomState(0)
+    X = np.round(rng.normal(size=(537, 6)).astype(np.float32), 5)
+    p = tmp_path_factory.mktemp("io") / "data.csv"
+    np.savetxt(p, X, delimiter=",", fmt="%.5f")
+    return str(p), X
+
+
+class TestCSV:
+    def test_dims(self, csv_file):
+        p, X = csv_file
+        assert dio.csv_dims(p) == X.shape
+
+    def test_read_matches_numpy(self, csv_file):
+        p, X = csv_file
+        out = dio.read_csv(p)
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out, X, rtol=1e-5)
+
+    def test_multithreaded_identical(self, csv_file):
+        p, X = csv_file
+        np.testing.assert_array_equal(
+            dio.read_csv(p, n_threads=1), dio.read_csv(p, n_threads=7)
+        )
+
+    def test_header_skipped(self, tmp_path):
+        p = tmp_path / "h.csv"
+        p.write_text("a,b\n1.5,2.5\n3.0,4.0\n")
+        out = dio.read_csv(str(p), has_header=True)
+        np.testing.assert_allclose(out, [[1.5, 2.5], [3.0, 4.0]])
+        assert dio.csv_dims(str(p), has_header=True) == (2, 2)
+
+    def test_malformed_raises(self, tmp_path):
+        p = tmp_path / "bad.csv"
+        p.write_text("1.0,2.0\nfoo,bar\n")
+        with pytest.raises(OSError):
+            dio.read_csv(str(p))
+
+    def test_missing_file_raises(self):
+        with pytest.raises(OSError):
+            dio.csv_dims("/nonexistent/x.csv")
+
+    def test_stream_blocks(self, csv_file):
+        p, X = csv_file
+        blocks = list(dio.stream_csv_blocks(p, 100))
+        assert [b.shape[0] for b in blocks] == [100] * 5 + [37]
+        np.testing.assert_allclose(np.vstack(blocks), X, rtol=1e-5)
+
+    def test_sharded_ingest(self, csv_file, mesh):
+        p, X = csv_file
+        s = dio.read_csv_sharded(p)
+        from dask_ml_tpu.core import unshard
+
+        assert s.shape == X.shape
+        np.testing.assert_allclose(unshard(s), X, rtol=1e-5)
+
+
+class TestBinary:
+    def test_roundtrip(self, tmp_path, rng):
+        X = rng.normal(size=(64, 5)).astype(np.float32)
+        p = tmp_path / "x.bin"
+        X.tofile(p)
+        out = dio.read_binary(str(p), (64, 5))
+        np.testing.assert_array_equal(out, X)
+
+    def test_offset(self, tmp_path, rng):
+        X = rng.normal(size=(10, 4)).astype(np.float32)
+        p = tmp_path / "x.bin"
+        X.tofile(p)
+        out = dio.read_binary(str(p), (5, 4), offset_bytes=5 * 4 * 4)
+        np.testing.assert_array_equal(out, X[5:])
+
+    def test_short_file_raises(self, tmp_path):
+        p = tmp_path / "short.bin"
+        np.zeros(3, dtype=np.float32).tofile(p)
+        with pytest.raises(OSError):
+            dio.read_binary(str(p), (100, 100))
+
+
+class TestIncrementalPipeline:
+    def test_stream_into_incremental(self, csv_file, mesh):
+        """End-to-end: native loader blocks → Incremental partial_fit."""
+        from sklearn.linear_model import SGDClassifier
+
+        from dask_ml_tpu.wrappers import Incremental
+
+        p, X = csv_file
+        w = np.ones(X.shape[1])
+        y = (X @ w > 0).astype(np.int32)
+        inc = Incremental(SGDClassifier(random_state=0))
+        lo = 0
+        for block in dio.stream_csv_blocks(p, 128):
+            inc.partial_fit(block, y[lo: lo + len(block)], classes=[0, 1])
+            lo += len(block)
+        acc = (inc.predict(X) == y).mean()
+        assert acc > 0.8
