@@ -58,8 +58,23 @@ def _to_host(value):
         return {k: _to_host(v) for k, v in value.items()}
     if isinstance(value, (list, tuple)):
         out = [_to_host(v) for v in value]
-        return type(value)(out) if isinstance(value, tuple) else out
+        return _rebuild_sequence(value, out)
     return value
+
+
+def _rebuild_sequence(original, out: list):
+    """Rebuild a converted list as the original's type.  Namedtuples take
+    positional fields (``cls(*out)``); other tuple subclasses that don't
+    accept an iterable fall back to a plain tuple rather than corrupting
+    state (e.g. an LBFGSState NamedTuple fitted attribute)."""
+    if not isinstance(original, tuple):
+        return out
+    if hasattr(original, "_fields"):  # namedtuple / NamedTuple
+        return type(original)(*out)
+    try:
+        return type(original)(out)
+    except TypeError:
+        return tuple(out)
 
 
 def _from_host(value):
@@ -71,7 +86,7 @@ def _from_host(value):
         return {k: _from_host(v) for k, v in value.items()}
     if isinstance(value, (list, tuple)):
         out = [_from_host(v) for v in value]
-        return type(value)(out) if isinstance(value, tuple) else out
+        return _rebuild_sequence(value, out)
     return value
 
 
@@ -180,6 +195,26 @@ class SearchCheckpoint:
             os.unlink(self.path)
 
 
+def _param_repr(v) -> str:
+    """Full-fidelity repr of one parameter value.  numpy truncates reprs of
+    arrays >1000 elements with '...', which would give two different large
+    parameter grids identical fingerprints — hash shape+dtype+raw bytes for
+    arrays (and recurse into containers) instead."""
+    if isinstance(v, (np.ndarray, jax.Array)):
+        import hashlib
+
+        a = np.asarray(v)
+        h = hashlib.sha256(np.ascontiguousarray(a).tobytes()).hexdigest()[:16]
+        return f"ndarray(shape={a.shape},dtype={a.dtype},sha={h})"
+    if isinstance(v, (list, tuple)):
+        inner = ",".join(_param_repr(x) for x in v)
+        return f"{type(v).__name__}[{inner}]"
+    if isinstance(v, dict):
+        inner = ",".join(f"{k!r}:{_param_repr(x)}" for k, x in sorted(v.items(), key=lambda kv: repr(kv[0])))
+        return f"dict{{{inner}}}"
+    return repr(v)
+
+
 def search_fingerprint(search) -> str:
     """Stable identity of a search's configuration (class + estimator class
     + every constructor param that shapes the schedule or model space)."""
@@ -189,9 +224,9 @@ def search_fingerprint(search) -> str:
         (
             type(search).__qualname__,
             type(search.estimator).__qualname__,
-            sorted((k, repr(v)) for k, v in search.estimator.get_params(deep=False).items()),
+            sorted((k, _param_repr(v)) for k, v in search.estimator.get_params(deep=False).items()),
             sorted(
-                (k, repr(v))
+                (k, _param_repr(v))
                 for k, v in search.get_params(deep=False).items()
                 if k not in ("estimator", "checkpoint", "verbose")
             ),

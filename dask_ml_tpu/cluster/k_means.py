@@ -84,10 +84,19 @@ def _lloyd_step_pallas(x, mask, centers, mesh):
 
 
 def _pallas_ok(x, centers) -> bool:
-    """Pallas path gate: TPU backend, kernel-friendly shapes, not opted out."""
+    """Pallas path gate: TPU backend, kernel-friendly shapes, opted IN.
+
+    The Mosaic lowering of the fused assign+reduce kernel is verified by a
+    hardware parity test (tests/test_kmeans.py::test_pallas_parity_on_tpu,
+    run only when a real TPU is present); until that test has blessed the
+    kernel on the running topology the default path is plain XLA, and the
+    kernel is enabled explicitly with ``DASK_ML_TPU_PALLAS=1``.
+    """
     import os
 
     if os.environ.get("DASK_ML_TPU_NO_PALLAS"):
+        return False
+    if not os.environ.get("DASK_ML_TPU_PALLAS"):
         return False
     if jax.default_backend() != "tpu":
         return False

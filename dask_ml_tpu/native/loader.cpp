@@ -41,7 +41,9 @@ int read_file(const char* path, FileBuf& buf) {
         return -EIO;
     }
     std::fseek(f, 0, SEEK_SET);
-    buf.data = static_cast<char*>(std::malloc(sz ? sz : 1));
+    // +1 for a NUL terminator: strtof needs a terminated buffer so a file
+    // with no trailing newline cannot read past the allocation.
+    buf.data = static_cast<char*>(std::malloc(sz + 1));
     if (!buf.data) {
         std::fclose(f);
         return -ENOMEM;
@@ -49,6 +51,7 @@ int read_file(const char* path, FileBuf& buf) {
     size_t got = std::fread(buf.data, 1, sz, f);
     std::fclose(f);
     if (got != static_cast<size_t>(sz)) return -EIO;
+    buf.data[sz] = '\0';
     buf.size = sz;
     return 0;
 }
@@ -73,22 +76,38 @@ long count_cols(const char* line, const char* end) {
     return cols;
 }
 
-// Parse rows [r0, r1) into out (already offset by caller).
+// Parse rows [r0, r1) into out (already offset by caller).  Each field
+// parse is bounded to its own line: a row with fewer than `cols` fields
+// errors with -EINVAL instead of silently consuming values from the next
+// line (strtof treats '\n' as skippable whitespace), and trailing
+// non-delimiter bytes (extra fields) also error.
 void parse_rows(const FileBuf& buf, const std::vector<size_t>& starts,
                 size_t r0, size_t r1, long cols, float* out, int* err) {
     for (size_t r = r0; r < r1; r++) {
         const char* p = buf.data + starts[r];
-        const char* line_end = buf.data + (r + 1 < starts.size() ? starts[r + 1] : buf.size);
+        const char* span_end = buf.data + (r + 1 < starts.size() ? starts[r + 1] : buf.size);
+        // End of THIS line's content (exclusive of '\n').
+        const char* eol = p;
+        while (eol < span_end && *eol != '\n') eol++;
         float* row = out + (r - r0) * cols;
         for (long c = 0; c < cols; c++) {
+            while (p < eol && (*p == ',' || *p == ' ' || *p == '\t' || *p == '\r')) p++;
+            if (p >= eol) {  // too few fields on this row
+                *err = -EINVAL;
+                return;
+            }
             char* next = nullptr;
             row[c] = std::strtof(p, &next);
-            if (next == p) {  // no parse progress: malformed field
+            if (next == p || next > eol) {  // malformed field or ran past line
                 *err = -EINVAL;
                 return;
             }
             p = next;
-            while (p < line_end && (*p == ',' || *p == ' ' || *p == '\r')) p++;
+        }
+        while (p < eol && (*p == ',' || *p == ' ' || *p == '\t' || *p == '\r')) p++;
+        if (p < eol) {  // trailing junk / extra fields
+            *err = -EINVAL;
+            return;
         }
     }
 }

@@ -271,3 +271,64 @@ class TestSearchCheckpoint:
             max_iter=3, random_state=0, checkpoint=path,
         ).fit(X, y)
         assert not SearchCheckpoint(path).exists()
+
+
+import collections
+
+from dask_ml_tpu.base import TPUEstimator
+
+_NTState = collections.namedtuple("_NTState", ["w", "n"])
+
+
+class _WithState(TPUEstimator):
+    def __init__(self):
+        pass
+
+
+class TestHostConversion:
+    def test_namedtuple_fitted_attr_roundtrip(self, tmp_path):
+        # Tuple subclasses with positional fields (NamedTuple solver states)
+        # must be rebuilt field-wise, not passed a single list argument.
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.checkpoint import _from_host, _to_host
+
+        State = _NTState
+        s = State(w=jnp.arange(3.0), n=7)
+        back = _from_host(_to_host(s))
+        assert isinstance(back, State)
+        np.testing.assert_allclose(np.asarray(back.w), [0.0, 1.0, 2.0])
+        assert back.n == 7
+
+        est = _WithState()
+        est.state_ = s
+        save_estimator(est, str(tmp_path / "ns"))
+        loaded = load_estimator(str(tmp_path / "ns"))
+        assert isinstance(loaded.state_, State)
+        np.testing.assert_allclose(np.asarray(loaded.state_.w), [0.0, 1.0, 2.0])
+
+
+class TestFingerprint:
+    def test_large_array_params_distinguished(self):
+        # numpy truncates reprs of >1000-element arrays; the fingerprint
+        # must still tell two different big grids apart.
+        from dask_ml_tpu.checkpoint import search_fingerprint
+
+        a = np.zeros(2000)
+        b = np.zeros(2000)
+        b[1500] = 1.0
+        s1 = IncrementalSearchCV(
+            LinearFunction(), {"intercept": a}, max_iter=3
+        )
+        s2 = IncrementalSearchCV(
+            LinearFunction(), {"intercept": b}, max_iter=3
+        )
+        assert search_fingerprint(s1) != search_fingerprint(s2)
+
+    def test_identical_config_same_fingerprint(self):
+        from dask_ml_tpu.checkpoint import search_fingerprint
+
+        g = {"intercept": np.linspace(0, 1, 5)}
+        s1 = IncrementalSearchCV(LinearFunction(), g, max_iter=3)
+        s2 = IncrementalSearchCV(LinearFunction(), dict(g), max_iter=3)
+        assert search_fingerprint(s1) == search_fingerprint(s2)

@@ -49,6 +49,26 @@ class TestCSV:
         with pytest.raises(OSError):
             dio.csv_dims("/nonexistent/x.csv")
 
+    def test_short_row_raises(self, tmp_path):
+        # A row with fewer fields must NOT silently consume values from the
+        # next line (strtof skips '\n' as whitespace).
+        p = tmp_path / "short.csv"
+        p.write_text("1.0,2.0\n3.0\n5.0,6.0\n")
+        with pytest.raises(OSError):
+            dio.read_csv(str(p))
+
+    def test_long_row_raises(self, tmp_path):
+        p = tmp_path / "long.csv"
+        p.write_text("1.0,2.0\n3.0,4.0,9.9\n")
+        with pytest.raises(OSError):
+            dio.read_csv(str(p))
+
+    def test_no_trailing_newline_ok(self, tmp_path):
+        p = tmp_path / "nonl.csv"
+        p.write_text("1.0,2.0\n3.0,4.0")
+        out = dio.read_csv(str(p))
+        np.testing.assert_allclose(out, [[1.0, 2.0], [3.0, 4.0]])
+
     def test_stream_blocks(self, csv_file):
         p, X = csv_file
         blocks = list(dio.stream_csv_blocks(p, 100))

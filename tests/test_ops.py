@@ -57,3 +57,23 @@ class TestLloydKernel:
             np.testing.assert_allclose(float(inertia), einertia, rtol=1e-4)
         finally:
             L._TILE = orig
+
+    def test_pallas_parity_on_tpu(self, rng):
+        # Hardware (Mosaic-lowered) parity check — the gate that lets
+        # DASK_ML_TPU_PALLAS=1 be safely enabled (cluster.k_means._pallas_ok).
+        import pytest
+
+        if jax.default_backend() != "tpu":
+            pytest.skip("requires a real TPU backend")
+        n, d, k = 4096, 16, 8
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        mask = np.ones(n, dtype=np.float32)
+        mask[-100:] = 0.0
+        centers = x[:k].copy()
+        sums, counts, inertia = lloyd_assign_reduce(
+            jnp.asarray(x), jnp.asarray(mask), jnp.asarray(centers)
+        )
+        esums, ecounts, einertia = _reference(x, mask, centers)
+        np.testing.assert_allclose(np.asarray(sums), esums, rtol=1e-3, atol=1e-2)
+        np.testing.assert_allclose(np.asarray(counts), ecounts)
+        np.testing.assert_allclose(float(inertia), einertia, rtol=1e-3)
