@@ -209,6 +209,12 @@ def _param_repr(v) -> str:
     if isinstance(v, (list, tuple)):
         inner = ",".join(_param_repr(x) for x in v)
         return f"{type(v).__name__}[{inner}]"
+    if isinstance(v, (set, frozenset)):
+        # set iteration order is hash-randomized per process for strings —
+        # sort element reprs so identical configs fingerprint identically
+        # across restarts (the whole point of the fingerprint).
+        inner = ",".join(sorted(_param_repr(x) for x in v))
+        return f"{type(v).__name__}{{{inner}}}"
     if isinstance(v, dict):
         inner = ",".join(f"{k!r}:{_param_repr(x)}" for k, x in sorted(v.items(), key=lambda kv: repr(kv[0])))
         return f"dict{{{inner}}}"

@@ -87,7 +87,8 @@ def _pallas_ok(x, centers) -> bool:
     """Pallas path gate: TPU backend, kernel-friendly shapes, opted IN.
 
     The Mosaic lowering of the fused assign+reduce kernel is verified by a
-    hardware parity test (tests/test_kmeans.py::test_pallas_parity_on_tpu,
+    hardware parity test
+    (tests/test_ops.py::TestLloydKernel::test_pallas_parity_on_tpu,
     run only when a real TPU is present); until that test has blessed the
     kernel on the running topology the default path is plain XLA, and the
     kernel is enabled explicitly with ``DASK_ML_TPU_PALLAS=1``.

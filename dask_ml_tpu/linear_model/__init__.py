@@ -1,5 +1,12 @@
 """Linear models — twin of ``dask_ml/linear_model/`` (SURVEY.md §2 #11)."""
 
+from ._sgd import SGDClassifier, SGDRegressor  # noqa: F401
 from .glm import LinearRegression, LogisticRegression, PoissonRegression  # noqa: F401
 
-__all__ = ["LogisticRegression", "LinearRegression", "PoissonRegression"]
+__all__ = [
+    "LogisticRegression",
+    "LinearRegression",
+    "PoissonRegression",
+    "SGDClassifier",
+    "SGDRegressor",
+]

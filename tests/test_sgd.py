@@ -1,0 +1,272 @@
+"""Device-native SGD estimator tests.
+
+Pattern per SURVEY.md §4: convergence parity vs sklearn at the accuracy
+level (loose tolerance for iterative solvers), plus the contracts the
+adaptive searches rely on (partial_fit block streaming, classes on first
+call, warm restart, device residency).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dask_ml_tpu.core import shard_rows, unshard
+from dask_ml_tpu.linear_model import SGDClassifier, SGDRegressor
+
+
+def _binary_data(rng, n=600, d=8):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d)
+    y = (X @ w + 0.1 * rng.normal(size=n) > 0).astype(np.int64)
+    return X, y
+
+
+def _multiclass_data(rng, n=900, d=6, k=4):
+    from sklearn.datasets import make_blobs
+
+    X, y = make_blobs(n_samples=n, n_features=d, centers=k,
+                      cluster_std=1.0, random_state=7)
+    return X.astype(np.float32), y
+
+
+class TestSGDClassifier:
+    def test_binary_parity_with_sklearn(self, rng):
+        from sklearn.linear_model import SGDClassifier as SkSGD
+
+        X, y = _binary_data(rng)
+        ours = SGDClassifier(alpha=1e-4, max_iter=300, tol=None).fit(X, y)
+        theirs = SkSGD(alpha=1e-4, max_iter=50, tol=None, random_state=0).fit(X, y)
+        acc_ours = (ours.predict(X) == y).mean()
+        acc_theirs = (theirs.predict(X) == y).mean()
+        assert acc_ours > 0.9
+        assert acc_ours >= acc_theirs - 0.05
+
+    def test_multiclass_labels_and_proba(self, rng):
+        X, y = _multiclass_data(rng)
+        clf = SGDClassifier(max_iter=300, tol=None).fit(X, y)
+        assert list(clf.classes_) == [0, 1, 2, 3]
+        pred = clf.predict(X)
+        assert pred.dtype == y.dtype  # real labels, not booleans
+        assert (pred == y).mean() > 0.9
+        proba = np.asarray(clf.predict_proba(X))
+        assert proba.shape == (len(y), 4)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+        assert clf.coef_.shape == (4, X.shape[1])
+
+    def test_string_labels(self, rng):
+        X, y = _binary_data(rng)
+        labels = np.array(["neg", "pos"])[y]
+        clf = SGDClassifier(max_iter=200, tol=None).fit(X, labels)
+        assert set(clf.predict(X[:10])) <= {"neg", "pos"}
+        assert clf.coef_.shape == (1, X.shape[1])
+
+    def test_partial_fit_stream_requires_classes(self, rng):
+        X, y = _binary_data(rng)
+        clf = SGDClassifier()
+        with pytest.raises(ValueError, match="classes"):
+            clf.partial_fit(X[:100], y[:100])
+
+    def test_partial_fit_stream_converges(self, rng):
+        X, y = _binary_data(rng, n=2000)
+        clf = SGDClassifier(learning_rate="constant", eta0=0.5)
+        classes = np.unique(y)
+        for epoch in range(30):
+            for lo in range(0, len(X), 256):
+                clf.partial_fit(X[lo:lo + 256], y[lo:lo + 256], classes=classes)
+        assert (clf.predict(X) == y).mean() > 0.9
+        assert clf.t_ == 30 * len(range(0, len(X), 256))
+
+    def test_ragged_blocks_bounded_compiles(self, rng):
+        # Streaming ragged chunk sizes must hit the bucket padding, not
+        # recompile per shape.
+        X, y = _binary_data(rng, n=700)
+        clf = SGDClassifier(learning_rate="constant", eta0=0.1)
+        classes = np.unique(y)
+        with jax.log_compiles(False):
+            for size in (100, 101, 117, 250, 255, 256, 90):
+                clf.partial_fit(X[:size], y[:size], classes=classes)
+        # all sizes <=256 → exactly one (bucketed) compiled shape
+        assert clf._state["coef"].shape == (X.shape[1], 1)
+
+    def test_sharded_rows_input(self, rng, mesh):
+        X, y = _binary_data(rng, n=333)  # not divisible by 8: pad+mask path
+        Xs, ys = shard_rows(X), shard_rows(y.astype(np.float32))
+        clf = SGDClassifier(max_iter=300, tol=None).fit(Xs, ys)
+        assert (clf.predict(Xs) == y).mean() > 0.9
+        dense = SGDClassifier(max_iter=300, tol=None).fit(X, y)
+        np.testing.assert_allclose(
+            clf.coef_, dense.coef_, rtol=1e-3, atol=1e-4
+        )
+
+    def test_device_resident_state(self, rng):
+        X, y = _binary_data(rng)
+        clf = SGDClassifier(max_iter=20, tol=None).fit(X, y)
+        assert isinstance(clf._state["coef"], jax.Array)
+
+    def test_hinge_and_penalties(self, rng):
+        X, y = _binary_data(rng)
+        for loss in ("hinge", "squared_hinge", "modified_huber", "log_loss"):
+            for penalty in ("l2", "l1", "elasticnet"):
+                clf = SGDClassifier(loss=loss, penalty=penalty, max_iter=150,
+                                    tol=None).fit(X, y)
+                assert (clf.predict(X) == y).mean() > 0.85, (loss, penalty)
+
+    def test_proba_unavailable_for_hinge(self, rng):
+        X, y = _binary_data(rng)
+        clf = SGDClassifier(loss="hinge", max_iter=20).fit(X, y)
+        with pytest.raises(AttributeError):
+            clf.predict_proba(X)
+
+    def test_clone_contract(self):
+        from sklearn.base import clone
+
+        clf = SGDClassifier(alpha=0.5, loss="hinge")
+        c = clone(clf)
+        assert c.get_params()["alpha"] == 0.5
+        assert c.get_params()["loss"] == "hinge"
+
+
+class TestSGDRegressor:
+    def test_parity_with_sklearn(self, rng):
+        from sklearn.linear_model import SGDRegressor as SkSGD
+
+        n, d = 800, 6
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w = rng.normal(size=d)
+        y = (X @ w + 0.05 * rng.normal(size=n)).astype(np.float32)
+        ours = SGDRegressor(max_iter=500, tol=None,
+                            learning_rate="constant", eta0=0.1).fit(X, y)
+        assert ours.score(X, y) > 0.98
+        theirs = SkSGD(max_iter=100, tol=None, random_state=0).fit(X, y)
+        assert ours.score(X, y) >= theirs.score(X, y) - 0.02
+        np.testing.assert_allclose(ours.coef_, w, rtol=0.1, atol=0.05)
+
+    def test_huber_loss(self, rng):
+        n, d = 600, 4
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w = rng.normal(size=d)
+        y = X @ w
+        y[::50] += 50.0  # outliers
+        hub = SGDRegressor(loss="huber", epsilon=0.5, max_iter=800, tol=None,
+                           learning_rate="constant", eta0=0.05).fit(X, y)
+        clean = ~(np.arange(n) % 50 == 0)
+        pred = np.asarray(hub.predict(X))
+        assert np.corrcoef(pred[clean], y[clean])[0, 1] > 0.95
+
+    def test_partial_fit_stream(self, rng):
+        n, d = 2000, 5
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w = rng.normal(size=d)
+        y = X @ w
+        reg = SGDRegressor(learning_rate="constant", eta0=0.1)
+        for _ in range(40):
+            for lo in range(0, n, 500):
+                reg.partial_fit(X[lo:lo + 500], y[lo:lo + 500])
+        assert reg.score(X, y) > 0.98
+
+    def test_sharded_input(self, rng, mesh):
+        n, d = 331, 4
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = X @ rng.normal(size=d).astype(np.float32)
+        reg = SGDRegressor(max_iter=400, tol=None, learning_rate="constant",
+                           eta0=0.1).fit(shard_rows(X), shard_rows(y))
+        assert reg.score(X, y) > 0.97
+
+
+class TestDeviceNativeAdaptivePlane:
+    """VERDICT round-1 item 2: the adaptive-search plane trains ON DEVICE
+    when given our SGD estimators — partial_fit is an XLA program, not a
+    host sklearn call."""
+
+    def test_incremental_wrapper_device_native(self, rng):
+        from dask_ml_tpu.wrappers import Incremental
+
+        X, y = _binary_data(rng, n=1500)
+        inc = Incremental(
+            SGDClassifier(learning_rate="constant", eta0=0.5),
+            chunk_size=256,
+        )
+        for _ in range(20):
+            inc.partial_fit(X, y, classes=np.unique(y))
+        est = inc.estimator_
+        assert isinstance(est._state["coef"], jax.Array)
+        assert (np.asarray(inc.predict(X)) == y).mean() > 0.9
+
+    def test_incremental_search_device_native(self, rng):
+        from dask_ml_tpu.model_selection import IncrementalSearchCV
+
+        X, y = _binary_data(rng, n=1200)
+        search = IncrementalSearchCV(
+            SGDClassifier(learning_rate="constant"),
+            {"eta0": [0.01, 0.1, 0.5], "alpha": [1e-4, 1e-2]},
+            n_initial_parameters=6,
+            max_iter=15,
+            random_state=0,
+        )
+        search.fit(X, y, classes=np.unique(y))
+        assert hasattr(search, "best_estimator_")
+        assert isinstance(search.best_estimator_._state["coef"], jax.Array)
+        assert search.best_score_ > 0.85
+
+    def test_hyperband_device_native(self, rng):
+        from dask_ml_tpu.model_selection import HyperbandSearchCV
+
+        X, y = _binary_data(rng, n=1200)
+        search = HyperbandSearchCV(
+            SGDClassifier(learning_rate="constant"),
+            {"eta0": [0.01, 0.1, 0.5, 1.0], "alpha": [1e-4, 1e-3, 1e-2]},
+            max_iter=9,
+            random_state=0,
+        )
+        search.fit(X, y, classes=np.unique(y))
+        assert isinstance(search.best_estimator_._state["coef"], jax.Array)
+        # the search actually exercised partial_fit as XLA programs
+        assert search.best_estimator_.t_ > 0
+
+
+class TestReviewRegressions:
+    def test_optimal_schedule_rejects_alpha_zero(self, rng):
+        X, y = _binary_data(rng, n=100)
+        with pytest.raises(ValueError, match="alpha"):
+            SGDClassifier(alpha=0.0, learning_rate="optimal").fit(X, y)
+
+    def test_one_bad_epoch_does_not_stop_fit(self, rng):
+        # A single non-improving epoch (oscillation at constant LR) must not
+        # halt training; only n_iter_no_change consecutive ones may.
+        n, d = 400, 5
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = X @ rng.normal(size=d).astype(np.float32)
+        reg = SGDRegressor(learning_rate="constant", eta0=0.9, max_iter=300,
+                           tol=1e-4).fit(X, y)
+        assert reg.score(X, y) > 0.9
+
+    def test_warm_start_rejects_new_labels(self, rng):
+        X, y = _multiclass_data(rng)
+        clf = SGDClassifier(max_iter=30, warm_start=True).fit(X, y)
+        y2 = y.copy()
+        y2[:] = 7  # label outside fitted classes_
+        with pytest.raises(ValueError, match="warm_start"):
+            clf.fit(X, y2)
+        # subset of fitted classes is fine
+        keep = y < 2
+        clf.fit(X[keep], y[keep])
+        assert clf.coef_.shape[0] == 4  # state keeps the full class set
+
+    def test_modified_huber_proba_matches_sklearn_formula(self, rng):
+        X, y = _binary_data(rng)
+        clf = SGDClassifier(loss="modified_huber", max_iter=100,
+                            tol=None).fit(X, y)
+        m = np.asarray(clf.decision_function(X))
+        expect_p1 = (np.clip(m, -1, 1) + 1) / 2
+        got = np.asarray(clf.predict_proba(X))
+        np.testing.assert_allclose(got[:, 1], expect_p1, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(got.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_set_param_fingerprint_stable(self):
+        from dask_ml_tpu.checkpoint import _param_repr
+
+        assert _param_repr({"hinge", "log_loss"}) == _param_repr(
+            {"log_loss", "hinge"}
+        )
