@@ -342,6 +342,11 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
                     "classes must be passed on the first partial_fit call"
                 )
             self.classes_ = np.sort(np.asarray(classes))
+            if len(self.classes_) < 2:
+                raise ValueError(
+                    "classifier needs samples of at least 2 classes; got "
+                    f"{self.classes_.tolist()}"
+                )
         if isinstance(y, ShardedRows):
             from ..core.sharded import unshard
 
@@ -374,6 +379,11 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
                 if hasattr(self, attr):
                     delattr(self, attr)
             self.classes_ = np.unique(y)
+            if len(self.classes_) < 2:
+                raise ValueError(
+                    "classifier needs samples of at least 2 classes; got "
+                    f"{self.classes_.tolist()}"
+                )
         # Encode/pad/transfer ONCE; every epoch is then just the fused step.
         xb, yb, mask = self._prep_block(X, self._encode_targets(y))
         self._ensure_state(xb.shape[1])
@@ -467,12 +477,15 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
             y = unshard(y)
         return np.asarray(y, dtype=np.float32).reshape(-1, 1)
 
+    def _ensure_state(self, n_features: int):
+        if not hasattr(self, "_state"):
+            self._state = sgd_init(n_features, 1)
+            self.n_features_in_ = int(n_features)
+
     def partial_fit(self, X, y, **kwargs):
         self._validate()
         xb, yb, mask = self._prep_block(X, self._targets(y))
-        if not hasattr(self, "_state"):
-            self._state = sgd_init(xb.shape[1], 1)
-            self.n_features_in_ = int(xb.shape[1])
+        self._ensure_state(xb.shape[1])
         self._loss_ = self._step_block(xb, yb, mask)
         return self
 
@@ -481,9 +494,7 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
         if not self.warm_start and hasattr(self, "_state"):
             delattr(self, "_state")
         xb, yb, mask = self._prep_block(X, self._targets(y))
-        if not hasattr(self, "_state"):
-            self._state = sgd_init(xb.shape[1], 1)
-            self.n_features_in_ = int(xb.shape[1])
+        self._ensure_state(xb.shape[1])
         self.n_iter_ = _run_epochs(self, xb, yb, mask)
         return self
 

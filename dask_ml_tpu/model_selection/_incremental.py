@@ -197,12 +197,71 @@ class BaseIncrementalSearchCV(TPUEstimator):
             info[ident].append(meta)
             return meta
 
+        def train_cohort(idents, n_calls):
+            """Lockstep group of packable models: ONE fused dispatch per
+            block advances the whole group (see _packing module docstring).
+            Equivalent to train_one per ident, minus the dispatches."""
+            from ._packing import Cohort
+
+            cohort = Cohort(
+                [models[i][0] for i in idents],
+                classes=(fit_params or {}).get("classes"),
+            )
+            calls0 = models[idents[0]][1]["partial_fit_calls"]
+            t0 = time.time()
+            for j in range(n_calls):
+                Xb, yb = blocks[(calls0 + j) % n_blocks]
+                cohort.step(Xb, yb)
+            cohort.finalize()
+            # train_one semantics: partial_fit_time is the duration of ONE
+            # block call (the last _partial_fit overwrites it)
+            pf_time = (time.time() - t0) / max(n_calls, 1)
+            for ident in idents:
+                model, meta = models[ident]
+                meta = dict(meta)
+                meta["partial_fit_calls"] += n_calls
+                meta["partial_fit_time"] = pf_time
+                meta = _score((model, meta), X_test, y_test, scorer)
+                meta["elapsed_wall_time"] = time.time() - start_time
+                models[ident] = (model, meta)
+                info[ident].append(meta)
+
+        def pack_groups(instructions):
+            """Group instructed models by (static config, budget, step
+            counter) — members of a group are in lockstep and can train as
+            one stacked program.  Returns (groups, leftovers)."""
+            from ._packing import pack_key
+
+            groups = defaultdict(list)
+            singles = []
+            for ident, n_calls in instructions.items():
+                if n_calls <= 0:
+                    continue
+                model, meta = models[ident]
+                key = pack_key(model)
+                if key is None:
+                    singles.append((ident, n_calls))
+                else:
+                    groups[(key, n_calls, meta["partial_fit_calls"])].append(ident)
+            packed = {k: v for k, v in groups.items() if len(v) > 1}
+            for k, v in groups.items():
+                if len(v) == 1:
+                    singles.append((v[0], k[1]))
+            return packed, singles
+
+        async def run_round(instructions):
+            packed, singles = pack_groups(instructions)
+            for (key, n_calls, _), idents in packed.items():
+                train_cohort(idents, n_calls)
+                await asyncio.sleep(0)  # cooperative yield (bracket interleave)
+            for ident, n_calls in singles:
+                train_one(ident, n_calls)
+                await asyncio.sleep(0)
+
         # initial round: one call each (skipped when resuming — the
         # snapshot already contains at least the initial round)
         if not resumed:
-            for ident in list(models):
-                train_one(ident, 1)
-                await asyncio.sleep(0)  # cooperative yield (multi-bracket interleave)
+            await run_round({ident: 1 for ident in models})
             if ckpt is not None:
                 ckpt.save(models, info, self._capture_policy_state(),
                           elapsed=time.time() - start_time)
@@ -214,10 +273,7 @@ class BaseIncrementalSearchCV(TPUEstimator):
             instructions = self._additional_calls(dict(info))
             if not instructions:
                 break
-            for ident, n_calls in instructions.items():
-                if n_calls > 0:
-                    train_one(ident, n_calls)
-                    await asyncio.sleep(0)
+            await run_round(instructions)
             if ckpt is not None:
                 ckpt.save(models, info, self._capture_policy_state(),
                           elapsed=time.time() - start_time)

@@ -1,0 +1,186 @@
+"""Multi-model packing: train a cohort of models in ONE XLA program.
+
+The reference's "model-parallel search" is task parallelism — one dask
+future per candidate model (``dask_ml/model_selection/_incremental.py ::
+_fit`` submits per-model ``_partial_fit`` futures; SURVEY.md §2.2 row 2).
+On TPU, dispatching one tiny program per model leaves the chip idle between
+dispatches; the idiomatic inversion (SURVEY.md §7 hard-part (c)) is to
+**vmap the SGD update over a stacked model axis**: configurations that share
+the compiled branches (loss / penalty / schedule — the *static* part of a
+config) are bucketed together, their state pytrees stacked to ``[M, d, K]``
+and their hyperparameters to ``[M]`` traced scalars, and one fused program
+advances all M models on the same data block.
+
+When the active mesh has a nontrivial ``model`` axis, the stacked state is
+sharded over MODEL_AXIS and the batch over DATA_AXIS — each device group
+trains its slice of the cohort on its slice of the rows, with XLA inserting
+the data-axis psum for the gradients: 2-D (model × data) parallelism from
+annotations alone, the scaling-book recipe.
+
+``BaseIncrementalSearchCV`` uses this automatically: each adaptive round
+groups the instructed models by (pack key, budget, step counter) and trains
+every lockstep group through one :class:`Cohort` — so a Hyperband bracket
+of 30 homogeneous configs costs ~1 dispatch per block instead of 30.
+``DISPATCH_STATS`` records the packing wins so tests (and users) can verify
+N models trained with ≪N dispatches.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.mesh import DATA_AXIS, MODEL_AXIS, get_mesh
+from ..linear_model._sgd import SGDClassifier, SGDRegressor, sgd_step
+
+__all__ = ["pack_key", "Cohort", "DISPATCH_STATS", "reset_dispatch_stats"]
+
+# Observability: how many fused dispatches ran vs how many model-steps they
+# covered.  A packed round of M models advances models_stepped by M while
+# dispatches grows by 1.
+DISPATCH_STATS = {"dispatches": 0, "models_stepped": 0, "cohorts": 0}
+
+
+def reset_dispatch_stats():
+    for k in DISPATCH_STATS:
+        DISPATCH_STATS[k] = 0
+
+
+def pack_key(model):
+    """Hashable static-config key, or None if the model can't be packed.
+
+    Models sharing a key compile to the SAME branches of the SGD step, so
+    only their (traced) hyperparameter scalars differ — the precondition
+    for stacking them under vmap with zero recompilation.
+    """
+    if isinstance(model, (SGDClassifier, SGDRegressor)):
+        return (
+            type(model).__name__,
+            model.loss,
+            model.penalty,
+            model.learning_rate,
+            model.fit_intercept,
+        )
+    return None
+
+
+@partial(
+    jax.jit,
+    static_argnames=("loss", "penalty", "schedule", "fit_intercept"),
+    donate_argnames=("states",),
+)
+def _packed_step(states, xb, yb, mask, hypers, *, loss, penalty, schedule,
+                 fit_intercept):
+    """vmap of the single-model fused step over the stacked model axis.
+    Data (xb/yb/mask) is broadcast; states and hyperparameters carry the
+    model axis.  One XLA program, M models."""
+    step = partial(
+        sgd_step, loss=loss, penalty=penalty, schedule=schedule,
+        fit_intercept=fit_intercept,
+    )
+    return jax.vmap(step, in_axes=(0, None, None, None, 0))(
+        states, xb, yb, mask, hypers
+    )
+
+
+def _model_sharding(mesh, ndim):
+    """Shard the leading (model) axis over MODEL_AXIS, replicate the rest."""
+    return NamedSharding(mesh, P(MODEL_AXIS, *([None] * (ndim - 1))))
+
+
+class Cohort:
+    """A lockstep group of same-pack-key SGD models trained as one stack.
+
+    Stacks the per-model state pytrees once, advances them with
+    :func:`_packed_step` for any number of blocks, then ``finalize()``
+    writes each model's slice (and final loss) back — models behave exactly
+    as if ``partial_fit`` had been called on each individually.
+    """
+
+    def __init__(self, models, classes=None):
+        if not models:
+            raise ValueError("empty cohort")
+        keys = {pack_key(m) for m in models}
+        if len(keys) != 1 or None in keys:
+            raise ValueError(f"models are not packable together: {keys}")
+        for m in models:
+            # same hyperparameter validation the unpacked plane applies in
+            # partial_fit — packed and unpacked rounds must reject the same
+            # configs (e.g. alpha=0 with learning_rate='optimal')
+            m._validate()
+        self.models = list(models)
+        self._m0 = models[0]
+        self._classes = classes
+        self._stacked = None
+        self._losses = None
+
+    # -- target prep (shared across the cohort: same y, same classes) ----
+    def _prep(self, X, y):
+        m0 = self._m0
+        if isinstance(m0, SGDClassifier):
+            for m in self.models:
+                if not hasattr(m, "classes_"):
+                    if self._classes is None:
+                        raise ValueError(
+                            "classes must be provided to pack unfitted "
+                            "classifiers (pass classes= to fit)"
+                        )
+                    m.classes_ = np.sort(np.asarray(self._classes))
+            targets = m0._encode_targets(np.asarray(y))
+        else:
+            targets = m0._targets(y)
+        xb, yb, mask = m0._prep_block(X, targets)
+        for m in self.models:
+            m._ensure_state(xb.shape[1])
+        return xb, yb, mask
+
+    def _stack(self):
+        states = [m._state for m in self.models]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+        hypers = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *[m._hyper() for m in self.models]
+        )
+        mesh = get_mesh()
+        M = len(self.models)
+        if mesh.shape.get(MODEL_AXIS, 1) > 1 and M % mesh.shape[MODEL_AXIS] == 0:
+            stacked = jax.tree.map(
+                lambda x: jax.device_put(x, _model_sharding(mesh, x.ndim)),
+                stacked,
+            )
+            hypers = jax.tree.map(
+                lambda x: jax.device_put(x, _model_sharding(mesh, x.ndim)),
+                hypers,
+            )
+        return stacked, hypers
+
+    def step(self, X, y):
+        """Advance every model in the cohort by one block: ONE dispatch."""
+        xb, yb, mask = self._prep(X, y)
+        if self._stacked is None:
+            self._stacked, self._hypers = self._stack()
+        m0 = self._m0
+        self._stacked, self._losses = _packed_step(
+            self._stacked, xb, yb, mask, self._hypers,
+            loss=m0.loss, penalty=m0.penalty, schedule=m0.learning_rate,
+            fit_intercept=m0.fit_intercept,
+        )
+        DISPATCH_STATS["dispatches"] += 1
+        DISPATCH_STATS["models_stepped"] += len(self.models)
+        return self
+
+    def finalize(self):
+        """Write stacked state back into the individual models."""
+        if self._stacked is None:
+            return self.models
+        for i, m in enumerate(self.models):
+            m._state = jax.tree.map(lambda x: x[i], self._stacked)
+            if self._losses is not None:
+                m._loss_ = self._losses[i]
+        self._stacked = None
+        DISPATCH_STATS["cohorts"] += 1
+        return self.models

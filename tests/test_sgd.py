@@ -23,6 +23,13 @@ def _binary_data(rng, n=600, d=8):
     return X, y
 
 
+def clf_targets(clf, y, classes):
+    """Encode y the way partial_fit would (for shape inspection in tests)."""
+    if not hasattr(clf, "classes_"):
+        clf.classes_ = np.sort(np.asarray(classes))
+    return clf._encode_targets(np.asarray(y))
+
+
 def _multiclass_data(rng, n=900, d=6, k=4):
     from sklearn.datasets import make_blobs
 
@@ -80,15 +87,26 @@ class TestSGDClassifier:
 
     def test_ragged_blocks_bounded_compiles(self, rng):
         # Streaming ragged chunk sizes must hit the bucket padding, not
-        # recompile per shape.
+        # recompile per shape: every chunk <=256 pads to the SAME 256-row
+        # program shape.
+        from dask_ml_tpu.linear_model._sgd import _bucket_rows
+
+        sizes = (100, 101, 117, 250, 255, 256, 90)
+        assert {_bucket_rows(s) for s in sizes} == {256}
+        assert _bucket_rows(257) == 1024
+        assert _bucket_rows(70000) == 65536 * 2  # beyond top bucket: rounded up
+
         X, y = _binary_data(rng, n=700)
         clf = SGDClassifier(learning_rate="constant", eta0=0.1)
         classes = np.unique(y)
-        with jax.log_compiles(False):
-            for size in (100, 101, 117, 250, 255, 256, 90):
-                clf.partial_fit(X[:size], y[:size], classes=classes)
-        # all sizes <=256 → exactly one (bucketed) compiled shape
-        assert clf._state["coef"].shape == (X.shape[1], 1)
+        shapes = set()
+        for size in sizes:
+            xb, yb, mask = clf._prep_block(
+                X[:size], clf_targets(clf, y[:size], classes)
+            )
+            shapes.add(xb.shape)
+            clf.partial_fit(X[:size], y[:size], classes=classes)
+        assert shapes == {(256, X.shape[1])}  # one compiled shape for all
 
     def test_sharded_rows_input(self, rng, mesh):
         X, y = _binary_data(rng, n=333)  # not divisible by 8: pad+mask path
@@ -270,3 +288,23 @@ class TestReviewRegressions:
         assert _param_repr({"hinge", "log_loss"}) == _param_repr(
             {"log_loss", "hinge"}
         )
+
+
+class TestReviewRegressions2:
+    def test_single_class_fit_rejected(self, rng):
+        X, _ = _binary_data(rng, n=50)
+        with pytest.raises(ValueError, match="2 classes"):
+            SGDClassifier(max_iter=5).fit(X, np.zeros(50))
+
+    def test_single_class_partial_fit_rejected(self, rng):
+        X, _ = _binary_data(rng, n=50)
+        with pytest.raises(ValueError, match="2 classes"):
+            SGDClassifier().partial_fit(X, np.zeros(50), classes=[0])
+
+    def test_packed_plane_validates_like_unpacked(self, rng):
+        from dask_ml_tpu.model_selection._packing import Cohort
+
+        bad = SGDClassifier(alpha=0.0, learning_rate="optimal")
+        ok = SGDClassifier(alpha=1e-4, learning_rate="optimal")
+        with pytest.raises(ValueError, match="alpha"):
+            Cohort([bad, ok], classes=[0, 1])
