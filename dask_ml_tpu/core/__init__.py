@@ -24,6 +24,7 @@ from .sharded import (  # noqa: F401
 )
 from .prng import fold_in_shard, per_shard_keys, as_key  # noqa: F401
 from .compat import shard_map  # noqa: F401
+from . import distributed  # noqa: F401  (multi-host plane; heavy deps lazy)
 
 __all__ = [
     "DATA_AXIS",

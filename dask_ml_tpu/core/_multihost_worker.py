@@ -1,0 +1,159 @@
+"""Multi-host dryrun worker: one process of the SPMD group.
+
+Run as ``python -m dask_ml_tpu.core._multihost_worker <pid> <nproc> <port>
+[<local_devices>]``.  Every process executes the SAME program (JAX
+multi-controller): bootstrap the group over localhost (Gloo collectives —
+the ``gen_cluster`` analogue: real protocol stack, fake cluster), build the
+global mesh, ingest per-host row blocks into one global ShardedRows, and
+run the framework's two flagship SPMD programs across the process
+boundary — an ADMM logistic solve and a fused Lloyd loop — asserting both
+converge on the global data.
+
+Used by ``__graft_entry__.dryrun_multihost`` and
+``tests/test_multihost.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(pid: int, nproc: int, port: str, local_devices: int = 4) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", local_devices)
+
+    from dask_ml_tpu.core import distributed as dist
+
+    dist.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+        local_device_count=local_devices,
+    )
+    assert jax.process_count() == nproc
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.core.mesh import set_mesh
+    from dask_ml_tpu.solvers import Logistic, admm
+
+    mesh = dist.global_mesh()
+    assert len(mesh.devices.flat) == nproc * local_devices
+    set_mesh(mesh)
+
+    # Per-host row block of one global dataset: process p holds rows
+    # [p*block, (p+1)*block) — deterministic across the group.
+    n_per, d = 400, 6
+    rng = np.random.RandomState(0)
+    w_true = rng.normal(size=d).astype(np.float32)
+    rng_p = np.random.RandomState(100 + pid)
+    Xl = rng_p.normal(size=(n_per, d)).astype(np.float32)
+    yl = (Xl @ w_true > 0).astype(np.float32)
+
+    Xs = dist.shard_rows_global(Xl, mesh)
+    ys = dist.shard_rows_global(yl, mesh)
+    assert Xs.n_samples == n_per * nproc
+
+    # -- flagship 1: ADMM logistic across hosts (psums ride the process
+    # boundary — DCN on a real fleet, Gloo here)
+    beta = admm(Xs, ys, family=Logistic, lamduh=1e-4, max_iter=50)
+
+    @jax.jit
+    def accuracy(x, y, mask, b):
+        pred = (x @ b > 0).astype(jnp.float32)
+        return jnp.sum((pred == y) * mask) / jnp.sum(mask)
+
+    acc = float(accuracy(Xs.data, ys.data, Xs.mask, beta))
+    assert acc > 0.9, f"ADMM cross-host accuracy {acc}"
+
+    # -- flagship 2: fused Lloyd loop on the same global mesh
+    from dask_ml_tpu.cluster.k_means import _lloyd_loop
+
+    centers0 = np.stack([Xl[:3].mean(0), Xl[3:6].mean(0) + 2.0]).astype(np.float32)
+    centers, inertia, n_iter = _lloyd_loop(
+        Xs.data, Xs.mask, jnp.asarray(centers0),
+        jnp.float32(1e-4), jnp.int32(20),
+    )[:3]
+    assert np.isfinite(float(inertia))
+
+    # hierarchical mesh builds too (explicit DCN axis)
+    hmesh = dist.global_mesh(hierarchical=True)
+    assert hmesh.axis_names == (dist.DCN_AXIS, "data", "model")
+
+    print(f"[proc {pid}] multihost OK: acc={acc:.3f} lloyd_iters={int(n_iter)}",
+          flush=True)
+
+
+def spawn_group(n_processes: int = 2, local_devices: int = 4,
+                timeout_s: int = 300):
+    """Spawn the worker group as subprocesses and collect results.
+
+    The ONE subprocess harness (used by ``__graft_entry__.dryrun_multihost``
+    and tests).  Each process's merged stdout/stderr is drained on its own
+    thread — a later worker filling its pipe while the parent waits on an
+    earlier one would otherwise block mid-collective and deadlock the whole
+    SPMD group.  Returns ``[(returncode, output), ...]``; raises
+    RuntimeError with all partial output on timeout.
+    """
+    import socket
+    import subprocess
+    import threading
+
+    with socket.socket() as s:  # free port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "dask_ml_tpu.core._multihost_worker",
+             str(pid), str(n_processes), str(port), str(local_devices)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=repo_root,
+        )
+        for pid in range(n_processes)
+    ]
+    outs: list = [""] * n_processes
+    timed_out = [False] * n_processes
+
+    def drain(i, p):
+        try:
+            outs[i], _ = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired as e:
+            timed_out[i] = True
+            outs[i] = (e.stdout or "") if isinstance(e.stdout, str) else ""
+
+    threads = [
+        threading.Thread(target=drain, args=(i, p), daemon=True)
+        for i, p in enumerate(procs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if any(timed_out):
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait()  # reap
+        joined = "\n---\n".join(outs)
+        raise RuntimeError(
+            f"multihost group timed out after {timeout_s}s; partial output:\n{joined}"
+        )
+    return [(p.returncode, out) for p, out in zip(procs, outs)]
+
+
+if __name__ == "__main__":
+    main(
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
+        int(sys.argv[4]) if len(sys.argv) > 4 else 4,
+    )

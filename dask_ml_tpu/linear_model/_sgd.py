@@ -314,6 +314,17 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
         if self.loss not in _CLS_LOSSES:
             raise ValueError(f"loss must be one of {_CLS_LOSSES}")
 
+    def _set_classes(self, classes):
+        """Validate + assign the class set (shared by fit / partial_fit /
+        the packed Cohort plane, so all three reject the same configs)."""
+        classes = np.sort(np.asarray(classes))
+        if len(classes) < 2:
+            raise ValueError(
+                "classifier needs samples of at least 2 classes; got "
+                f"{classes.tolist()}"
+            )
+        self.classes_ = classes
+
     def _encode_targets(self, y):
         """y labels → ±1 one-vs-all float matrix [n, K] (K=1 binary)."""
         y = np.asarray(y).ravel()
@@ -341,12 +352,7 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
                 raise ValueError(
                     "classes must be passed on the first partial_fit call"
                 )
-            self.classes_ = np.sort(np.asarray(classes))
-            if len(self.classes_) < 2:
-                raise ValueError(
-                    "classifier needs samples of at least 2 classes; got "
-                    f"{self.classes_.tolist()}"
-                )
+            self._set_classes(classes)
         if isinstance(y, ShardedRows):
             from ..core.sharded import unshard
 
@@ -378,12 +384,7 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
             for attr in ("_state", "classes_"):
                 if hasattr(self, attr):
                     delattr(self, attr)
-            self.classes_ = np.unique(y)
-            if len(self.classes_) < 2:
-                raise ValueError(
-                    "classifier needs samples of at least 2 classes; got "
-                    f"{self.classes_.tolist()}"
-                )
+            self._set_classes(np.unique(y))
         # Encode/pad/transfer ONCE; every epoch is then just the fused step.
         xb, yb, mask = self._prep_block(X, self._encode_targets(y))
         self._ensure_state(xb.shape[1])

@@ -214,8 +214,9 @@ class BaseIncrementalSearchCV(TPUEstimator):
                 cohort.step(Xb, yb)
             cohort.finalize()
             # train_one semantics: partial_fit_time is the duration of ONE
-            # block call (the last _partial_fit overwrites it)
-            pf_time = (time.time() - t0) / max(n_calls, 1)
+            # model's ONE block call — amortize the cohort-wide wall time
+            # over (models x calls) so packed and unpacked timings compare
+            pf_time = (time.time() - t0) / max(n_calls * len(idents), 1)
             for ident in idents:
                 model, meta = models[ident]
                 meta = dict(meta)

@@ -130,7 +130,7 @@ class Cohort:
                             "classes must be provided to pack unfitted "
                             "classifiers (pass classes= to fit)"
                         )
-                    m.classes_ = np.sort(np.asarray(self._classes))
+                    m._set_classes(self._classes)
             targets = m0._encode_targets(np.asarray(y))
         else:
             targets = m0._targets(y)

@@ -1,0 +1,81 @@
+"""Multi-host plane tests (VERDICT round-1 item 4; SURVEY.md §2.3).
+
+The in-process suite runs on one process, so the cross-process path is
+exercised the way the reference exercises multi-node behavior — a real
+protocol stack on localhost (``gen_cluster`` analogue): subprocesses form a
+``jax.distributed`` group with Gloo CPU collectives and run the flagship
+SPMD programs over the global mesh.
+"""
+
+import os
+import sys
+
+from dask_ml_tpu.core._multihost_worker import spawn_group
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestMultihost:
+    def test_two_process_admm_and_lloyd(self):
+        for rc, out in spawn_group(2, 4, timeout_s=240):
+            assert rc == 0, out
+            assert "multihost OK" in out
+
+    def test_graft_entry_dryrun_multihost(self):
+        # the driver-facing wrapper end-to-end
+        sys.path.insert(0, REPO)
+        try:
+            import __graft_entry__ as g
+
+            g.dryrun_multihost(2, local_devices=2)
+        finally:
+            sys.path.remove(REPO)
+
+
+class TestGlobalMeshSingleProcess:
+    """Mesh/axis logic that doesn't need a real process group."""
+
+    def test_global_mesh_flat_axes(self, mesh):
+        from dask_ml_tpu.core import distributed as dist
+
+        m = dist.global_mesh()
+        assert m.axis_names == ("data", "model")
+        assert len(m.devices.flat) == 8
+
+    def test_hierarchical_single_process(self, mesh):
+        from dask_ml_tpu.core import distributed as dist
+
+        m = dist.global_mesh(hierarchical=True)
+        assert m.axis_names == ("dcn", "data", "model")
+        assert m.shape["dcn"] == 1  # one process
+
+    def test_shard_rows_global_single_process(self, mesh, rng):
+        import numpy as np
+
+        from dask_ml_tpu.core import distributed as dist
+        from dask_ml_tpu.core import unshard
+
+        X = rng.normal(size=(37, 3)).astype(np.float32)
+        s = dist.shard_rows_global(X, dist.global_mesh())
+        assert s.n_samples == 37
+        np.testing.assert_allclose(unshard(s), X)
+
+    def test_mesh_process_mismatch_clear_error(self, mesh):
+        import numpy as np
+        import pytest
+
+        from dask_ml_tpu.core import distributed as dist
+
+        m = dist.global_mesh(model_axis=8)  # data axis size 1, 1 process ok
+        # fake a larger process count via monkeypatching is brittle; instead
+        # check the validation logic directly
+        with pytest.raises(ValueError, match="evenly"):
+            # simulate: 1 data shard cannot split over 2 processes
+            import jax
+
+            orig = jax.process_count
+            jax.process_count = lambda: 2
+            try:
+                dist.shard_rows_global(np.zeros((4, 2), np.float32), m)
+            finally:
+                jax.process_count = orig

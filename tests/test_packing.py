@@ -167,3 +167,12 @@ class TestSearchIntegration:
         # 6 models at 2 calls, survivors grow x3: the [2,2,2,2,6,18]-style
         # ladder must match the unpacked policy math
         assert calls[0] == 2 and calls[-1] > 2
+
+
+class TestPackedValidationParity:
+    def test_single_class_rejected_in_cohort(self, rng):
+        X, y = _data(rng, n=50)
+        with pytest.raises(ValueError, match="2 classes"):
+            Cohort(
+                [SGDClassifier(), SGDClassifier(alpha=1e-3)], classes=[0]
+            ).step(X, np.zeros(50))
