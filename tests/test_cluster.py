@@ -186,3 +186,20 @@ class TestReviewRegressions:
             kmeans_params={"random_state": 5, "max_iter": 50},
         ).fit(X)
         assert np.asarray(spec.labels_).shape == (500,)
+
+
+class TestFloat16KMeans:
+    def test_fit_float16_input(self, rng, mesh):
+        # the validity sentinel must be dtype-aware: 1e30 overflows to inf
+        # in float16 and would NaN-poison the init distances
+        import numpy as np
+
+        from dask_ml_tpu.cluster import KMeans
+        from dask_ml_tpu.core import shard_rows
+
+        X = np.concatenate([
+            rng.normal(0, 0.3, (200, 4)), rng.normal(8, 0.3, (200, 4))
+        ]).astype(np.float16)
+        km = KMeans(n_clusters=2, random_state=0).fit(shard_rows(X))
+        got = np.sort(np.asarray(km.cluster_centers_)[:, 0].astype(np.float64))
+        np.testing.assert_allclose(got, [0.0, 8.0], atol=1.0)

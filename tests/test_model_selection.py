@@ -320,3 +320,86 @@ class TestReviewRegressions:
         gs = dms.GridSearchCV(KMeans(init="random", random_state=0), {"n_clusters": [2, 3]}, cv=2)
         gs.fit(X)  # y=None path
         assert gs.best_params_["n_clusters"] in (2, 3)
+
+
+class TestDeviceSideSplit:
+    def test_take_no_host_materialization(self, rng, mesh):
+        # the sharded path must not call np.asarray on X-sized data
+        import unittest.mock as um
+
+        import numpy as np
+
+        from dask_ml_tpu.core import shard_rows, unshard
+        from dask_ml_tpu.model_selection import _split
+
+        X = rng.normal(size=(200, 4)).astype(np.float32)
+        Xs = shard_rows(X)
+        idx = rng.permutation(150)
+        real_asarray = np.asarray
+        big_pulls = []
+
+        def spy(a, *args, **kw):
+            out = real_asarray(a, *args, **kw)
+            import jax
+
+            if isinstance(a, jax.Array) and out.size >= 100 * 4:
+                big_pulls.append(out.shape)
+            return out
+
+        with um.patch.object(_split.np, "asarray", side_effect=spy):
+            taken = _split._take(Xs, idx)
+        assert big_pulls == []  # gather stayed on device
+        np.testing.assert_allclose(unshard(taken), X[idx])
+
+    def test_take_result_row_sharded(self, rng, mesh):
+        import numpy as np
+
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.core.mesh import DATA_AXIS
+        from dask_ml_tpu.model_selection._split import _take
+
+        X = rng.normal(size=(100, 3)).astype(np.float32)
+        taken = _take(shard_rows(X), np.arange(37))
+        assert taken.n_samples == 37
+        assert taken.data.sharding.spec[0] == DATA_AXIS
+
+
+class TestKMeansParInitDeviceSide:
+    def test_no_length_n_host_pull_per_round(self, rng, mesh):
+        import unittest.mock as um
+
+        import numpy as np
+
+        from dask_ml_tpu.cluster import k_means as km
+        from dask_ml_tpu.core import shard_rows
+
+        n = 4096
+        X = np.concatenate([
+            rng.normal(i * 5, 0.5, size=(n // 4, 8)) for i in range(4)
+        ]).astype(np.float32)
+        Xs = shard_rows(X)
+        real_asarray = np.asarray
+        big_pulls = []
+
+        def spy(a, *args, **kw):
+            out = real_asarray(a, *args, **kw)
+            import jax
+
+            # guard against O(n)-sized pulls (the old per-round boolean
+            # vector); the legitimate end-of-init candidate pull is
+            # O(k log n * d), far below n*4 at this shape
+            if isinstance(a, jax.Array) and out.size >= n * 4:
+                big_pulls.append(out.shape)
+            return out
+
+        import jax
+
+        with um.patch.object(km.np, "asarray", side_effect=spy):
+            centers = km.init_scalable(
+                Xs, 4, jax.random.PRNGKey(0), oversampling_factor=2
+            )
+        assert big_pulls == [], big_pulls
+        # init still finds the 4 well-separated blobs
+        got = np.sort(np.asarray(centers)[:, 0])
+        expect = np.array([0.0, 5.0, 10.0, 15.0])
+        np.testing.assert_allclose(got, expect, atol=1.5)
