@@ -25,6 +25,9 @@ from ..base import TPUEstimator, TransformerMixin
 from ..core.prng import as_key
 from ..core.sharded import ShardedRows, unshard
 from ..preprocessing.data import _ingest_float as _ingest_float_any
+from ..utils import _timer
+
+logger = logging.getLogger(__name__)
 
 
 def _ingest_float(est, X):
@@ -32,18 +35,11 @@ def _ingest_float(est, X):
     kernels accumulate distances and counts, and float16 accumulators both
     overflow early and break the fused loop's mixed-dtype carry (sklearn
     likewise computes k-means in wider precision than half)."""
-    import jax.numpy as _jnp
-
-    from ..core.sharded import ShardedRows as _SR
-
     X = _ingest_float_any(est, X)
-    if X.data.dtype in (_jnp.float16, _jnp.bfloat16):
-        X = _SR(data=X.data.astype(_jnp.float32), mask=X.mask,
-                n_samples=X.n_samples)
+    if X.data.dtype in (jnp.float16, jnp.bfloat16):
+        X = ShardedRows(data=X.data.astype(jnp.float32), mask=X.mask,
+                        n_samples=X.n_samples)
     return X
-from ..utils import _timer
-
-logger = logging.getLogger(__name__)
 
 
 # the one squared-distance kernel, shared with metrics.pairwise
@@ -176,11 +172,13 @@ def _assign(x, mask, centers):
 
 def _valid_d2(x, centers, cvalid):
     """Distances with INVALID candidate slots pushed out of every min/argmin.
-    dtype-aware sentinel via where (an additive 1e30 overflows to inf in
-    float16 and 0*inf = NaN would poison every distance)."""
+    The sentinel is +inf selected via ``where`` — never ADDED or multiplied
+    (an additive 1e30 overflows to inf in float16 and 0*inf = NaN would
+    poison every distance; a finite dtype-max sentinel can be beaten by
+    legitimate large distances).  Slot 0 is always valid, so min/argmin
+    always land on a real candidate."""
     d2 = _sq_dists(x, centers)
-    big = jnp.asarray(jnp.finfo(x.dtype).max / 4, x.dtype)
-    return jnp.where(cvalid[None, :] > 0, d2, big)
+    return jnp.where(cvalid[None, :] > 0, d2, jnp.asarray(jnp.inf, x.dtype))
 
 
 @jax.jit

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 from collections import defaultdict
 
@@ -30,6 +31,29 @@ from ..utils import check_random_state
 from ._split import train_test_split
 
 logger = logging.getLogger(__name__)
+
+# Shared training pool for the adaptive searches (the scheduler+worker
+# threadpools of the reference, collapsed to one process).  Module-level so
+# concurrent Hyperband brackets share workers instead of oversubscribing.
+_EXECUTOR = None
+_EXECUTOR_LOCK = threading.Lock()
+
+
+def _train_executor():
+    global _EXECUTOR
+    with _EXECUTOR_LOCK:
+        if _EXECUTOR is None:
+            import os
+            from concurrent.futures import ThreadPoolExecutor
+
+            # training threads mostly wait inside GIL-releasing kernels
+            # (sklearn C, XLA dispatch), so size past the core count the
+            # way an IO pool would — never below 4
+            _EXECUTOR = ThreadPoolExecutor(
+                max_workers=min(16, max(4, os.cpu_count() or 1)),
+                thread_name_prefix="dask_ml_tpu_train",
+            )
+        return _EXECUTOR
 
 
 def _partial_fit(model_and_meta, X, y, fit_params):
@@ -251,13 +275,36 @@ class BaseIncrementalSearchCV(TPUEstimator):
             return packed, singles
 
         async def run_round(instructions):
+            """Fan this round's training units over the shared thread pool
+            so independent models — and, above us, concurrent Hyperband
+            brackets on the same event loop — overlap in WALL CLOCK, not
+            just cooperatively (reference: the futures plane gets this from
+            the cluster; host sklearn fits release the GIL in C kernels and
+            device fits overlap via JAX async dispatch)."""
+            loop = asyncio.get_running_loop()
+            pool = _train_executor()
             packed, singles = pack_groups(instructions)
-            for (key, n_calls, _), idents in packed.items():
-                train_cohort(idents, n_calls)
-                await asyncio.sleep(0)  # cooperative yield (bracket interleave)
-            for ident, n_calls in singles:
-                train_one(ident, n_calls)
-                await asyncio.sleep(0)
+            # mesh scoping is thread-local: re-establish the CALLER's mesh
+            # inside each worker so device-native fits keep the fleet/user
+            # mesh instead of falling back to the all-devices default
+            from ..core.mesh import get_mesh, use_mesh
+
+            mesh = get_mesh()
+
+            def on_mesh(fn, *args):
+                with use_mesh(mesh):
+                    return fn(*args)
+
+            futs = [
+                loop.run_in_executor(pool, on_mesh, train_cohort, idents, n_calls)
+                for (key, n_calls, _), idents in packed.items()
+            ]
+            futs += [
+                loop.run_in_executor(pool, on_mesh, train_one, ident, n_calls)
+                for ident, n_calls in singles
+            ]
+            if futs:
+                await asyncio.gather(*futs)
 
         # initial round: one call each (skipped when resuming — the
         # snapshot already contains at least the initial round)

@@ -7,12 +7,21 @@ once.  Here the equivalent is a host-side **fit cache** keyed the same way:
 for ``Pipeline`` candidates, prefix steps whose (step params, data split)
 repeat across candidates are fit/transformed once and reused; the per-
 candidate math itself runs on device through the estimators.
+
+Candidate×fold fits fan out over a thread pool honoring ``n_jobs`` (the
+reference gets this parallelism from the distributed scheduler executing
+the merged graph; host sklearn estimators release the GIL in their C
+kernels, and device estimators overlap through JAX's async dispatch).  The
+prefix cache is compute-once under concurrency: the first thread to need a
+prefix fits it, later threads block on that entry rather than refitting.
 """
 
 from __future__ import annotations
 
 import logging
-from collections import defaultdict
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor, as_completed
 
 import numpy as np
 
@@ -37,6 +46,50 @@ class _CacheKey:
     def make(step, params, fold_idx):
         items = tuple(sorted((k, repr(v)) for k, v in params.items()))
         return (type(step).__name__, items, fold_idx)
+
+
+class _OnceCache:
+    """Compute-once concurrent cache: the first caller of a token computes;
+    concurrent callers of the SAME token wait for that result instead of
+    refitting (the thread-pool analogue of graph-node dedup)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict = {}
+
+    def get_or_compute(self, token, fn):
+        with self._lock:
+            entry = self._entries.get(token)
+            if entry is None:
+                entry = {"event": threading.Event(), "value": None, "error": None}
+                self._entries[token] = entry
+                owner = True
+            else:
+                owner = False
+        if owner:
+            try:
+                entry["value"] = fn()
+            except BaseException as e:  # propagate to waiters too
+                entry["error"] = e
+                raise
+            finally:
+                entry["event"].set()
+            return entry["value"]
+        entry["event"].wait()
+        if entry["error"] is not None:
+            raise entry["error"]
+        return entry["value"]
+
+
+def _resolve_n_jobs(n_jobs) -> int:
+    if n_jobs is None or n_jobs == 1:
+        return 1
+    if n_jobs < 0:  # sklearn convention: -1 -> all cores
+        cpus = os.cpu_count() or 1
+        return max(1, cpus + 1 + n_jobs)
+    # honor an explicit request as-is: fit threads block in GIL-releasing
+    # kernels, so oversubscribing cores is deliberate and cheap
+    return int(n_jobs)
 
 
 class _BaseSearchCV(TPUEstimator):
@@ -73,30 +126,59 @@ class _BaseSearchCV(TPUEstimator):
         splits = list(cv.split(Xh, yh))
         scorer = check_scoring(self.estimator, self.scoring)
 
-        # prefix-transform cache: (pipeline prefix token) -> transformed data
-        prefix_cache = {}
+        # prefix-transform cache: (pipeline prefix token) -> fitted step +
+        # transformed data, compute-once under the thread pool
+        prefix_cache = _OnceCache()
 
         n_cand = len(candidates)
         test_scores = np.zeros((n_cand, len(splits)))
         train_scores = np.zeros((n_cand, len(splits))) if self.return_train_score else None
         fit_failed = np.zeros(n_cand, dtype=bool)
 
-        for ci, params in enumerate(candidates):
-            for fi, (train_idx, test_idx) in enumerate(splits):
-                Xtr, ytr = Xh[train_idx], (yh[train_idx] if yh is not None else None)
-                Xte, yte = Xh[test_idx], (yh[test_idx] if yh is not None else None)
+        def run_task(ci, fi):
+            params = candidates[ci]
+            train_idx, test_idx = splits[fi]
+            Xtr, ytr = Xh[train_idx], (yh[train_idx] if yh is not None else None)
+            Xte, yte = Xh[test_idx], (yh[test_idx] if yh is not None else None)
+            try:
+                est = self._fit_candidate(
+                    params, Xtr, ytr, fi, prefix_cache, fit_params
+                )
+                test_scores[ci, fi] = scorer(est, Xte, yte)
+                if self.return_train_score:
+                    train_scores[ci, fi] = scorer(est, Xtr, ytr)
+            except Exception:
+                if self.error_score == "raise":
+                    raise
+                test_scores[ci, fi] = float(self.error_score)
+                fit_failed[ci] = True
+
+        tasks = [(ci, fi) for ci in range(n_cand) for fi in range(len(splits))]
+        n_workers = min(_resolve_n_jobs(self.n_jobs), len(tasks))
+        if n_workers <= 1:
+            for ci, fi in tasks:
+                run_task(ci, fi)
+        else:
+            # mesh scoping is thread-local: re-establish the caller's mesh
+            # inside each worker (device estimators would otherwise fall
+            # back to the all-devices default mesh)
+            from ..core.mesh import get_mesh, use_mesh
+
+            mesh = get_mesh()
+
+            def run_on_mesh(ci, fi):
+                with use_mesh(mesh):
+                    run_task(ci, fi)
+
+            with ThreadPoolExecutor(max_workers=n_workers) as pool:
+                futures = [pool.submit(run_on_mesh, ci, fi) for ci, fi in tasks]
                 try:
-                    est = self._fit_candidate(
-                        params, Xtr, ytr, fi, prefix_cache, fit_params
-                    )
-                    test_scores[ci, fi] = scorer(est, Xte, yte)
-                    if self.return_train_score:
-                        train_scores[ci, fi] = scorer(est, Xtr, ytr)
-                except Exception:
-                    if self.error_score == "raise":
-                        raise
-                    test_scores[ci, fi] = float(self.error_score)
-                    fit_failed[ci] = True
+                    for f in as_completed(futures):
+                        f.result()  # re-raise the FIRST failure...
+                except BaseException:
+                    # ...and don't run the rest of a doomed grid
+                    pool.shutdown(wait=False, cancel_futures=True)
+                    raise
 
         self._build_results(candidates, splits, test_scores, train_scores)
         if self.refit:
@@ -129,12 +211,12 @@ class _BaseSearchCV(TPUEstimator):
             step_params = step.get_params()
             prefix_tokens.append(_CacheKey.make(step, step_params, fold_idx))
             token = tuple(prefix_tokens)
-            if token in prefix_cache:
-                fitted_step, data = prefix_cache[token]
-            else:
-                fitted_step = clone(step)
-                data = fitted_step.fit_transform(data, ytr)
-                prefix_cache[token] = (fitted_step, data)
+
+            def fit_prefix(step=step, data_in=data):
+                fitted = clone(step)
+                return fitted, fitted.fit_transform(data_in, ytr)
+
+            fitted_step, data = prefix_cache.get_or_compute(token, fit_prefix)
             fitted_steps.append((name, fitted_step))
         final_name, final = steps[-1]
         final = clone(final)
