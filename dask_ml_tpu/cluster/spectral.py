@@ -17,6 +17,7 @@ and no arbitrary-index gathers are needed (the reference's
 from __future__ import annotations
 
 import logging
+from functools import partial
 
 import numpy as np
 
@@ -31,6 +32,74 @@ from ..preprocessing.data import _ingest_float
 from .k_means import KMeans
 
 logger = logging.getLogger(__name__)
+
+# Exact path materializes an O(n²/P) affinity per device; refuse beyond
+# this many rows rather than OOM a pod mid-fit.
+_EXACT_MAX_ROWS = 200_000
+
+
+# The exact eigensolve is three fused programs driven by a tiny host loop:
+# normalize once, advance the subspace in chunks, and check Ritz-value
+# convergence between chunks (sparse kNN graphs have near-degenerate
+# spectra — a fixed iteration count either wastes work on easy graphs or
+# under-converges hard ones).  The iteration runs on C + I (a spectrum
+# SHIFT): orthogonal iteration converges to the largest-|λ| subspace, and
+# normalized affinities can have dominant NEGATIVE eigenvalues
+# (near-bipartite graphs) that would crowd the wanted top positive
+# eigenvectors out of the k+p subspace; λ+1 ∈ [0, 2] makes signed order
+# equal magnitude order, and Rayleigh–Ritz on the ORIGINAL C recovers the
+# true eigenvalues.
+
+
+@jax.jit
+def _normalized_affinity(W, mask):
+    W = W * mask[:, None] * mask[None, :]
+    deg = jnp.sum(W, axis=1)
+    dinv = jnp.where((deg > 1e-12) & (mask > 0), 1.0 / jnp.sqrt(deg), 0.0)
+    return dinv[:, None] * W * dinv[None, :]
+
+
+@partial(jax.jit, static_argnames=("mesh_holder", "iters"))
+def _subspace_chunk(C, V, *, mesh_holder, iters):
+    from ..linalg.tsqr import _tsqr_impl
+
+    def body(_, v):
+        return _tsqr_impl(C @ v + v, mesh_holder=mesh_holder)[0]  # (C+I)v
+
+    return jax.lax.fori_loop(0, iters, body, V)
+
+
+@jax.jit
+def _ritz_values(C, V):
+    return jnp.linalg.eigvalsh(V.T @ (C @ V))
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _ritz_embedding(C, V, *, k):
+    M = V.T @ (C @ V)  # (kp, kp) replicated Rayleigh-Ritz on the TRUE C
+    w, u = jnp.linalg.eigh(M)
+    top = u[:, -k:][:, ::-1]
+    lam = w[-k:][::-1]
+    emb = V @ top
+    norms = jnp.linalg.norm(emb, axis=1, keepdims=True)
+    return emb / jnp.where(norms > 1e-12, norms, 1.0), lam
+
+
+@partial(jax.jit, static_argnames=("k_nn",))
+def _knn_graph(d2, mask, *, k_nn):
+    """Symmetric binary kNN graph from a (padded_n, padded_n) distance
+    matrix, fused: self/pad exclusion, EXACTLY-k neighbor scatter (a
+    `d2 <= kth` threshold would admit every tie — duplicate-heavy data
+    then blows degrees past k), union-symmetrize, mask."""
+    pn = d2.shape[0]
+    inf = jnp.asarray(jnp.inf, d2.dtype)
+    ridx = jnp.arange(pn)
+    d2 = jnp.where(ridx[:, None] == ridx[None, :], inf, d2)  # no self
+    d2 = jnp.where(mask[None, :] > 0, d2, inf)  # no pad cols
+    _, nbr = jax.lax.top_k(-d2, k_nn)  # (pn, k) nearest indices
+    W = jnp.zeros((pn, pn), d2.dtype).at[ridx[:, None], nbr].set(1.0)
+    W = jnp.maximum(W, W.T)
+    return W * mask[:, None] * mask[None, :]
 
 
 def _inv_sqrt_psd(a, eps=1e-8):
@@ -75,24 +144,55 @@ class SpectralClustering(TPUEstimator):
             params.setdefault("coef0", self.coef0)
             return PAIRWISE_KERNEL_FUNCTIONS["polynomial"](X, S, **params)
         raise ValueError(
-            f"Unsupported affinity: {self.affinity!r} (rbf, polynomial, or callable)"
+            f"Unsupported affinity: {self.affinity!r} "
+            "(rbf, polynomial, nearest_neighbors, precomputed, or callable)"
         )
+
+    def _sample_affinities(self, X, idx):
+        """(E, A): cross affinity (padded_n, m) sharded and sample affinity
+        (m, m) replicated, per the configured affinity."""
+        if self.affinity == "precomputed":
+            # X IS the affinity matrix: columns/rows at the sampled indices
+            # (reference SpectralClustering(affinity='precomputed'))
+            E = jnp.take(X.data, idx, axis=1)
+            A = jnp.take(E, idx, axis=0)
+            return E * X.mask[:, None], A
+        # feature affinities need the sampled ROWS; precomputed above works
+        # on columns only, so the gather lives here where it's used
+        sample = jnp.take(X.data, idx, axis=0)
+        E = self._kernel(X.data, sample)
+        return E * X.mask[:, None], self._kernel(sample, sample)
 
     def fit(self, X, y=None):
         X = _ingest_float(self, X)
         n = X.n_samples
+        if self.n_components is None or self.affinity == "nearest_neighbors":
+            if self.affinity == "nearest_neighbors" and self.n_components is not None:
+                # nearest_neighbors needs the FULL kNN graph (a binary kNN
+                # connectivity restricted to sample columns is not a valid
+                # Nyström decomposition), so n_components cannot apply
+                logger.warning(
+                    "affinity='nearest_neighbors' ignores n_components=%s: "
+                    "the full kNN graph is built and solved exactly "
+                    "(O(n^2/P) memory per device)", self.n_components,
+                )
+            if n > _EXACT_MAX_ROWS:
+                raise ValueError(
+                    f"exact spectral path materializes an n x n affinity and "
+                    f"n={n} exceeds the {_EXACT_MAX_ROWS} guard; use the "
+                    "Nyström path (set n_components, with affinity "
+                    "'rbf'/'polynomial'/'precomputed'/callable)"
+                )
+            return self._fit_exact(X)
         m = min(self.n_components, n)
         key = as_key(self.random_state)
 
-        # sample m real rows — index draw + gather stay on device (indices
-        # are < n_samples, so no pad rows are selectable)
+        # sample m real row INDICES — the gather of sampled rows (feature
+        # affinities only) stays on device; indices are < n_samples, so no
+        # pad rows are selectable
         idx = jax.random.choice(key, n, (m,), replace=False)
-        sample = jnp.take(X.data, idx, axis=0)
 
-        # E: (padded_n, m) sharded; zero padded rows via mask
-        E = self._kernel(X.data, sample)
-        E = E * X.mask[:, None]
-        A = self._kernel(sample, sample)  # (m, m) replicated
+        E, A = self._sample_affinities(X, idx)
 
         A_inv = jnp.linalg.pinv(A, hermitian=True)
         # approximate degrees: d = E A^{-1} (E^T 1)
@@ -124,6 +224,102 @@ class SpectralClustering(TPUEstimator):
         self.n_features_in_ = X.data.shape[1]
         if self.persist_embedding:
             self.embedding_ = emb
+        return self
+
+    # -- exact (non-Nyström) path --------------------------------------
+    def _full_affinity(self, X):
+        """(padded_n, padded_n) row-sharded affinity with masked rows/cols.
+        Feature affinities flow through the ppermute ring — the Y-blocks
+        circulate ICI while each device computes its tile (ring attention's
+        outer loop; SURVEY.md §5)."""
+        from ..core.mesh import MeshHolder, get_mesh
+        from ..metrics import pairwise as pw
+
+        if self.affinity == "precomputed":
+            W = X.data
+            pad = X.padded - W.shape[1]
+            if pad:
+                W = jnp.pad(W, ((0, 0), (0, pad)))
+        elif self.affinity == "nearest_neighbors":
+            # symmetric binary kNN connectivity over ALL rows (sklearn's
+            # kneighbors_graph semantics: self excluded, union-symmetrized),
+            # graph construction fused in _knn_graph
+            d2 = pw._ring_impl(
+                X.data, X.data, mesh_holder=MeshHolder(get_mesh()),
+                fn=pw._sq_euclidean,
+            )
+            k_nn = min(self.n_neighbors, max(X.n_samples - 1, 1))
+            W = _knn_graph(d2, X.mask, k_nn=k_nn)
+        else:
+            if callable(self.affinity):
+                tile = self.affinity
+            elif self.affinity == "rbf":
+                g = self.gamma if self.gamma is not None else 1.0 / X.data.shape[1]
+                tile = pw._BoundTile(pw._rbf_tile, gamma=float(g))
+            elif self.affinity == "polynomial":
+                g = self.gamma if self.gamma is not None else 1.0 / X.data.shape[1]
+                tile = pw._BoundTile(
+                    pw._poly_tile, gamma=float(g), coef0=float(self.coef0),
+                    degree=int(self.degree),
+                )
+            else:
+                raise ValueError(
+                    f"affinity {self.affinity!r} not supported on the exact "
+                    "path (rbf, polynomial, precomputed, or callable)"
+                )
+            W = pw._ring_impl(
+                X.data, X.data, mesh_holder=MeshHolder(get_mesh()), fn=tile
+            )
+        # NOTE: returned W is unmasked (except the fused kNN graph);
+        # _exact_embed applies the row+col mask inside its fused program so
+        # no extra n² temporary is materialized here.
+        return W
+
+    def _fit_exact(self, X, n_power_iters: int = 40, oversample: int = 8):
+        """Exact normalized-cuts embedding (``n_components=None``): full
+        affinity via the ring, top eigenvectors of D^{-1/2} W D^{-1/2} by
+        orthogonal iteration with TSQR re-orthogonalization — the whole
+        subspace stays row-sharded; only (k+p)² Rayleigh–Ritz matrices are
+        replicated.  The entire eigensolve compiles to ONE XLA program
+        (eager matmuls on sharded operands would issue cross-module
+        collectives per op).  O(n²/P) affinity memory per device: exact is
+        for moderate n, the Nyström default for the rest."""
+        n = X.n_samples
+        k = self.n_clusters
+        W = self._full_affinity(X)
+        key = as_key(self.random_state)
+        kp = min(k + oversample, n)
+        from ..core.mesh import MeshHolder, get_mesh
+        from ..core.sharded import row_sharding
+
+        mesh = get_mesh()
+        mh = MeshHolder(mesh)
+        C = _normalized_affinity(W, X.mask)
+        V = jax.device_put(
+            jax.random.normal(key, (X.padded, kp), dtype=X.data.dtype),
+            row_sharding(mesh, 2),
+        )
+        tol = max(float(self.eigen_tol or 0.0), 1e-6)
+        prev = None
+        for chunk in range(10):  # ≤ 10 * n_power_iters iterations
+            V = _subspace_chunk(C, V, mesh_holder=mh, iters=int(n_power_iters))
+            lam_now = np.asarray(_ritz_values(C, V))[-k:]
+            if prev is not None and np.max(np.abs(lam_now - prev)) < tol:
+                break
+            prev = lam_now
+        logger.debug("exact spectral: %d subspace chunks", chunk + 1)
+        emb, lam = _ritz_embedding(C, V, k=int(k))
+        emb_s = ShardedRows(data=emb, mask=X.mask, n_samples=n)
+        km_params = {"n_clusters": k, "random_state": self.random_state}
+        km_params.update(self.kmeans_params or {})
+        km = KMeans(**km_params)
+        km.fit(emb_s)
+        self.assign_labels_ = km
+        self.labels_ = km.labels_
+        self.eigenvalues_ = lam
+        self.n_features_in_ = X.data.shape[1]
+        if self.persist_embedding:
+            self.embedding_ = emb_s
         return self
 
     def fit_predict(self, X, y=None):

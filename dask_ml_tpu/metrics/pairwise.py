@@ -6,13 +6,28 @@ mesh; Y (typically centers or a sample) is replicated, so each device
 computes its tile with one local gemm — the distance matrix comes out
 row-sharded with zero communication.  This is the MXU hot path for KMeans
 and SpectralClustering.
+
+When BOTH operands are sharded (the reference's general
+``pairwise_distances(X, Y)`` over two chunked arrays), the tiles are
+computed with a **ppermute ring**: each device computes its local X-block
+against the Y-block it currently holds, then passes the Y-block one hop
+around the data-axis ring.  After P steps every device has its full row
+block of the n×m result.  Structurally this is ring attention's outer loop
+(SURVEY.md §5 long-context paragraph): Y blocks flow over ICI while the
+gemms overlap with the transfers; no device ever materializes more than
+(n/P)·m of the output or m/P·d of the remote operand.
 """
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
+from ..core.compat import shard_map_unchecked as _shard_map
+from ..core.mesh import DATA_AXIS, MeshHolder, get_mesh
 from ..core.sharded import ShardedRows
 
 
@@ -26,6 +41,56 @@ def _data_of(x):
     return x, x.shape[0]
 
 
+def _both_sharded(X, Y):
+    return isinstance(X, ShardedRows) and isinstance(Y, ShardedRows)
+
+
+@partial(jax.jit, static_argnames=("mesh_holder", "fn"))
+def _ring_impl(x, y, *, mesh_holder, fn):
+    """n×m tile matrix with both operands row-sharded: Y circulates the
+    ring while each device fills its row block column-block by
+    column-block."""
+    mesh = mesh_holder.mesh
+    n_shards = mesh.shape[DATA_AXIS]
+
+    def local(x_l, y_l):
+        i = jax.lax.axis_index(DATA_AXIS)
+        m_l = y_l.shape[0]
+        out0 = jnp.zeros((x_l.shape[0], n_shards * m_l), dtype=x_l.dtype)
+        perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+        def body(carry, s):
+            y_cur, out = carry
+            tile = fn(x_l, y_cur)  # (n_l, m_l) — local MXU gemm
+            col = ((i - s) % n_shards) * m_l  # block y_cur came from
+            out = jax.lax.dynamic_update_slice(out, tile, (0, col))
+            y_cur = jax.lax.ppermute(y_cur, DATA_AXIS, perm)
+            return (y_cur, out), None
+
+        (_, out), _ = jax.lax.scan(
+            body, (y_l, out0), jnp.arange(n_shards)
+        )
+        return out
+
+    return _shard_map(
+        local, mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+        out_specs=P(DATA_AXIS, None),
+    )(x, y)
+
+
+def ring_pairwise(X: ShardedRows, Y: ShardedRows, fn, mesh=None):
+    """Apply a pairwise tile kernel ``fn(x_tile, y_tile) -> (nx, ny)`` with
+    both operands sharded, via the ppermute ring.  Returns the (n, m)
+    result row-sharded and sliced to real rows/cols (Y's padding rows are
+    trailing in global order, so a column slice removes them)."""
+    mesh = mesh or get_mesh()
+    out = _ring_impl(
+        X.data, Y.data, mesh_holder=MeshHolder(mesh), fn=fn
+    )
+    return out[: X.n_samples, : Y.n_samples]
+
+
 @jax.jit
 def _sq_euclidean(x, y):
     x_norm = jnp.sum(x * x, axis=1, keepdims=True)
@@ -33,8 +98,23 @@ def _sq_euclidean(x, y):
     d2 = x_norm + y_norm - 2.0 * (x @ y.T)
     return jnp.maximum(d2, 0.0)
 
+def _euclid_tile(x, y):
+    return jnp.sqrt(_sq_euclidean(x, y))
+
+
+def _cosine_tile(x, y):
+    xn = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-30)
+    yn = y / jnp.maximum(jnp.linalg.norm(y, axis=1, keepdims=True), 1e-30)
+    return 1.0 - xn @ yn.T
+
+
 def euclidean_distances(X, Y=None, squared: bool = False):
-    """Row-sharded ‖x−y‖ distances (reference ``euclidean_distances``)."""
+    """Row-sharded ‖x−y‖ distances (reference ``euclidean_distances``).
+    Sharded×sharded inputs route through the ppermute ring."""
+    if Y is not None and _both_sharded(X, Y):
+        return ring_pairwise(
+            X, Y, _sq_euclidean if squared else _euclid_tile
+        )
     x, n = _data_of(X)
     y, m = (x, n) if Y is None else _data_of(Y)
     d2 = _sq_euclidean(x, y)
@@ -44,6 +124,8 @@ def euclidean_distances(X, Y=None, squared: bool = False):
 
 def pairwise_distances(X, Y=None, metric: str = "euclidean", **kwargs):
     if callable(metric):
+        if Y is not None and _both_sharded(X, Y) and not kwargs:
+            return ring_pairwise(X, Y, metric)
         x, n = _data_of(X)
         y, m = (x, n) if Y is None else _data_of(Y)
         return metric(x, y, **kwargs)[:n, :m]
@@ -52,11 +134,11 @@ def pairwise_distances(X, Y=None, metric: str = "euclidean", **kwargs):
     if metric == "sqeuclidean":
         return euclidean_distances(X, Y, squared=True)
     if metric == "cosine":
+        if Y is not None and _both_sharded(X, Y):
+            return ring_pairwise(X, Y, _cosine_tile)
         x, n = _data_of(X)
         y, m = (x, n) if Y is None else _data_of(Y)
-        xn = x / jnp.linalg.norm(x, axis=1, keepdims=True)
-        yn = y / jnp.linalg.norm(y, axis=1, keepdims=True)
-        return (1.0 - xn @ yn.T)[:n, :m]
+        return _cosine_tile(x, y)[:n, :m]
     raise ValueError(f"Unsupported metric: {metric!r}")
 
 
@@ -75,13 +157,30 @@ def pairwise_distances_argmin_min(X, Y):
     return idx[:n], dist[:n]
 
 
+def _linear_tile(x, y):
+    return x @ y.T
+
+
 def linear_kernel(X, Y=None):
+    if Y is not None and _both_sharded(X, Y):
+        return ring_pairwise(X, Y, _linear_tile)
     x, n = _data_of(X)
     y, m = (x, n) if Y is None else _data_of(Y)
     return (x @ y.T)[:n, :m]
 
 
+def _poly_tile(x, y, gamma, coef0, degree):
+    return (gamma * (x @ y.T) + coef0) ** degree
+
+
 def polynomial_kernel(X, Y=None, degree: int = 3, gamma=None, coef0: float = 1.0):
+    if Y is not None and _both_sharded(X, Y):
+        g = 1.0 / X.data.shape[1] if gamma is None else gamma
+        return ring_pairwise(
+            X, Y,
+            _BoundTile(_poly_tile, gamma=float(g), coef0=float(coef0),
+                       degree=int(degree)),
+        )
     x, n = _data_of(X)
     y, m = (x, n) if Y is None else _data_of(Y)
     if gamma is None:
@@ -89,7 +188,37 @@ def polynomial_kernel(X, Y=None, degree: int = 3, gamma=None, coef0: float = 1.0
     return ((gamma * (x @ y.T) + coef0) ** degree)[:n, :m]
 
 
+class _BoundTile:
+    """Hashable-by-value tile kernel with bound scalars, so passing it as a
+    static jit argument caches the compiled ring per (fn, params) instead
+    of recompiling per call (functools.partial hashes by identity)."""
+
+    def __init__(self, fn, **params):
+        self.fn = fn
+        self.params = tuple(sorted(params.items()))
+
+    def __call__(self, x, y):
+        return self.fn(x, y, **dict(self.params))
+
+    def __hash__(self):
+        return hash((self.fn, self.params))
+
+    def __eq__(self, other):
+        return (
+            type(other) is _BoundTile
+            and other.fn is self.fn
+            and other.params == self.params
+        )
+
+
+def _rbf_tile(x, y, gamma):
+    return jnp.exp(-gamma * _sq_euclidean(x, y))
+
+
 def rbf_kernel(X, Y=None, gamma=None):
+    if Y is not None and _both_sharded(X, Y):
+        g = 1.0 / X.data.shape[1] if gamma is None else gamma
+        return ring_pairwise(X, Y, _BoundTile(_rbf_tile, gamma=float(g)))
     x, n = _data_of(X)
     y, m = (x, n) if Y is None else _data_of(Y)
     if gamma is None:

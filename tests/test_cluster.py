@@ -120,7 +120,119 @@ class TestSpectralClustering:
     def test_bad_affinity(self, blobs):
         X, _ = blobs
         with pytest.raises(ValueError, match="affinity"):
-            dc.SpectralClustering(affinity="nearest_neighbors").fit(X)
+            dc.SpectralClustering(affinity="chi2").fit(X)
+
+    def test_nearest_neighbors_affinity(self, rng):
+        from sklearn.datasets import make_circles
+
+        X, y = make_circles(n_samples=400, factor=0.3, noise=0.05, random_state=0)
+        spec = dc.SpectralClustering(
+            n_clusters=2, n_components=120, affinity="nearest_neighbors",
+            n_neighbors=12, random_state=0,
+        ).fit(shard_rows(X.astype(np.float32)))
+        assert adjusted_rand_score(y, np.asarray(spec.labels_)) > 0.8
+
+    def test_precomputed_affinity_matches_rbf(self, rng):
+        from sklearn.datasets import make_circles
+        from sklearn.metrics.pairwise import rbf_kernel as sk_rbf
+
+        X, y = make_circles(n_samples=300, factor=0.3, noise=0.05, random_state=0)
+        X = X.astype(np.float32)
+        W = sk_rbf(X, gamma=30.0).astype(np.float32)
+        spec = dc.SpectralClustering(
+            n_clusters=2, n_components=100, affinity="precomputed",
+            random_state=0,
+        ).fit(shard_rows(W))
+        assert adjusted_rand_score(y, np.asarray(spec.labels_)) > 0.9
+
+    def test_exact_path_n_components_none(self, rng):
+        from sklearn.datasets import make_circles
+
+        X, y = make_circles(n_samples=300, factor=0.3, noise=0.05, random_state=0)
+        spec = dc.SpectralClustering(
+            n_clusters=2, n_components=None, gamma=30.0, random_state=0,
+        ).fit(shard_rows(X.astype(np.float32)))
+        assert adjusted_rand_score(y, np.asarray(spec.labels_)) > 0.9
+
+    def test_exact_path_affinity_variants(self, rng):
+        # precomputed (non-divisible n -> column padding), polynomial, and
+        # callable all flow through _full_affinity's exact branches
+        from sklearn.datasets import make_blobs
+        from sklearn.metrics.pairwise import rbf_kernel as sk_rbf
+
+        X, y = make_blobs(n_samples=203, n_features=4, centers=3,
+                          cluster_std=0.5, random_state=0)
+        X = X.astype(np.float32)
+
+        W = sk_rbf(X, gamma=2.0).astype(np.float32)
+        pre = dc.SpectralClustering(
+            n_clusters=3, n_components=None, affinity="precomputed",
+            random_state=0,
+        ).fit(shard_rows(W))
+        assert adjusted_rand_score(y, np.asarray(pre.labels_)) > 0.9
+
+        import jax.numpy as jnp
+
+        def my_affinity(a, b):
+            d2 = (
+                jnp.sum(a * a, 1)[:, None] + jnp.sum(b * b, 1)[None, :]
+                - 2 * a @ b.T
+            )
+            return jnp.exp(-2.0 * jnp.maximum(d2, 0))
+
+        cal = dc.SpectralClustering(
+            n_clusters=3, n_components=None, affinity=my_affinity,
+            random_state=0,
+        ).fit(shard_rows(X))
+        assert adjusted_rand_score(y, np.asarray(cal.labels_)) > 0.9
+
+    def test_exact_path_negative_eigenvalue_spectrum(self, rng):
+        # near-bipartite graph: dominant NEGATIVE eigenvalues must not
+        # crowd the wanted positive eigenvectors out of the subspace
+        import scipy.linalg as sla
+
+        k, sz = 6, 12
+        blocks = []
+        for _ in range(k):
+            half = sz // 2
+            B = np.zeros((sz, sz), np.float32)
+            B[:half, half:] = 1.0
+            B[half:, :half] = 1.0
+            blocks.append(B)
+        W = sla.block_diag(*blocks).astype(np.float32)
+        y = np.repeat(np.arange(k), sz)
+        spec = dc.SpectralClustering(
+            n_clusters=k, n_components=None, affinity="precomputed",
+            random_state=0,
+        ).fit(shard_rows(W))
+        np.testing.assert_allclose(np.asarray(spec.eigenvalues_), 1.0, atol=1e-3)
+        assert adjusted_rand_score(y, np.asarray(spec.labels_)) > 0.99
+
+    def test_knn_exact_neighbor_count_with_duplicates(self, rng):
+        # ties at the kth distance must not blow degrees past k
+        from dask_ml_tpu.cluster.spectral import _knn_graph
+        import jax.numpy as jnp
+
+        X = np.repeat(rng.normal(size=(4, 3)).astype(np.float32), 10, axis=0)
+        d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        mask = np.ones(40, np.float32)
+        W = np.asarray(_knn_graph(jnp.asarray(d2), jnp.asarray(mask), k_nn=5))
+        # out-degree before symmetrization is exactly 5; after union-
+        # symmetrization degree is bounded by 2k, not the duplicate-group
+        # size (10+ under the old tie-inclusive threshold)
+        assert W.sum(axis=1).max() <= 10
+
+    def test_exact_guard_rejects_huge_n(self, rng):
+        from dask_ml_tpu.cluster import spectral as sp
+
+        spec = dc.SpectralClustering(n_clusters=2, n_components=None)
+        orig = sp._EXACT_MAX_ROWS
+        sp._EXACT_MAX_ROWS = 100
+        try:
+            with pytest.raises(ValueError, match="exceeds"):
+                spec.fit(shard_rows(rng.normal(size=(200, 3)).astype(np.float32)))
+        finally:
+            sp._EXACT_MAX_ROWS = orig
 
 
 class TestDatasets:
