@@ -166,6 +166,11 @@ class SpectralClustering(TPUEstimator):
     def fit(self, X, y=None):
         X = _ingest_float(self, X)
         n = X.n_samples
+        if self.affinity == "precomputed" and X.data.shape[1] != n:
+            raise ValueError(
+                "affinity='precomputed' expects the (n_samples, n_samples) "
+                f"affinity matrix itself; got shape ({n}, {X.data.shape[1]})"
+            )
         if self.n_components is None or self.affinity == "nearest_neighbors":
             if self.affinity == "nearest_neighbors" and self.n_components is not None:
                 # nearest_neighbors needs the FULL kNN graph (a binary kNN
@@ -213,7 +218,12 @@ class SpectralClustering(TPUEstimator):
         norms = jnp.linalg.norm(V, axis=1, keepdims=True)
         V = V / jnp.where(norms > 1e-12, norms, 1.0)
 
-        emb = ShardedRows(data=V, mask=X.mask, n_samples=n)
+        return self._finalize(V, lam, X)
+
+    def _finalize(self, emb_data, lam, X):
+        """Cluster the row-normalized embedding and set the fitted attrs
+        (shared by the Nyström and exact paths)."""
+        emb = ShardedRows(data=emb_data, mask=X.mask, n_samples=X.n_samples)
         km_params = {"n_clusters": self.n_clusters, "random_state": self.random_state}
         km_params.update(self.kmeans_params or {})
         km = KMeans(**km_params)
@@ -309,18 +319,7 @@ class SpectralClustering(TPUEstimator):
             prev = lam_now
         logger.debug("exact spectral: %d subspace chunks", chunk + 1)
         emb, lam = _ritz_embedding(C, V, k=int(k))
-        emb_s = ShardedRows(data=emb, mask=X.mask, n_samples=n)
-        km_params = {"n_clusters": k, "random_state": self.random_state}
-        km_params.update(self.kmeans_params or {})
-        km = KMeans(**km_params)
-        km.fit(emb_s)
-        self.assign_labels_ = km
-        self.labels_ = km.labels_
-        self.eigenvalues_ = lam
-        self.n_features_in_ = X.data.shape[1]
-        if self.persist_embedding:
-            self.embedding_ = emb_s
-        return self
+        return self._finalize(emb, lam, X)
 
     def fit_predict(self, X, y=None):
         return self.fit(X).labels_

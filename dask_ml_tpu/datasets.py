@@ -110,3 +110,38 @@ def make_counts(n_samples=100, n_features=20, n_informative=10, scale=1.0,
     y = np.concatenate(ys)
     mesh = get_mesh()
     return shard_rows(X, mesh), shard_rows(y.astype(np.float32), mesh)
+
+
+def make_classification_df(n_samples=100, n_features=20, chunks=None,
+                           random_state=None, dates=None,
+                           feature_prefix="feature_", target_name="target",
+                           **kwargs):
+    """Classification data as a (DataFrame, Series) pair — twin of
+    ``dask_ml/datasets.py :: make_classification_df`` (named feature
+    columns; optional ``dates=(start, end)`` adds a random ``date`` column,
+    the reference's time-series-flavored knob).  Chunk seeding matches
+    :func:`make_classification` exactly."""
+    import pandas as pd
+
+    Xs, ys = make_classification(
+        n_samples=n_samples, n_features=n_features, chunks=chunks,
+        random_state=random_state, **kwargs,
+    )
+    from .core.sharded import unshard
+
+    X = unshard(Xs)
+    y = unshard(ys).astype(np.int64)
+    columns = [f"{feature_prefix}{i}" for i in range(n_features)]
+    df = pd.DataFrame(X, columns=columns)
+    if dates is not None:
+        start, end = dates
+        rng = np.random.RandomState(draw_seed(random_state))
+        stamps = pd.to_datetime(start) + pd.to_timedelta(
+            rng.uniform(
+                0, (pd.to_datetime(end) - pd.to_datetime(start)).total_seconds(),
+                size=n_samples,
+            ),
+            unit="s",
+        )
+        df.insert(0, "date", stamps)
+    return df, pd.Series(y, name=target_name)

@@ -107,27 +107,112 @@ class _GLM(TPUEstimator):
 
 
 class LogisticRegression(_GLM):
+    """Binary and multiclass logistic regression over the solver library.
+
+    Multiclass is one-vs-rest (`multi_class='ovr'`, sklearn's classic
+    scheme): one convex solve per class through the SAME fused solvers, so
+    every class's fit is a full XLA program.  ``classes_`` is fitted and
+    ``predict`` returns original labels.  ``class_weight``/``warm_start``
+    are accepted for signature parity with the reference but inert (as in
+    the reference, whose dask_glm backend ignores them) — a warning is
+    emitted if set.
+    """
+
     family = Logistic
 
+    def fit(self, X, y=None):
+        import warnings
+
+        if self.class_weight is not None or self.warm_start:
+            warnings.warn(
+                "class_weight/warm_start are accepted for API parity but "
+                "not implemented by the solver library (reference behavior)",
+                UserWarning, stacklevel=2,
+            )
+        from ..core.sharded import ShardedRows as _SR
+        from ..core.sharded import unshard
+
+        yv = unshard(y) if isinstance(y, _SR) else np.asarray(y)
+        self.classes_ = np.unique(yv)
+        if len(self.classes_) < 2:
+            raise ValueError(
+                "LogisticRegression needs samples of at least 2 classes; "
+                f"got {self.classes_.tolist()}"
+            )
+        X = _ingest_float(self, X)
+        self.n_features_in_ = X.data.shape[1]
+        Xi = add_intercept(X) if self.fit_intercept else X
+
+        if len(self.classes_) == 2:
+            y01 = (yv == self.classes_[1]).astype(np.float32)
+            beta = self._solve(Xi, y01)
+            self.betas_ = beta[None, :]
+        else:
+            betas = []
+            for cls in self.classes_:
+                y01 = (yv == cls).astype(np.float32)
+                betas.append(self._solve(Xi, y01))
+            self.betas_ = jnp.stack(betas)  # (K, d[+1])
+        if self.fit_intercept:
+            self.coef_ = (
+                self.betas_[0, :-1] if len(self.classes_) == 2
+                else self.betas_[:, :-1]
+            )
+            self.intercept_ = (
+                float(self.betas_[0, -1]) if len(self.classes_) == 2
+                else np.asarray(self.betas_[:, -1])
+            )
+        else:
+            self.coef_ = (
+                self.betas_[0] if len(self.classes_) == 2 else self.betas_
+            )
+            self.intercept_ = (
+                0.0 if len(self.classes_) == 2
+                else np.zeros(len(self.classes_))
+            )
+        self._coef = self.betas_[0] if len(self.classes_) == 2 else self.betas_
+        return self
+
+    def _etas(self, X):
+        """(X, per-class raw margins [n, K_or_1])."""
+        X = _ingest_float(self, X)
+        if self.fit_intercept:
+            eta = X.data @ self.betas_[:, :-1].T + self.betas_[:, -1]
+        else:
+            eta = X.data @ self.betas_.T
+        return X, eta
+
     def predict(self, X):
-        return self.predict_proba(X)[:, 1] > 0.5
+        X, eta = self._etas(X)
+        eta = eta[: X.n_samples]
+        if len(self.classes_) == 2:
+            idx = (eta[:, 0] > 0).astype(jnp.int32)
+        else:
+            idx = jnp.argmax(eta, axis=1)
+        return self.classes_[np.asarray(idx)]
 
     def predict_proba(self, X):
-        X, eta = self._eta(X)
-        p1 = Logistic.predict(eta)[: X.n_samples]
-        return jnp.stack([1.0 - p1, p1], axis=1)
+        X, eta = self._etas(X)
+        eta = eta[: X.n_samples]
+        if len(self.classes_) == 2:
+            p1 = Logistic.predict(eta[:, 0])
+            return jnp.stack([1.0 - p1, p1], axis=1)
+        p = Logistic.predict(eta)  # per-class sigmoid, OvR-normalized
+        return p / jnp.sum(p, axis=1, keepdims=True)
 
     def decision_function(self, X):
-        X, eta = self._eta(X)
-        return eta[: X.n_samples]
+        X, eta = self._etas(X)
+        eta = eta[: X.n_samples]
+        return eta[:, 0] if len(self.classes_) == 2 else eta
 
     def score(self, X, y):
         """Mean accuracy (reference forwards to dask accuracy_score);
         accepts plain or ShardedRows y."""
-        from ..metrics import accuracy_score
+        from ..core.sharded import ShardedRows as _SR
+        from ..core.sharded import unshard
 
-        pred = jnp.asarray(self.predict(X)).astype(jnp.float32)
-        return accuracy_score(y, pred)
+        yv = unshard(y) if isinstance(y, _SR) else np.asarray(y)
+        return float((self.predict(X) == yv).mean())
 
 
 class LinearRegression(_GLM):

@@ -124,8 +124,10 @@ def euclidean_distances(X, Y=None, squared: bool = False):
 
 def pairwise_distances(X, Y=None, metric: str = "euclidean", **kwargs):
     if callable(metric):
-        if Y is not None and _both_sharded(X, Y) and not kwargs:
-            return ring_pairwise(X, Y, metric)
+        # Callables run EAGERLY on the (global) operands — they may be
+        # numpy-based or depend on global structure, neither of which
+        # survives being traced per-tile inside the ring's shard_map.
+        # Jit-safe tile kernels can opt into the ring via ring_pairwise.
         x, n = _data_of(X)
         y, m = (x, n) if Y is None else _data_of(Y)
         return metric(x, y, **kwargs)[:n, :m]

@@ -78,13 +78,54 @@ class OneHotEncoder(TransformerMixin, TPUEstimator):
         self.dtype = dtype
         self.handle_unknown = handle_unknown
 
+    def _compute_drop_idx(self):
+        """sklearn semantics: None | 'first' | 'if_binary' | per-feature
+        category array.  Sets ``drop_idx_`` (object array of int-or-None
+        per feature, or None)."""
+        if self.drop is None:
+            self.drop_idx_ = None
+            return
+        cats = self.categories_
+        if isinstance(self.drop, str):
+            if self.drop == "first":
+                self.drop_idx_ = np.array([0] * len(cats), dtype=object)
+            elif self.drop == "if_binary":
+                self.drop_idx_ = np.array(
+                    [0 if len(c) == 2 else None for c in cats], dtype=object
+                )
+            else:
+                raise ValueError(
+                    f"drop must be None, 'first', 'if_binary' or an array; "
+                    f"got {self.drop!r}"
+                )
+            return
+        drop = np.asarray(self.drop, dtype=object)
+        if drop.shape[0] != len(cats):
+            raise ValueError(
+                f"drop has {drop.shape[0]} entries for {len(cats)} features"
+            )
+        idxs = []
+        for j, (c, val) in enumerate(zip(cats, drop)):
+            where = np.flatnonzero(np.asarray(c, dtype=object) == val)
+            if where.size == 0:
+                raise ValueError(
+                    f"drop value {val!r} is not a category of feature {j}"
+                )
+            idxs.append(int(where[0]))
+        self.drop_idx_ = np.array(idxs, dtype=object)
+
+    def _kept(self, j):
+        """Column indices of feature j's one-hot block that survive drop."""
+        n = len(self.categories_[j])
+        if self.drop_idx_ is None or self.drop_idx_[j] is None:
+            return list(range(n))
+        return [i for i in range(n) if i != self.drop_idx_[j]]
+
     def fit(self, X, y=None):
         if self.handle_unknown not in ("error", "ignore"):
             raise ValueError(
                 f"handle_unknown must be 'error' or 'ignore', got {self.handle_unknown!r}"
             )
-        if self.drop is not None:
-            raise NotImplementedError("drop is not supported yet")
         if _is_frame(X):
             self.feature_names_in_ = np.asarray(X.columns, dtype=object)
             if self.categories == "auto":
@@ -98,6 +139,7 @@ class OneHotEncoder(TransformerMixin, TPUEstimator):
                 self.categories_ = [np.asarray(c) for c in self.categories]
             self.n_features_in_ = len(X.columns)
             self._frame_input_ = True
+            self._compute_drop_idx()
             return self
         x = _host_2d(X)
         if self.categories == "auto":
@@ -106,6 +148,7 @@ class OneHotEncoder(TransformerMixin, TPUEstimator):
             self.categories_ = [np.asarray(c) for c in self.categories]
         self.n_features_in_ = x.shape[1]
         self._frame_input_ = False
+        self._compute_drop_idx()
         return self
 
     def _transform_frame(self, X: pd.DataFrame):
@@ -125,8 +168,8 @@ class OneHotEncoder(TransformerMixin, TPUEstimator):
             if self.handle_unknown == "error" and (codes < 0).any():
                 bad = set(X[c][codes < 0])
                 raise ValueError(f"Found unknown categories {bad} in column {c}")
-            for k, cat in enumerate(cats):
-                out[f"{c}_{cat}"] = (codes == k).astype(self.dtype)
+            for k in self._kept(j):
+                out[f"{c}_{cats[k]}"] = (codes == k).astype(self.dtype)
         return pd.DataFrame(out, index=X.index)
 
     def transform(self, X):
@@ -148,21 +191,22 @@ class OneHotEncoder(TransformerMixin, TPUEstimator):
             code_cols.append(codes)
         codes_np = np.stack(code_cols, axis=1)
         sizes = [len(c) for c in self.categories_]
+
+        def expand(codes_dev, j):
+            oh = jax.nn.one_hot(codes_dev[:, j], sizes[j], dtype=self.dtype)
+            kept = self._kept(j)
+            if len(kept) != sizes[j]:
+                oh = jnp.take(oh, jnp.asarray(kept), axis=1)
+            return oh
+
         if isinstance(X, ShardedRows):
             from ..core.sharded import shard_rows
 
             s = shard_rows(codes_np)
-            data = jnp.concatenate(
-                [jax.nn.one_hot(s.data[:, j], sizes[j], dtype=self.dtype)
-                 for j in range(d)],
-                axis=1,
-            )
+            data = jnp.concatenate([expand(s.data, j) for j in range(d)], axis=1)
             return ShardedRows(data=data, mask=s.mask, n_samples=s.n_samples)
-        out = jnp.concatenate(
-            [jax.nn.one_hot(jnp.asarray(codes_np[:, j]), sizes[j], dtype=self.dtype)
-             for j in range(d)],
-            axis=1,
-        )
+        codes_dev = jnp.asarray(codes_np)
+        out = jnp.concatenate([expand(codes_dev, j) for j in range(d)], axis=1)
         if self.sparse_output:
             import scipy.sparse
 
@@ -173,18 +217,28 @@ class OneHotEncoder(TransformerMixin, TPUEstimator):
         names = (self.feature_names_in_ if getattr(self, "_frame_input_", False)
                  else (input_features if input_features is not None
                        else [f"x{j}" for j in range(self.n_features_in_)]))
-        return np.asarray(
-            [f"{c}_{cat}" for c, cats in zip(names, self.categories_) for cat in cats],
-            dtype=object,
-        )
+        out = []
+        for j, (c, cats) in enumerate(zip(names, self.categories_)):
+            for k in self._kept(j):
+                out.append(f"{c}_{cats[k]}")
+        return np.asarray(out, dtype=object)
 
     def inverse_transform(self, X):
         x = np.asarray(unshard(X) if isinstance(X, ShardedRows) else X)
         cols, start = [], 0
-        for cats in self.categories_:
-            block = x[:, start:start + len(cats)]
-            cols.append(np.asarray(cats)[block.argmax(axis=1)])
-            start += len(cats)
+        for j, cats in enumerate(self.categories_):
+            kept = self._kept(j)
+            block = x[:, start:start + len(kept)]
+            cats = np.asarray(cats)
+            if len(kept) == len(cats):
+                cols.append(cats[block.argmax(axis=1)])
+            else:
+                # all-zeros row means the dropped category
+                hit = block.argmax(axis=1)
+                picked = cats[np.asarray(kept)][hit]
+                dropped = cats[int(self.drop_idx_[j])]
+                cols.append(np.where(block.sum(axis=1) > 0, picked, dropped))
+            start += len(kept)
         return np.stack(cols, axis=1)
 
 

@@ -34,6 +34,26 @@ class LabelEncoder(TransformerMixin, TPUEstimator):
         return self.fit(y).transform(y)
 
     def transform(self, y):
+        if (
+            isinstance(y, ShardedRows)
+            and np.issubdtype(self.classes_.dtype, np.number)
+        ):
+            # fully device-side: searchsorted + validity check on the
+            # sharded labels; sharded in → sharded out.  Only ONE scalar
+            # (the unseen-label count) syncs to host.
+            classes = jnp.asarray(self.classes_)
+            idx = jnp.clip(
+                jnp.searchsorted(classes, y.data), 0, len(classes) - 1
+            )
+            ok = (jnp.take(classes, idx) == y.data) | (y.mask == 0)
+            n_bad = int(jnp.sum(~ok))
+            if n_bad:
+                vals = unshard(y)
+                diff = np.setdiff1d(vals, self.classes_)
+                raise ValueError(
+                    f"y contains previously unseen labels: {diff.tolist()}"
+                )
+            return ShardedRows(data=idx, mask=y.mask, n_samples=y.n_samples)
         vals = unshard(y) if isinstance(y, ShardedRows) else np.asarray(y)
         diff = np.setdiff1d(vals, self.classes_)
         if diff.size:
