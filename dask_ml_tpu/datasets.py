@@ -135,7 +135,13 @@ def make_classification_df(n_samples=100, n_features=20, chunks=None,
     df = pd.DataFrame(X, columns=columns)
     if dates is not None:
         start, end = dates
-        rng = np.random.RandomState(draw_seed(random_state))
+        # the dates seed must not alias any chunk/global seed consumed by
+        # make_classification's _seeds(random_state, n_chunks + 1): draw
+        # one PAST that range from the same stream
+        n_chunks = len(_chunk_sizes(n_samples, chunks))
+        rng = np.random.RandomState(
+            int(draw_seed(random_state, size=n_chunks + 2)[-1])
+        )
         stamps = pd.to_datetime(start) + pd.to_timedelta(
             rng.uniform(
                 0, (pd.to_datetime(end) - pd.to_datetime(start)).total_seconds(),

@@ -17,7 +17,13 @@ import threading
 
 import numpy as np
 
-__all__ = ["read_csv", "read_binary", "stream_csv_blocks", "read_csv_sharded"]
+__all__ = [
+    "read_csv",
+    "read_binary",
+    "stream_csv_blocks",
+    "read_csv_sharded",
+    "stream_text_lines",
+]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
 _SRC = os.path.join(_NATIVE_DIR, "loader.cpp")
@@ -130,6 +136,22 @@ def stream_csv_blocks(path: str, block_rows: int, *, has_header: bool = False,
         )
         _check(rc, path)
         yield out
+
+
+def stream_text_lines(path: str, block_lines: int = 10_000):
+    """Yield lists of (at most) ``block_lines`` stripped text lines —
+    out-of-core text ingest feeding the streaming vectorizers
+    (``feature_extraction.text.*.stream_transform``): the file is read
+    incrementally, never whole."""
+    block: list[str] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            block.append(line.rstrip("\n"))
+            if len(block) >= block_lines:
+                yield block
+                block = []
+    if block:
+        yield block
 
 
 def read_csv_sharded(path: str, *, has_header: bool = False, mesh=None):

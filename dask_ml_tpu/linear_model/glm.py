@@ -129,6 +129,13 @@ class LogisticRegression(_GLM):
                 "not implemented by the solver library (reference behavior)",
                 UserWarning, stacklevel=2,
             )
+        if self.multi_class not in ("ovr", "auto"):
+            warnings.warn(
+                f"multi_class={self.multi_class!r} is not implemented; "
+                "fitting one-vs-rest (per-class sigmoids, OvR-normalized "
+                "probabilities)",
+                UserWarning, stacklevel=2,
+            )
         from ..core.sharded import ShardedRows as _SR
         from ..core.sharded import unshard
 

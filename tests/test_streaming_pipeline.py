@@ -1,0 +1,186 @@
+"""Out-of-core text + streaming ingestion (VERDICT round-1 item 10): lazy
+corpus chunking (no list(seq)), streaming vectorizer blocks, and the
+end-to-end file -> vectorizer -> device-native SGD pipeline."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from dask_ml_tpu import io as dio
+from dask_ml_tpu.feature_extraction.text import (
+    CountVectorizer,
+    HashingVectorizer,
+    densify_to_device,
+)
+from dask_ml_tpu.linear_model import SGDClassifier
+
+
+class CountingIter:
+    """A one-shot document iterator that records peak simultaneous
+    materialization (would be len(corpus) if anything list()'d it)."""
+
+    def __init__(self, docs):
+        self._docs = list(docs)
+        self.yielded = 0
+
+    def __iter__(self):
+        for d in self._docs:
+            self.yielded += 1
+            yield d
+
+
+class TestLazyChunking:
+    def test_chunks_is_lazy(self):
+        from dask_ml_tpu.feature_extraction.text import _chunks
+
+        def gen():
+            for i in range(100):
+                yield f"doc {i}"
+
+        it = _chunks(gen(), 10)
+        first = next(it)
+        assert len(first) == 10  # only one chunk pulled so far
+
+    def test_hashing_transform_accepts_generator(self):
+        docs = [f"word{i % 7} common text" for i in range(500)]
+        hv = HashingVectorizer(n_features=64)
+        out_gen = hv.transform(iter(docs))
+        out_list = hv.transform(docs)
+        assert (out_gen != out_list).nnz == 0
+
+    def test_count_fit_accepts_generator(self):
+        docs = ["apple banana", "banana cherry", "apple apple"] * 50
+        cv_gen = CountVectorizer().fit(iter(docs))
+        cv_list = CountVectorizer().fit(docs)
+        assert cv_gen.vocabulary_ == cv_list.vocabulary_
+
+    def test_count_min_df_fraction_with_generator(self):
+        # n_docs must be counted during the streaming pass
+        docs = ["rare word"] + ["common text"] * 99
+        cv = CountVectorizer(min_df=0.5).fit(iter(docs))
+        assert set(cv.vocabulary_) == {"common", "text"}
+
+    def test_stream_transform_blocks(self):
+        docs = [f"tok{i % 5} filler" for i in range(250)]
+        hv = HashingVectorizer(n_features=32)
+        hv.chunk_size = 100
+        blocks = list(hv.stream_transform(iter(docs)))
+        assert [b.shape[0] for b in blocks] == [100, 100, 50]
+        import scipy.sparse
+
+        np.testing.assert_allclose(
+            scipy.sparse.vstack(blocks).toarray(), hv.transform(docs).toarray()
+        )
+
+    def test_count_stream_transform(self):
+        docs = ["apple banana", "banana cherry"] * 60
+        cv = CountVectorizer().fit(docs)
+        cv.chunk_size = 50
+        blocks = list(cv.stream_transform(iter(docs)))
+        import scipy.sparse
+
+        np.testing.assert_allclose(
+            scipy.sparse.vstack(blocks).toarray(), cv.transform(docs).toarray()
+        )
+
+
+class TestEndToEndStreaming:
+    def test_text_file_to_device_sgd(self, tmp_path, rng, mesh):
+        # file -> stream_text_lines -> HashingVectorizer.stream_transform
+        # -> densify -> device-native SGD partial_fit: the full out-of-core
+        # text pipeline, with labels derived per line
+        n = 2000
+        lines, labels = [], []
+        for i in range(n):
+            if rng.rand() > 0.5:
+                lines.append("good great excellent fine product")
+                labels.append(1)
+            else:
+                lines.append("bad awful poor terrible product")
+                labels.append(0)
+        p = tmp_path / "docs.txt"
+        p.write_text("\n".join(lines) + "\n")
+        labels = np.asarray(labels)
+
+        hv = HashingVectorizer(n_features=128)
+        clf = SGDClassifier(learning_rate="constant", eta0=0.5)
+        offset = 0
+        for _ in range(3):  # epochs over the stream
+            offset = 0
+            for block_lines in dio.stream_text_lines(str(p), block_lines=256):
+                Xb = np.asarray(hv.transform(block_lines).todense(), np.float32)
+                yb = labels[offset: offset + len(block_lines)]
+                offset += len(block_lines)
+                clf.partial_fit(Xb, yb, classes=[0, 1])
+        assert offset == n
+        X_all = np.asarray(hv.transform(lines).todense(), np.float32)
+        assert (clf.predict(X_all) == labels).mean() > 0.99
+        assert isinstance(clf._state["coef"], jax.Array)
+
+    def test_csv_stream_to_sgd_regressor(self, tmp_path, rng, mesh):
+        # numeric side: stream_csv_blocks -> device SGD partial_fit
+        from dask_ml_tpu.linear_model import SGDRegressor
+
+        n, d = 3000, 6
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w = rng.normal(size=d).astype(np.float32)
+        y = X @ w
+        p = tmp_path / "data.csv"
+        np.savetxt(p, np.column_stack([X, y]), delimiter=",", fmt="%.6f")
+
+        reg = SGDRegressor(learning_rate="constant", eta0=0.1)
+        for _ in range(15):
+            for block in dio.stream_csv_blocks(str(p), block_rows=512):
+                reg.partial_fit(block[:, :d], block[:, d])
+        assert reg.score(X, y) > 0.98
+
+    def test_densify_to_device_sharded(self, rng, mesh):
+        import scipy.sparse
+
+        from dask_ml_tpu.core import ShardedRows
+
+        S = scipy.sparse.random(37, 8, density=0.3, random_state=0, format="csr")
+        out = densify_to_device(S)
+        assert isinstance(out, ShardedRows)
+        np.testing.assert_allclose(
+            np.asarray(out.unpad()), S.toarray(), rtol=1e-6
+        )
+
+
+class TestReviewRegressions:
+    def test_stream_transform_fixed_vocab_unfitted(self):
+        cv = CountVectorizer(vocabulary={"apple": 0, "banana": 1})
+        blocks = list(cv.stream_transform(["apple banana", "banana"]))
+        assert blocks[0].shape == (2, 2)
+
+    def test_fit_transform_fixed_vocab_streams(self):
+        # one-shot generator + fixed vocabulary: single pass, no list()
+        cv = CountVectorizer(vocabulary={"apple": 0, "banana": 1})
+        out = cv.fit_transform(d for d in ["apple", "banana banana"])
+        np.testing.assert_allclose(out.toarray(), [[1, 0], [0, 2]])
+
+    def test_multinomial_warns(self, rng):
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        X = rng.normal(size=(90, 3)).astype(np.float32)
+        y = rng.randint(0, 3, size=90)
+        with pytest.warns(UserWarning, match="multi_class"):
+            LogisticRegression(
+                solver="lbfgs", max_iter=5, multi_class="multinomial"
+            ).fit(X, y)
+
+    def test_dates_seed_does_not_alias_chunk_seed(self):
+        from dask_ml_tpu.datasets import make_classification_df
+
+        a, _ = make_classification_df(
+            n_samples=60, n_features=5, chunks=30, random_state=3
+        )
+        b, _ = make_classification_df(
+            n_samples=60, n_features=5, chunks=30, random_state=3,
+            dates=("2024-01-01", "2024-02-01"),
+        )
+        # feature data identical whether or not dates are requested
+        np.testing.assert_allclose(
+            a.to_numpy(), b.drop(columns="date").to_numpy()
+        )
