@@ -256,7 +256,7 @@ class SpectralClustering(TPUEstimator):
             # graph construction fused in _knn_graph
             d2 = pw._ring_impl(
                 X.data, X.data, mesh_holder=MeshHolder(get_mesh()),
-                fn=pw._sq_euclidean,
+                fn=pw._sq_euclidean_hi,
             )
             k_nn = min(self.n_neighbors, max(X.n_samples - 1, 1))
             W = _knn_graph(d2, X.mask, k_nn=k_nn)

@@ -91,12 +91,21 @@ def ring_pairwise(X: ShardedRows, Y: ShardedRows, fn, mesh=None):
     return out[: X.n_samples, : Y.n_samples]
 
 
-@jax.jit
-def _sq_euclidean(x, y):
+@partial(jax.jit, static_argnames=("precision",))
+def _sq_euclidean(x, y, precision=None):
     x_norm = jnp.sum(x * x, axis=1, keepdims=True)
     y_norm = jnp.sum(y * y, axis=1, keepdims=True).T
-    d2 = x_norm + y_norm - 2.0 * (x @ y.T)
+    d2 = x_norm + y_norm - 2.0 * jnp.dot(x, y.T, precision=precision)
     return jnp.maximum(d2, 0.0)
+
+
+def _sq_euclidean_hi(x, y):
+    """HIGHEST-precision distances for ARGMIN consumers (KMeans
+    assignment, kNN graphs, argmin_min): the TPU MXU's default precision
+    truncates fp32 operands to bf16, flipping labels near cluster
+    boundaries.  Kernel consumers (rbf/exp, sqrt outputs) keep the fast
+    default — their outputs are smooth in the distance."""
+    return _sq_euclidean(x, y, precision=jax.lax.Precision.HIGHEST)
 
 def _euclid_tile(x, y):
     return jnp.sqrt(_sq_euclidean(x, y))
@@ -146,7 +155,7 @@ def pairwise_distances(X, Y=None, metric: str = "euclidean", **kwargs):
 
 @jax.jit
 def _argmin_min(x, y):
-    d2 = _sq_euclidean(x, y)
+    d2 = _sq_euclidean_hi(x, y)
     idx = jnp.argmin(d2, axis=1)
     return idx, jnp.sqrt(jnp.take_along_axis(d2, idx[:, None], axis=1)[:, 0])
 

@@ -34,7 +34,11 @@ def _kernel(x_ref, m_ref, c_ref, sums_ref, counts_ref, inertia_ref):
     c = c_ref[:]  # (k, d)
     k = c.shape[0]
 
-    cross = jnp.dot(x, c.T, preferred_element_type=jnp.float32)  # (T, k) MXU
+    cross = jnp.dot(x, c.T, preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)  # (T, k) MXU
+    # HIGHEST: the default MXU precision truncates fp32 operands to
+    # bf16, flipping argmin for rows near a cluster boundary — the
+    # assignment must match the fp32 reference, not just be close
     xn = jnp.sum(x * x, axis=1, keepdims=True)
     cn = jnp.sum(c * c, axis=1)[None, :]
     d2 = xn + cn - 2.0 * cross
@@ -45,7 +49,8 @@ def _kernel(x_ref, m_ref, c_ref, sums_ref, counts_ref, inertia_ref):
     onehot = (
         labels[:, None] == jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1)
     ).astype(jnp.float32) * m
-    psums = jnp.dot(onehot.T, x, preferred_element_type=jnp.float32)  # (k, d) MXU
+    psums = jnp.dot(onehot.T, x, preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)  # (k, d) MXU
     pcounts = jnp.sum(onehot, axis=0, keepdims=True).T  # (k, 1)
     pinertia = jnp.sum(min_d2 * m, axis=0, keepdims=True)  # (1, 1)
 

@@ -10,19 +10,25 @@ Must set XLA flags BEFORE jax initializes — hence the top of conftest.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the image presets JAX_PLATFORMS=axon (TPU)
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# DASK_ML_TPU_TEST_TPU=1 keeps the preset TPU backend so hardware-only
+# tests (e.g. the Pallas parity blessing) can run on a real chip.
+_USE_TPU = os.environ.get("DASK_ML_TPU_TEST_TPU") not in (None, "", "0")
+
+if not _USE_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"  # image presets JAX_PLATFORMS=axon (TPU)
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 # The image's sitecustomize imports jax at interpreter start, so jax.config
 # captured JAX_PLATFORMS=axon before this file ran — override via config too.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+if not _USE_TPU:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
