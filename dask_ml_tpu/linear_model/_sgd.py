@@ -176,28 +176,46 @@ _jitted_step = partial(
 )(sgd_step)
 
 
-def _run_epochs(est, xb, yb, mask) -> int:
-    """Full-batch epoch loop for ``fit``: one fused step per epoch with a
-    host tol check on the scalar loss (the only sync per epoch).
-
-    sklearn's stopping rule: stop only after ``n_iter_no_change``
+class EpochStopper:
+    """sklearn's stopping rule, shared by every epoch loop (fit,
+    blockwise-ensemble packed fits): stop only after ``n_iter_no_change``
     CONSECUTIVE epochs fail to improve the best loss by ``tol`` — a single
     oscillating epoch (constant LR, large eta0) must not halt training.
-    """
+    ``update`` returns True when training should stop; with ``tol=None``
+    it never syncs the loss (callers should skip the host pull)."""
+
+    def __init__(self, tol, patience: int = 5):
+        self.tol = tol
+        self.patience = patience
+        self.best = np.inf
+        self.bad = 0
+
+    @property
+    def active(self) -> bool:
+        return self.tol is not None
+
+    def update(self, cur: float) -> bool:
+        if not self.active:
+            return False
+        if cur > self.best - self.tol:
+            self.bad += 1
+            if self.bad >= self.patience:
+                return True
+        else:
+            self.bad = 0
+        self.best = min(self.best, cur)
+        return False
+
+
+def _run_epochs(est, xb, yb, mask) -> int:
+    """Full-batch epoch loop for ``fit``: one fused step per epoch; the
+    scalar loss syncs to host only when a tol check is active."""
     hyper = est._hyper()
-    best = np.inf
-    bad = 0
-    patience = getattr(est, "n_iter_no_change", 5)
+    stop = EpochStopper(est.tol, getattr(est, "n_iter_no_change", 5))
     for epoch in range(est.max_iter):
-        cur = float(est._step_block(xb, yb, mask, hyper))
-        if est.tol is not None:
-            if cur > best - est.tol:
-                bad += 1
-                if bad >= patience:
-                    return epoch + 1
-            else:
-                bad = 0
-            best = min(best, cur)
+        loss = est._step_block(xb, yb, mask, hyper)
+        if stop.active and stop.update(float(loss)):
+            return epoch + 1
     return est.max_iter
 
 

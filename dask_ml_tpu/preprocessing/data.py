@@ -7,6 +7,8 @@ each fit here is one jitted masked reduction over the sharded sample axis.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -46,15 +48,96 @@ def _like_input(X, out):
     return out
 
 
-def _masked_quantiles(x, mask, probs):
+# Above this many (padded) rows the exact sort-based quantile becomes an
+# all-gather-shaped cost on a sharded column (SURVEY.md §7 hard-part (d));
+# switch to the one-pass histogram sketch.  Env-overridable for tests.
+def _approx_rows_threshold() -> int:
+    import os
+
+    return int(os.environ.get("DASK_ML_TPU_EXACT_QUANTILE_MAX_ROWS", 4_000_000))
+
+
+@partial(jax.jit, static_argnames=("bins", "refinements"))
+def _hist_quantiles(x, mask, probs, *, bins=4096, refinements=2):
+    """Merge-based approximate per-feature quantiles, one fused program.
+
+    The ``da.percentile`` twin: per-shard histograms merge by ADDITION
+    (XLA inserts the psum over the sharded row axis), then quantiles are
+    linearly interpolated inside the bracketing bin.  A fixed uniform grid
+    collapses on outlier-heavy features (one 1e9 outlier makes the bin
+    width swamp a [0,1] bulk), so the histogram is RE-FOCUSED
+    ``refinements`` times onto the bins bracketing the requested
+    quantiles — each pass shrinks the error by ~``bins``×, giving
+    range/bins^(refinements+1) (≈ range/6.9e10 at the defaults) for
+    2 + refinements full data scans, still far cheaper than a distributed
+    sort at the billion-row scale this path targets.
+    """
+    n, d = x.shape
+    mvalid = mask[:, None] > 0
+    lo = jnp.min(jnp.where(mvalid, x, jnp.inf), axis=0)  # (d,)
+    hi = jnp.max(jnp.where(mvalid, x, -jnp.inf), axis=0)
+
+    probs = jnp.asarray(probs, x.dtype)
+    total = jnp.sum(mask)
+    targets = probs[:, None] * jnp.broadcast_to(total, (d,))[None, :]  # (p, d)
+
+    weights_all = jnp.broadcast_to(mask[:, None], x.shape)
+    feat_off = jnp.arange(d, dtype=jnp.int32)[None, :] * bins
+
+    def hist_pass(lo_f, hi_f):
+        """One histogram over [lo_f, hi_f] per feature; returns per-prob
+        interpolated values and the next (tighter) bracketing ranges."""
+        width = jnp.maximum(hi_f - lo_f, 1e-30)
+        pos = (x - lo_f[None, :]) / width[None, :] * bins
+        idx = jnp.clip(pos.astype(jnp.int32), 0, bins - 1)
+        inside = weights_all * (x >= lo_f[None, :]) * (x <= hi_f[None, :])
+        below = jnp.sum(weights_all * (x < lo_f[None, :]), axis=0)  # (d,)
+        counts = jax.ops.segment_sum(
+            (inside).ravel(), (feat_off + idx).ravel(), num_segments=d * bins
+        ).reshape(d, bins)
+        cdf = jnp.cumsum(counts, axis=1)
+
+        def one_feature(cdf_f, lo_1, width_1, below_1, tgt_f):
+            t = tgt_f - below_1  # ranks relative to this window
+            b = jnp.clip(jnp.searchsorted(cdf_f, t), 0, bins - 1)
+            prev = jnp.where(b > 0, cdf_f[jnp.maximum(b - 1, 0)], 0.0)
+            cnt = jnp.maximum(cdf_f[b] - prev, 1e-30)
+            frac = jnp.clip((t - prev) / cnt, 0.0, 1.0)
+            binw = width_1 / bins
+            val = lo_1 + (b.astype(x.dtype) + frac) * binw
+            # next window: the bins bracketing ALL requested quantiles,
+            # widened one bin each side — fp32 edge arithmetic at large
+            # scales (lo ~ 1e9, ulp 64) can otherwise round the window
+            # past the true quantile region and exclude the bulk
+            nlo = lo_1 + (jnp.min(b).astype(x.dtype) - 1.0) * binw
+            nhi = lo_1 + (jnp.max(b).astype(x.dtype) + 2.0) * binw
+            return val, nlo, nhi
+
+        vals, nlo, nhi = jax.vmap(
+            one_feature, in_axes=(0, 0, 0, 0, 1), out_axes=(1, 0, 0)
+        )(cdf, lo_f, width, below, targets)
+        return vals, nlo, nhi
+
+    vals, lo_r, hi_r = hist_pass(lo, hi)
+    for _ in range(refinements):
+        vals, lo_r, hi_r = hist_pass(lo_r, hi_r)
+    return vals  # (p, d)
+
+
+def _masked_quantiles(x, mask, probs, method: str = "auto"):
     """Per-feature quantiles ignoring padded rows.
 
-    `jnp.nanquantile` over rows with padding mapped to NaN.  Exact (sort
-    based) — the reference uses dask's approximate ``da.percentile``; exact
-    is strictly more accurate and a single device sort per feature.
+    ``exact``: ``jnp.nanquantile`` (one device sort per feature) — strictly
+    more accurate than the reference's approximate ``da.percentile``.
+    ``auto`` switches to the histogram sketch past the row threshold,
+    where a distributed sort would all-gather the column.
     """
-    xm = jnp.where(mask[:, None] > 0, x, jnp.nan)
-    return jnp.nanquantile(xm, jnp.asarray(probs), axis=0)
+    if method == "exact" or (
+        method == "auto" and x.shape[0] <= _approx_rows_threshold()
+    ):
+        xm = jnp.where(mask[:, None] > 0, x, jnp.nan)
+        return jnp.nanquantile(xm, jnp.asarray(probs), axis=0)
+    return _hist_quantiles(x, mask, jnp.asarray(probs))
 
 
 class StandardScaler(TransformerMixin, TPUEstimator):

@@ -165,3 +165,70 @@ class TestReviewRegressions:
         bt = dp.BlockTransformer(lambda a: a, validate=True)
         with pytest.raises(ValueError):
             bt.transform(np.arange(5.0))  # 1-D rejected when validate=True
+
+
+class TestApproxQuantiles:
+    """Merge-based quantile sketch (VERDICT round-1 weak #9; SURVEY §7
+    hard-part (d)): histogram-merge path kicks in past the row threshold
+    and matches the exact quantiles to bin resolution."""
+
+    def test_hist_matches_exact(self, rng, mesh):
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.preprocessing.data import (
+            _hist_quantiles,
+            _masked_quantiles,
+        )
+
+        X = rng.normal(size=(20_000, 4)).astype(np.float32) * [1, 10, 0.1, 100]
+        s = shard_rows(X)
+        probs = [0.25, 0.5, 0.75]
+        exact = np.asarray(_masked_quantiles(s.data, s.mask, probs, method="exact"))
+        approx = np.asarray(_hist_quantiles(s.data, s.mask, jnp.asarray(probs)))
+        spread = X.max(axis=0) - X.min(axis=0)
+        assert np.all(np.abs(exact - approx) <= spread / 8192 * 4 + 1e-6)
+
+    def test_threshold_switches_methods(self, rng, mesh, monkeypatch):
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.preprocessing import RobustScaler
+
+        monkeypatch.setenv("DASK_ML_TPU_EXACT_QUANTILE_MAX_ROWS", "100")
+        X = rng.normal(size=(5000, 3)).astype(np.float32)
+        s = shard_rows(X)
+        rs = RobustScaler().fit(s)  # histogram path (5000 > 100)
+        med = np.median(X, axis=0)
+        np.testing.assert_allclose(np.asarray(rs.center_), med, atol=0.01)
+        iqr = np.percentile(X, 75, axis=0) - np.percentile(X, 25, axis=0)
+        np.testing.assert_allclose(np.asarray(rs.scale_), iqr, rtol=0.02)
+
+    def test_masked_rows_excluded(self, rng, mesh):
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.preprocessing.data import _hist_quantiles
+
+        X = rng.normal(size=(999, 2)).astype(np.float32)  # pad+mask path
+        s = shard_rows(X)
+        # poison would-be pad contributions: approx median must track the
+        # REAL rows only
+        got = np.asarray(_hist_quantiles(s.data, s.mask, jnp.asarray([0.5])))
+        np.testing.assert_allclose(got[0], np.median(X, axis=0), atol=0.01)
+
+    def test_outlier_robust_sketch(self, rng, mesh):
+        # one 1e9 outlier must not collapse the sketch's resolution on a
+        # [0,1]-scale bulk: the refined passes re-focus the histogram
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.preprocessing.data import _hist_quantiles
+
+        X = rng.uniform(0, 1, size=(50_000, 2)).astype(np.float32)
+        X[0, 0] = 1e9
+        X[1, 1] = -1e9
+        s = shard_rows(X)
+        got = np.asarray(
+            _hist_quantiles(s.data, s.mask, jnp.asarray([0.25, 0.5, 0.75]))
+        )
+        expect = np.percentile(X, [25, 50, 75], axis=0)
+        np.testing.assert_allclose(got, expect, atol=5e-3)

