@@ -119,31 +119,53 @@ def main():
     def _time_lloyd(s, centers, n, d, k, iters, use_pallas, mh):
         from dask_ml_tpu.cluster.k_means import _lloyd_loop
 
-        args = (s.data, s.mask, centers, jnp.float32(0.0), jnp.int32(iters))
-        # the trailing float() pull is the only reliable sync on the axon
-        # relay (block_until_ready returns early); the loop may stop short
-        # of `iters` at an exact fixed point, so throughput uses the ACTUAL
-        # round count
-        float(_lloyd_loop(*args, mesh_holder=mh, use_pallas=use_pallas)[1])
-        t0 = time.perf_counter()
-        out = _lloyd_loop(*args, mesh_holder=mh, use_pallas=use_pallas)
-        float(out[1])
-        dt = time.perf_counter() - t0
-        n_rounds = max(int(out[2]), 1)
+        # Sync discipline (measured on the axon relay this session):
+        # block_until_ready returns BEFORE remote execution completes, and
+        # every result fetch carries a ~70 ms tunnel round-trip.  The only
+        # honest per-iteration time is therefore the SLOPE between two
+        # fetched runs of different iteration counts — the RTT and any
+        # constant dispatch cost cancel.  tol=0 keeps the loop from
+        # converging early, so the round counts are exact.
+        def run(n_it):
+            out = _lloyd_loop(
+                s.data, s.mask, centers, jnp.float32(0.0), jnp.int32(n_it),
+                mesh_holder=mh, use_pallas=use_pallas,
+            )
+            float(out[1])  # result fetch = the one reliable sync
+            return int(out[2])  # rounds ACTUALLY executed (the loop may
+            # hit an exact fixed point before n_it even at tol=0)
+
+        lo, hi = max(iters // 10, 1), iters
+        run(hi)  # compile both counts (same executable: iters is traced)
+        times, rounds = {}, {}
+        for n_it in (lo, hi):
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                rounds[n_it] = run(n_it)
+                best = min(best, time.perf_counter() - t0)
+            times[n_it] = best
+        per_iter = max(
+            (times[hi] - times[lo]) / max(rounds[hi] - rounds[lo], 1), 1e-9
+        )
         # per round: assign gemm 2ndk + onehot-reduce gemm 2ndk flops;
         # minimum HBM traffic = one X read (n*d*4B) per round
-        flops = 4.0 * n * d * k * n_rounds
-        gbytes = n * d * 4 * n_rounds / 1e9
+        flops = 4.0 * n * d * k
+        gbytes = n * d * 4 / 1e9
         return {
             "workload": f"kmeans_lloyd_{n}x{d}_k{k}" + ("_pallas" if use_pallas else "_xla"),
-            "wall_s": round(dt, 3),
-            "rounds": n_rounds,
-            "rows_per_s": round(n * n_rounds / dt, 1),
-            "achieved_gb_s": round(gbytes / dt, 2),
-            "bw_frac": round(gbytes / dt / peak_gb_s, 4),
-            "achieved_tflops": round(flops / dt / 1e12, 3),
-            "mfu": round(flops / dt / 1e12 / peak_tflops, 4),
+            "wall_s": round(times[hi], 3),
+            "rounds": rounds[hi],
+            "per_iter_ms": round(per_iter * 1e3, 3),
+            "rows_per_s": round(n / per_iter, 1),
+            "achieved_gb_s": round(gbytes / per_iter, 2),
+            "bw_frac": round(gbytes / per_iter / peak_gb_s, 4),
+            "achieved_tflops": round(flops / per_iter / 1e12, 3),
+            "mfu": round(flops / per_iter / 1e12 / peak_tflops, 4),
         }
+
+    section_s = extra["section_s"] = {}
+    _t_sec = time.time()
 
     # --- KMeans Lloyd throughput (north-star #2 shape, scaled to chip) ---
     try:
@@ -162,10 +184,10 @@ def main():
         best = xla_stats
 
         if on_tpu:
-            # Pallas is the TPU default (blessed by the hardware parity
-            # test; cluster.k_means._pallas_ok) — bench still re-verifies
-            # on the RUNNING chip and records the result alongside the
-            # Pallas-vs-XLA timing delta
+            # The Pallas kernel is opt-in (cluster.k_means._pallas_ok):
+            # with slope-timed measurement the XLA lowering wins on v5e.
+            # Bench still verifies the kernel's parity on the RUNNING chip
+            # and records the honest Pallas-vs-XLA delta
             try:
                 from dask_ml_tpu.ops import lloyd_assign_reduce
 
@@ -200,7 +222,7 @@ def main():
                     pallas_stats = _time_lloyd(s, centers, n, d, k, iters, True, mh)
                     workloads.append(pallas_stats)
                     extra["pallas_vs_xla_speedup"] = round(
-                        xla_stats["wall_s"] / pallas_stats["wall_s"], 3
+                        xla_stats["per_iter_ms"] / pallas_stats["per_iter_ms"], 3
                     )
                     if pallas_stats["rows_per_s"] > best["rows_per_s"]:
                         best = pallas_stats
@@ -212,6 +234,9 @@ def main():
         result["vs_baseline"] = 1.0
     except Exception:
         extra["lloyd_error"] = traceback.format_exc(limit=3)
+
+    section_s["lloyd"] = round(time.time() - _t_sec, 1)
+    _t_sec = time.time()
 
     # --- ADMM logistic fit (north-star #1, HIGGS shape scaled to chip) ---
     try:
@@ -225,39 +250,148 @@ def main():
             (11_000_000 if half_left else 1_000_000, 28) if on_tpu
             else (100_000, 28)
         )
-        w = rng.normal(size=d2).astype(np.float32)
-        X2 = rng.normal(size=(n2, d2)).astype(np.float32)
-        y2 = (1 / (1 + np.exp(-(X2 @ w))) > rng.uniform(size=n2)).astype(
-            np.float32
-        )
-        sX2, sy2 = shard_rows(X2), shard_rows(y2)
+        # generate ON device: host datagen + 1.2 GB ingest over the axon
+        # tunnel costs ~65 s that says nothing about the framework
+        from dask_ml_tpu.core.sharded import ShardedRows
+        from dask_ml_tpu.core.sharded import row_sharding
+        from dask_ml_tpu.core.mesh import get_mesh as _get_mesh
+
+        mesh2 = _get_mesh()
+        n_sh = mesh2.shape["data"]
+        n2 -= n2 % n_sh  # keep rows an exact shard multiple
+
+        @jax.jit
+        def _gen(key):
+            kw, kx, ku = jax.random.split(key, 3)
+            w = jax.random.normal(kw, (d2,), jnp.float32)
+            X = jax.random.normal(kx, (n2, d2), jnp.float32)
+            p = jax.nn.sigmoid(X @ w)
+            y = (p > jax.random.uniform(ku, (n2,))).astype(jnp.float32)
+            return X, y
+
+        Xd, yd = _gen(jax.random.PRNGKey(0))
+        ones = jnp.ones((n2,), jnp.float32)
+        sh2, sh1 = row_sharding(mesh2, 2), row_sharding(mesh2, 1)
+        sX2 = ShardedRows(data=jax.device_put(Xd, sh2),
+                          mask=jax.device_put(ones, sh1), n_samples=n2)
+        sy2 = ShardedRows(data=jax.device_put(yd, sh1),
+                          mask=sX2.mask, n_samples=n2)
         admm_iters, inner = 10, 30
+
+        # end-to-end fit once, for accuracy + the sklearn-contract path
         lr = LogisticRegression(
             solver="admm", C=1e4, max_iter=admm_iters,
             solver_kwargs={"inner_iter": inner},
         )
-        lr.fit(sX2, sy2)  # compile
-        t0 = time.perf_counter()
         lr.fit(sX2, sy2)
-        dt2 = time.perf_counter() - t0
-        acc = float(lr.score(sX2, y2))
-        # per outer iter: inner L-BFGS evals of loss+grad ~ 2 matvecs
-        # (4*n*d flops) each; X re-read per eval bounds HBM traffic
-        flops2 = admm_iters * inner * 4.0 * n2 * d2
-        gbytes2 = admm_iters * inner * n2 * d2 * 4 / 1e9
+        # accuracy ON DEVICE, one scalar fetch: lr.score pulls the full
+        # 11M-row prediction vector to host, and device->host transfers
+        # of that size take minutes on the axon relay (and can wedge the
+        # tunnel entirely — observed this session)
+        @jax.jit
+        def _device_acc(xd, yd, mask, coef, intercept):
+            pred = (xd @ coef + intercept) > 0
+            hit = (pred == (yd > 0.5)).astype(jnp.float32) * mask
+            return jnp.sum(hit) / jnp.maximum(jnp.sum(mask), 1.0)
+
+        acc = float(_device_acc(
+            sX2.data, sy2.data, sX2.mask,
+            jnp.asarray(lr.coef_), jnp.float32(lr.intercept_),
+        ))
+
+        # Per-outer-round timing drives the SOLVER entry point directly:
+        # the estimator wrapper's host-side chatter costs ~2 s of tunnel
+        # round-trips per fit with ±0.5 s jitter, which swamps the slope.
+        # A direct admm() call is one dispatch + one result fetch.  Same
+        # slope discipline as Lloyd; tolerances 0 so the outer loop runs
+        # exactly max_iter rounds (the inner L-BFGS count stays adaptive —
+        # hence no bw/mfu claim; see logreg_value_and_grad below).
+        from dask_ml_tpu.linear_model.utils import add_intercept
+        from dask_ml_tpu.solvers import admm as admm_solver
+        from dask_ml_tpu.solvers.regularizers import L2
+
+        sXi = add_intercept(sX2)
+
+        def solve(n_outer):
+            beta = admm_solver(
+                sXi, sy2, lamduh=1e-4, max_iter=n_outer,
+                regularizer=L2, inner_iter=inner,
+                abstol=0.0, reltol=0.0, inner_tol=0.0,
+            )
+            np.asarray(beta)  # result fetch = the one reliable sync
+
+        lo_it, hi_it = 2, 20
+        solve(hi_it)  # compile (max_iter is traced: one executable)
+        t_admm = {}
+        for n_outer in (lo_it, hi_it):
+            best_t = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                solve(n_outer)
+                best_t = min(best_t, time.perf_counter() - t0)
+            t_admm[n_outer] = best_t
+        per_outer = max((t_admm[hi_it] - t_admm[lo_it]) / (hi_it - lo_it), 1e-9)
+        dt2 = per_outer * admm_iters
+        # NO bw/mfu claim here: the inner L-BFGS iteration count is
+        # adaptive (Wolfe-failure exit), so X-pass counts are data-
+        # dependent; the roofline-accountable proxy is the
+        # logreg_value_and_grad workload below
         workloads.append({
             "workload": f"admm_logreg_{n2}x{d2}_{admm_iters}outer",
-            "wall_s": round(dt2, 3),
+            "wall_s": round(per_outer * admm_iters, 3),
+            "per_outer_ms": round(per_outer * 1e3, 3),
             "rows_per_s": round(n2 * admm_iters / dt2, 1),
             "train_accuracy": round(acc, 4),
-            "achieved_gb_s": round(gbytes2 / dt2, 2),
-            "bw_frac": round(gbytes2 / dt2 / peak_gb_s, 4),
-            "achieved_tflops": round(flops2 / dt2 / 1e12, 3),
-            "mfu": round(flops2 / dt2 / 1e12 / peak_tflops, 4),
+        })
+
+        # --- logistic value_and_grad: the ADMM/L-BFGS inner primitive,
+        # with EXACT traffic accounting (2 X-passes per eval: forward
+        # X@b, backward X^T r), slope-timed over chained evals ---
+        from dask_ml_tpu.solvers.families import Logistic
+
+        @jax.jit
+        def vg_run(n_evals, b0):
+            # fori_loop with a TRACED bound: one compile serves both
+            # iteration counts (scan would recompile per static length)
+            vg = jax.value_and_grad(
+                lambda b: Logistic.loss(b, sX2.data, sy2.data, sX2.mask)
+            )
+
+            def one(_, carry):
+                b, _v = carry
+                val, g = vg(b)
+                return b - jnp.float32(1e-6) * g, val
+
+            return jax.lax.fori_loop(
+                0, n_evals, one, (b0, jnp.float32(0.0))
+            )
+
+        b0 = jnp.zeros((d2,), jnp.float32)
+        t_vg = {}
+        for n_evals in (2, 20):
+            float(vg_run(jnp.int32(n_evals), b0)[1])
+            best_t = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(vg_run(jnp.int32(n_evals), b0)[1])
+                best_t = min(best_t, time.perf_counter() - t0)
+            t_vg[n_evals] = best_t
+        per_eval = max((t_vg[20] - t_vg[2]) / 18, 1e-9)
+        ev_gbytes = 2 * n2 * d2 * 4 / 1e9
+        ev_flops = 4.0 * n2 * d2
+        workloads.append({
+            "workload": f"logreg_value_and_grad_{n2}x{d2}",
+            "per_eval_ms": round(per_eval * 1e3, 3),
+            "rows_per_s": round(n2 / per_eval, 1),
+            "achieved_gb_s": round(ev_gbytes / per_eval, 2),
+            "bw_frac": round(ev_gbytes / per_eval / peak_gb_s, 4),
+            "achieved_tflops": round(ev_flops / per_eval / 1e12, 3),
+            "mfu": round(ev_flops / per_eval / 1e12 / peak_tflops, 4),
         })
     except Exception:
         extra["admm_error"] = traceback.format_exc(limit=3)
 
+    section_s["admm"] = round(time.time() - _t_sec, 1)
     watchdog.cancel()
     print(json.dumps(result))
 

@@ -17,8 +17,12 @@ row slice, and the embarrassing parallelism is REAL (SURVEY.md §2.2
 from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
+from functools import partial
 
 import numpy as np
+
+import jax
+import jax.numpy as jnp
 
 from ..base import ClassifierMixin, RegressorMixin, TPUEstimator, clone
 from ..core.sharded import ShardedRows, unshard
@@ -30,77 +34,146 @@ def _to_host_pair(X, y):
     return Xh, yh
 
 
+def _device_classes(y: ShardedRows) -> np.ndarray:
+    """Class inventory of device-resident labels without an O(n) fetch —
+    pad rows are remapped to the first real label so padding cannot mint
+    a phantom class (same pattern as linear_model.glm)."""
+    yd = jnp.where(y.mask > 0, y.data, y.data[0])
+    return np.asarray(jnp.unique(yd))
+
+
+# One compiled program per (loss, penalty, schedule, fit_intercept, shapes)
+# for the WHOLE ensemble's epoch — module-level so repeated fits (grid
+# search candidates, pipeline refits) reuse the executable instead of
+# paying a fresh XLA compile per fit.
+@partial(
+    jax.jit,
+    static_argnames=("loss", "penalty", "schedule", "fit_intercept"),
+    donate_argnames=("states",),
+)
+def _ensemble_epoch(states, xb, yb, mask, hypers, *, loss, penalty,
+                    schedule, fit_intercept):
+    from ..linear_model._sgd import sgd_step
+
+    step = partial(
+        sgd_step, loss=loss, penalty=penalty, schedule=schedule,
+        fit_intercept=fit_intercept,
+    )
+    # vmap over (state, OWN block, OWN mask, hyper): one dispatch per epoch
+    return jax.vmap(step)(states, xb, yb, mask, hypers)
+
+
 class _BlockwiseBase(TPUEstimator):
     def __init__(self, estimator, n_blocks=8):
         self.estimator = estimator
         self.n_blocks = n_blocks
 
     def _fit_blocks(self, X, y, **kwargs):
-        Xh, yh = _to_host_pair(X, y)
-        n = Xh.shape[0]
         if self.n_blocks < 1:
             raise ValueError("n_blocks must be >= 1")
+        # the packed device path slices blocks straight from the (possibly
+        # device-resident) arrays — NO host round-trip; only the thread
+        # fallback for arbitrary sklearn estimators materializes X on host
+        if self._try_fit_packed(X, y, kwargs):
+            return self
+
+        Xh, yh = _to_host_pair(X, y)
+        n = Xh.shape[0]
         bounds = np.linspace(0, n, self.n_blocks + 1, dtype=int)
         spans = [(lo, hi) for lo, hi in zip(bounds[:-1], bounds[1:]) if hi > lo]
         members = [clone(self.estimator) for _ in spans]
 
-        if not self._fit_packed(members, spans, Xh, yh, kwargs):
-            # mesh scoping is thread-local: re-enter the caller's mesh in
-            # each worker so device-native members keep the active mesh
-            from ..core.mesh import get_mesh, use_mesh
+        # mesh scoping is thread-local: re-enter the caller's mesh in
+        # each worker so device-native members keep the active mesh
+        from ..core.mesh import get_mesh, use_mesh
 
-            mesh = get_mesh()
+        mesh = get_mesh()
 
-            def fit_one(pair):
-                est, (lo, hi) = pair
-                with use_mesh(mesh):
-                    if yh is not None:
-                        est.fit(Xh[lo:hi], yh[lo:hi], **kwargs)
-                    else:
-                        est.fit(Xh[lo:hi], **kwargs)
-                return est
+        def fit_one(pair):
+            est, (lo, hi) = pair
+            with use_mesh(mesh):
+                if yh is not None:
+                    est.fit(Xh[lo:hi], yh[lo:hi], **kwargs)
+                else:
+                    est.fit(Xh[lo:hi], **kwargs)
+            return est
 
-            with ThreadPoolExecutor(
-                max_workers=min(8, max(4, len(members)))
-            ) as pool:
-                members = list(pool.map(fit_one, zip(members, spans)))
+        with ThreadPoolExecutor(
+            max_workers=min(8, max(4, len(members)))
+        ) as pool:
+            members = list(pool.map(fit_one, zip(members, spans)))
         self.estimators_ = members
         self.n_features_in_ = Xh.shape[1]
         return self
 
-    def _fit_packed(self, members, spans, Xh, yh, kwargs) -> bool:
+    def _try_fit_packed(self, X, y, kwargs) -> bool:
         """Device-native path: same-config SGD members train as ONE stacked
         program — member i's batch is block i, so each epoch is a single
-        vmapped dispatch for the whole ensemble.  Returns False when the
+        vmapped dispatch for the whole ensemble.  Blocks are sliced from
+        the input WHERE IT LIVES: a ShardedRows never round-trips to host
+        (an O(n) device→host fetch takes minutes at scale on the axon
+        relay and can wedge the tunnel).  Returns False when the
         sub-estimator isn't packable (caller falls back to threads)."""
         from ..linear_model._sgd import SGDClassifier, sgd_init
         from ..model_selection._packing import pack_key
 
-        if yh is None or pack_key(members[0]) is None or len(members) < 2:
+        probe = clone(self.estimator)
+        if y is None or pack_key(probe) is None or self.n_blocks < 2:
             return False
+
+        if isinstance(X, ShardedRows):
+            data = X.data.astype(jnp.float32)
+            mask_full = X.mask
+            ydata = y.data if isinstance(y, ShardedRows) else jnp.asarray(
+                np.asarray(y))
+        else:
+            Xh = np.asarray(X, dtype=np.float32)
+            data = jnp.asarray(Xh)
+            mask_full = jnp.ones((data.shape[0],), jnp.float32)
+            ydata = jnp.asarray(
+                unshard(y) if isinstance(y, ShardedRows) else np.asarray(y)
+            )
+        n = data.shape[0]
+        if ydata.shape[0] < n:  # host y vs padded device X: align lengths
+            ydata = jnp.pad(ydata, (0, n - ydata.shape[0]))
+        bounds = np.linspace(0, n, self.n_blocks + 1, dtype=int)
+        spans = [(lo, hi) for lo, hi in zip(bounds[:-1], bounds[1:]) if hi > lo]
+        members = [clone(self.estimator) for _ in spans]
         # equal block shapes are required to stack; trim is at most
         # n_blocks-1 rows (the linspace remainder)
         size = min(hi - lo for lo, hi in spans)
-        xb = np.stack([Xh[lo:lo + size] for lo, _ in spans]).astype(np.float32)
+        los = [lo for lo, _ in spans]
+        xb = jnp.stack([jax.lax.dynamic_slice_in_dim(data, lo, size) for lo in los])
+        mask = jnp.stack([
+            jax.lax.dynamic_slice_in_dim(mask_full, lo, size) for lo in los
+        ]).astype(jnp.float32)
+
         is_clf = isinstance(members[0], SGDClassifier)
         if is_clf:
-            classes = np.unique(yh)
+            if "classes" in kwargs:
+                classes = np.sort(np.asarray(kwargs["classes"]))
+            elif isinstance(y, ShardedRows):
+                classes = _device_classes(y)
+            else:
+                classes = np.unique(np.asarray(ydata))
             for m in members:
-                m._set_classes(kwargs.get("classes", classes))
-            yb = np.stack([
-                members[0]._encode_targets(yh[lo:lo + size]) for lo, _ in spans
-            ])
+                m._set_classes(classes)
+            # ±1 one-vs-all targets built on device (device labels never
+            # round-trip): pad rows are inert through the mask
+            cd = jnp.asarray(classes, ydata.dtype)
+            idx = jnp.clip(jnp.searchsorted(cd, ydata), 0, len(classes) - 1)
+            bad = jnp.sum((cd[idx] != ydata).astype(jnp.float32) * mask_full)
+            if float(bad) > 0:  # scalar fetch, mirrors _encode_targets
+                raise ValueError("y contains labels not in `classes`")
+            if len(classes) == 2:
+                enc = jnp.where(idx == 1, 1.0, -1.0)[:, None]
+            else:
+                enc = 2.0 * jax.nn.one_hot(idx, len(classes)) - 1.0
         else:
-            yb = np.stack([
-                yh[lo:lo + size].astype(np.float32).reshape(-1, 1)
-                for lo, _ in spans
-            ])
+            enc = ydata.astype(jnp.float32).reshape(-1, 1)
+        yb = jnp.stack([jax.lax.dynamic_slice_in_dim(enc, lo, size) for lo in los])
 
-        import jax
-        import jax.numpy as jnp
-        from functools import partial
-
-        from ..linear_model._sgd import sgd_step
+        from ..linear_model._sgd import EpochStopper
 
         m0 = members[0]
         k_out = yb.shape[2]
@@ -114,21 +187,14 @@ class _BlockwiseBase(TPUEstimator):
         hypers = jax.tree.map(
             lambda *xs: jnp.stack(xs), *[m._hyper() for m in members]
         )
-        xb, yb = jnp.asarray(xb), jnp.asarray(yb)
-        mask = jnp.ones((len(members), size), jnp.float32)
 
-        # vmap the pure step over (state, OWN block, hyper): each epoch is
-        # ONE dispatch advancing every ensemble member on its own data
-        from ..linear_model._sgd import EpochStopper
-
-        step_fn = partial(
-            sgd_step, loss=m0.loss, penalty=m0.penalty,
-            schedule=m0.learning_rate, fit_intercept=m0.fit_intercept,
-        )
-        vstep = jax.jit(jax.vmap(step_fn), donate_argnums=(0,))
         stop = EpochStopper(m0.tol, getattr(m0, "n_iter_no_change", 5))
         for epoch in range(m0.max_iter):
-            states, losses = vstep(states, xb, yb, mask, hypers)
+            states, losses = _ensemble_epoch(
+                states, xb, yb, mask, hypers, loss=m0.loss,
+                penalty=m0.penalty, schedule=m0.learning_rate,
+                fit_intercept=m0.fit_intercept,
+            )
             # the host sync happens only when a tol check is active —
             # tol=None epochs pipeline without a device round-trip
             if stop.active and stop.update(float(jnp.mean(losses))):
@@ -136,6 +202,8 @@ class _BlockwiseBase(TPUEstimator):
         for i, m in enumerate(members):
             m._state = jax.tree.map(lambda v: v[i], states)
             m.n_iter_ = epoch + 1
+        self.estimators_ = members
+        self.n_features_in_ = int(data.shape[1])
         return True
 
 
@@ -149,9 +217,14 @@ class BlockwiseVotingClassifier(ClassifierMixin, _BlockwiseBase):
         if self.voting not in ("hard", "soft"):
             raise ValueError(f"voting must be 'hard' or 'soft', got {self.voting!r}")
         self._fit_blocks(X, y, **kwargs)
-        _, yh = _to_host_pair(X, y)
-        # keep classes_ sorted: vote counting indexes by searchsorted
-        self.classes_ = np.unique(yh if self.classes is None else np.asarray(self.classes))
+        # keep classes_ sorted: vote counting indexes by searchsorted;
+        # device labels are inventoried on device (no O(n) fetch)
+        if self.classes is not None:
+            self.classes_ = np.unique(np.asarray(self.classes))
+        elif isinstance(y, ShardedRows):
+            self.classes_ = _device_classes(y)
+        else:
+            self.classes_ = np.unique(np.asarray(y))
         return self
 
     def predict(self, X):

@@ -1,0 +1,35 @@
+"""Deprecated shim — reference parity for ``dask_ml/joblib.py``.
+
+The reference module registered dask's joblib backend so plain sklearn
+``n_jobs`` fits could fan out over a dask cluster; upstream deprecated it
+once joblib shipped the dask backend itself (SURVEY.md §2.1 component
+27).  This twin preserves the import surface and explains the TPU-native
+replacement: parallelism here comes from sharded XLA programs and the
+thread-pool search planes (``GridSearchCV(n_jobs=...)``,
+``model_selection._incremental``'s shared executor), not a joblib
+backend.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+warnings.warn(
+    "dask_ml_tpu.joblib is a deprecation shim (the reference's "
+    "dask_ml.joblib backend registration was itself deprecated). "
+    "Parallelism in dask_ml_tpu comes from sharded XLA programs and the "
+    "n_jobs thread pools of the search planes; no joblib backend is "
+    "needed or provided.",
+    FutureWarning,
+    stacklevel=2,
+)
+
+
+def register_parallel_backend(*args, **kwargs):
+    """The reference registered a 'dask' joblib backend; there is no
+    backend to register here — raise with the supported alternative."""
+    raise NotImplementedError(
+        "dask_ml_tpu does not provide a joblib backend. Use "
+        "GridSearchCV(n_jobs=...) / the incremental searches, which "
+        "parallelize internally."
+    )

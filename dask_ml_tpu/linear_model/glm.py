@@ -137,10 +137,21 @@ class LogisticRegression(_GLM):
                 UserWarning, stacklevel=2,
             )
         from ..core.sharded import ShardedRows as _SR
-        from ..core.sharded import unshard
 
-        yv = unshard(y) if isinstance(y, _SR) else np.asarray(y)
-        self.classes_ = np.unique(yv)
+        if isinstance(y, _SR):
+            # device-side class discovery: only the unique label VALUES
+            # cross to host (a handful of scalars), never the n-row label
+            # vector — a full unshard of device-resident labels is an
+            # O(n) device->host transfer (minutes at HIGGS scale on the
+            # axon relay, and large fetches can wedge the tunnel).  Pad
+            # rows are remapped to the first (real) label so padding
+            # cannot mint a phantom class.
+            yd = jnp.where(y.mask > 0, y.data, y.data[0])
+            self.classes_ = np.asarray(jnp.unique(yd))
+            yv = None
+        else:
+            yv = np.asarray(y)
+            self.classes_ = np.unique(yv)
         if len(self.classes_) < 2:
             raise ValueError(
                 "LogisticRegression needs samples of at least 2 classes; "
@@ -150,14 +161,26 @@ class LogisticRegression(_GLM):
         self.n_features_in_ = X.data.shape[1]
         Xi = add_intercept(X) if self.fit_intercept else X
 
+        def _indicator(cls):
+            """0/1 target for one-vs-rest, built where y lives (device
+            labels never round-trip; the mask keeps pad rows inert)."""
+            if yv is not None:
+                return (yv == cls).astype(np.float32)
+            return _SR(
+                data=(y.data == jnp.asarray(cls, y.data.dtype)).astype(
+                    jnp.float32
+                ),
+                mask=y.mask, n_samples=y.n_samples,
+            )
+
         if len(self.classes_) == 2:
-            y01 = (yv == self.classes_[1]).astype(np.float32)
+            y01 = _indicator(self.classes_[1])
             beta = self._solve(Xi, y01)
             self.betas_ = beta[None, :]
         else:
             betas = []
             for cls in self.classes_:
-                y01 = (yv == cls).astype(np.float32)
+                y01 = _indicator(cls)
                 betas.append(self._solve(Xi, y01))
             self.betas_ = jnp.stack(betas)  # (K, d[+1])
         if self.fit_intercept:

@@ -157,7 +157,9 @@ def pairwise_distances(X, Y=None, metric: str = "euclidean", **kwargs):
 def _argmin_min(x, y):
     d2 = _sq_euclidean_hi(x, y)
     idx = jnp.argmin(d2, axis=1)
-    return idx, jnp.sqrt(jnp.take_along_axis(d2, idx[:, None], axis=1)[:, 0])
+    # jnp.min picks the same element as d2[idx] without the dynamic
+    # row-gather (take_along_axis), which XLA:TPU lowers ~10x slower
+    return idx, jnp.sqrt(jnp.maximum(jnp.min(d2, axis=1), 0.0))
 
 
 def pairwise_distances_argmin_min(X, Y):

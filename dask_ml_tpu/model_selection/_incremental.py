@@ -167,6 +167,8 @@ class BaseIncrementalSearchCV(TPUEstimator):
 
     async def _fit(self, X_train, y_train, X_test, y_test, **fit_params):
         self._reset_policy()
+        self._fit_failures = 0
+        self._fit_failures_lock = threading.Lock()
         scorer = check_scoring(self.estimator, self.scoring)
         params = self._get_params()
         rng = check_random_state(self.random_state)
@@ -274,6 +276,38 @@ class BaseIncrementalSearchCV(TPUEstimator):
                     singles.append((v[0], k[1]))
             return packed, singles
 
+        def run_unit(fn, unit_ids, first_arg, n_calls):
+            """One training unit with single-retry fault recovery.
+
+            The reference's resilience comes from the scheduler: a task
+            lost to a dead worker is resubmitted and lineage recomputes
+            its inputs (SURVEY.md §5 failure detection).  Here the unit
+            retries once from a deep-copied round-start state — exact-state
+            recovery (sklearn partial_fit mutates in place, so re-running
+            without the snapshot would double-apply blocks).  A second
+            failure propagates: persistent faults must surface, not spin.
+            """
+            import copy
+
+            snapshot = {i: copy.deepcopy(models[i]) for i in unit_ids}
+            # a cohort can fail after appending SOME members' history
+            # records — roll info back too, or the policy sees phantom
+            # rounds for the members that finished before the fault
+            info_snapshot = {i: len(info[i]) for i in unit_ids}
+            try:
+                return fn(first_arg, n_calls)
+            except Exception:
+                logger.warning(
+                    "training unit %s failed; retrying once from "
+                    "round-start state", unit_ids, exc_info=True,
+                )
+                with self._fit_failures_lock:
+                    self._fit_failures += len(unit_ids)
+                for i in unit_ids:
+                    models[i] = snapshot[i]
+                    del info[i][info_snapshot[i]:]
+                return fn(first_arg, n_calls)
+
         async def run_round(instructions):
             """Fan this round's training units over the shared thread pool
             so independent models — and, above us, concurrent Hyperband
@@ -296,11 +330,17 @@ class BaseIncrementalSearchCV(TPUEstimator):
                     return fn(*args)
 
             futs = [
-                loop.run_in_executor(pool, on_mesh, train_cohort, idents, n_calls)
+                loop.run_in_executor(
+                    pool, on_mesh, run_unit, train_cohort, list(idents),
+                    idents, n_calls,
+                )
                 for (key, n_calls, _), idents in packed.items()
             ]
             futs += [
-                loop.run_in_executor(pool, on_mesh, train_one, ident, n_calls)
+                loop.run_in_executor(
+                    pool, on_mesh, run_unit, train_one, [ident], ident,
+                    n_calls,
+                )
                 for ident, n_calls in singles
             ]
             if futs:
@@ -363,6 +403,9 @@ class BaseIncrementalSearchCV(TPUEstimator):
         cv_results["rank_test_score"] = ranks.tolist()
         self.cv_results_ = cv_results
         self.n_models_ = len(info)
+        # observability for the fault-recovery path: how many training
+        # units were retried from their round-start snapshot this fit
+        self.fit_failures_ = getattr(self, "_fit_failures", 0)
         return self
 
     def fit(self, X, y=None, **fit_params):

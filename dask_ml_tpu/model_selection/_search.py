@@ -231,7 +231,10 @@ class _BaseSearchCV(TPUEstimator):
     def _build_results(self, candidates, splits, test_scores, train_scores):
         mean_test = test_scores.mean(axis=1)
         std_test = test_scores.std(axis=1)
-        ranks = np.argsort(np.argsort(-mean_test)) + 1
+        # error_score=nan candidates rank (and select) WORST: a raw
+        # argsort/argmax treats NaN as the maximum
+        mean_ranked = np.where(np.isnan(mean_test), -np.inf, mean_test)
+        ranks = np.argsort(np.argsort(-mean_ranked)) + 1
         cv_results = {
             "params": candidates,
             "mean_test_score": mean_test.tolist(),
@@ -248,7 +251,12 @@ class _BaseSearchCV(TPUEstimator):
         for k in sorted(keys):
             cv_results[f"param_{k}"] = [p.get(k) for p in candidates]
         self.cv_results_ = cv_results
-        self.best_index_ = int(np.argmax(mean_test))
+        if np.all(np.isnan(mean_test)):
+            raise ValueError(
+                "every candidate's fit failed (all mean test scores are "
+                "NaN); re-run with error_score='raise' to see the cause"
+            )
+        self.best_index_ = int(np.nanargmax(mean_test))
         self.best_score_ = float(mean_test[self.best_index_])
         self.best_params_ = candidates[self.best_index_]
         self.n_splits_ = len(splits)

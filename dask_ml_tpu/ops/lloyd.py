@@ -1,13 +1,22 @@
-"""Fused Lloyd assign+reduce Pallas kernel.
+"""Fused Lloyd assign+reduce Pallas kernel (experimental, opt-in).
 
-The XLA lowering of a Lloyd round materializes the (n, k) distance matrix,
-gathers the per-row minimum, materializes an (n, k) one-hot, and runs a
-second gemm over X — reading X from HBM twice and the intermediates once
-more (~23 ms for 2M×50 on a v5e chip, ~8× off the bandwidth roof).  This
-kernel streams X through VMEM ONCE per round: for each row tile it computes
-the distance cross-term on the MXU, reduces argmin/min on the VPU, and
-accumulates per-cluster sums/counts and the inertia into VMEM accumulators
-across the (sequential) grid.  HBM traffic drops to one read of X.
+Design: stream X through VMEM ONCE per round — per row tile, distance
+cross-term on the MXU, argmin/min on the VPU, per-cluster sums/counts and
+inertia accumulated in VMEM across the (sequential) grid; HBM traffic is
+one read of X.
+
+Measured reality (v5e, slope-timed with result-fetch sync — see bench.py
+for why block_until_ready cannot be trusted on the axon relay): the XLA
+lowering of ``cluster.k_means._lloyd_step`` runs a 2M×50 k=8 round in
+~1.4 ms (~2 HBM passes, near roofline) while this kernel takes ~5.5 ms.
+The two fp32 ``Precision.HIGHEST`` gemms — mandatory for assignment
+parity — cost ~6 bf16 MXU passes each and are padded k=8→128 lanes, so
+the kernel is MXU-bound, not bandwidth-bound, and the single-pass design
+cannot pay off at these shapes.  Hence opt-in via ``DASK_ML_TPU_PALLAS=1``
+(``cluster.k_means._pallas_ok``); revisit for d≈128 / large-k workloads.
+Known Mosaic limit: tiles ≥4096 rows fail to compile with the separate
+(T, 1) mask input stream (fold the mask into X's trailing column if a
+larger tile is ever needed).
 
 Reference parity: this replaces the per-block "labels = argmin; per-block
 per-cluster sums & counts → tree-reduce" stage of

@@ -58,7 +58,7 @@ def _approx_rows_threshold() -> int:
 
 
 @partial(jax.jit, static_argnames=("bins", "refinements"))
-def _hist_quantiles(x, mask, probs, *, bins=4096, refinements=2):
+def _hist_quantiles(x, mask, probs, *, bins=4096, refinements=3):
     """Merge-based approximate per-feature quantiles, one fused program.
 
     The ``da.percentile`` twin: per-shard histograms merge by ADDITION
@@ -67,10 +67,11 @@ def _hist_quantiles(x, mask, probs, *, bins=4096, refinements=2):
     collapses on outlier-heavy features (one 1e9 outlier makes the bin
     width swamp a [0,1] bulk), so the histogram is RE-FOCUSED
     ``refinements`` times onto the bins bracketing the requested
-    quantiles — each pass shrinks the error by ~``bins``×, giving
-    range/bins^(refinements+1) (≈ range/6.9e10 at the defaults) for
-    2 + refinements full data scans, still far cheaper than a distributed
-    sort at the billion-row scale this path targets.
+    quantiles.  When the interior quantiles land in one bin the window
+    shrinks ~``bins/3``× per pass (window = bracketing bins ±1), so the
+    defaults resolve a 1e9-range outlier column to ~1e-4 absolute in
+    1 + refinements full data scans — still far cheaper than a
+    distributed sort at the billion-row scale this path targets.
     """
     n, d = x.shape
     mvalid = mask[:, None] > 0
@@ -80,6 +81,11 @@ def _hist_quantiles(x, mask, probs, *, bins=4096, refinements=2):
     probs = jnp.asarray(probs, x.dtype)
     total = jnp.sum(mask)
     targets = probs[:, None] * jnp.broadcast_to(total, (d,))[None, :]  # (p, d)
+    # p=0 / p=1 are EXACTLY the masked min/max (already in hand) and must
+    # not steer the refinement window: with an extreme outlier the max's
+    # bin keeps the window at full range forever and the promised
+    # per-pass tightening never happens for everything else
+    interior = (probs > 0.0) & (probs < 1.0)  # (p,)
 
     weights_all = jnp.broadcast_to(mask[:, None], x.shape)
     feat_off = jnp.arange(d, dtype=jnp.int32)[None, :] * bins
@@ -105,12 +111,16 @@ def _hist_quantiles(x, mask, probs, *, bins=4096, refinements=2):
             frac = jnp.clip((t - prev) / cnt, 0.0, 1.0)
             binw = width_1 / bins
             val = lo_1 + (b.astype(x.dtype) + frac) * binw
-            # next window: the bins bracketing ALL requested quantiles,
+            # next window: the bins bracketing the INTERIOR quantiles,
             # widened one bin each side — fp32 edge arithmetic at large
             # scales (lo ~ 1e9, ulp 64) can otherwise round the window
-            # past the true quantile region and exclude the bulk
-            nlo = lo_1 + (jnp.min(b).astype(x.dtype) - 1.0) * binw
-            nhi = lo_1 + (jnp.max(b).astype(x.dtype) + 2.0) * binw
+            # past the true quantile region and exclude the bulk.  With
+            # no interior probs the window is irrelevant (endpoints are
+            # exact); keep it degenerate-safe at the full span.
+            bmin = jnp.min(jnp.where(interior, b, bins - 1))
+            bmax = jnp.max(jnp.where(interior, b, 0))
+            nlo = lo_1 + (bmin.astype(x.dtype) - 1.0) * binw
+            nhi = lo_1 + (bmax.astype(x.dtype) + 2.0) * binw
             return val, nlo, nhi
 
         vals, nlo, nhi = jax.vmap(
@@ -121,6 +131,10 @@ def _hist_quantiles(x, mask, probs, *, bins=4096, refinements=2):
     vals, lo_r, hi_r = hist_pass(lo, hi)
     for _ in range(refinements):
         vals, lo_r, hi_r = hist_pass(lo_r, hi_r)
+    # exact endpoints: the sketch's interpolation cannot beat the masked
+    # min/max it already computed
+    vals = jnp.where(interior[:, None], vals, jnp.where(
+        (probs <= 0.0)[:, None], lo[None, :], hi[None, :]))
     return vals  # (p, d)
 
 
@@ -261,9 +275,13 @@ class QuantileTransformer(TransformerMixin, TPUEstimator):
     ``jnp.interp`` per feature — one fused XLA program.
 
     ``subsample``/``random_state``/``ignore_implicit_zeros`` are accepted for
-    API compatibility but inert: quantiles are computed exactly on device
-    (a single sort per feature), so subsampling is unnecessary, and sparse
-    input is densified at ingest.
+    API compatibility but inert: quantiles are computed on device for the
+    FULL data — exactly (one sort per feature) up to the
+    ``DASK_ML_TPU_EXACT_QUANTILE_MAX_ROWS`` threshold, then via the
+    refining histogram sketch (``_hist_quantiles``: endpoint probs are the
+    exact masked min/max; interior probs tighten by ~bins× per refinement
+    pass) — so subsampling is unnecessary, and sparse input is densified
+    at ingest.
     """
 
     def __init__(self, n_quantiles=1000, output_distribution="uniform",

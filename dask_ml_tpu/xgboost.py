@@ -1,0 +1,39 @@
+"""Deprecated shim — reference parity for ``dask_ml/xgboost.py``.
+
+The reference module was a historical re-export of the external
+``dask-xgboost`` integration and was deprecated upstream in favor of
+``xgboost.dask``; it carries no capability of its own (SURVEY.md §2.1
+component 27).  This twin preserves the import surface and the
+deprecation behavior: importing it works, touching any attribute raises
+with a pointer to the supported path.
+
+There is no TPU XGBoost: gradient-boosted trees are hostile to the MXU
+(data-dependent splits, scalar control flow).  Users wanting boosted
+trees should train with the upstream ``xgboost`` package on host and wrap
+the fitted model in :class:`dask_ml_tpu.wrappers.ParallelPostFit` for
+sharded inference — that combination is tested and supported.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+warnings.warn(
+    "dask_ml_tpu.xgboost is a deprecation shim (the reference's "
+    "dask_ml.xgboost re-export was itself deprecated). Train with the "
+    "upstream xgboost package and wrap the fitted model in "
+    "dask_ml_tpu.wrappers.ParallelPostFit for sharded inference.",
+    FutureWarning,
+    stacklevel=2,
+)
+
+_MSG = (
+    "dask_ml_tpu.xgboost.{name} is not provided: the reference module was "
+    "a deprecated re-export of dask-xgboost. Use the upstream xgboost "
+    "package for training and dask_ml_tpu.wrappers.ParallelPostFit for "
+    "sharded inference."
+)
+
+
+def __getattr__(name):
+    raise AttributeError(_MSG.format(name=name))

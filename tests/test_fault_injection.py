@@ -1,0 +1,173 @@
+"""Fault injection for the dynamic search plane.
+
+The reference inherits resilience from distributed: tasks of a dead worker
+are resubmitted and lineage recomputes their inputs; a handful of its tests
+kill workers mid-search (SURVEY.md §5 failure detection).  The analogue
+here is process-local: a training unit that raises is retried ONCE from a
+deep-copied round-start snapshot (exact-state recovery —
+``model_selection/_incremental.py :: run_unit``), persistent faults
+propagate, and round-granular checkpoints (tests/test_checkpoint.py) cover
+whole-process death.  These tests inject faults at the partial_fit level
+and assert recovery semantics, determinism, and failure accounting.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+from sklearn.base import BaseEstimator
+
+from dask_ml_tpu.model_selection import IncrementalSearchCV, GridSearchCV
+
+
+class FlakyOnce(BaseEstimator):
+    """Linear-score fake model whose partial_fit raises once, globally
+    coordinated: call number ``fail_at`` (1-based, across ALL instances)
+    raises RuntimeError, every other call succeeds.  Deterministic score
+    keeps search results comparable across runs."""
+
+    # class-level so all clones share the fault schedule
+    _calls = 0
+    _failed = False
+    _lock = threading.Lock()
+    fail_at = None
+
+    def __init__(self, slope=1.0, fail_marker=0):
+        self.slope = slope
+        self.fail_marker = fail_marker
+
+    @classmethod
+    def reset(cls, fail_at=None):
+        cls._calls = 0
+        cls._failed = False
+        cls.fail_at = fail_at
+
+    def partial_fit(self, X, y, **kw):
+        cls = type(self)
+        with cls._lock:
+            cls._calls += 1
+            should_fail = (
+                cls.fail_at is not None
+                and cls._calls == cls.fail_at
+                and not cls._failed
+            )
+            if should_fail:
+                cls._failed = True
+        if should_fail:
+            raise RuntimeError("injected fault")
+        self.n_calls_ = getattr(self, "n_calls_", 0) + 1
+        return self
+
+    def score(self, X, y):
+        return self.slope * getattr(self, "n_calls_", 0)
+
+
+class AlwaysFails(BaseEstimator):
+    def __init__(self, dummy=0):
+        self.dummy = dummy
+
+    def partial_fit(self, X, y, **kw):
+        raise RuntimeError("persistent injected fault")
+
+    def score(self, X, y):  # pragma: no cover
+        return 0.0
+
+
+class FailingFit(BaseEstimator):
+    """For GridSearchCV: fit raises for a poisoned parameter value."""
+
+    def __init__(self, c=1.0):
+        self.c = c
+
+    def fit(self, X, y):
+        if self.c < 0:
+            raise ValueError("injected candidate failure")
+        self.fitted_ = True
+        return self
+
+    def score(self, X, y):
+        return float(self.c)
+
+
+@pytest.fixture
+def xy(rng):
+    X = rng.normal(size=(64, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    return X, y
+
+
+class TestIncrementalFaultRecovery:
+    def _search(self, **kw):
+        kw.setdefault("n_initial_parameters", 3)
+        kw.setdefault("max_iter", 4)
+        kw.setdefault("random_state", 0)
+        return IncrementalSearchCV(
+            FlakyOnce(), {"slope": [1.0, 2.0, 3.0]}, **kw
+        )
+
+    def test_transient_fault_recovers(self, xy):
+        X, y = xy
+        FlakyOnce.reset(fail_at=5)
+        search = self._search().fit(X, y)
+        assert search.fit_failures_ == 1
+        # the search still trained every model to budget and ranked them
+        assert search.best_score_ == max(
+            r["score"] for r in search.history_
+        )
+
+    def test_recovery_is_exact_state(self, xy):
+        """A retried unit restarts from its round-start snapshot, so the
+        final fitted state matches an entirely fault-free run."""
+        X, y = xy
+        FlakyOnce.reset(fail_at=None)
+        clean = self._search().fit(X, y)
+        FlakyOnce.reset(fail_at=4)
+        faulty = self._search().fit(X, y)
+        assert faulty.fit_failures_ == 1
+        assert clean.best_params_ == faulty.best_params_
+        assert clean.best_score_ == faulty.best_score_
+        # every model saw the same number of effective partial_fit calls
+        clean_calls = {
+            m: recs[-1]["partial_fit_calls"]
+            for m, recs in clean.model_history_.items()
+        }
+        faulty_calls = {
+            m: recs[-1]["partial_fit_calls"]
+            for m, recs in faulty.model_history_.items()
+        }
+        assert clean_calls == faulty_calls
+
+    def test_no_fault_counts_zero(self, xy):
+        X, y = xy
+        FlakyOnce.reset(fail_at=None)
+        search = self._search().fit(X, y)
+        assert search.fit_failures_ == 0
+
+    def test_persistent_fault_raises(self, xy):
+        X, y = xy
+        search = IncrementalSearchCV(
+            AlwaysFails(), {"dummy": [0, 1]},
+            n_initial_parameters=2, max_iter=2, random_state=0,
+        )
+        with pytest.raises(RuntimeError, match="persistent injected fault"):
+            search.fit(X, y)
+
+
+class TestGridSearchErrorScore:
+    def test_error_score_nan_keeps_good_candidates(self, xy):
+        X, y = xy
+        search = GridSearchCV(
+            FailingFit(), {"c": [-1.0, 1.0, 2.0]}, cv=3,
+            error_score=np.nan,
+        ).fit(X, y)
+        scores = search.cv_results_["mean_test_score"]
+        bad = search.cv_results_["param_c"].index(-1.0)
+        assert np.isnan(scores[bad])
+        assert search.best_params_ == {"c": 2.0}
+
+    def test_error_score_raise_propagates(self, xy):
+        X, y = xy
+        with pytest.raises(ValueError, match="injected candidate failure"):
+            GridSearchCV(
+                FailingFit(), {"c": [-1.0, 1.0]}, cv=3, error_score="raise"
+            ).fit(X, y)

@@ -232,3 +232,42 @@ class TestApproxQuantiles:
         )
         expect = np.percentile(X, [25, 50, 75], axis=0)
         np.testing.assert_allclose(got, expect, atol=5e-3)
+
+    def test_outlier_with_full_prob_grid(self, rng, mesh):
+        """QuantileTransformer's grid includes p=0 and p=1: those must map
+        to the exact masked min/max WITHOUT pinning the refinement window
+        to the outlier's bin (which would leave every interior quantile at
+        one-bin-of-the-full-range resolution)."""
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.preprocessing.data import _hist_quantiles
+
+        X = rng.uniform(0, 1, size=(50_000, 2)).astype(np.float32)
+        X[0, 0] = 1e9
+        X[1, 1] = -1e9
+        s = shard_rows(X)
+        probs = np.linspace(0.0, 1.0, 101)
+        got = np.asarray(_hist_quantiles(s.data, s.mask, jnp.asarray(probs)))
+        expect = np.percentile(X, probs * 100, axis=0).astype(np.float32)
+        # endpoints exact
+        np.testing.assert_allclose(got[0], X.min(axis=0), rtol=1e-6)
+        np.testing.assert_allclose(got[-1], X.max(axis=0), rtol=1e-6)
+        # interior quantiles resolve the [0,1] bulk despite the 1e9 range
+        np.testing.assert_allclose(got[1:-1], expect[1:-1], atol=5e-3)
+
+    def test_quantile_transformer_sketch_path(self, rng, mesh, monkeypatch):
+        """End-to-end: QuantileTransformer past the row threshold uses the
+        sketch and still produces a near-uniform output on outlier data."""
+        monkeypatch.setenv("DASK_ML_TPU_EXACT_QUANTILE_MAX_ROWS", "1000")
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.preprocessing import QuantileTransformer
+
+        X = rng.uniform(0, 1, size=(20_000, 2)).astype(np.float32)
+        X[0, 0] = 1e9
+        s = shard_rows(X)
+        qt = QuantileTransformer(n_quantiles=101).fit(s)
+        out = np.asarray(qt.transform(s).unpad())
+        # the bulk must spread over [0,1], not collapse to ~0
+        assert np.percentile(out[:, 0], 50) == pytest.approx(0.5, abs=0.05)
+        assert np.percentile(out[:, 1], 50) == pytest.approx(0.5, abs=0.05)

@@ -206,6 +206,36 @@ class TestBlockwiseEnsembles:
         with pytest.raises(ValueError, match="voting"):
             BlockwiseVotingClassifier(DecisionTreeClassifier(), voting="mean").fit(X, y)
 
+    def test_packed_fit_never_unshards_device_input(self, clf_data, monkeypatch):
+        """Packable (SGD) members + ShardedRows input must slice blocks on
+        device: the fit path may not call unshard (an O(n) device→host
+        fetch — minutes at scale on the axon relay)."""
+        import dask_ml_tpu.ensemble._blockwise as bw
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X, y = clf_data
+
+        def _forbidden(*a, **k):  # pragma: no cover - should not run
+            raise AssertionError("unshard called on the packed fit path")
+
+        monkeypatch.setattr(bw, "unshard", _forbidden)
+        ens = BlockwiseVotingClassifier(
+            SGDClassifier(max_iter=20, random_state=0, tol=None), n_blocks=4
+        ).fit(shard_rows(X), shard_rows(y.astype(np.float32)))
+        assert len(ens.estimators_) == 4
+        assert sorted(ens.classes_.tolist()) == sorted(np.unique(y).tolist())
+        # inference back on host data still works
+        assert (ens.predict(X) == y).mean() > 0.7
+
+    def test_packed_fit_matches_threaded_quality(self, clf_data):
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X, y = clf_data
+        ens = BlockwiseVotingClassifier(
+            SGDClassifier(max_iter=50, random_state=0), n_blocks=3
+        ).fit(X, y)
+        assert ens.score(X, y) > 0.8
+
 
 class TestColumnTransformer:
     def test_basic_columns(self, rng):
