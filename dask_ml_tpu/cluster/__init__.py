@@ -1,6 +1,9 @@
-"""Clustering — twin of ``dask_ml/cluster/`` (SURVEY.md §2 #6, #7)."""
+"""Clustering — twin of ``dask_ml/cluster/`` (SURVEY.md §2 #6, #7), plus
+a device-native ``MiniBatchKMeans`` for the streaming/Incremental plane
+(the reference streams sklearn's MiniBatchKMeans through ``_partial.py``)."""
 
 from .k_means import KMeans  # noqa: F401
+from .minibatch_kmeans import MiniBatchKMeans  # noqa: F401
 from .spectral import SpectralClustering  # noqa: F401
 
-__all__ = ["KMeans", "SpectralClustering"]
+__all__ = ["KMeans", "MiniBatchKMeans", "SpectralClustering"]

@@ -1,0 +1,251 @@
+"""Device-native MiniBatchKMeans (Sculley 2010) with the partial_fit contract.
+
+Reference capability: the reference's flagship streaming pattern is
+``Incremental(sklearn.cluster.MiniBatchKMeans)`` — sklearn's minibatch
+k-means driven block-by-block through the sequential partial_fit chain
+(``dask_ml/_partial.py :: fit``, SURVEY.md §3.5).  There the model hops
+between workers and every update runs sklearn's Cython on a host CPU.
+Here the model state (centers + per-center counts) is device-resident and
+``partial_fit`` IS one fused XLA program — assignment gemm on the MXU,
+per-center sums via the one-hot gemm, and Sculley's per-center
+learning-rate update — so ``Incremental``/``wrappers`` stream blocks into
+the TPU exactly the way the SGD family does (linear_model/_sgd.py).
+
+``fit`` runs epochs of contiguous mini-batches over the (possibly
+sharded) array as ONE ``lax.scan`` program per epoch: batches are
+``dynamic_slice`` windows (row GATHERS are ~10x slower on XLA:TPU — see
+cluster/k_means.py), randomness enters through a per-epoch offset, and
+only the epoch-mean inertia is fetched for the stopping rule (one scalar
+sync per epoch).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import TPUEstimator, TransformerMixin
+from ..core.prng import as_key
+from ..core.sharded import ShardedRows
+from .k_means import _assign, _ingest_float, _sq_dists
+
+logger = logging.getLogger(__name__)
+
+
+@jax.jit
+def _mbk_step(centers, counts, xb, mask):
+    """One Sculley update on one batch: returns (centers, counts, inertia).
+
+    Per-center learning rate 1/n_c (cumulative count), applied as
+    ``c += (batch_sum - batch_cnt * c) / n_c_new`` — the closed form of
+    sklearn's per-sample ``c += (x - c)/n_c`` stream over the batch.
+    """
+    d2 = _sq_dists(xb, centers)
+    labels = jnp.argmin(d2, axis=1)
+    min_d2 = jnp.min(d2, axis=1)
+    inertia = jnp.sum(min_d2 * mask)
+    onehot = jax.nn.one_hot(labels, centers.shape[0], dtype=xb.dtype) * mask[:, None]
+    bsum = jnp.dot(onehot.T, xb, precision=lax.Precision.HIGHEST)
+    bcnt = jnp.sum(onehot, axis=0)
+    new_counts = counts + bcnt
+    inv = jnp.where(new_counts > 0, 1.0 / jnp.maximum(new_counts, 1.0), 0.0)
+    new_centers = centers + (bsum - bcnt[:, None] * centers) * inv[:, None]
+    return new_centers, new_counts, inertia
+
+
+from functools import partial as _fpartial  # noqa: E402
+
+
+@_fpartial(jax.jit, static_argnames=("batch_size", "n_batches"))
+def _mbk_epoch(centers, counts, x, mask, start, *, batch_size, n_batches):
+    """One epoch = lax.scan over contiguous batch windows (one dispatch).
+
+    ``start`` (traced) rotates the window origin per epoch so successive
+    epochs see different batch boundaries without any gather/shuffle.
+    """
+    n = x.shape[0]
+
+    def body(carry, i):
+        c, cnt = carry
+        # valid window starts are 0..n-batch_size INCLUSIVE (hence +1):
+        # mod (n - bs) would leave the last row out of every batch
+        off = jnp.mod(start + i * batch_size, jnp.maximum(n - batch_size + 1, 1))
+        xb = lax.dynamic_slice_in_dim(x, off, batch_size)
+        mb = lax.dynamic_slice_in_dim(mask, off, batch_size)
+        c, cnt, inertia = _mbk_step(c, cnt, xb, mb)
+        return (c, cnt), inertia
+
+    (centers, counts), inertias = lax.scan(
+        body, (centers, counts), jnp.arange(n_batches)
+    )
+    return centers, counts, jnp.mean(inertias)
+
+
+class MiniBatchKMeans(TransformerMixin, TPUEstimator):
+    """Sklearn-contract minibatch k-means, state resident on device.
+
+    Parameters mirror sklearn's (``reassignment_ratio`` is accepted-inert;
+    center reassignment of empty clusters is a fit-quality nicety the
+    streaming contract does not require).  ``partial_fit`` consumes one
+    block per call — the unit of budget for ``Incremental`` and the
+    adaptive searches.
+    """
+
+    def __init__(self, n_clusters=8, init="k-means++", max_iter=100,
+                 batch_size=1024, tol=0.0, max_no_improvement=10,
+                 random_state=None, reassignment_ratio=0.01,
+                 oversampling_factor=2):
+        self.n_clusters = n_clusters
+        self.init = init
+        self.max_iter = max_iter
+        self.batch_size = batch_size
+        self.tol = tol
+        self.max_no_improvement = max_no_improvement
+        self.random_state = random_state
+        self.reassignment_ratio = reassignment_ratio
+        self.oversampling_factor = oversampling_factor
+
+    # -- init --------------------------------------------------------------
+    def _init_from_block(self, X: ShardedRows, key):
+        """First-seen-block initialization (sklearn seeds from the first
+        minibatch).  k-means++ runs on a small host-pulled sample — an
+        O(k) fetch, never O(n)."""
+        if isinstance(self.init, (np.ndarray, jnp.ndarray)):
+            c = jnp.asarray(self.init, dtype=X.data.dtype)
+            if c.shape != (self.n_clusters, X.data.shape[1]):
+                raise ValueError(
+                    f"init array must be ({self.n_clusters}, "
+                    f"{X.data.shape[1]}), got {c.shape}"
+                )
+            return c
+        if self.init == "random":
+            p = X.mask / jnp.sum(X.mask)
+            idx = jax.random.choice(
+                key, X.data.shape[0], (self.n_clusters,),
+                replace=X.n_samples < self.n_clusters, p=p,
+            )
+            return jnp.take(X.data, idx, axis=0)
+        if self.init in ("k-means++", "k-means||"):
+            from sklearn.cluster import kmeans_plusplus
+
+            from ..utils import draw_seed
+
+            n_sample = int(min(X.n_samples, max(1000, 50 * self.n_clusters)))
+            key, sub = jax.random.split(key)
+            p = X.mask / jnp.sum(X.mask)
+            idx = jax.random.choice(
+                sub, X.data.shape[0], (n_sample,),
+                replace=n_sample > X.n_samples, p=p,
+            )
+            sample = np.asarray(jnp.take(X.data, idx, axis=0), np.float64)
+            seed = int(draw_seed(int(jax.random.randint(key, (), 0, 2**31 - 1))))
+            c, _ = kmeans_plusplus(sample, self.n_clusters, random_state=seed)
+            return jnp.asarray(c, dtype=X.data.dtype)
+        raise ValueError(f"Unknown init: {self.init!r}")
+
+    def _ensure_state(self, X: ShardedRows):
+        if not hasattr(self, "cluster_centers_"):
+            if X.n_samples < self.n_clusters:
+                raise ValueError(
+                    f"n_samples={X.n_samples} < n_clusters={self.n_clusters}"
+                )
+            key = as_key(self.random_state)
+            self.cluster_centers_ = self._init_from_block(X, key)
+            self._counts = jnp.zeros((self.n_clusters,), X.data.dtype)
+            self.n_features_in_ = X.data.shape[1]
+            self.n_steps_ = 0
+
+    # -- streaming contract ------------------------------------------------
+    def partial_fit(self, X, y=None, **kwargs):
+        """One fused device update on this block (the budget unit).
+
+        Host blocks are padded to the SGD family's bucket sizes
+        (``linear_model._sgd._BUCKETS``) before ingest, so a stream of
+        ragged chunk sizes compiles a handful of programs, not one per
+        distinct length."""
+        if not isinstance(X, ShardedRows):
+            from ..linear_model._sgd import _bucket_rows
+
+            Xh = np.asarray(X, dtype=np.float32)
+            n = Xh.shape[0]
+            b = _bucket_rows(n)
+            if b != n:
+                Xh = np.concatenate(
+                    [Xh, np.zeros((b - n, Xh.shape[1]), np.float32)]
+                )
+            mask = np.zeros(b, dtype=np.float32)
+            mask[:n] = 1.0
+            X = ShardedRows(
+                data=jnp.asarray(Xh), mask=jnp.asarray(mask), n_samples=n
+            )
+        X = _ingest_float(self, X)
+        self._ensure_state(X)
+        self.cluster_centers_, self._counts, inertia = _mbk_step(
+            self.cluster_centers_, self._counts, X.data, X.mask
+        )
+        self.n_steps_ += 1
+        self._inertia_last = inertia  # device scalar; fetch only on demand
+        return self
+
+    # -- whole-array fit ---------------------------------------------------
+    def fit(self, X, y=None):
+        X = _ingest_float(self, X)
+        for attr in ("cluster_centers_", "_counts"):
+            if hasattr(self, attr):
+                delattr(self, attr)
+        self._ensure_state(X)
+        n = X.data.shape[0]
+        bs = int(min(self.batch_size, n))
+        n_batches = max(n // bs, 1)
+        key = as_key(self.random_state)
+
+        best = np.inf
+        bad = 0
+        centers, counts = self.cluster_centers_, self._counts
+        for epoch in range(self.max_iter):
+            key, sub = jax.random.split(key)
+            start = jax.random.randint(sub, (), 0, max(n - bs + 1, 1))
+            centers, counts, mean_inertia = _mbk_epoch(
+                centers, counts, X.data, X.mask, start,
+                batch_size=bs, n_batches=n_batches,
+            )
+            cur = float(mean_inertia)  # one scalar sync per epoch
+            if self.max_no_improvement is not None:
+                if cur > best - self.tol * max(abs(best), 1.0):
+                    bad += 1
+                    if bad >= self.max_no_improvement:
+                        break
+                else:
+                    bad = 0
+            best = min(best, cur)
+        self.cluster_centers_, self._counts = centers, counts
+        self.n_iter_ = epoch + 1
+        self.n_steps_ = (epoch + 1) * n_batches
+        labels, inertia = _assign(X.data, X.mask, self.cluster_centers_)
+        self.labels_ = labels[: X.n_samples]
+        self.inertia_ = float(inertia)
+        return self
+
+    # -- inference ---------------------------------------------------------
+    def predict(self, X):
+        X = _ingest_float(self, X)
+        labels, _ = _assign(X.data, X.mask, self.cluster_centers_)
+        return labels[: X.n_samples]
+
+    def fit_predict(self, X, y=None):
+        return self.fit(X).labels_
+
+    def transform(self, X):
+        X = _ingest_float(self, X)
+        d = jnp.sqrt(jnp.maximum(_sq_dists(X.data, self.cluster_centers_), 0.0))
+        return d[: X.n_samples]
+
+    def score(self, X, y=None):
+        X = _ingest_float(self, X)
+        _, inertia = _assign(X.data, X.mask, self.cluster_centers_)
+        return -float(inertia)

@@ -159,16 +159,8 @@ class _BlockwiseBase(TPUEstimator):
             for m in members:
                 m._set_classes(classes)
             # ±1 one-vs-all targets built on device (device labels never
-            # round-trip): pad rows are inert through the mask
-            cd = jnp.asarray(classes, ydata.dtype)
-            idx = jnp.clip(jnp.searchsorted(cd, ydata), 0, len(classes) - 1)
-            bad = jnp.sum((cd[idx] != ydata).astype(jnp.float32) * mask_full)
-            if float(bad) > 0:  # scalar fetch, mirrors _encode_targets
-                raise ValueError("y contains labels not in `classes`")
-            if len(classes) == 2:
-                enc = jnp.where(idx == 1, 1.0, -1.0)[:, None]
-            else:
-                enc = 2.0 * jax.nn.one_hot(idx, len(classes)) - 1.0
+            # round-trip); shared encoder with the SGD streaming path
+            enc = members[0]._encode_targets_device(ydata, mask_full)
         else:
             enc = ydata.astype(jnp.float32).reshape(-1, 1)
         yb = jnp.stack([jax.lax.dynamic_slice_in_dim(enc, lo, size) for lo in los])

@@ -264,6 +264,10 @@ class _BaseSGD(TPUEstimator):
         streamed chunks don't recompile per shape.
         """
         if isinstance(X, ShardedRows):
+            if isinstance(targets, jnp.ndarray):
+                # device-encoded targets (see _encode_targets_device):
+                # already row-aligned with X.data, nothing crosses to host
+                return X.data.astype(jnp.float32), targets, X.mask
             from ..core.sharded import shard_rows
 
             return (
@@ -357,6 +361,27 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
         out[np.arange(y.shape[0]), idx] = 1.0
         return out
 
+    def _encode_targets_device(self, ydata, mask):
+        """Device twin of :meth:`_encode_targets`: labels → ±1 one-vs-all
+        WITHOUT pulling the label block to host (an O(block) fetch per
+        partial_fit call on the streaming path).  Pad rows (mask 0) are
+        exempt from the label-validity check; one scalar crosses to host.
+        """
+        classes = jnp.asarray(self.classes_, ydata.dtype)
+        idx = jnp.clip(
+            jnp.searchsorted(classes, ydata), 0, len(self.classes_) - 1
+        )
+        bad = jnp.sum(
+            (jnp.take(classes, idx) != ydata).astype(jnp.float32)
+            * (mask > 0)
+        )
+        if float(bad) > 0:  # scalar fetch, mirrors the host path's check
+            raise ValueError("y contains labels not in `classes`")
+        if len(self.classes_) == 2:
+            return jnp.where(idx == 1, 1.0, -1.0)[:, None].astype(jnp.float32)
+        k = len(self.classes_)
+        return (2.0 * jax.nn.one_hot(idx, k) - 1.0).astype(jnp.float32)
+
     def _ensure_state(self, n_features: int):
         if not hasattr(self, "_state"):
             k = 1 if len(self.classes_) == 2 else len(self.classes_)
@@ -372,10 +397,15 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
                 )
             self._set_classes(classes)
         if isinstance(y, ShardedRows):
-            from ..core.sharded import unshard
+            if isinstance(X, ShardedRows):
+                # all-device block: encode labels in place, zero host I/O
+                targets = self._encode_targets_device(y.data, y.mask)
+            else:
+                from ..core.sharded import unshard
 
-            y = unshard(y)
-        targets = self._encode_targets(np.asarray(y))
+                targets = self._encode_targets(np.asarray(unshard(y)))
+        else:
+            targets = self._encode_targets(np.asarray(y))
         xb, yb, mask = self._prep_block(X, targets)
         self._ensure_state(xb.shape[1])
         self._loss_ = self._step_block(xb, yb, mask)
@@ -489,8 +519,14 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
         if self.loss not in _REG_LOSSES:
             raise ValueError(f"loss must be one of {_REG_LOSSES}")
 
-    def _targets(self, y):
+    def _targets(self, y, X=None):
         if isinstance(y, ShardedRows):
+            if isinstance(X, ShardedRows):
+                # all-device block: targets stay on device, row-aligned
+                # with X.data (pad rows masked out in sgd_step)
+                return y.data.astype(jnp.float32).reshape(-1, 1)
+            # mixed host-X + device-y: the host bucketing path needs
+            # exactly n unpadded rows
             from ..core.sharded import unshard
 
             y = unshard(y)
@@ -503,7 +539,7 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
 
     def partial_fit(self, X, y, **kwargs):
         self._validate()
-        xb, yb, mask = self._prep_block(X, self._targets(y))
+        xb, yb, mask = self._prep_block(X, self._targets(y, X))
         self._ensure_state(xb.shape[1])
         self._loss_ = self._step_block(xb, yb, mask)
         return self
@@ -512,7 +548,7 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
         self._validate()
         if not self.warm_start and hasattr(self, "_state"):
             delattr(self, "_state")
-        xb, yb, mask = self._prep_block(X, self._targets(y))
+        xb, yb, mask = self._prep_block(X, self._targets(y, X))
         self._ensure_state(xb.shape[1])
         self.n_iter_ = _run_epochs(self, xb, yb, mask)
         return self

@@ -140,15 +140,48 @@ class BaseIncrementalSearchCV(TPUEstimator):
 
     # -- data plumbing -------------------------------------------------
     def _to_blocks(self, X, y):
-        Xh = unshard(X) if isinstance(X, ShardedRows) else np.asarray(X)
+        """Row blocks, kept WHERE THE DATA LIVES.
+
+        Device-resident (ShardedRows) input yields device-slice blocks —
+        an O(n) unshard here would pull the training set to host (minutes
+        at scale on the axon relay) only for device-native models to
+        re-upload it every round.  Host input yields host blocks (what
+        sklearn models consume); host models consuming device blocks get
+        a once-per-block cached host view (``block_for`` in ``_fit``).
+
+        NOTE: the sliced blocks deliberately RELAX ShardedRows' "rows
+        divisible by the data axis" invariant (core/sharded.py) — they
+        are plain-jit views for partial_fit consumers, not shard_map
+        operands; do not feed them to P(DATA_AXIS) shard_map programs.
+        """
+        if isinstance(X, ShardedRows):
+            n = X.n_samples
+            chunk = self.chunk_size or max(1, n // 10)
+            ysr = y if isinstance(y, ShardedRows) else None
+            yh = None if ysr is not None else np.asarray(y)
+            blocks = []
+            for lo in range(0, n, chunk):
+                hi = min(lo + chunk, n)
+                xb = ShardedRows(
+                    data=X.data[lo:hi], mask=X.mask[lo:hi], n_samples=hi - lo
+                )
+                if ysr is not None:
+                    yb = ShardedRows(
+                        data=ysr.data[lo:hi], mask=ysr.mask[lo:hi],
+                        n_samples=hi - lo,
+                    )
+                else:
+                    yb = yh[lo:hi]
+                blocks.append((xb, yb))
+            return blocks
+        Xh = np.asarray(X)
         yh = unshard(y) if isinstance(y, ShardedRows) else np.asarray(y)
         n = Xh.shape[0]
         chunk = self.chunk_size or max(1, n // 10)
-        blocks = [
+        return [
             (Xh[lo: lo + chunk], yh[lo: lo + chunk])
             for lo in range(0, n, chunk)
         ]
-        return blocks
 
     # -- checkpoint plumbing (see dask_ml_tpu.checkpoint) ---------------
     def _checkpointer(self):
@@ -211,11 +244,30 @@ class BaseIncrementalSearchCV(TPUEstimator):
                 }
                 models[ident] = (model, meta)
 
+        # host (sklearn) models consume host views of device blocks; fetch
+        # each block's host copy ONCE for the whole search, not per call
+        # (benign write race from pool threads: all writers store the same
+        # value)
+        host_block_cache: dict = {}
+
+        def block_for(model, block_idx):
+            Xb, yb = blocks[block_idx]
+            if isinstance(Xb, ShardedRows) and not isinstance(
+                model, TPUEstimator
+            ):
+                if block_idx not in host_block_cache:
+                    host_block_cache[block_idx] = (
+                        unshard(Xb),
+                        unshard(yb) if isinstance(yb, ShardedRows) else yb,
+                    )
+                return host_block_cache[block_idx]
+            return Xb, yb
+
         def train_one(ident, n_calls):
             model, meta = models[ident]
             for _ in range(n_calls):
                 block_idx = meta["partial_fit_calls"] % n_blocks
-                Xb, yb = blocks[block_idx]
+                Xb, yb = block_for(model, block_idx)
                 model, meta = _partial_fit((model, meta), Xb, yb, fit_params)
             meta = _score((model, meta), X_test, y_test, scorer)
             meta["elapsed_wall_time"] = time.time() - start_time

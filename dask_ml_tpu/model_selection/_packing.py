@@ -121,6 +121,8 @@ class Cohort:
 
     # -- target prep (shared across the cohort: same y, same classes) ----
     def _prep(self, X, y):
+        from ..core.sharded import ShardedRows
+
         m0 = self._m0
         if isinstance(m0, SGDClassifier):
             for m in self.models:
@@ -131,9 +133,14 @@ class Cohort:
                             "classifiers (pass classes= to fit)"
                         )
                     m._set_classes(self._classes)
-            targets = m0._encode_targets(np.asarray(y))
+            if isinstance(y, ShardedRows) and isinstance(X, ShardedRows):
+                # device blocks (see _incremental._to_blocks): encode on
+                # device, zero host I/O on the packed training path
+                targets = m0._encode_targets_device(y.data, y.mask)
+            else:
+                targets = m0._encode_targets(np.asarray(y))
         else:
-            targets = m0._targets(y)
+            targets = m0._targets(y, X)
         xb, yb, mask = m0._prep_block(X, targets)
         for m in self.models:
             m._ensure_state(xb.shape[1])

@@ -315,3 +315,70 @@ class TestFloat16KMeans:
         km = KMeans(n_clusters=2, random_state=0).fit(shard_rows(X))
         got = np.sort(np.asarray(km.cluster_centers_)[:, 0].astype(np.float64))
         np.testing.assert_allclose(got, [0.0, 8.0], atol=1.0)
+
+
+class TestMiniBatchKMeans:
+    def test_recovers_blobs(self, blobs):
+        X, y = blobs
+        mbk = dc.MiniBatchKMeans(
+            n_clusters=4, batch_size=128, random_state=0, max_iter=50
+        ).fit(shard_rows(X))
+        assert adjusted_rand_score(y, np.asarray(mbk.labels_)) > 0.95
+        assert mbk.cluster_centers_.shape == (4, 5)
+        assert mbk.n_iter_ >= 1 and mbk.inertia_ > 0
+
+    def test_near_full_kmeans_quality(self, blobs):
+        X, y = blobs
+        mbk = dc.MiniBatchKMeans(
+            n_clusters=4, batch_size=128, random_state=0, max_iter=50
+        ).fit(X)
+        full = sc.KMeans(n_clusters=4, n_init=10, random_state=0).fit(X)
+        # Sculley's bound: minibatch inertia within a few % of Lloyd's
+        assert mbk.inertia_ <= full.inertia_ * 1.10
+
+    def test_partial_fit_streaming(self, blobs):
+        X, y = blobs
+        mbk = dc.MiniBatchKMeans(n_clusters=4, random_state=0)
+        for lo in range(0, len(X), 100):
+            mbk.partial_fit(X[lo:lo + 100])
+        assert mbk.n_steps_ == 5
+        pred = np.asarray(mbk.predict(X))
+        assert adjusted_rand_score(y, pred) > 0.9
+
+    def test_incremental_wrapper_streams_device_model(self, blobs):
+        from dask_ml_tpu.wrappers import Incremental
+
+        X, y = blobs
+        inc = Incremental(
+            dc.MiniBatchKMeans(n_clusters=4, random_state=0), chunk_size=100
+        ).fit(shard_rows(X))
+        pred = np.asarray(inc.estimator_.predict(X))
+        assert adjusted_rand_score(y, pred) > 0.9
+
+    def test_transform_and_score(self, blobs):
+        X, y = blobs
+        mbk = dc.MiniBatchKMeans(n_clusters=4, random_state=0, max_iter=20).fit(X)
+        d = np.asarray(mbk.transform(X[:10]))
+        assert d.shape == (10, 4) and (d >= 0).all()
+        assert mbk.score(X) == pytest.approx(-mbk.inertia_, rel=1e-5)
+
+    def test_uneven_rows_pad_mask(self, rng):
+        X = rng.normal(size=(1003, 3)).astype(np.float32)
+        mbk = dc.MiniBatchKMeans(n_clusters=3, random_state=0, max_iter=10)
+        mbk.fit(shard_rows(X))
+        assert mbk.labels_.shape == (1003,)
+
+    def test_init_array_and_random(self, blobs):
+        X, y = blobs
+        init = X[:4].copy()
+        mbk = dc.MiniBatchKMeans(n_clusters=4, init=init, max_iter=10).fit(X)
+        assert mbk.cluster_centers_.shape == (4, 5)
+        mbk2 = dc.MiniBatchKMeans(
+            n_clusters=4, init="random", random_state=3, max_iter=10
+        ).fit(X)
+        assert mbk2.cluster_centers_.shape == (4, 5)
+
+    def test_partial_fit_requires_enough_samples(self, rng):
+        X = rng.normal(size=(3, 2)).astype(np.float32)
+        with pytest.raises(ValueError, match="n_samples"):
+            dc.MiniBatchKMeans(n_clusters=8).partial_fit(X)

@@ -176,3 +176,35 @@ class TestPackedValidationParity:
             Cohort(
                 [SGDClassifier(), SGDClassifier(alpha=1e-3)], classes=[0]
             ).step(X, np.zeros(50))
+
+
+class TestDeviceResidentSearchPath:
+    def test_device_input_search_never_unshards(self, rng, monkeypatch):
+        """ShardedRows input + device-native SGD models: the adaptive
+        search's TRAINING plane must do zero O(n) device→host transfers
+        (blocks are device slices, targets encode on device).  Only the
+        held-out test split may cross to host (scorers are host-side)."""
+        import dask_ml_tpu.model_selection._incremental as inc
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.model_selection import IncrementalSearchCV
+
+        X, y = _data(rng, n=400)
+        sX, sy = shard_rows(X), shard_rows(y.astype(np.float32))
+
+        real_unshard = inc.unshard
+        calls = []
+
+        def counting_unshard(a):
+            calls.append(getattr(a, "n_samples", None))
+            return real_unshard(a)
+
+        monkeypatch.setattr(inc, "unshard", counting_unshard)
+        search = IncrementalSearchCV(
+            SGDClassifier(learning_rate="constant", eta0=0.1),
+            {"alpha": [1e-4, 1e-3]},
+            n_initial_parameters=2, max_iter=3, random_state=0,
+        )
+        search.fit(sX, sy, classes=[0.0, 1.0])
+        assert search.best_score_ > 0
+        # the only permitted unshards are the test split (~15% of rows)
+        assert all(c is not None and c <= 0.2 * 400 for c in calls), calls
