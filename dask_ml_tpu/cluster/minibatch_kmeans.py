@@ -125,9 +125,9 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
             return c
         if self.init == "random":
             p = X.mask / jnp.sum(X.mask)
+            # _ensure_state already rejected n_samples < n_clusters
             idx = jax.random.choice(
-                key, X.data.shape[0], (self.n_clusters,),
-                replace=X.n_samples < self.n_clusters, p=p,
+                key, X.data.shape[0], (self.n_clusters,), replace=False, p=p,
             )
             return jnp.take(X.data, idx, axis=0)
         if self.init in ("k-means++", "k-means||"):
@@ -138,9 +138,10 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
             n_sample = int(min(X.n_samples, max(1000, 50 * self.n_clusters)))
             key, sub = jax.random.split(key)
             p = X.mask / jnp.sum(X.mask)
+            # n_sample = min(n_samples, ...) so sampling w/o replacement
+            # is always valid
             idx = jax.random.choice(
-                sub, X.data.shape[0], (n_sample,),
-                replace=n_sample > X.n_samples, p=p,
+                sub, X.data.shape[0], (n_sample,), replace=False, p=p,
             )
             sample = np.asarray(jnp.take(X.data, idx, axis=0), np.float64)
             seed = int(draw_seed(int(jax.random.randint(key, (), 0, 2**31 - 1))))
@@ -169,17 +170,11 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
         ragged chunk sizes compiles a handful of programs, not one per
         distinct length."""
         if not isinstance(X, ShardedRows):
-            from ..linear_model._sgd import _bucket_rows
+            from ..linear_model._sgd import _bucket_pad
 
             Xh = np.asarray(X, dtype=np.float32)
             n = Xh.shape[0]
-            b = _bucket_rows(n)
-            if b != n:
-                Xh = np.concatenate(
-                    [Xh, np.zeros((b - n, Xh.shape[1]), np.float32)]
-                )
-            mask = np.zeros(b, dtype=np.float32)
-            mask[:n] = 1.0
+            Xh, _, mask = _bucket_pad(Xh)
             X = ShardedRows(
                 data=jnp.asarray(Xh), mask=jnp.asarray(mask), n_samples=n
             )

@@ -62,6 +62,26 @@ def _bucket_rows(n: int) -> int:
     return ((n + top - 1) // top) * top
 
 
+def _bucket_pad(X: np.ndarray, targets: np.ndarray | None = None):
+    """Zero-pad host block rows to the bucket size, with a validity mask.
+
+    Shared by the SGD `_prep_block` host branch and MiniBatchKMeans'
+    streaming ingest, so the bucketing discipline cannot drift between
+    the two.  Returns ``(X_padded, targets_padded_or_None, mask)``.
+    """
+    n = X.shape[0]
+    b = _bucket_rows(n)
+    mask = np.zeros(b, dtype=np.float32)
+    mask[:n] = 1.0
+    if b != n:
+        X = np.concatenate([X, np.zeros((b - n, X.shape[1]), X.dtype)])
+        if targets is not None:
+            targets = np.concatenate(
+                [targets, np.zeros((b - n, targets.shape[1]), targets.dtype)]
+            )
+    return X, targets, mask
+
+
 def _margin_losses(loss: str, margins, ysigned):
     """Per-row, per-class loss and dLoss/dMargin for ±1 targets.
 
@@ -264,28 +284,32 @@ class _BaseSGD(TPUEstimator):
         streamed chunks don't recompile per shape.
         """
         if isinstance(X, ShardedRows):
+            # keep floating X as-is: bf16 rows halve HBM traffic and the
+            # step's gemms promote to f32 internally; an eager astype here
+            # would materialize an f32 copy on device EVERY call
+            xd = X.data
+            if not jnp.issubdtype(xd.dtype, jnp.floating):
+                xd = xd.astype(jnp.float32)
             if isinstance(targets, jnp.ndarray):
                 # device-encoded targets (see _encode_targets_device):
                 # already row-aligned with X.data, nothing crosses to host
-                return X.data.astype(jnp.float32), targets, X.mask
-            from ..core.sharded import shard_rows
-
-            return (
-                X.data.astype(jnp.float32),
-                shard_rows(np.asarray(targets, np.float32)).data,
-                X.mask,
-            )
-        X = np.asarray(X, dtype=np.float32)
-        targets = np.asarray(targets, dtype=np.float32)
-        n = X.shape[0]
-        b = _bucket_rows(n)
-        mask = np.zeros(b, dtype=np.float32)
-        mask[:n] = 1.0
-        if b != n:
-            X = np.concatenate([X, np.zeros((b - n, X.shape[1]), np.float32)])
-            targets = np.concatenate(
-                [targets, np.zeros((b - n, targets.shape[1]), np.float32)]
-            )
+                return xd, targets, X.mask
+            # host-encoded targets must match xd's row count EXACTLY —
+            # X may be a relaxed _to_blocks slice whose length is NOT a
+            # data-axis multiple, so re-sharding targets (which pads to
+            # that multiple) would diverge from xd on multi-device meshes
+            t = np.asarray(targets, np.float32)
+            if t.shape[0] != xd.shape[0]:
+                t = np.concatenate([
+                    t,
+                    np.zeros((xd.shape[0] - t.shape[0], t.shape[1]),
+                             np.float32),
+                ])
+            return xd, jnp.asarray(t), X.mask
+        X, targets, mask = _bucket_pad(
+            np.asarray(X, dtype=np.float32),
+            np.asarray(targets, dtype=np.float32),
+        )
         return jnp.asarray(X), jnp.asarray(targets), jnp.asarray(mask)
 
     def _step_block(self, xb, yb, mask, hyper=None):

@@ -54,7 +54,16 @@ def _prep(X, y):
         yv = jnp.asarray(np.asarray(y))
         if yv.shape[0] != x.shape[0]:
             yv = jnp.pad(yv, (0, x.shape[0] - yv.shape[0]))
-    return x, yv.astype(x.dtype), mask
+    # mixed precision: X may stay half (bf16 halves its HBM traffic, the
+    # dominant cost of every solver pass); parameters, targets, and every
+    # reduction run in >= float32 — XLA fuses the widening into the matvec
+    # so no f32 copy of X ever materializes
+    return x, yv.astype(_param_dtype(x)), mask
+
+
+def _param_dtype(x):
+    """Accumulation/parameter dtype for a design matrix: at least f32."""
+    return jnp.promote_types(x.dtype, jnp.float32)
 
 
 def _make_objective(family, reg, x, y, mask, lamduh):
@@ -101,10 +110,10 @@ def lbfgs(X, y, *, family: type[Family] = Logistic, regularizer=L2,
             "Use proximal_grad or admm for l1/elastic_net."
         )
     x, yv, mask = _prep(X, y)
-    beta0 = jnp.zeros(x.shape[1], dtype=x.dtype)
+    beta0 = jnp.zeros(x.shape[1], dtype=_param_dtype(x))
     return _lbfgs_run(
-        x, yv, mask, beta0, jnp.asarray(lamduh, x.dtype),
-        jnp.int32(max_iter), jnp.asarray(tol, x.dtype),
+        x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
+        jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
         family=family, reg=reg,
     )
 
@@ -147,10 +156,10 @@ def gradient_descent(X, y, *, family: type[Family] = Logistic,
     if lamduh and not reg.smooth:
         raise ValueError("gradient_descent requires a smooth penalty; use proximal_grad")
     x, yv, mask = _prep(X, y)
-    beta0 = jnp.zeros(x.shape[1], dtype=x.dtype)
+    beta0 = jnp.zeros(x.shape[1], dtype=_param_dtype(x))
     return _gd_run(
-        x, yv, mask, beta0, jnp.asarray(lamduh, x.dtype),
-        jnp.int32(max_iter), jnp.asarray(tol, x.dtype),
+        x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
+        jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
         family=family, reg=reg,
     )
 
@@ -206,10 +215,10 @@ def proximal_grad(X, y, *, family: type[Family] = Logistic, regularizer=L2,
     ``proximal_grad``): z = prox_{tλ}(β − t∇f(β))."""
     reg = get_regularizer(regularizer)
     x, yv, mask = _prep(X, y)
-    beta0 = jnp.zeros(x.shape[1], dtype=x.dtype)
+    beta0 = jnp.zeros(x.shape[1], dtype=_param_dtype(x))
     return _pg_run(
-        x, yv, mask, beta0, jnp.asarray(lamduh, x.dtype),
-        jnp.int32(max_iter), jnp.asarray(tol, x.dtype),
+        x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
+        jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
         family=family, reg=reg,
     )
 
@@ -229,8 +238,8 @@ def _newton_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg):
         w = family.hessian_weights(eta) * mask
         H = (x * w[:, None]).T @ x  # (d, d) psum-reduced gemm
         if reg.smooth:
-            H = H + lamduh * jnp.eye(d, dtype=x.dtype)
-        H = H + 1e-8 * jnp.eye(d, dtype=x.dtype)
+            H = H + lamduh * jnp.eye(d, dtype=_param_dtype(x))
+        H = H + 1e-8 * jnp.eye(d, dtype=_param_dtype(x))
         p = -jnp.linalg.solve(H, g)
         t, f_new, failed = _backtrack(obj, beta, f, g, p, 1e-4, 30)
         return beta + t * p, f, f_new
@@ -261,10 +270,10 @@ def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
     if lamduh and not reg.smooth:
         raise ValueError("newton requires a smooth penalty")
     x, yv, mask = _prep(X, y)
-    beta0 = jnp.zeros(x.shape[1], dtype=x.dtype)
+    beta0 = jnp.zeros(x.shape[1], dtype=_param_dtype(x))
     return _newton_run(
-        x, yv, mask, beta0, jnp.asarray(lamduh, x.dtype),
-        jnp.int32(max_iter), jnp.asarray(tol, x.dtype),
+        x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
+        jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
         family=family, reg=reg,
     )
 
@@ -323,7 +332,7 @@ def _admm_run(x, yv, mask, lamduh, rho, abstol, reltol, inner_tol, max_it,
 
     # Boyd residual stopping rule, also on device: the whole solve is one
     # XLA program regardless of iteration count.
-    sqrt_d = jnp.sqrt(jnp.asarray(d, x.dtype))
+    sqrt_d = jnp.sqrt(jnp.asarray(d, _param_dtype(x)))
 
     def cond(state):
         i, _, _, _, primal, dual, eps_pri, eps_dual = state
@@ -343,11 +352,11 @@ def _admm_run(x, yv, mask, lamduh, rho, abstol, reltol, inner_tol, max_it,
         eps_dual = sqrt_d * abstol + reltol * rho * jnp.sqrt(u_sq)
         return i + 1, beta_l, u_l, z, primal, dual, eps_pri, eps_dual
 
-    inf = jnp.asarray(jnp.inf, x.dtype)
-    zero = jnp.asarray(0.0, x.dtype)
-    beta_l0 = jnp.zeros((n_shards, d), dtype=x.dtype)
-    u_l0 = jnp.zeros((n_shards, d), dtype=x.dtype)
-    z0 = jnp.zeros(d, dtype=x.dtype)
+    inf = jnp.asarray(jnp.inf, _param_dtype(x))
+    zero = jnp.asarray(0.0, _param_dtype(x))
+    beta_l0 = jnp.zeros((n_shards, d), dtype=_param_dtype(x))
+    u_l0 = jnp.zeros((n_shards, d), dtype=_param_dtype(x))
+    z0 = jnp.zeros(d, dtype=_param_dtype(x))
     init = (jnp.int32(0), beta_l0, u_l0, z0, inf, inf, zero, zero)
     return lax.while_loop(cond, body, init)[3]
 
@@ -369,7 +378,7 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
     reg = get_regularizer(regularizer)
     mesh = mesh or get_mesh()
     x, yv, mask = _prep(X, y)
-    dt = x.dtype
+    dt = _param_dtype(x)
     return _admm_run(
         x, yv, mask,
         jnp.asarray(lamduh, dt), jnp.asarray(rho, dt),

@@ -208,3 +208,21 @@ class TestDeviceResidentSearchPath:
         assert search.best_score_ > 0
         # the only permitted unshards are the test split (~15% of rows)
         assert all(c is not None and c <= 0.2 * 400 for c in calls), calls
+
+    def test_device_blocks_with_host_labels(self, rng):
+        """Relaxed device X blocks (length NOT a data-axis multiple) +
+        host numpy y: host-encoded targets must align with the block's
+        exact row count (regression: re-sharding targets padded them to
+        the 8-device multiple and diverged from xb)."""
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.model_selection import IncrementalSearchCV
+
+        X, y = _data(rng, n=410)  # 410/4-block chunks are not 8-multiples
+        search = IncrementalSearchCV(
+            SGDClassifier(learning_rate="constant", eta0=0.1),
+            {"alpha": [1e-4, 1e-3]},
+            n_initial_parameters=2, max_iter=2, random_state=0,
+            chunk_size=103,
+        )
+        search.fit(shard_rows(X), y, classes=[0.0, 1.0])
+        assert search.best_score_ > 0

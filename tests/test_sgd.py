@@ -308,3 +308,21 @@ class TestReviewRegressions2:
         ok = SGDClassifier(alpha=1e-4, learning_rate="optimal")
         with pytest.raises(ValueError, match="alpha"):
             Cohort([bad, ok], classes=[0, 1])
+
+
+class TestMixedPrecisionSGD:
+    def test_bf16_blocks_train_f32_params(self, rng):
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X = rng.normal(size=(512, 6)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        sX = shard_rows(X, dtype=jnp.bfloat16)
+        sy = shard_rows(y)
+        clf = SGDClassifier(learning_rate="constant", eta0=0.3, max_iter=80)
+        clf.fit(sX, sy)
+        assert clf._state["coef"].dtype == jnp.float32
+        acc = (np.asarray(clf.predict(sX)) == y).mean()
+        assert acc > 0.9
