@@ -12,12 +12,22 @@
 //   dmlt_csv_dims(path, has_header, &rows, &cols)
 //   dmlt_csv_read_f32(path, has_header, row_start, rows, cols, out, n_threads)
 //   dmlt_bin_read_f32(path, offset_bytes, count, out)
+// Streaming session (file read + line index built ONCE, a background
+// worker parses blocks ahead of the consumer into a bounded ring —
+// the per-block re-scan of the naive path is O(blocks * filesize)):
+//   dmlt_stream_open(path, has_header, block_rows, n_threads, depth,
+//                    &rows, &cols, &err) -> handle (NULL on error)
+//   dmlt_stream_next(handle, out, &rows_out)   (rows_out=0 at EOF)
+//   dmlt_stream_close(handle)
 
 #include <cerrno>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -112,9 +122,159 @@ void parse_rows(const FileBuf& buf, const std::vector<size_t>& starts,
     }
 }
 
+// Parse rows [r0, r1) with an inner thread fan-out (same splitting as
+// dmlt_csv_read_f32).  Returns 0 or the first worker's error.
+int parse_rows_mt(const FileBuf& buf, const std::vector<size_t>& starts,
+                  size_t r0, size_t r1, long cols, float* out,
+                  int n_threads) {
+    int64_t rows = static_cast<int64_t>(r1 - r0);
+    if (n_threads < 1) n_threads = 1;
+    if (static_cast<int64_t>(n_threads) > rows) n_threads = rows > 0 ? rows : 1;
+    std::vector<std::thread> threads;
+    std::vector<int> errs(n_threads, 0);
+    int64_t per = (rows + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; t++) {
+        int64_t a = t * per;
+        int64_t b = std::min(rows, a + per);
+        if (a >= b) break;
+        threads.emplace_back([&, t, a, b] {
+            parse_rows(buf, starts, r0 + a, r0 + b, cols, out + a * cols,
+                       &errs[t]);
+        });
+    }
+    for (auto& th : threads) th.join();
+    for (int e : errs)
+        if (e) return e;
+    return 0;
+}
+
+struct Stream {
+    FileBuf buf;
+    std::vector<size_t> starts;
+    size_t next_row = 0;   // worker's cursor (absolute line index)
+    size_t end_row = 0;    // one past the last data line
+    long cols = 0;
+    int64_t block_rows = 0;
+    int n_threads = 1;
+    size_t depth = 2;
+
+    struct Block {
+        std::vector<float> data;
+        int64_t rows = 0;
+    };
+    std::deque<Block> ready;
+    std::mutex mu;
+    std::condition_variable cv_ready;   // consumer waits: a block or EOF/err
+    std::condition_variable cv_space;   // worker waits: ring has space
+    bool done = false;   // worker finished (EOF or error)
+    bool stop = false;   // close() requested
+    int err = 0;
+    std::thread worker;
+
+    void run() {
+        while (true) {
+            size_t r0 = next_row;
+            size_t r1 = std::min(end_row, r0 + static_cast<size_t>(block_rows));
+            if (r0 >= r1) break;
+            Block b;
+            b.rows = static_cast<int64_t>(r1 - r0);
+            b.data.resize(static_cast<size_t>(b.rows) * cols);
+            int rc = parse_rows_mt(buf, starts, r0, r1, cols, b.data.data(),
+                                   n_threads);
+            std::unique_lock<std::mutex> lk(mu);
+            if (rc) {
+                err = rc;
+                break;
+            }
+            cv_space.wait(lk, [&] { return ready.size() < depth || stop; });
+            if (stop) break;
+            ready.push_back(std::move(b));
+            next_row = r1;
+            cv_ready.notify_one();
+        }
+        std::lock_guard<std::mutex> lk(mu);
+        done = true;
+        cv_ready.notify_all();
+    }
+};
+
 }  // namespace
 
 extern "C" {
+
+void* dmlt_stream_open(const char* path, int has_header, int64_t block_rows,
+                       int n_threads, int depth, int64_t* rows, int64_t* cols,
+                       int* err) {
+    auto* s = new Stream();
+    int rc = read_file(path, s->buf);
+    if (rc) {
+        *err = rc;
+        delete s;
+        return nullptr;
+    }
+    line_starts(s->buf, s->starts);
+    size_t skip = has_header ? 1 : 0;
+    size_t n = s->starts.size();
+    if (n <= skip) {
+        *rows = 0;
+        *cols = 0;
+        *err = 0;
+        s->next_row = s->end_row = 0;
+        s->block_rows = block_rows > 0 ? block_rows : 1;
+        // no worker needed: EOF immediately
+        s->done = true;
+        return s;
+    }
+    const char* first = s->buf.data + s->starts[skip];
+    const char* end =
+        s->buf.data + (skip + 1 < n ? s->starts[skip + 1] : s->buf.size);
+    s->cols = count_cols(first, end);
+    s->next_row = skip;
+    s->end_row = n;
+    s->block_rows = block_rows > 0 ? block_rows : 1;
+    s->n_threads = n_threads > 0 ? n_threads : 1;
+    s->depth = depth > 0 ? static_cast<size_t>(depth) : 1;
+    *rows = static_cast<int64_t>(n - skip);
+    *cols = s->cols;
+    *err = 0;
+    s->worker = std::thread([s] { s->run(); });
+    return s;
+}
+
+// Copies the next parsed block into `out` (caller-sized to
+// block_rows*cols floats).  rows_out = 0 signals EOF.  Blocks until the
+// prefetch worker has a block ready.
+int dmlt_stream_next(void* handle, float* out, int64_t* rows_out) {
+    auto* s = static_cast<Stream*>(handle);
+    std::unique_lock<std::mutex> lk(s->mu);
+    s->cv_ready.wait(lk, [&] { return !s->ready.empty() || s->done; });
+    if (s->ready.empty()) {
+        // drained: surface a worker error only AFTER every valid block
+        // parsed before it has been delivered (the sequential path's
+        // deterministic prefix semantics)
+        if (s->err) return s->err;
+        *rows_out = 0;  // EOF
+        return 0;
+    }
+    Stream::Block b = std::move(s->ready.front());
+    s->ready.pop_front();
+    s->cv_space.notify_one();
+    lk.unlock();
+    std::memcpy(out, b.data.data(), b.data.size() * sizeof(float));
+    *rows_out = b.rows;
+    return 0;
+}
+
+void dmlt_stream_close(void* handle) {
+    auto* s = static_cast<Stream*>(handle);
+    {
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->stop = true;
+        s->cv_space.notify_all();
+    }
+    if (s->worker.joinable()) s->worker.join();
+    delete s;
+}
 
 int dmlt_csv_dims(const char* path, int has_header, int64_t* rows, int64_t* cols) {
     FileBuf buf;
@@ -145,25 +305,7 @@ int dmlt_csv_read_f32(const char* path, int has_header, int64_t row_start,
     line_starts(buf, starts);
     size_t skip = (has_header ? 1 : 0) + static_cast<size_t>(row_start);
     if (starts.size() < skip + rows) return -ERANGE;
-
-    if (n_threads < 1) n_threads = 1;
-    if (static_cast<int64_t>(n_threads) > rows) n_threads = rows > 0 ? rows : 1;
-    std::vector<std::thread> threads;
-    std::vector<int> errs(n_threads, 0);
-    int64_t per = (rows + n_threads - 1) / n_threads;
-    for (int t = 0; t < n_threads; t++) {
-        int64_t r0 = t * per;
-        int64_t r1 = std::min(rows, r0 + per);
-        if (r0 >= r1) break;
-        threads.emplace_back([&, t, r0, r1] {
-            parse_rows(buf, starts, skip + r0, skip + r1, cols,
-                       out + r0 * cols, &errs[t]);
-        });
-    }
-    for (auto& th : threads) th.join();
-    for (int e : errs)
-        if (e) return e;
-    return 0;
+    return parse_rows_mt(buf, starts, skip, skip + rows, cols, out, n_threads);
 }
 
 int dmlt_bin_read_f32(const char* path, int64_t offset_bytes, int64_t count,

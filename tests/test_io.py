@@ -123,3 +123,52 @@ class TestIncrementalPipeline:
             lo += len(block)
         acc = (inc.predict(X) == y).mean()
         assert acc > 0.8
+
+
+class TestNativeStreamSession:
+    def test_blocks_match_full_read(self, tmp_path, rng):
+        p = tmp_path / "s.csv"
+        X = rng.normal(size=(997, 5)).astype(np.float32)
+        np.savetxt(p, X, delimiter=",", fmt="%.6f")
+        full = dio.read_csv(str(p))
+        blocks = list(dio.stream_csv_blocks(str(p), 100, prefetch=3))
+        assert [b.shape[0] for b in blocks] == [100] * 9 + [97]
+        np.testing.assert_array_equal(np.concatenate(blocks), full)
+
+    def test_abandoned_generator_closes_cleanly(self, tmp_path, rng):
+        p = tmp_path / "s.csv"
+        np.savetxt(p, rng.normal(size=(500, 3)), delimiter=",", fmt="%.4f")
+        gen = dio.stream_csv_blocks(str(p), 50, prefetch=2)
+        next(gen)
+        next(gen)
+        gen.close()  # must join the native worker without hanging
+
+    def test_malformed_row_errors(self, tmp_path):
+        p = tmp_path / "bad.csv"
+        p.write_text("1.0,2.0\n3.0\n5.0,6.0\n")
+        with pytest.raises(OSError):
+            list(dio.stream_csv_blocks(str(p), 2))
+
+    def test_empty_file(self, tmp_path):
+        p = tmp_path / "empty.csv"
+        p.write_text("")
+        assert list(dio.stream_csv_blocks(str(p), 10)) == []
+
+    def test_error_surfaces_after_valid_prefix(self, tmp_path):
+        """All valid blocks before a malformed row are yielded, THEN the
+        error raises — deterministic prefix despite prefetch."""
+        p = tmp_path / "mid.csv"
+        lines = ["%d.0,%d.0" % (i, i) for i in range(10)]
+        lines[7] = "bad_row"
+        p.write_text("\n".join(lines) + "\n")
+        got = []
+        with pytest.raises(OSError):
+            for b in dio.stream_csv_blocks(str(p), 2, prefetch=4):
+                got.append(b)
+        assert len(got) == 3  # rows 0-5 (3 full blocks before row 7's block)
+
+    def test_zero_block_rows_rejected(self, tmp_path):
+        p = tmp_path / "z.csv"
+        p.write_text("1.0,2.0\n")
+        with pytest.raises(ValueError, match="block_rows"):
+            next(dio.stream_csv_blocks(str(p), 0))
