@@ -78,13 +78,16 @@ class _GLM(TPUEstimator):
             kwargs["tol"] = self.tol
         else:  # admm
             kwargs["abstol"] = self.tol
-        return solve(X, y, **kwargs)
+        return solve(X, y, return_n_iter=True, **kwargs)
 
     def fit(self, X, y=None):
         X = _ingest_float(self, X)
         self.n_features_in_ = X.data.shape[1]
         Xi = add_intercept(X) if self.fit_intercept else X
-        beta = self._solve(Xi, y)
+        beta, n_it = self._solve(Xi, y)
+        # sklearn contract: iteration count(s) of the solver run(s);
+        # converted only now, after the solve is dispatched
+        self.n_iter_ = np.asarray([n_it], dtype=np.int32)
         if self.fit_intercept:
             self.coef_ = beta[:-1]
             self.intercept_ = float(beta[-1])
@@ -175,14 +178,20 @@ class LogisticRegression(_GLM):
 
         if len(self.classes_) == 2:
             y01 = _indicator(self.classes_[1])
-            beta = self._solve(Xi, y01)
+            beta, n_it = self._solve(Xi, y01)
             self.betas_ = beta[None, :]
+            n_iter_runs = [n_it]
         else:
-            betas = []
+            betas, n_iter_runs = [], []
             for cls in self.classes_:
                 y01 = _indicator(cls)
-                betas.append(self._solve(Xi, y01))
+                beta, n_it = self._solve(Xi, y01)
+                betas.append(beta)
+                n_iter_runs.append(n_it)
             self.betas_ = jnp.stack(betas)  # (K, d[+1])
+        # sklearn contract: one count per OvR solve — device scalars are
+        # converted only here, after every class's solve has dispatched
+        self.n_iter_ = np.asarray(n_iter_runs, dtype=np.int32)
         if self.fit_intercept:
             self.coef_ = (
                 self.betas_[0, :-1] if len(self.classes_) == 2

@@ -93,11 +93,13 @@ def _converged(f_prev, f_new, tol):
 @partial(jax.jit, static_argnames=("family", "reg"))
 def _lbfgs_run(x, yv, mask, beta0, lamduh, max_iter, tol, *, family, reg):
     obj = _make_objective(family, reg, x, yv, mask, lamduh)
-    return lbfgs_minimize(obj, beta0, max_iter=max_iter, tol=tol)[0]
+    beta, st = lbfgs_minimize(obj, beta0, max_iter=max_iter, tol=tol)
+    return beta, st.k
 
 
 def lbfgs(X, y, *, family: type[Family] = Logistic, regularizer=L2,
-          lamduh: float = 0.0, max_iter: int = 100, tol: float = 1e-5):
+          lamduh: float = 0.0, max_iter: int = 100, tol: float = 1e-5,
+          return_n_iter: bool = False):
     """Full-gradient L-BFGS on the total (smooth) objective.
 
     Reference: ``dask_glm/algorithms.py :: lbfgs`` (scipy driver with
@@ -111,11 +113,14 @@ def lbfgs(X, y, *, family: type[Family] = Logistic, regularizer=L2,
         )
     x, yv, mask = _prep(X, y)
     beta0 = jnp.zeros(x.shape[1], dtype=_param_dtype(x))
-    return _lbfgs_run(
+    beta, n_it = _lbfgs_run(
         x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
         jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
         family=family, reg=reg,
     )
+    # n_it stays a device scalar: converting here would block the
+    # async dispatch pipeline (callers convert after ALL solves)
+    return (beta, n_it) if return_n_iter else beta
 
 
 # ---------------------------------------------------- gradient descent --
@@ -145,23 +150,28 @@ def _gd_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg):
         jnp.asarray(jnp.inf, beta0.dtype),
         jnp.asarray(False),
     )
-    return lax.while_loop(cond, body, init)[1]
+    final = lax.while_loop(cond, body, init)
+    return final[1], final[0]
 
 
 def gradient_descent(X, y, *, family: type[Family] = Logistic,
                      regularizer=L2, lamduh: float = 0.0,
-                     max_iter: int = 100, tol: float = 1e-7):
+                     max_iter: int = 100, tol: float = 1e-7,
+          return_n_iter: bool = False):
     """Armijo-backtracking gradient descent (reference ``gradient_descent``)."""
     reg = get_regularizer(regularizer)
     if lamduh and not reg.smooth:
         raise ValueError("gradient_descent requires a smooth penalty; use proximal_grad")
     x, yv, mask = _prep(X, y)
     beta0 = jnp.zeros(x.shape[1], dtype=_param_dtype(x))
-    return _gd_run(
+    beta, n_it = _gd_run(
         x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
         jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
         family=family, reg=reg,
     )
+    # n_it stays a device scalar: converting here would block the
+    # async dispatch pipeline (callers convert after ALL solves)
+    return (beta, n_it) if return_n_iter else beta
 
 
 # ------------------------------------------------------ proximal grad --
@@ -206,21 +216,26 @@ def _pg_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg):
         jnp.asarray(jnp.inf, beta0.dtype),
         jnp.asarray(False),
     )
-    return lax.while_loop(cond, body, init)[1]
+    final = lax.while_loop(cond, body, init)
+    return final[1], final[0]
 
 
 def proximal_grad(X, y, *, family: type[Family] = Logistic, regularizer=L2,
-                  lamduh: float = 0.0, max_iter: int = 100, tol: float = 1e-7):
+                  lamduh: float = 0.0, max_iter: int = 100, tol: float = 1e-7,
+          return_n_iter: bool = False):
     """Proximal gradient with backtracking on the smooth part (reference
     ``proximal_grad``): z = prox_{tλ}(β − t∇f(β))."""
     reg = get_regularizer(regularizer)
     x, yv, mask = _prep(X, y)
     beta0 = jnp.zeros(x.shape[1], dtype=_param_dtype(x))
-    return _pg_run(
+    beta, n_it = _pg_run(
         x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
         jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
         family=family, reg=reg,
     )
+    # n_it stays a device scalar: converting here would block the
+    # async dispatch pipeline (callers convert after ALL solves)
+    return (beta, n_it) if return_n_iter else beta
 
 
 # ------------------------------------------------------------- newton --
@@ -259,11 +274,13 @@ def _newton_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg):
         jnp.asarray(jnp.inf, beta0.dtype),
         jnp.asarray(False),
     )
-    return lax.while_loop(cond, body, init)[1]
+    final = lax.while_loop(cond, body, init)
+    return final[1], final[0]
 
 
 def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
-           lamduh: float = 0.0, max_iter: int = 50, tol: float = 1e-8):
+           lamduh: float = 0.0, max_iter: int = 50, tol: float = 1e-8,
+          return_n_iter: bool = False):
     """Damped Newton: distributed Hessian XᵀWX (one psum-reduced gemm),
     replicated (d×d) solve (reference ``newton``)."""
     reg = get_regularizer(regularizer)
@@ -271,11 +288,14 @@ def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
         raise ValueError("newton requires a smooth penalty")
     x, yv, mask = _prep(X, y)
     beta0 = jnp.zeros(x.shape[1], dtype=_param_dtype(x))
-    return _newton_run(
+    beta, n_it = _newton_run(
         x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
         jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
         family=family, reg=reg,
     )
+    # n_it stays a device scalar: converting here would block the
+    # async dispatch pipeline (callers convert after ALL solves)
+    return (beta, n_it) if return_n_iter else beta
 
 
 # --------------------------------------------------------------- admm --
@@ -358,13 +378,15 @@ def _admm_run(x, yv, mask, lamduh, rho, abstol, reltol, inner_tol, max_it,
     u_l0 = jnp.zeros((n_shards, d), dtype=_param_dtype(x))
     z0 = jnp.zeros(d, dtype=_param_dtype(x))
     init = (jnp.int32(0), beta_l0, u_l0, z0, inf, inf, zero, zero)
-    return lax.while_loop(cond, body, init)[3]
+    final = lax.while_loop(cond, body, init)
+    return final[3], final[0]
 
 
 def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
          lamduh: float = 0.0, rho: float = 1.0, max_iter: int = 100,
          abstol: float = 1e-4, reltol: float = 1e-2,
-         inner_iter: int = 50, inner_tol: float = 1e-6, mesh=None):
+         inner_iter: int = 50, inner_tol: float = 1e-6, mesh=None,
+          return_n_iter: bool = False):
     """Consensus ADMM (Boyd et al. §8): per-shard local subproblems solved by
     the jit-safe L-BFGS inside ``shard_map``, consensus z through the
     regularizer's prox, scaled dual updates.
@@ -379,7 +401,7 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
     mesh = mesh or get_mesh()
     x, yv, mask = _prep(X, y)
     dt = _param_dtype(x)
-    return _admm_run(
+    beta, n_it = _admm_run(
         x, yv, mask,
         jnp.asarray(lamduh, dt), jnp.asarray(rho, dt),
         jnp.asarray(abstol, dt), jnp.asarray(reltol, dt),
@@ -387,3 +409,6 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
         family=family, reg=reg, mesh_holder=MeshHolder(mesh),
         inner_iter=inner_iter,
     )
+    # n_it stays a device scalar: converting here would block the
+    # async dispatch pipeline (callers convert after ALL solves)
+    return (beta, n_it) if return_n_iter else beta

@@ -173,3 +173,23 @@ class TestMixedPrecision:
         )
         assert np.asarray(lr.coef_).dtype == np.float32
         assert lr.score(shard_rows(X), y) > 0.85
+
+
+class TestNIter:
+    @pytest.mark.parametrize("solver", ["admm", "lbfgs", "newton",
+                                        "gradient_descent", "proximal_grad"])
+    def test_n_iter_recorded(self, clf_data, solver):
+        X, y = clf_data
+        lr = dlm.LogisticRegression(solver=solver).fit(shard_rows(X), y)
+        assert lr.n_iter_.shape == (1,) and 1 <= lr.n_iter_[0] <= lr.max_iter
+
+    def test_multiclass_n_iter_per_class(self, rng):
+        X = rng.normal(size=(300, 5)).astype(np.float32)
+        y = rng.randint(0, 3, size=300)
+        lr = dlm.LogisticRegression(solver="lbfgs").fit(shard_rows(X), y)
+        assert lr.n_iter_.shape == (3,)
+
+    def test_linear_regression_n_iter(self, reg_data):
+        X, y = reg_data
+        lr = dlm.LinearRegression(solver="lbfgs").fit(shard_rows(X), y)
+        assert lr.n_iter_.shape == (1,)
