@@ -10,7 +10,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from ..base import TPUEstimator
+from ..base import ClassifierMixin, RegressorMixin, TPUEstimator
 from ..core.sharded import ShardedRows
 from ..preprocessing.data import _ingest_float
 from ..solvers import (
@@ -109,7 +109,7 @@ class _GLM(TPUEstimator):
         raise NotImplementedError
 
 
-class LogisticRegression(_GLM):
+class LogisticRegression(ClassifierMixin, _GLM):
     """Binary and multiclass logistic regression over the solver library.
 
     Multiclass is one-vs-rest (`multi_class='ovr'`, sklearn's classic
@@ -254,7 +254,7 @@ class LogisticRegression(_GLM):
         return float((self.predict(X) == yv).mean())
 
 
-class LinearRegression(_GLM):
+class LinearRegression(RegressorMixin, _GLM):
     family = Normal
 
     def predict(self, X):
@@ -267,7 +267,7 @@ class LinearRegression(_GLM):
         return r2_score(y, self.predict(X))
 
 
-class PoissonRegression(_GLM):
+class PoissonRegression(RegressorMixin, _GLM):
     family = Poisson
 
     def predict(self, X):

@@ -70,11 +70,24 @@ def accuracy_score(y_true, y_pred, normalize: bool = True, sample_weight=None, c
     return float(result) if compute else result
 
 
-def log_loss(y_true, y_pred, eps: float = 1e-15, normalize: bool = True, sample_weight=None, labels=None):
+def log_loss(y_true, y_pred, eps="auto", normalize: bool = True, sample_weight=None, labels=None):
     """Negative log-likelihood of a classifier's probabilistic predictions.
 
     ``y_pred`` may be (n, k) probabilities or (n,) positive-class probability.
+    ``eps="auto"`` clips at the INPUT's machine epsilon (sklearn semantics:
+    a float64 probability of 0 contributes log(2.2e-16), not log(1e-15) —
+    the clip level, not the log arithmetic, is what parity depends on).
     """
+    if eps == "auto":
+        # read the dtype WITHOUT materializing device data on host
+        # (np.asarray of a jax array transfers; of a ShardedRows it makes
+        # an object scalar); f32 inputs need f32's eps or the upper clip
+        # 1-eps rounds back to 1.0 and log(1-p) overflows to -inf
+        in_dtype = getattr(y_pred, "dtype", None)
+        if in_dtype is None:
+            in_dtype = np.asarray(y_pred).dtype
+        eps = float(np.finfo(in_dtype if np.issubdtype(in_dtype, np.floating)
+                             else np.float64).eps)
     t, p, mask = _align(y_true, y_pred)
     w = _apply_weight(mask, sample_weight)
     p = jnp.clip(p, eps, 1.0 - eps)

@@ -29,7 +29,6 @@ from ..base import TPUEstimator, clone
 from ..core.sharded import ShardedRows, unshard
 from ..metrics.scorer import check_scoring
 from ..utils import check_random_state
-from ._split import KFold
 
 logger = logging.getLogger(__name__)
 
@@ -81,6 +80,35 @@ class _OnceCache:
         return entry["value"]
 
 
+class _CachedPredictor:
+    """Memoizing proxy for multimetric scoring: K scorers over the same
+    (estimator, X) pair compute predict / predict_proba / decision_function
+    ONCE instead of once per metric (sklearn's ``_MultimetricScorer``
+    rationale — on device estimators each call is a dispatch)."""
+
+    def __init__(self, est):
+        self._est = est
+        self._memo: dict = {}
+
+    def _cached(self, method, X):
+        key = (method, id(X))
+        if key not in self._memo:
+            self._memo[key] = getattr(self._est, method)(X)
+        return self._memo[key]
+
+    def predict(self, X):
+        return self._cached("predict", X)
+
+    def predict_proba(self, X):
+        return self._cached("predict_proba", X)
+
+    def decision_function(self, X):
+        return self._cached("decision_function", X)
+
+    def __getattr__(self, name):  # score, classes_, transform, ...
+        return getattr(self._est, name)
+
+
 def _resolve_n_jobs(n_jobs) -> int:
     if n_jobs is None or n_jobs == 1:
         return 1
@@ -109,30 +137,67 @@ class _BaseSearchCV(TPUEstimator):
     def _get_param_iterator(self):
         raise NotImplementedError
 
-    def _resolve_cv(self):
+    def _resolve_cv(self, yh=None):
         cv = self.cv
-        if cv is None:
-            return KFold(n_splits=5)
-        if isinstance(cv, int):
-            return KFold(n_splits=cv)
+        if cv is None or isinstance(cv, int):
+            # sklearn/reference semantics: an int (or default) stratifies
+            # for classifiers — the splits run on host labels anyway
+            from sklearn.base import is_classifier
+            from sklearn.model_selection import check_cv
+
+            return check_cv(
+                cv, yh, classifier=is_classifier(self.estimator)
+            )
         return cv
+
+    def _resolve_scorers(self):
+        """Normalize ``scoring`` to an ordered {name: scorer} dict.
+
+        Single-metric (None / str / callable) keeps the reference's
+        ``"score"`` key; a list/tuple/dict is sklearn's multimetric form
+        and requires ``refit`` to name one of the metrics (or be False).
+        """
+        from ..metrics.scorer import get_scorer
+
+        sc = self.scoring
+        if sc is None or isinstance(sc, str) or callable(sc):
+            return {"score": check_scoring(self.estimator, sc)}, False
+        if isinstance(sc, (list, tuple, set)):
+            scorers = {name: get_scorer(name) for name in sc}
+        elif isinstance(sc, dict):
+            scorers = {
+                name: (v if callable(v) else get_scorer(v))
+                for name, v in sc.items()
+            }
+        else:
+            raise ValueError(f"Invalid scoring: {sc!r}")
+        if self.refit is not False and self.refit not in scorers:
+            raise ValueError(
+                "For multimetric scoring, refit must be False or the name "
+                f"of the metric used to pick the best candidate; got "
+                f"{self.refit!r} with metrics {sorted(scorers)}"
+            )
+        return scorers, True
 
     def fit(self, X, y=None, **fit_params):
         Xh, yh = _host(X), _host(y) if y is not None else None
         candidates = list(self._get_param_iterator())
         if not candidates:
             raise ValueError("No candidate parameters")
-        cv = self._resolve_cv()
+        cv = self._resolve_cv(yh)
         splits = list(cv.split(Xh, yh))
-        scorer = check_scoring(self.estimator, self.scoring)
+        scorers, multimetric = self._resolve_scorers()
 
         # prefix-transform cache: (pipeline prefix token) -> fitted step +
         # transformed data, compute-once under the thread pool
         prefix_cache = _OnceCache()
 
         n_cand = len(candidates)
-        test_scores = np.zeros((n_cand, len(splits)))
-        train_scores = np.zeros((n_cand, len(splits))) if self.return_train_score else None
+        test_scores = {m: np.zeros((n_cand, len(splits))) for m in scorers}
+        train_scores = (
+            {m: np.zeros((n_cand, len(splits))) for m in scorers}
+            if self.return_train_score else None
+        )
         fit_failed = np.zeros(n_cand, dtype=bool)
 
         def run_task(ci, fi):
@@ -144,13 +209,21 @@ class _BaseSearchCV(TPUEstimator):
                 est = self._fit_candidate(
                     params, Xtr, ytr, fi, prefix_cache, fit_params
                 )
-                test_scores[ci, fi] = scorer(est, Xte, yte)
-                if self.return_train_score:
-                    train_scores[ci, fi] = scorer(est, Xtr, ytr)
+                if len(scorers) > 1:
+                    # one predict per (X, method) across all metrics — the
+                    # _MultimetricScorer caching idea, as a proxy
+                    est = _CachedPredictor(est)
+                for m, scorer in scorers.items():
+                    test_scores[m][ci, fi] = scorer(est, Xte, yte)
+                    if self.return_train_score:
+                        train_scores[m][ci, fi] = scorer(est, Xtr, ytr)
             except Exception:
                 if self.error_score == "raise":
                     raise
-                test_scores[ci, fi] = float(self.error_score)
+                for m in scorers:
+                    test_scores[m][ci, fi] = float(self.error_score)
+                    if self.return_train_score:
+                        train_scores[m][ci, fi] = float(self.error_score)
                 fit_failed[ci] = True
 
         tasks = [(ci, fi) for ci in range(n_cand) for fi in range(len(splits))]
@@ -180,7 +253,11 @@ class _BaseSearchCV(TPUEstimator):
                     pool.shutdown(wait=False, cancel_futures=True)
                     raise
 
-        self._build_results(candidates, splits, test_scores, train_scores)
+        self._build_results(
+            candidates, splits, test_scores, train_scores,
+            primary=(self.refit if multimetric else "score"),
+        )
+        self.multimetric_ = multimetric
         if self.refit:
             best = clone(self.estimator).set_params(**self.best_params_)
             if yh is not None:
@@ -228,29 +305,42 @@ class _BaseSearchCV(TPUEstimator):
         est.steps = fitted_steps
         return est
 
-    def _build_results(self, candidates, splits, test_scores, train_scores):
-        mean_test = test_scores.mean(axis=1)
-        std_test = test_scores.std(axis=1)
-        # error_score=nan candidates rank (and select) WORST: a raw
-        # argsort/argmax treats NaN as the maximum
-        mean_ranked = np.where(np.isnan(mean_test), -np.inf, mean_test)
-        ranks = np.argsort(np.argsort(-mean_ranked)) + 1
-        cv_results = {
-            "params": candidates,
-            "mean_test_score": mean_test.tolist(),
-            "std_test_score": std_test.tolist(),
-            "rank_test_score": ranks.tolist(),
-        }
-        for fi in range(len(splits)):
-            cv_results[f"split{fi}_test_score"] = test_scores[:, fi].tolist()
-        if train_scores is not None:
-            cv_results["mean_train_score"] = train_scores.mean(axis=1).tolist()
+    def _build_results(self, candidates, splits, test_scores, train_scores,
+                       *, primary):
+        """``test_scores``/``train_scores``: {metric: (n_cand, n_folds)}.
+
+        ``primary`` selects best_*; the single-metric key "score" keeps
+        the reference's ``*_test_score`` result names; multimetric adds
+        one column family per metric (sklearn's convention).  ``primary``
+        may be False (multimetric + refit=False): per-metric columns are
+        built but no best_* attributes exist, per sklearn.
+        """
+        cv_results = {"params": candidates}
+        for metric, scores in test_scores.items():
+            mean_test = scores.mean(axis=1)
+            std_test = scores.std(axis=1)
+            # error_score=nan candidates rank (and select) WORST: a raw
+            # argsort/argmax treats NaN as the maximum
+            mean_ranked = np.where(np.isnan(mean_test), -np.inf, mean_test)
+            ranks = np.argsort(np.argsort(-mean_ranked)) + 1
+            cv_results[f"mean_test_{metric}"] = mean_test.tolist()
+            cv_results[f"std_test_{metric}"] = std_test.tolist()
+            cv_results[f"rank_test_{metric}"] = ranks.tolist()
             for fi in range(len(splits)):
-                cv_results[f"split{fi}_train_score"] = train_scores[:, fi].tolist()
+                cv_results[f"split{fi}_test_{metric}"] = scores[:, fi].tolist()
+            if train_scores is not None:
+                tr = train_scores[metric]
+                cv_results[f"mean_train_{metric}"] = tr.mean(axis=1).tolist()
+                for fi in range(len(splits)):
+                    cv_results[f"split{fi}_train_{metric}"] = tr[:, fi].tolist()
         keys = {k for p in candidates for k in p}
         for k in sorted(keys):
             cv_results[f"param_{k}"] = [p.get(k) for p in candidates]
         self.cv_results_ = cv_results
+        self.n_splits_ = len(splits)
+        if primary is False:
+            return
+        mean_test = np.asarray(cv_results[f"mean_test_{primary}"])
         if np.all(np.isnan(mean_test)):
             raise ValueError(
                 "every candidate's fit failed (all mean test scores are "
@@ -259,7 +349,6 @@ class _BaseSearchCV(TPUEstimator):
         self.best_index_ = int(np.nanargmax(mean_test))
         self.best_score_ = float(mean_test[self.best_index_])
         self.best_params_ = candidates[self.best_index_]
-        self.n_splits_ = len(splits)
 
     # -- post-fit API --------------------------------------------------
     def _check_refit(self, method):
@@ -280,7 +369,8 @@ class _BaseSearchCV(TPUEstimator):
 
     def score(self, X, y=None):
         self._check_refit("score")
-        scorer = check_scoring(self.estimator, self.scoring)
+        scorers, multimetric = self._resolve_scorers()
+        scorer = scorers[self.refit] if multimetric else scorers["score"]
         return scorer(self.best_estimator_, _host(X), _host(y))
 
 

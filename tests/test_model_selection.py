@@ -403,3 +403,132 @@ class TestKMeansParInitDeviceSide:
         got = np.sort(np.asarray(centers)[:, 0])
         expect = np.array([0.0, 5.0, 10.0, 15.0])
         np.testing.assert_allclose(got, expect, atol=1.5)
+
+
+class TestMultimetricScoring:
+    """sklearn's multimetric contract on GridSearchCV (reference surface:
+    dask-ml forwards sklearn's scoring semantics): list/dict scoring,
+    per-metric cv_results_ columns, refit-by-name, refit=False."""
+
+    def _data(self, rng):
+        X = rng.normal(size=(120, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int32)
+        return X, y
+
+    def test_list_scoring_refit_by_name(self, rng):
+        from sklearn.tree import DecisionTreeClassifier
+
+        X, y = self._data(rng)
+        gs = dms.GridSearchCV(
+            DecisionTreeClassifier(random_state=0), {"max_depth": [1, 3]},
+            scoring=["accuracy", "neg_log_loss"], refit="accuracy", cv=3,
+        ).fit(X, y)
+        assert gs.multimetric_
+        for m in ("accuracy", "neg_log_loss"):
+            assert f"mean_test_{m}" in gs.cv_results_
+            assert f"rank_test_{m}" in gs.cv_results_
+            assert f"split0_test_{m}" in gs.cv_results_
+        best = int(np.argmax(gs.cv_results_["mean_test_accuracy"]))
+        assert gs.best_index_ == best
+        assert gs.score(X, y) == pytest.approx(
+            gs.best_estimator_.score(X, y))
+
+    def test_dict_scoring_with_callable(self, rng):
+        from sklearn.tree import DecisionTreeClassifier
+
+        from dask_ml_tpu.metrics import accuracy_score
+
+        X, y = self._data(rng)
+
+        def my_scorer(est, Xv, yv):
+            return float(accuracy_score(yv, est.predict(Xv)))
+
+        gs = dms.GridSearchCV(
+            DecisionTreeClassifier(random_state=0), {"max_depth": [1, 3]},
+            scoring={"acc": "accuracy", "mine": my_scorer}, refit="mine",
+            cv=3,
+        ).fit(X, y)
+        np.testing.assert_allclose(
+            gs.cv_results_["mean_test_acc"], gs.cv_results_["mean_test_mine"]
+        )
+
+    def test_refit_false_builds_columns_without_best(self, rng):
+        from sklearn.tree import DecisionTreeClassifier
+
+        X, y = self._data(rng)
+        gs = dms.GridSearchCV(
+            DecisionTreeClassifier(random_state=0), {"max_depth": [1, 3]},
+            scoring=["accuracy", "r2"], refit=False, cv=3,
+        ).fit(X, y)
+        assert "mean_test_accuracy" in gs.cv_results_
+        assert not hasattr(gs, "best_index_")
+
+    def test_bad_refit_name_raises(self, rng):
+        from sklearn.tree import DecisionTreeClassifier
+
+        X, y = self._data(rng)
+        with pytest.raises(ValueError, match="refit must be False or"):
+            dms.GridSearchCV(
+                DecisionTreeClassifier(), {"max_depth": [1]},
+                scoring=["accuracy"], refit=True, cv=3,
+            ).fit(X, y)
+
+    def test_single_metric_keys_unchanged(self, rng):
+        from sklearn.tree import DecisionTreeClassifier
+
+        X, y = self._data(rng)
+        gs = dms.GridSearchCV(
+            DecisionTreeClassifier(random_state=0), {"max_depth": [1, 3]},
+            cv=3,
+        ).fit(X, y)
+        assert not gs.multimetric_
+        assert "mean_test_score" in gs.cv_results_
+        assert "rank_test_score" in gs.cv_results_
+
+    def test_stratified_cv_for_library_classifiers(self, rng):
+        """Our own GLM classifiers must stratify under cv=int like sklearn
+        estimators do (is_classifier sees the ClassifierMixin)."""
+        from sklearn.base import is_classifier
+
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        assert is_classifier(LogisticRegression())
+        # class-sorted labels: unstratified contiguous folds would give a
+        # single-class train split and error
+        X = rng.normal(size=(90, 3)).astype(np.float32)
+        y = np.repeat([0, 1, 2], 30)
+        X[y == 1] += 3.0
+        X[y == 2] -= 3.0
+        gs = dms.GridSearchCV(
+            LogisticRegression(solver="lbfgs", max_iter=30),
+            {"C": [1.0]}, cv=3,
+        ).fit(X, y)
+        assert gs.best_score_ > 0.5
+
+    def test_multimetric_prediction_caching(self, rng):
+        from sklearn.base import BaseEstimator
+
+        calls = {"n": 0}
+
+        class Counting(BaseEstimator):
+            def __init__(self, c=1.0):
+                self.c = c
+            def fit(self, X, y):
+                self.classes_ = np.unique(y)
+                return self
+            def predict(self, X):
+                calls["n"] += 1
+                return np.zeros(len(X), dtype=np.int64)
+            def predict_proba(self, X):
+                p = np.full((len(X), 2), 0.5)
+                return p
+
+        X = rng.normal(size=(60, 3)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int64)
+        dms.GridSearchCV(
+            Counting(), {"c": [1.0]},
+            scoring={"a": "accuracy", "b": "accuracy"}, refit="a",
+            cv=2, n_jobs=1,
+        ).fit(X, y)
+        # 2 folds x 1 candidate: one predict per fold despite 2 metrics
+        assert calls["n"] == 2
