@@ -19,7 +19,7 @@ import jax
 
 from .utils import _timer  # noqa: F401  (re-export: phase logging)
 
-__all__ = ["trace", "benchmark_step", "_timer"]
+__all__ = ["trace", "benchmark_step", "benchmark_slope", "_timer"]
 
 
 @contextlib.contextmanager
@@ -37,19 +37,45 @@ def trace(log_dir: str):
         jax.profiler.stop_trace()
 
 
+def _sync(out):
+    """Force completion by FETCHING a result, not block_until_ready.
+
+    On relayed/remote backends (the axon TPU tunnel in this image)
+    ``block_until_ready`` returns before remote execution finishes and
+    identical executions can appear cached — timings built on it are
+    fiction (see BENCH_LOCAL.md).  Materializing one scalar-ish leaf is
+    the only sync that holds everywhere.
+    """
+    fetched = False
+    for leaf in jax.tree.leaves(out):
+        if hasattr(leaf, "ravel"):
+            # one element from EVERY array leaf: separate dispatches (or
+            # mixed host/device leaves) must all complete, not just the
+            # first leaf in tree order
+            np.asarray(jax.numpy.ravel(leaf)[:1])
+            fetched = True
+    if not fetched:
+        jax.block_until_ready(out)  # no array leaves: best effort
+
+
 def benchmark_step(fn, *args, warmup: int = 1, iters: int = 10, **kwargs):
     """Time a jitted step function honestly (async dispatch flushed).
 
     Returns ``{"mean_s", "std_s", "min_s", "iters"}``.  The first
-    ``warmup`` calls (compilation) are excluded; every timed call blocks on
-    its outputs so XLA's async dispatch cannot hide device time.
+    ``warmup`` calls (compilation) are excluded; every timed call fetches
+    an output element so neither XLA's async dispatch nor a remote
+    relay's early ``block_until_ready`` can hide device time.  NOTE: on
+    a relayed backend every fetch carries the tunnel round-trip — for
+    per-iteration numbers free of that constant, time a CHAINED loop at
+    two iteration counts and divide the difference (the slope method
+    bench.py uses).
     """
     for _ in range(warmup):
-        jax.block_until_ready(fn(*args, **kwargs))
+        _sync(fn(*args, **kwargs))
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args, **kwargs))
+        _sync(fn(*args, **kwargs))
         times.append(time.perf_counter() - t0)
     arr = np.asarray(times)
     return {
@@ -57,4 +83,35 @@ def benchmark_step(fn, *args, warmup: int = 1, iters: int = 10, **kwargs):
         "std_s": float(arr.std()),
         "min_s": float(arr.min()),
         "iters": iters,
+    }
+
+
+def benchmark_slope(run, counts=(4, 24), reps: int = 3):
+    """Per-iteration time via the slope method (RTT/dispatch cancel).
+
+    ``run(n)`` must execute n chained iterations (a traced-bound
+    ``lax.fori_loop``/``scan``/``while_loop`` program) and FETCH a result
+    before returning.  Returns ``{"per_iter_s", "counts", "raw_s"}``.
+    """
+    lo, hi = counts
+    run(hi)  # compile
+    run(lo)  # a static-bound run(n) compiles per count: warm BOTH
+    raw = {}
+    for n in (lo, hi):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run(n)
+            best = min(best, time.perf_counter() - t0)
+        raw[n] = best
+    per = (raw[hi] - raw[lo]) / (hi - lo)
+    if per <= 0:
+        # a non-positive slope means the measurement is broken (noise
+        # larger than the signal, or per-count recompilation): surface it
+        # as NaN — a silent 0.0 reads as "infinitely fast"
+        per = float("nan")
+    return {
+        "per_iter_s": per,
+        "counts": (lo, hi),
+        "raw_s": raw,
     }

@@ -86,8 +86,13 @@ def log_loss(y_true, y_pred, eps="auto", normalize: bool = True, sample_weight=N
         in_dtype = getattr(y_pred, "dtype", None)
         if in_dtype is None:
             in_dtype = np.asarray(y_pred).dtype
-        eps = float(np.finfo(in_dtype if np.issubdtype(in_dtype, np.floating)
-                             else np.float64).eps)
+        # jnp.finfo: recognizes ml_dtypes floats (bfloat16) that
+        # np.issubdtype rejects — falling back to float64 eps for bf16
+        # would clip above bf16 resolution and let p==1.0 reach log(0)
+        if jnp.issubdtype(in_dtype, jnp.floating):
+            eps = float(jnp.finfo(in_dtype).eps)
+        else:
+            eps = float(np.finfo(np.float64).eps)
     t, p, mask = _align(y_true, y_pred)
     w = _apply_weight(mask, sample_weight)
     p = jnp.clip(p, eps, 1.0 - eps)

@@ -55,6 +55,10 @@ def _take(a, idx):
         )
         mask = jax.device_put(jnp.asarray(mask_np), row_sharding(mesh, 1))
         return ShardedRows(data=data, mask=mask, n_samples=k)
+    if hasattr(a, "iloc"):  # pandas DataFrame/Series stay pandas
+        # (reference semantics: dask-ml splits dataframes partition-wise
+        # and returns dataframes)
+        return a.iloc[idx]
     return np.asarray(a)[idx]
 
 

@@ -22,3 +22,38 @@ def test_trace_writes_profile(tmp_path):
     # a trace directory with at least one event file appears
     produced = list(tmp_path.rglob("*"))
     assert produced, "profiler produced no output"
+
+
+class TestBenchmarkSlope:
+    def test_slope_of_chained_loop(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from dask_ml_tpu.diagnostics import benchmark_slope
+
+        # random data + a carry-dependent nonlinearity: constant inputs or
+        # hoistable bodies get folded by XLA and the slope measures nothing
+        x = jnp.asarray(np.random.RandomState(0).normal(size=(50_000, 16)),
+                        jnp.float32)
+
+        @jax.jit
+        def chained(n):
+            def body(_, c):
+                return c + jnp.sum(jnp.sin(x + c)) * 1e-9
+            return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+
+        out = benchmark_slope(lambda n: float(chained(jnp.int32(n))),
+                              counts=(2, 20), reps=2)
+        assert out["per_iter_s"] > 0.0
+        assert set(out["raw_s"]) == {2, 20}
+
+    def test_benchmark_step_fetch_sync(self):
+        import jax
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.diagnostics import benchmark_step
+
+        f = jax.jit(lambda x: (x * 2, {"loss": jnp.sum(x)}))
+        stats = benchmark_step(f, jnp.ones((64, 8)), iters=3)
+        assert stats["min_s"] > 0 and stats["iters"] == 3

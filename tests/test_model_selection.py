@@ -532,3 +532,18 @@ class TestMultimetricScoring:
         ).fit(X, y)
         # 2 folds x 1 candidate: one predict per fold despite 2 metrics
         assert calls["n"] == 2
+
+
+class TestDataFrameSplit:
+    def test_train_test_split_preserves_pandas(self, rng):
+        import pandas as pd
+
+        df = pd.DataFrame({"a": range(20), "b": np.arange(20.0)})
+        y = pd.Series(np.arange(20) % 2, name="t")
+        Xtr, Xte, ytr, yte = dms.train_test_split(
+            df, y, test_size=0.25, random_state=0
+        )
+        assert isinstance(Xtr, pd.DataFrame) and isinstance(yte, pd.Series)
+        assert len(Xtr) == 15 and len(Xte) == 5
+        # row alignment preserved between X and y
+        assert (Xtr["a"].to_numpy() % 2 == ytr.to_numpy()).all()

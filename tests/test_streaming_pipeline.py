@@ -184,3 +184,26 @@ class TestReviewRegressions:
         np.testing.assert_allclose(
             a.to_numpy(), b.drop(columns="date").to_numpy()
         )
+
+
+class TestStreamedClustering:
+    def test_file_to_device_minibatch_kmeans(self, tmp_path, rng):
+        """Out-of-core clustering: CSV -> native prefetched blocks ->
+        device-resident MiniBatchKMeans partial_fit (the reference's
+        Incremental(sklearn.MiniBatchKMeans) streaming pattern, with the
+        model on device instead of hopping hosts)."""
+        from sklearn.datasets import make_blobs
+        from sklearn.metrics import adjusted_rand_score
+
+        from dask_ml_tpu.cluster import MiniBatchKMeans
+
+        X, y = make_blobs(n_samples=3000, centers=4, n_features=6,
+                          cluster_std=0.5, random_state=2)
+        p = tmp_path / "blobs.csv"
+        np.savetxt(p, X.astype(np.float32), delimiter=",", fmt="%.6f")
+
+        mbk = MiniBatchKMeans(n_clusters=4, random_state=0)
+        for block in dio.stream_csv_blocks(str(p), 512, prefetch=2):
+            mbk.partial_fit(block)
+        pred = np.asarray(mbk.predict(X.astype(np.float32)))
+        assert adjusted_rand_score(y, pred) > 0.95
