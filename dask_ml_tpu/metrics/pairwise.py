@@ -111,6 +111,27 @@ def _euclid_tile(x, y):
     return jnp.sqrt(_sq_euclidean(x, y))
 
 
+def _manhattan_tile(x, y):
+    """L1 distances, chunked over rows of x: |x-y| has no gemm form, so
+    the (tile, m, d) broadcast is bounded to ~64MB per chunk instead of
+    materializing the full (n, m, d) cube."""
+    m = y.shape[0]
+    d = x.shape[1]
+    chunk = max(int(16_000_000 / max(m * d, 1)), 1)
+    chunk = min(chunk, max(x.shape[0], 1))  # never pad past the real rows
+
+    def one(lo):
+        xb = jax.lax.dynamic_slice_in_dim(x, lo, chunk)
+        return jnp.sum(jnp.abs(xb[:, None, :] - y[None, :, :]), axis=-1)
+
+    n = x.shape[0]
+    pad = (-n) % chunk
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    outs = [one(lo) for lo in range(0, x.shape[0], chunk)]
+    return jnp.concatenate(outs, axis=0)[:n]
+
+
 def _cosine_tile(x, y):
     xn = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-30)
     yn = y / jnp.maximum(jnp.linalg.norm(y, axis=1, keepdims=True), 1e-30)
@@ -150,6 +171,12 @@ def pairwise_distances(X, Y=None, metric: str = "euclidean", **kwargs):
         x, n = _data_of(X)
         y, m = (x, n) if Y is None else _data_of(Y)
         return _cosine_tile(x, y)[:n, :m]
+    if metric in ("manhattan", "cityblock", "l1"):
+        if Y is not None and _both_sharded(X, Y):
+            return ring_pairwise(X, Y, _manhattan_tile)
+        x, n = _data_of(X)
+        y, m = (x, n) if Y is None else _data_of(Y)
+        return _manhattan_tile(x, y)[:n, :m]
     raise ValueError(f"Unsupported metric: {metric!r}")
 
 

@@ -29,6 +29,7 @@ from ..base import TPUEstimator, clone
 from ..core.sharded import ShardedRows, unshard
 from ..metrics.scorer import check_scoring
 from ..utils import check_random_state
+from ._split import _take as _rows  # pandas/array/ShardedRows row subset
 
 logger = logging.getLogger(__name__)
 
@@ -171,10 +172,12 @@ class _BaseSearchCV(TPUEstimator):
             }
         else:
             raise ValueError(f"Invalid scoring: {sc!r}")
-        if self.refit is not False and self.refit not in scorers:
+        if (self.refit is not False and not callable(self.refit)
+                and self.refit not in scorers):
             raise ValueError(
-                "For multimetric scoring, refit must be False or the name "
-                f"of the metric used to pick the best candidate; got "
+                "For multimetric scoring, refit must be False, a callable "
+                "selecting best_index_ from cv_results_, or the name of "
+                f"the metric used to pick the best candidate; got "
                 f"{self.refit!r} with metrics {sorted(scorers)}"
             )
         return scorers, True
@@ -203,8 +206,10 @@ class _BaseSearchCV(TPUEstimator):
         def run_task(ci, fi):
             params = candidates[ci]
             train_idx, test_idx = splits[fi]
-            Xtr, ytr = Xh[train_idx], (yh[train_idx] if yh is not None else None)
-            Xte, yte = Xh[test_idx], (yh[test_idx] if yh is not None else None)
+            Xtr = _rows(Xh, train_idx)
+            ytr = _rows(yh, train_idx) if yh is not None else None
+            Xte = _rows(Xh, test_idx)
+            yte = _rows(yh, test_idx) if yh is not None else None
             try:
                 est = self._fit_candidate(
                     params, Xtr, ytr, fi, prefix_cache, fit_params
@@ -255,9 +260,28 @@ class _BaseSearchCV(TPUEstimator):
 
         self._build_results(
             candidates, splits, test_scores, train_scores,
-            primary=(self.refit if multimetric else "score"),
+            primary=(
+                False if callable(self.refit)
+                else (self.refit if multimetric else "score")
+            ),
         )
         self.multimetric_ = multimetric
+        if callable(self.refit):
+            # sklearn semantics: a callable refit selects best_index_ from
+            # cv_results_ (best_score_ is undefined in this mode)
+            picked = self.refit(self.cv_results_)
+            if not isinstance(picked, (int, np.integer)):
+                raise TypeError(
+                    "refit callable must return an integer index, got "
+                    f"{type(picked).__name__} ({picked!r})"
+                )
+            self.best_index_ = int(picked)
+            if not 0 <= self.best_index_ < len(candidates):
+                raise IndexError(
+                    f"refit callable returned index {self.best_index_} "
+                    f"outside [0, {len(candidates)})"
+                )
+            self.best_params_ = candidates[self.best_index_]
         if self.refit:
             best = clone(self.estimator).set_params(**self.best_params_)
             if yh is not None:
@@ -370,6 +394,12 @@ class _BaseSearchCV(TPUEstimator):
     def score(self, X, y=None):
         self._check_refit("score")
         scorers, multimetric = self._resolve_scorers()
+        if multimetric and callable(self.refit):
+            raise ValueError(
+                "score() is ambiguous with multimetric scoring and a "
+                "callable refit (no single refit metric); score the "
+                "best_estimator_ directly or pass refit=<metric name>"
+            )
         scorer = scorers[self.refit] if multimetric else scorers["score"]
         return scorer(self.best_estimator_, _host(X), _host(y))
 

@@ -258,3 +258,30 @@ class TestRingPairwise:
         from sklearn.metrics.pairwise import euclidean_distances as sk_euc
 
         np.testing.assert_allclose(ring, sk_euc(X, Y), rtol=1e-4, atol=1e-4)
+
+
+class TestManhattan:
+    def test_matches_sklearn(self, rng, mesh):
+        from sklearn.metrics import pairwise_distances as sk_pd
+
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.metrics import pairwise_distances
+
+        X = rng.normal(size=(101, 7)).astype(np.float32)
+        Y = rng.normal(size=(23, 7)).astype(np.float32)
+        for name in ("manhattan", "cityblock", "l1"):
+            D = np.asarray(pairwise_distances(shard_rows(X), Y, metric=name))
+            np.testing.assert_allclose(
+                D, sk_pd(X, Y, metric="manhattan"), rtol=1e-4, atol=1e-4
+            )
+
+    def test_sharded_x_sharded_rides_ring(self, rng, mesh):
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.metrics import pairwise_distances
+
+        X = rng.normal(size=(64, 5)).astype(np.float32)
+        Y = rng.normal(size=(40, 5)).astype(np.float32)
+        D = np.asarray(pairwise_distances(shard_rows(X), shard_rows(Y),
+                                          metric="manhattan"))
+        ref = np.abs(X[:, None, :] - Y[None, :, :]).sum(-1)
+        np.testing.assert_allclose(D, ref, rtol=1e-4, atol=1e-4)

@@ -467,7 +467,7 @@ class TestMultimetricScoring:
         from sklearn.tree import DecisionTreeClassifier
 
         X, y = self._data(rng)
-        with pytest.raises(ValueError, match="refit must be False or"):
+        with pytest.raises(ValueError, match="refit must be False"):
             dms.GridSearchCV(
                 DecisionTreeClassifier(), {"max_depth": [1]},
                 scoring=["accuracy"], refit=True, cv=3,
@@ -547,3 +547,37 @@ class TestDataFrameSplit:
         assert len(Xtr) == 15 and len(Xte) == 5
         # row alignment preserved between X and y
         assert (Xtr["a"].to_numpy() % 2 == ytr.to_numpy()).all()
+
+    def test_pandas_X_in_grid_search(self, rng):
+        import pandas as pd
+        from sklearn.tree import DecisionTreeClassifier
+
+        df = pd.DataFrame({
+            "a": rng.normal(size=100), "b": rng.normal(size=100),
+        })
+        y = (df["a"] > 0).astype(int)
+        gs = dms.GridSearchCV(
+            DecisionTreeClassifier(random_state=0), {"max_depth": [1, 2]},
+            cv=3,
+        ).fit(df, y)
+        assert gs.best_score_ > 0.9
+
+    def test_callable_refit_selects_index(self, rng):
+        from sklearn.tree import DecisionTreeClassifier
+
+        X = rng.normal(size=(120, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int32)
+
+        def pick_simplest_within_1pct(cv_results):
+            scores = np.asarray(cv_results["mean_test_score"])
+            ok = scores >= scores.max() - 0.01
+            return int(np.flatnonzero(ok)[0])  # candidates ordered simple->complex
+
+        gs = dms.GridSearchCV(
+            DecisionTreeClassifier(random_state=0),
+            {"max_depth": [1, 2, 4, 8]}, cv=3,
+            refit=pick_simplest_within_1pct,
+        ).fit(X, y)
+        assert gs.best_params_["max_depth"] in (1, 2)
+        assert hasattr(gs, "best_estimator_")
+        assert not hasattr(gs, "best_score_")
