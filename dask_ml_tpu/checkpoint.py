@@ -109,6 +109,10 @@ def save_estimator(estimator, path: str) -> None:
 
     Layout: ``meta.json`` (class identity + format version) and
     ``state.pkl`` (constructor params + fitted attrs, host-side).
+    Persisted attrs are the trailing-underscore sklearn fitted attrs PLUS
+    any names the estimator lists in ``_checkpoint_private_attrs`` — the
+    opt-in for device state kept in private attrs (e.g. SGD's ``_state``
+    pytree, MiniBatchKMeans' ``_counts``).
     """
     os.makedirs(path, exist_ok=True)
     cls = type(estimator)
@@ -117,10 +121,11 @@ def save_estimator(estimator, path: str) -> None:
         "module": cls.__module__,
         "qualname": cls.__qualname__,
     }
+    extra = tuple(getattr(estimator, "_checkpoint_private_attrs", ()))
     fitted = {
         k: _to_host(v)
         for k, v in vars(estimator).items()
-        if k.endswith("_") and not k.startswith("__")
+        if (k.endswith("_") and not k.startswith("__")) or k in extra
     }
     state = {"params": estimator.get_params(deep=False), "fitted": fitted}
     with open(os.path.join(path, "meta.json"), "w") as f:

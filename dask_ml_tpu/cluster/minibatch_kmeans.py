@@ -96,6 +96,8 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
     adaptive searches.
     """
 
+    _checkpoint_private_attrs = ("_counts",)
+
     def __init__(self, n_clusters=8, init="k-means++", max_iter=100,
                  batch_size=1024, tol=0.0, max_no_improvement=10,
                  random_state=None, reassignment_ratio=0.01,

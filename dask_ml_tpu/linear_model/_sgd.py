@@ -321,6 +321,10 @@ class _BaseSGD(TPUEstimator):
         )
         return loss
 
+    # device state lives in a non-underscore-suffixed private attr; tell
+    # checkpoint.save_estimator to persist it with the fitted attrs
+    _checkpoint_private_attrs = ("_state",)
+
     # -- sklearn surface ---------------------------------------------------
     @property
     def t_(self):

@@ -332,3 +332,31 @@ class TestFingerprint:
         s1 = IncrementalSearchCV(LinearFunction(), g, max_iter=3)
         s2 = IncrementalSearchCV(LinearFunction(), dict(g), max_iter=3)
         assert search_fingerprint(s1) == search_fingerprint(s2)
+
+
+class TestDeviceEstimatorRoundtrips:
+    def test_sgd_classifier_roundtrip(self, tmp_path, rng):
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X = rng.normal(size=(200, 5)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        clf = SGDClassifier(max_iter=30, random_state=0).fit(X, y)
+        save_estimator(clf, str(tmp_path / "sgd"))
+        back = load_estimator(str(tmp_path / "sgd"))
+        np.testing.assert_array_equal(back.predict(X), clf.predict(X))
+        np.testing.assert_array_equal(back.classes_, clf.classes_)
+
+    def test_minibatch_kmeans_roundtrip(self, tmp_path, rng):
+        from dask_ml_tpu.cluster import MiniBatchKMeans
+
+        X = rng.normal(size=(300, 4)).astype(np.float32)
+        mbk = MiniBatchKMeans(n_clusters=3, random_state=0, max_iter=10).fit(X)
+        save_estimator(mbk, str(tmp_path / "mbk"))
+        back = load_estimator(str(tmp_path / "mbk"))
+        np.testing.assert_allclose(
+            np.asarray(back.cluster_centers_),
+            np.asarray(mbk.cluster_centers_), rtol=1e-6,
+        )
+        # the restored model keeps STREAMING: counts survived the roundtrip
+        back.partial_fit(X[:64])
+        assert back.n_steps_ == mbk.n_steps_ + 1
