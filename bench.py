@@ -341,7 +341,7 @@ def main():
                 times[n_outer] = best_t
             return max((times[hi_it] - times[lo_it]) / (hi_it - lo_it), 1e-9), last
 
-        per_outer, _ = slope_time(lambda n: solve(n, sXi))
+        per_outer, (_, n_it32) = slope_time(lambda n: solve(n, sXi))
         dt2 = per_outer * admm_iters
 
         # mixed precision: same solve with a bf16 design matrix (f32
@@ -362,6 +362,9 @@ def main():
                 "vs_fp32_speedup": round(per_outer / per16, 3),
                 "train_accuracy": round(acc16, 4),
                 "parity_ok": bool(acc16 >= acc - 0.02),
+                # executed OUTER counts of the timed hi runs: if these
+                # differ the ratio mixes work-count and bandwidth effects
+                "outer_iters": {"fp32": n_it32, "bf16": n_it16},
             })
         except Exception:
             extra["admm_bf16_error"] = traceback.format_exc(limit=2)

@@ -14,7 +14,13 @@ from .pairwise import (  # noqa: F401
     sigmoid_kernel,
     PAIRWISE_KERNEL_FUNCTIONS,
 )
-from .classification import accuracy_score, log_loss  # noqa: F401
+from .classification import (  # noqa: F401
+    accuracy_score,
+    f1_score,
+    log_loss,
+    precision_score,
+    recall_score,
+)
 from .regression import (  # noqa: F401
     mean_absolute_error,
     mean_squared_error,
@@ -33,6 +39,9 @@ __all__ = [
     "sigmoid_kernel",
     "PAIRWISE_KERNEL_FUNCTIONS",
     "accuracy_score",
+    "f1_score",
+    "precision_score",
+    "recall_score",
     "log_loss",
     "mean_absolute_error",
     "mean_squared_error",

@@ -14,7 +14,10 @@ from ..core.sharded import ShardedRows
 
 
 def _lengths(a):
-    return (a.n_samples, a.padded) if isinstance(a, ShardedRows) else (a.shape[0], a.shape[0])
+    if isinstance(a, ShardedRows):
+        return a.n_samples, a.padded
+    n = len(a) if not hasattr(a, "shape") else a.shape[0]  # lists welcome
+    return n, n
 
 
 def _align(y_true, y_pred):
@@ -114,3 +117,109 @@ def log_loss(y_true, y_pred, eps="auto", normalize: bool = True, sample_weight=N
         per = -jnp.sum(onehot * jnp.log(p), axis=1)
     total = jnp.sum(per * w)
     return float(total / jnp.sum(w)) if normalize else float(total)
+
+
+def _class_inventory(t, p, mask, labels):
+    """Sorted class values for P/R/F: from ``labels`` if given, else the
+    union of true+predicted REAL values discovered on device (only the
+    unique values cross to host)."""
+    if labels is not None:
+        # CALLER's order is the output order for average=None (sklearn
+        # contract) — do not sort
+        return np.asarray(labels)
+    fill = t[0]
+    tv = jnp.where(mask > 0, t, fill)
+    pv = jnp.where(mask > 0, p, fill)
+    return np.union1d(np.asarray(jnp.unique(tv)), np.asarray(jnp.unique(pv)))
+
+
+def _prf_counts(y_true, y_pred, sample_weight, labels):
+    """Per-class (tp, pred_pos, true_pos) as one device reduction via
+    one-hot gemms — no confusion-matrix scatter (slow on XLA:TPU)."""
+    t, p, mask = _align(y_true, y_pred)
+    w = _apply_weight(mask, sample_weight)
+    classes = _class_inventory(t, p, mask, labels)
+    cd = jnp.asarray(classes, t.dtype)
+    t1 = (t[:, None] == cd[None, :]).astype(jnp.float32)
+    p1 = (p[:, None] == cd[None, :]).astype(jnp.float32)
+    # weight each ROW once (weighting both indicators would square w in
+    # the tp term)
+    wc = w[:, None]
+    tp = jnp.sum(t1 * p1 * wc, axis=0)
+    pred_pos = jnp.sum(p1 * wc, axis=0)
+    true_pos = jnp.sum(t1 * wc, axis=0)
+    return classes, np.asarray(tp), np.asarray(pred_pos), np.asarray(true_pos)
+
+
+def _prf(y_true, y_pred, *, average, sample_weight, labels, pos_label, beta=1.0):
+    classes, tp, pp, tpos = _prf_counts(y_true, y_pred, sample_weight, labels)
+
+    def safe(num, den):
+        return np.where(den > 0, num / np.maximum(den, 1e-30), 0.0)
+
+    prec = safe(tp, pp)
+    rec = safe(tp, tpos)
+    b2 = beta * beta
+    f = safe((1 + b2) * prec * rec, b2 * prec + rec)
+    if average == "binary":
+        if len(classes) > 2:
+            raise ValueError(
+                "Target is multiclass but average='binary'; choose "
+                "average from {'micro', 'macro', 'weighted', None} "
+                f"(observed labels: {classes.tolist()})"
+            )
+        where = np.flatnonzero(classes == pos_label)
+        if where.size == 0:
+            # sklearn semantics: an absent pos_label scores 0 with an
+            # UndefinedMetricWarning, it does not abort the CV loop
+            import warnings
+
+            from sklearn.exceptions import UndefinedMetricWarning
+
+            warnings.warn(
+                f"pos_label={pos_label!r} not in observed labels "
+                f"{classes.tolist()}; scores are 0.0",
+                UndefinedMetricWarning, stacklevel=3,
+            )
+            return 0.0, 0.0, 0.0
+        i = int(where[0])
+        return float(prec[i]), float(rec[i]), float(f[i])
+    if average == "macro":
+        return float(prec.mean()), float(rec.mean()), float(f.mean())
+    if average == "micro":
+        P = safe(tp.sum(), pp.sum())
+        R = safe(tp.sum(), tpos.sum())
+        F = safe((1 + b2) * P * R, b2 * P + R)
+        return float(P), float(R), float(F)
+    if average == "weighted":
+        wts = tpos / max(tpos.sum(), 1e-30)
+        return (
+            float((prec * wts).sum()),
+            float((rec * wts).sum()),
+            float((f * wts).sum()),
+        )
+    if average is None:
+        return prec, rec, f
+    raise ValueError(f"Unsupported average: {average!r}")
+
+
+def precision_score(y_true, y_pred, *, average="binary", pos_label=1,
+                    sample_weight=None, labels=None):
+    """tp / (tp + fp), per sklearn semantics (binary/micro/macro/weighted
+    or per-class with average=None); counts reduce on device."""
+    return _prf(y_true, y_pred, average=average, sample_weight=sample_weight,
+                labels=labels, pos_label=pos_label)[0]
+
+
+def recall_score(y_true, y_pred, *, average="binary", pos_label=1,
+                 sample_weight=None, labels=None):
+    """tp / (tp + fn), per sklearn semantics."""
+    return _prf(y_true, y_pred, average=average, sample_weight=sample_weight,
+                labels=labels, pos_label=pos_label)[1]
+
+
+def f1_score(y_true, y_pred, *, average="binary", pos_label=1,
+             sample_weight=None, labels=None):
+    """Harmonic mean of precision and recall, per sklearn semantics."""
+    return _prf(y_true, y_pred, average=average, sample_weight=sample_weight,
+                labels=labels, pos_label=pos_label)[2]

@@ -6,7 +6,7 @@ from __future__ import annotations
 
 from functools import partial
 
-from .classification import accuracy_score, log_loss
+from .classification import accuracy_score, f1_score, log_loss, precision_score, recall_score
 from .regression import mean_absolute_error, mean_squared_error, r2_score
 
 
@@ -33,6 +33,14 @@ def _neg_log_loss_scorer(estimator, X, y):
 
 SCORERS = {
     "accuracy": make_scorer(accuracy_score),
+    "f1": make_scorer(f1_score),
+    "f1_macro": make_scorer(partial(f1_score, average="macro")),
+    "f1_micro": make_scorer(partial(f1_score, average="micro")),
+    "f1_weighted": make_scorer(partial(f1_score, average="weighted")),
+    "precision": make_scorer(precision_score),
+    "precision_macro": make_scorer(partial(precision_score, average="macro")),
+    "recall": make_scorer(recall_score),
+    "recall_macro": make_scorer(partial(recall_score, average="macro")),
     "neg_mean_squared_error": make_scorer(mean_squared_error, greater_is_better=False),
     "neg_root_mean_squared_error": make_scorer(
         partial(mean_squared_error, squared=False), greater_is_better=False

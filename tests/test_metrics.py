@@ -285,3 +285,87 @@ class TestManhattan:
                                           metric="manhattan"))
         ref = np.abs(X[:, None, :] - Y[None, :, :]).sum(-1)
         np.testing.assert_allclose(D, ref, rtol=1e-4, atol=1e-4)
+
+
+class TestPrecisionRecallF1:
+    def _data(self, rng, k=2):
+        t = rng.randint(0, k, size=403)
+        p = t.copy()
+        flip = rng.rand(403) < 0.3
+        p[flip] = rng.randint(0, k, size=flip.sum())
+        return t, p
+
+    @pytest.mark.parametrize("average", ["binary", "macro", "micro", "weighted"])
+    def test_binary_parity(self, rng, mesh, average):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+        from dask_ml_tpu.core import shard_rows
+
+        t, p = self._data(rng, 2)
+        for fn, name in ((dm.precision_score, "precision_score"),
+                         (dm.recall_score, "recall_score"),
+                         (dm.f1_score, "f1_score")):
+            ours = fn(shard_rows(t.astype(np.float32)),
+                      shard_rows(p.astype(np.float32)), average=average)
+            theirs = getattr(skm, name)(t, p, average=average)
+            assert ours == pytest.approx(theirs, abs=1e-6), (name, average)
+
+    @pytest.mark.parametrize("average", ["macro", "micro", "weighted"])
+    def test_multiclass_parity(self, rng, mesh, average):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+
+        t, p = self._data(rng, 4)
+        assert dm.f1_score(t, p, average=average) == pytest.approx(
+            skm.f1_score(t, p, average=average), abs=1e-6)
+        assert dm.precision_score(t, p, average=average) == pytest.approx(
+            skm.precision_score(t, p, average=average), abs=1e-6)
+
+    def test_per_class_and_weights(self, rng, mesh):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+
+        t, p = self._data(rng, 3)
+        w = rng.rand(403)
+        np.testing.assert_allclose(
+            dm.recall_score(t, p, average=None, sample_weight=w),
+            skm.recall_score(t, p, average=None, sample_weight=w),
+            atol=1e-6,
+        )
+
+    def test_scorer_registry(self, rng, mesh):
+        from dask_ml_tpu.metrics import get_scorer
+
+        for name in ("f1", "f1_macro", "precision", "recall_macro"):
+            assert callable(get_scorer(name))
+
+    def test_binary_average_rejects_multiclass(self, rng, mesh):
+        from dask_ml_tpu import metrics as dm
+
+        t, p = self._data(rng, 3)
+        with pytest.raises(ValueError, match="multiclass"):
+            dm.f1_score(t, p)  # default average='binary'
+
+    def test_absent_pos_label_scores_zero_with_warning(self, mesh):
+        from sklearn.exceptions import UndefinedMetricWarning
+
+        from dask_ml_tpu import metrics as dm
+
+        with pytest.warns(UndefinedMetricWarning):
+            assert dm.precision_score([0, 0, 0], [0, 0, 0]) == 0.0
+
+    def test_labels_order_preserved(self, rng, mesh):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+
+        t, p = self._data(rng, 3)
+        order = [2, 0, 1]
+        np.testing.assert_allclose(
+            dm.recall_score(t, p, average=None, labels=order),
+            skm.recall_score(t, p, average=None, labels=order),
+            atol=1e-6,
+        )
