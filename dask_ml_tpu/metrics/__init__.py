@@ -20,6 +20,7 @@ from .classification import (  # noqa: F401
     log_loss,
     precision_score,
     recall_score,
+    roc_auc_score,
 )
 from .regression import (  # noqa: F401
     mean_absolute_error,
@@ -42,6 +43,7 @@ __all__ = [
     "f1_score",
     "precision_score",
     "recall_score",
+    "roc_auc_score",
     "log_loss",
     "mean_absolute_error",
     "mean_squared_error",

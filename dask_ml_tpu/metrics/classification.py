@@ -223,3 +223,49 @@ def f1_score(y_true, y_pred, *, average="binary", pos_label=1,
     """Harmonic mean of precision and recall, per sklearn semantics."""
     return _prf(y_true, y_pred, average=average, sample_weight=sample_weight,
                 labels=labels, pos_label=pos_label)[2]
+
+
+def roc_auc_score(y_true, y_score, sample_weight=None):
+    """Binary ROC AUC via the rank (Mann-Whitney U) formulation.
+
+    One device sort + two vectorized binary searches — exact under score
+    ties (tied positive/negative pairs count 0.5) and sample weights, and
+    pad rows drop out through their zero weight:
+    ``AUC = sum over positives of w * (W_neg_below + W_neg_tied / 2)
+    / (W_pos * W_neg)``.
+    """
+    t, s, mask = _align(y_true, y_score)
+    w = _apply_weight(mask, sample_weight)
+    classes = np.asarray(jnp.unique(jnp.where(mask > 0, t, t[0])))
+    if len(classes) != 2:
+        raise ValueError(
+            "roc_auc_score needs exactly 2 classes in y_true; got "
+            f"{classes.tolist()}"
+        )
+    pos = (t == jnp.asarray(classes[1], t.dtype)).astype(jnp.float32)
+    # keep the scores' own floating dtype: a cast would create spurious
+    # ties between scores that differ below the narrower resolution
+    # (under default JAX config device floats are at most f32; enable
+    # x64 for float64-exact tie handling)
+    if not jnp.issubdtype(s.dtype, jnp.floating):
+        s = s.astype(jnp.float32)
+    # pad rows: weight 0 — push them to the front so real ties are intact
+    s = jnp.where(mask > 0, s, -jnp.inf)
+    order = jnp.argsort(s)
+    s_sorted = s[order]
+    wneg_sorted = (w * (1.0 - pos))[order]
+    cumneg = jnp.concatenate(
+        [jnp.zeros((1,), jnp.float32), jnp.cumsum(wneg_sorted)]
+    )
+    lo = jnp.searchsorted(s_sorted, s, side="left")
+    hi = jnp.searchsorted(s_sorted, s, side="right")
+    below = cumneg[lo]
+    tied = cumneg[hi] - cumneg[lo]
+    wpos = w * pos
+    num = jnp.sum(wpos * (below + 0.5 * tied))
+    W_pos = jnp.sum(wpos)
+    W_neg = jnp.sum(w * (1.0 - pos))
+    denom = float(W_pos) * float(W_neg)
+    if denom <= 0:
+        raise ValueError("Only one class present after weighting")
+    return float(num) / denom

@@ -6,7 +6,7 @@ from __future__ import annotations
 
 from functools import partial
 
-from .classification import accuracy_score, f1_score, log_loss, precision_score, recall_score
+from .classification import accuracy_score, f1_score, log_loss, precision_score, recall_score, roc_auc_score
 from .regression import mean_absolute_error, mean_squared_error, r2_score
 
 
@@ -31,6 +31,14 @@ def _neg_log_loss_scorer(estimator, X, y):
     return -log_loss(y, proba)
 
 
+def _roc_auc_scorer(estimator, X, y):
+    if hasattr(estimator, "decision_function"):
+        s = estimator.decision_function(X)
+    else:
+        s = estimator.predict_proba(X)[:, 1]
+    return roc_auc_score(y, s)
+
+
 SCORERS = {
     "accuracy": make_scorer(accuracy_score),
     "f1": make_scorer(f1_score),
@@ -41,6 +49,7 @@ SCORERS = {
     "precision_macro": make_scorer(partial(precision_score, average="macro")),
     "recall": make_scorer(recall_score),
     "recall_macro": make_scorer(partial(recall_score, average="macro")),
+    "roc_auc": _roc_auc_scorer,
     "neg_mean_squared_error": make_scorer(mean_squared_error, greater_is_better=False),
     "neg_root_mean_squared_error": make_scorer(
         partial(mean_squared_error, squared=False), greater_is_better=False

@@ -87,27 +87,30 @@ class _CachedPredictor:
     ONCE instead of once per metric (sklearn's ``_MultimetricScorer``
     rationale — on device estimators each call is a dispatch)."""
 
+    _CACHEABLE = ("predict", "predict_proba", "decision_function",
+                  "transform")
+
     def __init__(self, est):
         self._est = est
         self._memo: dict = {}
 
-    def _cached(self, method, X):
-        key = (method, id(X))
-        if key not in self._memo:
-            self._memo[key] = getattr(self._est, method)(X)
-        return self._memo[key]
+    def __getattr__(self, name):
+        # No methods are defined on the proxy itself, so hasattr()
+        # probes (e.g. the roc_auc scorer's decision_function fallback)
+        # see exactly what the wrapped estimator exposes; an estimator
+        # without the method raises AttributeError here, truthfully.
+        attr = getattr(self._est, name)
+        if name in self._CACHEABLE and callable(attr):
+            memo = self._memo
 
-    def predict(self, X):
-        return self._cached("predict", X)
+            def cached(X, _name=name, _fn=attr):
+                key = (_name, id(X))
+                if key not in memo:
+                    memo[key] = _fn(X)
+                return memo[key]
 
-    def predict_proba(self, X):
-        return self._cached("predict_proba", X)
-
-    def decision_function(self, X):
-        return self._cached("decision_function", X)
-
-    def __getattr__(self, name):  # score, classes_, transform, ...
-        return getattr(self._est, name)
+            return cached
+        return attr
 
 
 def _resolve_n_jobs(n_jobs) -> int:

@@ -369,3 +369,44 @@ class TestPrecisionRecallF1:
             skm.recall_score(t, p, average=None, labels=order),
             atol=1e-6,
         )
+
+
+class TestRocAuc:
+    def test_parity_with_sklearn(self, rng, mesh):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+        from dask_ml_tpu.core import shard_rows
+
+        t = rng.randint(0, 2, size=501)
+        s = rng.normal(size=501).astype(np.float32) + t  # informative
+        ours = dm.roc_auc_score(shard_rows(t.astype(np.float32)),
+                                shard_rows(s))
+        assert ours == pytest.approx(skm.roc_auc_score(t, s), abs=1e-6)
+
+    def test_ties_and_weights(self, rng, mesh):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+
+        t = rng.randint(0, 2, size=400)
+        s = np.round(rng.normal(size=400) + t, 1)  # heavy ties
+        w = rng.rand(400)
+        assert dm.roc_auc_score(t, s, sample_weight=w) == pytest.approx(
+            skm.roc_auc_score(t, s, sample_weight=w), abs=1e-6)
+
+    def test_single_class_raises(self, mesh):
+        from dask_ml_tpu import metrics as dm
+
+        with pytest.raises(ValueError, match="2 classes"):
+            dm.roc_auc_score([1, 1, 1], [0.1, 0.2, 0.3])
+
+    def test_scorer_uses_decision_function(self, rng, mesh):
+        from sklearn.linear_model import LogisticRegression as SKLR
+
+        from dask_ml_tpu.metrics import get_scorer
+
+        X = rng.normal(size=(200, 4)); y = (X[:, 0] > 0).astype(int)
+        est = SKLR().fit(X, y)
+        auc = get_scorer("roc_auc")(est, X, y)
+        assert 0.9 < auc <= 1.0

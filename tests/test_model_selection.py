@@ -581,3 +581,16 @@ class TestDataFrameSplit:
         assert gs.best_params_["max_depth"] in (1, 2)
         assert hasattr(gs, "best_estimator_")
         assert not hasattr(gs, "best_score_")
+
+    def test_multimetric_roc_auc_proba_only_estimator(self, rng):
+        """The prediction-caching proxy must not invent decision_function:
+        a probability-only classifier goes through predict_proba."""
+        from dask_ml_tpu.naive_bayes import GaussianNB
+
+        X = rng.normal(size=(150, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        gs = dms.GridSearchCV(
+            GaussianNB(), {"var_smoothing": [1e-9, 1e-7]},
+            scoring=["accuracy", "roc_auc"], refit="roc_auc", cv=3,
+        ).fit(X, y)
+        assert gs.cv_results_["mean_test_roc_auc"][gs.best_index_] > 0.8
