@@ -16,6 +16,8 @@ from .pairwise import (  # noqa: F401
 )
 from .classification import (  # noqa: F401
     accuracy_score,
+    balanced_accuracy_score,
+    confusion_matrix,
     f1_score,
     log_loss,
     precision_score,
@@ -40,6 +42,8 @@ __all__ = [
     "sigmoid_kernel",
     "PAIRWISE_KERNEL_FUNCTIONS",
     "accuracy_score",
+    "balanced_accuracy_score",
+    "confusion_matrix",
     "f1_score",
     "precision_score",
     "recall_score",

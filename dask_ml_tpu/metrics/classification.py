@@ -236,7 +236,7 @@ def roc_auc_score(y_true, y_score, sample_weight=None):
     """
     t, s, mask = _align(y_true, y_score)
     w = _apply_weight(mask, sample_weight)
-    classes = np.asarray(jnp.unique(jnp.where(mask > 0, t, t[0])))
+    classes = _class_inventory(t, t, mask, None)
     if len(classes) != 2:
         raise ValueError(
             "roc_auc_score needs exactly 2 classes in y_true; got "
@@ -269,3 +269,63 @@ def roc_auc_score(y_true, y_score, sample_weight=None):
     if denom <= 0:
         raise ValueError("Only one class present after weighting")
     return float(num) / denom
+
+
+def confusion_matrix(y_true, y_pred, *, labels=None, sample_weight=None,
+                     normalize=None):
+    """Confusion matrix C with C[i, j] = weight of samples of true class i
+    predicted as class j — ONE device gemm (true-one-hot^T @ weighted
+    pred-one-hot), no scatter (slow on XLA:TPU).
+    """
+    t, p, mask = _align(y_true, y_pred)
+    w = _apply_weight(mask, sample_weight)
+    classes = _class_inventory(t, p, mask, labels)
+    cd = jnp.asarray(classes, t.dtype)
+    t1 = (t[:, None] == cd[None, :]).astype(jnp.float32)
+    p1 = (p[:, None] == cd[None, :]).astype(jnp.float32)
+    # chunked accumulation: a single f32 gemm silently saturates counts
+    # at 2^24; per-chunk partial matrices stay exact (chunk < 2^22 rows)
+    # and are summed in float64 on host (each is a tiny k x k fetch)
+    n_rows = t1.shape[0]
+    chunk = 1 << 22
+    if n_rows <= chunk:
+        cm = jnp.dot(t1.T, p1 * w[:, None]).astype(jnp.float32)
+        cm = np.asarray(cm, dtype=np.float64)
+    else:
+        cm = np.zeros((len(classes), len(classes)), np.float64)
+        for lo in range(0, n_rows, chunk):
+            hi = min(lo + chunk, n_rows)
+            cm += np.asarray(
+                jnp.dot(t1[lo:hi].T, p1[lo:hi] * w[lo:hi, None]),
+                dtype=np.float64,
+            )
+    cm = jnp.asarray(cm)
+    if normalize == "true":
+        cm = cm / jnp.maximum(jnp.sum(cm, axis=1, keepdims=True), 1e-30)
+    elif normalize == "pred":
+        cm = cm / jnp.maximum(jnp.sum(cm, axis=0, keepdims=True), 1e-30)
+    elif normalize == "all":
+        cm = cm / jnp.maximum(jnp.sum(cm), 1e-30)
+    elif normalize is not None:
+        raise ValueError(f"Unsupported normalize: {normalize!r}")
+    out = np.asarray(cm)
+    if sample_weight is None and normalize is None:
+        out = out.astype(np.int64)
+    return out
+
+
+def balanced_accuracy_score(y_true, y_pred, *, sample_weight=None,
+                            adjusted=False):
+    """Mean per-class recall over classes PRESENT in ``y_true`` (sklearn
+    drops classes with no true samples before averaging — a plain macro
+    recall would count a predicted-only class as recall 0)."""
+    _, tp, _, tpos = _prf_counts(y_true, y_pred, sample_weight, None)
+    present = tpos > 0
+    if not present.any():
+        raise ValueError("y_true has no represented classes")
+    rec = tp[present] / tpos[present]
+    score = float(rec.mean())
+    if adjusted:
+        chance = 1.0 / int(present.sum())
+        score = (score - chance) / (1.0 - chance)
+    return score

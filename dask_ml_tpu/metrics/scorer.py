@@ -6,7 +6,7 @@ from __future__ import annotations
 
 from functools import partial
 
-from .classification import accuracy_score, f1_score, log_loss, precision_score, recall_score, roc_auc_score
+from .classification import accuracy_score, balanced_accuracy_score, f1_score, log_loss, precision_score, recall_score, roc_auc_score
 from .regression import mean_absolute_error, mean_squared_error, r2_score
 
 
@@ -50,6 +50,7 @@ SCORERS = {
     "recall": make_scorer(recall_score),
     "recall_macro": make_scorer(partial(recall_score, average="macro")),
     "roc_auc": _roc_auc_scorer,
+    "balanced_accuracy": make_scorer(balanced_accuracy_score),
     "neg_mean_squared_error": make_scorer(mean_squared_error, greater_is_better=False),
     "neg_root_mean_squared_error": make_scorer(
         partial(mean_squared_error, squared=False), greater_is_better=False

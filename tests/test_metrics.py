@@ -410,3 +410,75 @@ class TestRocAuc:
         est = SKLR().fit(X, y)
         auc = get_scorer("roc_auc")(est, X, y)
         assert 0.9 < auc <= 1.0
+
+
+class TestConfusionMatrix:
+    def test_parity_with_sklearn(self, rng, mesh):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+        from dask_ml_tpu.core import shard_rows
+
+        t = rng.randint(0, 4, size=333)
+        p = rng.randint(0, 4, size=333)
+        ours = dm.confusion_matrix(shard_rows(t.astype(np.float32)),
+                                   shard_rows(p.astype(np.float32)))
+        np.testing.assert_array_equal(ours, skm.confusion_matrix(t, p))
+        assert ours.dtype == np.int64
+
+    @pytest.mark.parametrize("normalize", ["true", "pred", "all"])
+    def test_normalized(self, rng, mesh, normalize):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+
+        t = rng.randint(0, 3, size=200)
+        p = rng.randint(0, 3, size=200)
+        np.testing.assert_allclose(
+            dm.confusion_matrix(t, p, normalize=normalize),
+            skm.confusion_matrix(t, p, normalize=normalize), atol=1e-6)
+
+    def test_weighted_and_labels(self, rng, mesh):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+
+        t = rng.randint(0, 3, size=150)
+        p = rng.randint(0, 3, size=150)
+        w = rng.rand(150)
+        np.testing.assert_allclose(
+            dm.confusion_matrix(t, p, labels=[2, 1, 0], sample_weight=w),
+            skm.confusion_matrix(t, p, labels=[2, 1, 0], sample_weight=w),
+            atol=1e-5)
+
+    def test_balanced_accuracy(self, rng, mesh):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+
+        t = rng.randint(0, 3, size=300)
+        p = rng.randint(0, 3, size=300)
+        assert dm.balanced_accuracy_score(t, p) == pytest.approx(
+            skm.balanced_accuracy_score(t, p), abs=1e-6)
+
+    def test_balanced_accuracy_predicted_only_class(self, mesh):
+        """A class appearing only in y_pred must not drag the average
+        (sklearn drops true-absent classes)."""
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+
+        t = [0, 0, 1]
+        p = [0, 0, 2]
+        assert dm.balanced_accuracy_score(t, p) == pytest.approx(
+            skm.balanced_accuracy_score(t, p))
+
+    def test_balanced_accuracy_adjusted(self, rng, mesh):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+
+        t = rng.randint(0, 3, size=200)
+        p = rng.randint(0, 3, size=200)
+        assert dm.balanced_accuracy_score(t, p, adjusted=True) == pytest.approx(
+            skm.balanced_accuracy_score(t, p, adjusted=True), abs=1e-6)
