@@ -133,15 +133,24 @@ def _class_inventory(t, p, mask, labels):
     return np.union1d(np.asarray(jnp.unique(tv)), np.asarray(jnp.unique(pv)))
 
 
-def _prf_counts(y_true, y_pred, sample_weight, labels):
-    """Per-class (tp, pred_pos, true_pos) as one device reduction via
-    one-hot gemms — no confusion-matrix scatter (slow on XLA:TPU)."""
+def _indicator_matrices(y_true, y_pred, sample_weight, labels):
+    """Shared preamble of the count-based metrics: class inventory and
+    the per-class one-hot indicators, plus the per-row weights."""
     t, p, mask = _align(y_true, y_pred)
     w = _apply_weight(mask, sample_weight)
     classes = _class_inventory(t, p, mask, labels)
     cd = jnp.asarray(classes, t.dtype)
     t1 = (t[:, None] == cd[None, :]).astype(jnp.float32)
     p1 = (p[:, None] == cd[None, :]).astype(jnp.float32)
+    return classes, t1, p1, w
+
+
+def _prf_counts(y_true, y_pred, sample_weight, labels):
+    """Per-class (tp, pred_pos, true_pos) as one device reduction via
+    one-hot products — no confusion-matrix scatter (slow on XLA:TPU)."""
+    classes, t1, p1, w = _indicator_matrices(
+        y_true, y_pred, sample_weight, labels
+    )
     # weight each ROW once (weighting both indicators would square w in
     # the tp term)
     wc = w[:, None]
@@ -277,41 +286,44 @@ def confusion_matrix(y_true, y_pred, *, labels=None, sample_weight=None,
     predicted as class j — ONE device gemm (true-one-hot^T @ weighted
     pred-one-hot), no scatter (slow on XLA:TPU).
     """
-    t, p, mask = _align(y_true, y_pred)
-    w = _apply_weight(mask, sample_weight)
-    classes = _class_inventory(t, p, mask, labels)
-    cd = jnp.asarray(classes, t.dtype)
-    t1 = (t[:, None] == cd[None, :]).astype(jnp.float32)
-    p1 = (p[:, None] == cd[None, :]).astype(jnp.float32)
+    classes, t1, p1, w = _indicator_matrices(
+        y_true, y_pred, sample_weight, labels
+    )
     # chunked accumulation: a single f32 gemm silently saturates counts
     # at 2^24; per-chunk partial matrices stay exact (chunk < 2^22 rows)
-    # and are summed in float64 on host (each is a tiny k x k fetch)
+    # and are summed in float64 ON HOST — the k x k result never goes
+    # back to device (jnp would downcast the f64 sums without x64)
     n_rows = t1.shape[0]
     chunk = 1 << 22
-    if n_rows <= chunk:
-        cm = jnp.dot(t1.T, p1 * w[:, None]).astype(jnp.float32)
-        cm = np.asarray(cm, dtype=np.float64)
-    else:
-        cm = np.zeros((len(classes), len(classes)), np.float64)
-        for lo in range(0, n_rows, chunk):
-            hi = min(lo + chunk, n_rows)
-            cm += np.asarray(
-                jnp.dot(t1[lo:hi].T, p1[lo:hi] * w[lo:hi, None]),
-                dtype=np.float64,
-            )
-    cm = jnp.asarray(cm)
+    hi_prec = jax.lax.Precision.HIGHEST  # default MXU bf16 would
+    # truncate weights to 8 mantissa bits
+    cm = np.zeros((len(classes), len(classes)), np.float64)
+    for lo in range(0, n_rows, chunk):
+        hi = min(lo + chunk, n_rows)
+        cm += np.asarray(
+            jnp.dot(t1[lo:hi].T, p1[lo:hi] * w[lo:hi, None],
+                    precision=hi_prec),
+            dtype=np.float64,
+        )
     if normalize == "true":
-        cm = cm / jnp.maximum(jnp.sum(cm, axis=1, keepdims=True), 1e-30)
+        denom = cm.sum(axis=1, keepdims=True)
     elif normalize == "pred":
-        cm = cm / jnp.maximum(jnp.sum(cm, axis=0, keepdims=True), 1e-30)
+        denom = cm.sum(axis=0, keepdims=True)
     elif normalize == "all":
-        cm = cm / jnp.maximum(jnp.sum(cm), 1e-30)
-    elif normalize is not None:
+        denom = np.asarray(cm.sum())
+    elif normalize is None:
+        denom = None
+    else:
         raise ValueError(f"Unsupported normalize: {normalize!r}")
-    out = np.asarray(cm)
-    if sample_weight is None and normalize is None:
-        out = out.astype(np.int64)
-    return out
+    if denom is not None:
+        with np.errstate(divide="ignore", invalid="ignore"):
+            cm = cm / denom
+        # sklearn nan_to_nums the zero-support rows/cols (verified
+        # empirically; its docs read as NaN but the code zero-fills)
+        return np.nan_to_num(cm)
+    if sample_weight is None:
+        return cm.astype(np.int64)
+    return cm
 
 
 def balanced_accuracy_score(y_true, y_pred, *, sample_weight=None,

@@ -158,6 +158,11 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
                 all_models[new_id] = models[ident]
             offset += len(info)
 
+        # fault-recovery accounting rolls up from the bracket SHAs (each
+        # ran its own _fit with its own retry counter)
+        self._fit_failures = sum(
+            getattr(sha, "_fit_failures", 0) for _, sha in brackets
+        )
         self._process_results(all_models, all_info)
         self.metadata_ = {
             "n_models": sum(m["n_models"] for m in meta_observed),

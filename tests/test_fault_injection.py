@@ -171,3 +171,23 @@ class TestGridSearchErrorScore:
             GridSearchCV(
                 FailingFit(), {"c": [-1.0, 1.0]}, cv=3, error_score="raise"
             ).fit(X, y)
+
+
+class TestHyperbandFaultRollup:
+    def test_bracket_failures_surface_on_hyperband(self, xy):
+        from dask_ml_tpu.model_selection import HyperbandSearchCV
+
+        X, y = xy
+        FlakyOnce.reset(fail_at=6)
+        hb = HyperbandSearchCV(
+            FlakyOnce(), {"slope": [1.0, 2.0, 3.0]},
+            max_iter=4, random_state=0,
+        ).fit(X, y)
+        assert hb.fit_failures_ == 1
+        FlakyOnce.reset(fail_at=None)
+        clean = HyperbandSearchCV(
+            FlakyOnce(), {"slope": [1.0, 2.0, 3.0]},
+            max_iter=4, random_state=0,
+        ).fit(X, y)
+        assert clean.fit_failures_ == 0
+        assert clean.best_params_ == hb.best_params_

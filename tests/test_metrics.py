@@ -482,3 +482,15 @@ class TestConfusionMatrix:
         p = rng.randint(0, 3, size=200)
         assert dm.balanced_accuracy_score(t, p, adjusted=True) == pytest.approx(
             skm.balanced_accuracy_score(t, p, adjusted=True), abs=1e-6)
+
+    def test_normalized_absent_class_is_nan(self, mesh):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+
+        ours = dm.confusion_matrix([0, 1], [0, 1], labels=[0, 1, 2],
+                                   normalize="true")
+        theirs = skm.confusion_matrix([0, 1], [0, 1], labels=[0, 1, 2],
+                                      normalize="true")
+        # sklearn zero-fills the absent class rows (nan_to_num)
+        np.testing.assert_allclose(ours, theirs)
