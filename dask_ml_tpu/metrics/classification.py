@@ -145,19 +145,33 @@ def _indicator_matrices(y_true, y_pred, sample_weight, labels):
     return classes, t1, p1, w
 
 
+_COUNT_CHUNK = 1 << 22  # rows per f32 device partial sum: keeps every
+# per-chunk count below 2^24, where f32 accumulation saturates
+
+
 def _prf_counts(y_true, y_pred, sample_weight, labels):
-    """Per-class (tp, pred_pos, true_pos) as one device reduction via
-    one-hot products — no confusion-matrix scatter (slow on XLA:TPU)."""
+    """Per-class (tp, pred_pos, true_pos) via one-hot products — no
+    confusion-matrix scatter (slow on XLA:TPU).  Chunked with host
+    float64 accumulation so counts stay exact past f32's 2^24 (same
+    discipline as confusion_matrix)."""
     classes, t1, p1, w = _indicator_matrices(
         y_true, y_pred, sample_weight, labels
     )
-    # weight each ROW once (weighting both indicators would square w in
-    # the tp term)
-    wc = w[:, None]
-    tp = jnp.sum(t1 * p1 * wc, axis=0)
-    pred_pos = jnp.sum(p1 * wc, axis=0)
-    true_pos = jnp.sum(t1 * wc, axis=0)
-    return classes, np.asarray(tp), np.asarray(pred_pos), np.asarray(true_pos)
+    k = len(classes)
+    tp = np.zeros(k, np.float64)
+    pred_pos = np.zeros(k, np.float64)
+    true_pos = np.zeros(k, np.float64)
+    n = t1.shape[0]
+    for lo in range(0, n, _COUNT_CHUNK):
+        hi = min(lo + _COUNT_CHUNK, n)
+        # weight each ROW once (weighting both indicators would square w
+        # in the tp term)
+        wc = w[lo:hi, None]
+        tb, pb = t1[lo:hi], p1[lo:hi]
+        tp += np.asarray(jnp.sum(tb * pb * wc, axis=0), np.float64)
+        pred_pos += np.asarray(jnp.sum(pb * wc, axis=0), np.float64)
+        true_pos += np.asarray(jnp.sum(tb * wc, axis=0), np.float64)
+    return classes, tp, pred_pos, true_pos
 
 
 def _prf(y_true, y_pred, *, average, sample_weight, labels, pos_label, beta=1.0):
@@ -294,7 +308,7 @@ def confusion_matrix(y_true, y_pred, *, labels=None, sample_weight=None,
     # and are summed in float64 ON HOST — the k x k result never goes
     # back to device (jnp would downcast the f64 sums without x64)
     n_rows = t1.shape[0]
-    chunk = 1 << 22
+    chunk = _COUNT_CHUNK
     hi_prec = jax.lax.Precision.HIGHEST  # default MXU bf16 would
     # truncate weights to 8 mantissa bits
     cm = np.zeros((len(classes), len(classes)), np.float64)

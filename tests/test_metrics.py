@@ -483,7 +483,7 @@ class TestConfusionMatrix:
         assert dm.balanced_accuracy_score(t, p, adjusted=True) == pytest.approx(
             skm.balanced_accuracy_score(t, p, adjusted=True), abs=1e-6)
 
-    def test_normalized_absent_class_is_nan(self, mesh):
+    def test_normalized_absent_class_zero_filled(self, mesh):
         import sklearn.metrics as skm
 
         from dask_ml_tpu import metrics as dm
