@@ -25,9 +25,12 @@ from .classification import (  # noqa: F401
     roc_auc_score,
 )
 from .regression import (  # noqa: F401
+    explained_variance_score,
     mean_absolute_error,
+    mean_absolute_percentage_error,
     mean_squared_error,
     mean_squared_log_error,
+    median_absolute_error,
     r2_score,
 )
 from .scorer import SCORERS, check_scoring, get_scorer  # noqa: F401
@@ -53,6 +56,9 @@ __all__ = [
     "mean_squared_error",
     "mean_squared_log_error",
     "r2_score",
+    "explained_variance_score",
+    "mean_absolute_percentage_error",
+    "median_absolute_error",
     "SCORERS",
     "check_scoring",
     "get_scorer",

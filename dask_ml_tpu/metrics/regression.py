@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from .classification import _align, _apply_weight
 
@@ -51,4 +52,69 @@ def r2_score(y_true, y_pred, sample_weight=None, compute=True):
         1.0 - ss_res / jnp.where(ss_tot > eps, ss_tot, 1.0),
         jnp.where(ss_res > eps, 0.0, 1.0),
     )
+    return float(out) if compute else out
+
+
+def _as_2d(a):
+    return a.reshape(a.shape[0], -1) if a.ndim > 1 else a[:, None]
+
+
+def mean_absolute_percentage_error(y_true, y_pred, sample_weight=None,
+                                   compute=True):
+    """|y - p| / max(|y|, eps), averaged (sklearn semantics: eps is
+    FLOAT64's machine epsilon — exactly representable in f32 — so zero
+    targets blow up identically to sklearn; 2D inputs take the uniform
+    average over outputs like the sibling mse/mae)."""
+    t, p, mask = _align(y_true, y_pred)
+    w = _apply_weight(mask, sample_weight)
+    eps = float(np.finfo(np.float64).eps)
+    ape = jnp.abs(_as_2d(t) - _as_2d(p)) / jnp.maximum(
+        jnp.abs(_as_2d(t)), eps
+    )
+    per = jnp.mean(ape, axis=1)
+    out = jnp.sum(per * w) / jnp.sum(w)
+    return float(out) if compute else out
+
+
+def median_absolute_error(y_true, y_pred, sample_weight=None, compute=True):
+    """Median |y - p| over REAL rows (pad rows pushed past the median via
+    an inf sentinel); 2D inputs average the per-output medians (sklearn's
+    uniform_average)."""
+    t, p, mask = _align(y_true, y_pred)
+    if sample_weight is not None:
+        raise NotImplementedError(
+            "median_absolute_error does not support sample_weight "
+            "(sklearn computes a weighted percentile; open an issue if "
+            "needed)"
+        )
+    err = jnp.abs(_as_2d(t) - _as_2d(p))
+    err = jnp.where(mask[:, None] > 0, err, jnp.inf)  # pads sort last
+    n_real = jnp.sum(mask > 0)
+    s = jnp.sort(err, axis=0)
+    hi_idx = n_real // 2
+    lo_idx = jnp.maximum((n_real - 1) // 2, 0)
+    out = jnp.mean((s[lo_idx] + s[hi_idx]) / 2.0)
+    return float(out) if compute else out
+
+
+def explained_variance_score(y_true, y_pred, sample_weight=None,
+                             compute=True):
+    """1 - Var[y - p] / Var[y] per output, uniform-averaged (sklearn
+    semantics, weighted variances)."""
+    t, p, mask = _align(y_true, y_pred)
+    w = _apply_weight(mask, sample_weight)[:, None]
+    td, pd = _as_2d(t), _as_2d(p)
+    wsum = jnp.sum(w)
+    resid = td - pd
+    mean_r = jnp.sum(resid * w, axis=0) / wsum
+    var_r = jnp.sum((resid - mean_r) ** 2 * w, axis=0) / wsum
+    mean_t = jnp.sum(td * w, axis=0) / wsum
+    var_t = jnp.sum((td - mean_t) ** 2 * w, axis=0) / wsum
+    eps = jnp.finfo(var_t.dtype).tiny
+    per_output = jnp.where(
+        var_t > eps,
+        1.0 - var_r / jnp.where(var_t > eps, var_t, 1.0),
+        jnp.where(var_r > eps, 0.0, 1.0),
+    )
+    out = jnp.mean(per_output)
     return float(out) if compute else out

@@ -494,3 +494,62 @@ class TestConfusionMatrix:
                                       normalize="true")
         # sklearn zero-fills the absent class rows (nan_to_num)
         np.testing.assert_allclose(ours, theirs)
+
+
+class TestExtraRegressionMetrics:
+    def test_parity_with_sklearn(self, rng, mesh):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+        from dask_ml_tpu.core import shard_rows
+
+        t = rng.normal(size=501).astype(np.float32) + 3.0
+        p = t + 0.3 * rng.normal(size=501).astype(np.float32)
+        w = rng.rand(501)
+        st, sp = shard_rows(t), shard_rows(p)
+        assert dm.mean_absolute_percentage_error(st, sp, sample_weight=w) == \
+            pytest.approx(skm.mean_absolute_percentage_error(t, p, sample_weight=w), rel=1e-5)
+        assert dm.median_absolute_error(st, sp) == pytest.approx(
+            skm.median_absolute_error(t, p), rel=1e-5)
+        assert dm.explained_variance_score(st, sp, sample_weight=w) == \
+            pytest.approx(skm.explained_variance_score(t, p, sample_weight=w), rel=1e-4)
+
+    def test_median_even_and_odd(self, rng, mesh):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+
+        for n in (10, 11):
+            t = rng.normal(size=n).astype(np.float32)
+            p = rng.normal(size=n).astype(np.float32)
+            assert dm.median_absolute_error(t, p) == pytest.approx(
+                skm.median_absolute_error(t, p), rel=1e-5)
+
+    def test_constant_target_explained_variance(self, mesh):
+        from dask_ml_tpu import metrics as dm
+
+        assert dm.explained_variance_score([2.0, 2.0], [2.0, 2.0]) == 1.0
+        assert dm.explained_variance_score([2.0, 2.0], [1.0, 3.0]) == 0.0
+
+    def test_mape_zero_target_matches_sklearn(self, mesh):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+
+        t = np.array([0.0, 1.0], np.float32)
+        p = np.array([0.5, 1.0], np.float32)
+        ours = dm.mean_absolute_percentage_error(t, p)
+        theirs = skm.mean_absolute_percentage_error(t, p)
+        assert ours == pytest.approx(theirs, rel=1e-4)
+
+    def test_multioutput_uniform_average(self, rng, mesh):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+
+        t = rng.normal(size=(60, 3)).astype(np.float32) + 4.0
+        p = t + 0.2 * rng.normal(size=(60, 3)).astype(np.float32)
+        for name in ("mean_absolute_percentage_error",
+                     "median_absolute_error", "explained_variance_score"):
+            assert getattr(dm, name)(t, p) == pytest.approx(
+                getattr(skm, name)(t, p), rel=1e-4), name
