@@ -8,7 +8,9 @@ transformers (Categorizer/DummyEncoder) stay host-side like the reference.
 """
 
 from .data import (  # noqa: F401
+    MaxAbsScaler,
     MinMaxScaler,
+    Normalizer,
     PolynomialFeatures,
     QuantileTransformer,
     RobustScaler,
@@ -21,7 +23,9 @@ from .categorical import Categorizer, DummyEncoder  # noqa: F401
 
 __all__ = [
     "StandardScaler",
+    "MaxAbsScaler",
     "MinMaxScaler",
+    "Normalizer",
     "RobustScaler",
     "QuantileTransformer",
     "PolynomialFeatures",

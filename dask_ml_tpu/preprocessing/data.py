@@ -422,3 +422,64 @@ class PolynomialFeatures(TransformerMixin, TPUEstimator):
             return pd.DataFrame(np.asarray(out), index=X.index,
                                 columns=self.get_feature_names_out())
         return _like_input(X, out)
+
+
+class MaxAbsScaler(TransformerMixin, TPUEstimator):
+    """Scale each feature by its maximum absolute value (sparse-friendly
+    sklearn semantics: no centering, zeros stay zero).  One masked
+    reduction over the sharded sample axis."""
+
+    def __init__(self, copy=True):
+        self.copy = copy
+
+    def fit(self, X, y=None):
+        X = _ingest_float(self, X)
+        data, mask = X.data, X.mask
+        mabs = jnp.max(
+            jnp.where(mask[:, None] > 0, jnp.abs(data), 0.0), axis=0
+        )
+        self.max_abs_ = mabs
+        self.scale_ = handle_zeros_in_scale(mabs)
+        self.n_features_in_ = data.shape[1]
+        self.n_samples_seen_ = X.n_samples
+        return self
+
+    def transform(self, X, y=None, copy=None):
+        x, _ = _masked_or_plain(X)
+        return _like_input(X, x / self.scale_)
+
+    def inverse_transform(self, X, copy=None):
+        x, _ = _masked_or_plain(X)
+        return _like_input(X, x * self.scale_)
+
+
+class Normalizer(TransformerMixin, TPUEstimator):
+    """Scale each ROW to unit norm (l1/l2/max) — stateless, one fused
+    elementwise pass; rows of all zeros stay zero (sklearn semantics)."""
+
+    def __init__(self, norm="l2", copy=True):
+        self.norm = norm
+        self.copy = copy
+
+    def fit(self, X, y=None):
+        if self.norm not in ("l1", "l2", "max"):
+            raise ValueError(f"Invalid norm: {self.norm!r}")
+        # stateless: fit only records the width — no device transfer
+        check_array(X)
+        self.n_features_in_ = (
+            X.data.shape[1] if isinstance(X, ShardedRows)
+            else np.asarray(X).shape[1]
+        )
+        return self
+
+    def transform(self, X, y=None, copy=None):
+        if self.norm not in ("l1", "l2", "max"):
+            raise ValueError(f"Invalid norm: {self.norm!r}")
+        d, _ = _masked_or_plain(X)
+        if self.norm == "l1":
+            n = jnp.sum(jnp.abs(d), axis=1, keepdims=True)
+        elif self.norm == "l2":
+            n = jnp.sqrt(jnp.sum(d * d, axis=1, keepdims=True))
+        else:
+            n = jnp.max(jnp.abs(d), axis=1, keepdims=True)
+        return _like_input(X, d / jnp.where(n > 0, n, 1.0))

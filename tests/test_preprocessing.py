@@ -271,3 +271,49 @@ class TestApproxQuantiles:
         # the bulk must spread over [0,1], not collapse to ~0
         assert np.percentile(out[:, 0], 50) == pytest.approx(0.5, abs=0.05)
         assert np.percentile(out[:, 1], 50) == pytest.approx(0.5, abs=0.05)
+
+
+class TestMaxAbsScaler:
+    def test_parity_with_sklearn(self, rng, mesh):
+        import sklearn.preprocessing as skp
+
+        from dask_ml_tpu.core import shard_rows, unshard
+        from dask_ml_tpu.preprocessing import MaxAbsScaler
+
+        X = rng.normal(size=(203, 5)).astype(np.float32) * [1, 10, 0.1, 5, 2]
+        ours = MaxAbsScaler().fit(shard_rows(X))
+        theirs = skp.MaxAbsScaler().fit(X)
+        np.testing.assert_allclose(np.asarray(ours.scale_), theirs.scale_, rtol=1e-6)
+        np.testing.assert_allclose(
+            unshard(ours.transform(shard_rows(X))), theirs.transform(X), rtol=1e-5)
+        np.testing.assert_allclose(
+            unshard(ours.inverse_transform(ours.transform(shard_rows(X)))),
+            X, rtol=1e-4, atol=1e-5)
+
+    def test_zero_feature_safe(self, mesh):
+        from dask_ml_tpu.preprocessing import MaxAbsScaler
+
+        X = np.zeros((10, 2), np.float32)
+        out = MaxAbsScaler().fit(X).transform(X)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestNormalizer:
+    @pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+    def test_parity_with_sklearn(self, rng, mesh, norm):
+        import sklearn.preprocessing as skp
+
+        from dask_ml_tpu.core import shard_rows, unshard
+        from dask_ml_tpu.preprocessing import Normalizer
+
+        X = rng.normal(size=(101, 4)).astype(np.float32)
+        X[3] = 0.0  # zero row stays zero
+        ours = unshard(Normalizer(norm=norm).fit(shard_rows(X)).transform(shard_rows(X)))
+        theirs = skp.Normalizer(norm=norm).fit(X).transform(X)
+        np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-6)
+
+    def test_bad_norm(self):
+        from dask_ml_tpu.preprocessing import Normalizer
+
+        with pytest.raises(ValueError, match="norm"):
+            Normalizer(norm="l3").fit(np.ones((3, 2), np.float32))
