@@ -111,3 +111,43 @@ def test_all_are_tpuestimator_or_sklearn():
         from sklearn.base import BaseEstimator
 
         assert issubclass(cls, (TPUEstimator, BaseEstimator)), name
+
+
+class TestDtypePreservation:
+    """Reference test strategy #5 (SURVEY.md §4): float32 in, float32 out
+    on the transform surface — the device-canonical dtype must survive
+    every scaler round-trip."""
+
+    SCALERS = ["StandardScaler", "MinMaxScaler", "RobustScaler",
+               "MaxAbsScaler", "Normalizer", "QuantileTransformer"]
+
+    @pytest.mark.parametrize("name", SCALERS)
+    def test_f32_in_f32_out(self, name):
+        import dask_ml_tpu.preprocessing as dp
+        from dask_ml_tpu.core import shard_rows
+
+        rng = np.random.RandomState(0)
+        X = rng.normal(size=(64, 3)).astype(np.float32) + 2.0
+        est = getattr(dp, name)()
+        out = est.fit(shard_rows(X)).transform(shard_rows(X))
+        assert out.data.dtype == np.float32, name
+
+    def test_bf16_matrix_survives_solver(self):
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        rng = np.random.RandomState(0)
+        X = rng.normal(size=(128, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        sX = shard_rows(X, dtype=jnp.bfloat16)
+        # the SOLVER-side contract: the design matrix enters the solve in
+        # bf16 (no silent f32 copy) while targets promote to f32
+        from dask_ml_tpu.solvers.algorithms import _prep
+
+        xd, yv, _ = _prep(sX, y)
+        assert xd.dtype == jnp.bfloat16
+        assert yv.dtype == jnp.float32
+        lr = LogisticRegression(solver="lbfgs").fit(sX, y)
+        assert np.asarray(lr.coef_).dtype == np.float32
