@@ -169,10 +169,16 @@ def _compact_partial():
             continue
         seen.add(key)
         keep.append(rec)
+    # temp + rename: a kill or ENOSPC mid-rewrite must not destroy the
+    # chip history this file exists to protect
+    tmp = _PARTIAL_PATH + ".tmp"
     try:
-        with open(_PARTIAL_PATH, "w") as f:
+        with open(tmp, "w") as f:
             for rec in reversed(keep):
                 f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, _PARTIAL_PATH)
     except OSError:
         pass
 
@@ -204,6 +210,25 @@ def _emit_and_exit():
         except Exception:
             pass
     os._exit(0)
+
+
+def _two_point_slope(fn, lo_i, hi_i, reps=3):
+    """Best-of-``reps`` wall time at two chained-iteration counts; the
+    slope cancels the constant RTT/dispatch cost (the only honest
+    per-iteration time on the axon relay — see module docstring).
+    ``fn`` takes the iteration count, must sync internally (fetch a
+    scalar), and must hit ONE jit executable for both counts (convert
+    the count to a consistent aval inside ``fn``)."""
+    fn(hi_i)  # compile
+    ts = {}
+    for n_i in (lo_i, hi_i):
+        best_t = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn(n_i)
+            best_t = min(best_t, time.perf_counter() - t0)
+        ts[n_i] = best_t
+    return max((ts[hi_i] - ts[lo_i]) / (hi_i - lo_i), 1e-9)
 
 
 def _tpu_backend_usable(probe_timeout_s: float = 75.0) -> bool:
@@ -501,18 +526,17 @@ def main():
             return beta, int(n_it)
 
         def slope_time(fn, reps=3):
-            """best-of-reps at two iteration counts; returns (per_iter_s,
-            last_result) with the constant RTT/dispatch cost cancelled."""
-            fn(hi_it)  # compile (max_iter is traced: one executable)
-            times, last = {}, None
-            for n_outer in (lo_it, hi_it):
-                best_t = float("inf")
-                for _ in range(reps):
-                    t0 = time.perf_counter()
-                    last = fn(n_outer)
-                    best_t = min(best_t, time.perf_counter() - t0)
-                times[n_outer] = best_t
-            return max((times[hi_it] - times[lo_it]) / (hi_it - lo_it), 1e-9), last
+            """_two_point_slope + capture of the last result (for the
+            parity gate); max_iter is traced, so both counts hit one
+            executable."""
+            last = None
+
+            def run(n_outer):
+                nonlocal last
+                last = fn(n_outer)
+
+            per = _two_point_slope(run, lo_it, hi_it, reps=reps)
+            return per, last
 
         per_outer, (_, n_it32) = slope_time(lambda n: solve(n, sXi))
         dt2 = per_outer * admm_iters
@@ -576,16 +600,9 @@ def main():
             )
 
         b0 = jnp.zeros((d2,), jnp.float32)
-        t_vg = {}
-        for n_evals in (2, 20):
-            float(vg_run(jnp.int32(n_evals), b0)[1])
-            best_t = float("inf")
-            for _ in range(3):
-                t0 = time.perf_counter()
-                float(vg_run(jnp.int32(n_evals), b0)[1])
-                best_t = min(best_t, time.perf_counter() - t0)
-            t_vg[n_evals] = best_t
-        per_eval = max((t_vg[20] - t_vg[2]) / 18, 1e-9)
+        per_eval = _two_point_slope(
+            lambda n_evals: float(vg_run(jnp.int32(n_evals), b0)[1]), 2, 20
+        )
         ev_gbytes = 2 * n2 * d2 * 4 / 1e9
         ev_flops = 4.0 * n2 * d2
         _record({
@@ -613,21 +630,6 @@ def main():
             nS = 2_000_000 if on_tpu else 200_000
             nbins = 256
             vals = jnp.asarray(rng.normal(size=(nS,)).astype(np.float32))
-
-            def _slope(fn, lo_i=2, hi_i=20, reps=3):
-                # jnp.int32 consistently in warmup AND timed calls: the
-                # jit cache keys on weak_type, so mixing Python ints with
-                # jnp scalars compiles a second, unused executable
-                fn(jnp.int32(hi_i))  # compile (traced bound: one executable)
-                ts = {}
-                for n_i in (lo_i, hi_i):
-                    best_t = float("inf")
-                    for _ in range(reps):
-                        t0 = time.perf_counter()
-                        fn(jnp.int32(n_i))
-                        best_t = min(best_t, time.perf_counter() - t0)
-                    ts[n_i] = best_t
-                return max((ts[hi_i] - ts[lo_i]) / (hi_i - lo_i), 1e-9)
 
             @jax.jit
             def hist_scatter(n_it):
@@ -666,12 +668,18 @@ def main():
                 return jax.lax.fori_loop(
                     0, n_it, one, jnp.zeros((1024,), jnp.float32))
 
+            per_by_name = {}
             for name, fn, n_out in (
                 ("hist_segment_sum", hist_scatter, nbins),
                 ("hist_onehot_matmul", hist_onehot, nbins),
                 ("mode_at_add", mode_scatter, 1024),
             ):
-                per = _slope(lambda n_i, f=fn: float(f(n_i)[0]))
+                # jnp.int32 inside the lambda: consistent aval for the
+                # warmup and timed calls → one jit executable
+                per = _two_point_slope(
+                    lambda n_i, f=fn: float(f(jnp.int32(n_i))[0]), 2, 20
+                )
+                per_by_name[name] = per
                 _record({
                     "workload": f"scatter_{name}_{nS}x{n_out}",
                     "per_iter_ms": round(per * 1e3, 3),
@@ -679,12 +687,9 @@ def main():
                     # minimum traffic: read vals once per round
                     "achieved_gb_s": round(nS * 4 / per / 1e9, 2),
                 })
-            sc = {w["workload"].split("_", 1)[1].rsplit("_", 1)[0]: w
-                  for w in workloads if w["workload"].startswith("scatter_")}
-            if "hist_segment_sum" in sc and "hist_onehot_matmul" in sc:
-                _record_extra("hist_onehot_vs_segsum_speedup", round(
-                    sc["hist_segment_sum"]["per_iter_ms"]
-                    / sc["hist_onehot_matmul"]["per_iter_ms"], 3))
+            _record_extra("hist_onehot_vs_segsum_speedup", round(
+                per_by_name["hist_segment_sum"]
+                / per_by_name["hist_onehot_matmul"], 3))
     except Exception:
         extra["scatter_error"] = traceback.format_exc(limit=3)
 

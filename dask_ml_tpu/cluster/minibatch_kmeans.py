@@ -32,6 +32,7 @@ from jax import lax
 from ..base import TPUEstimator, TransformerMixin
 from ..core.prng import as_key
 from ..core.sharded import ShardedRows
+from ..utils import check_max_iter
 from .k_means import _assign, _ingest_float, _sq_dists
 
 logger = logging.getLogger(__name__)
@@ -51,9 +52,17 @@ def _mbk_step(centers, counts, xb, mask):
     inertia = jnp.sum(min_d2 * mask)
     onehot = jax.nn.one_hot(labels, centers.shape[0], dtype=xb.dtype) * mask[:, None]
     bsum = jnp.dot(onehot.T, xb, precision=lax.Precision.HIGHEST)
-    bcnt = jnp.sum(onehot, axis=0)
-    new_counts = counts + bcnt
-    inv = jnp.where(new_counts > 0, 1.0 / jnp.maximum(new_counts, 1.0), 0.0)
+    # batch counts summed in f32 explicitly: with bf16 data the one-hot
+    # sum would round back to bf16 (256-row resolution) BEFORE the int
+    # cast; the center update keeps the data dtype as designed
+    bcnt32 = jnp.sum(onehot, axis=0, dtype=jnp.float32)
+    bcnt = bcnt32.astype(xb.dtype)
+    # cumulative counts live in int32: exact to 2^31, where a float32 (or
+    # worse, bf16 when the data is bf16) count would silently stop
+    # incrementing at 2^24 rows/center and freeze the 1/n_c decay
+    new_counts = counts + bcnt32.astype(jnp.int32)
+    ncf = new_counts.astype(xb.dtype)
+    inv = jnp.where(new_counts > 0, 1.0 / jnp.maximum(ncf, 1.0), 0.0)
     new_centers = centers + (bsum - bcnt[:, None] * centers) * inv[:, None]
     return new_centers, new_counts, inertia
 
@@ -159,7 +168,7 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
                 )
             key = as_key(self.random_state)
             self.cluster_centers_ = self._init_from_block(X, key)
-            self._counts = jnp.zeros((self.n_clusters,), X.data.dtype)
+            self._counts = jnp.zeros((self.n_clusters,), jnp.int32)
             self.n_features_in_ = X.data.shape[1]
             self.n_steps_ = 0
 
@@ -191,6 +200,7 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
 
     # -- whole-array fit ---------------------------------------------------
     def fit(self, X, y=None):
+        check_max_iter(self.max_iter)
         X = _ingest_float(self, X)
         for attr in ("cluster_centers_", "_counts"):
             if hasattr(self, attr):

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from ..base import ClassifierMixin, RegressorMixin, TPUEstimator, clone
 from ..core.sharded import ShardedRows, unshard
+from ..utils import check_max_iter
 
 
 def _to_host_pair(X, y):
@@ -180,6 +181,7 @@ class _BlockwiseBase(TPUEstimator):
             lambda *xs: jnp.stack(xs), *[m._hyper() for m in members]
         )
 
+        check_max_iter(m0.max_iter)
         stop = EpochStopper(m0.tol, getattr(m0, "n_iter_no_change", 5))
         for epoch in range(m0.max_iter):
             states, losses = _ensemble_epoch(

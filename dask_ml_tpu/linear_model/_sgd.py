@@ -230,6 +230,9 @@ class EpochStopper:
 def _run_epochs(est, xb, yb, mask) -> int:
     """Full-batch epoch loop for ``fit``: one fused step per epoch; the
     scalar loss syncs to host only when a tol check is active."""
+    from ..utils import check_max_iter
+
+    check_max_iter(est.max_iter)
     hyper = est._hyper()
     stop = EpochStopper(est.tol, getattr(est, "n_iter_no_change", 5))
     for epoch in range(est.max_iter):

@@ -148,6 +148,10 @@ def _indicator_matrices(y_true, y_pred, sample_weight, labels):
 _COUNT_CHUNK = 1 << 22  # rows per f32 device partial sum: keeps every
 # per-chunk count below 2^24, where f32 accumulation saturates
 
+_AUC_BLOCK = 1 << 20  # roc_auc two-level prefix sum: within-block f32
+# cumsums stay far below the 2^24 saturation point; block bases
+# accumulate in float64 on host (tests shrink this to hit multi-block)
+
 
 def _prf_counts(y_true, y_pred, sample_weight, labels):
     """Per-class (tp, pred_pos, true_pos) via one-hot products — no
@@ -193,6 +197,14 @@ def _prf(y_true, y_pred, *, average, sample_weight, labels, pos_label, beta=1.0)
             )
         where = np.flatnonzero(classes == pos_label)
         if where.size == 0:
+            if labels is not None:
+                # the caller spelled out the label set: a pos_label not
+                # in it is a coding error, not a thin CV fold — raise
+                # like sklearn instead of silently scoring 0
+                raise ValueError(
+                    f"pos_label={pos_label!r} is not a valid label: "
+                    f"{classes.tolist()}"
+                )
             # sklearn semantics: an absent pos_label scores 0 with an
             # UndefinedMetricWarning, it does not abort the CV loop
             import warnings
@@ -277,21 +289,58 @@ def roc_auc_score(y_true, y_score, sample_weight=None):
     order = jnp.argsort(s)
     s_sorted = s[order]
     wneg_sorted = (w * (1.0 - pos))[order]
-    cumneg = jnp.concatenate(
-        [jnp.zeros((1,), jnp.float32), jnp.cumsum(wneg_sorted)]
-    )
     lo = jnp.searchsorted(s_sorted, s, side="left")
     hi = jnp.searchsorted(s_sorted, s, side="right")
-    below = cumneg[lo]
-    tied = cumneg[hi] - cumneg[lo]
     wpos = w * pos
-    num = jnp.sum(wpos * (below + 0.5 * tied))
-    W_pos = jnp.sum(wpos)
-    W_neg = jnp.sum(w * (1.0 - pos))
-    denom = float(W_pos) * float(W_neg)
+    # below + tied/2 at index j is 0.5*(cum(lo_j) + cum(hi_j)) where cum
+    # is the exclusive prefix sum of negative weight.  A single f32
+    # cumsum loses unit precision past 2^24 accumulated weight, so the
+    # prefix sum is TWO-LEVEL: within-block cumsums stay on device in
+    # f32 (exact at block scale), while the O(B) block bases accumulate
+    # in float64 on host — fetches are B-sized, never O(n) (large D2H
+    # transfers can wedge the axon relay).
+    n_tot = int(s.shape[0])
+    L = _AUC_BLOCK
+    while L >= 2 * max(n_tot, 1):
+        L >>= 1
+    B = -(-n_tot // L)
+    n_pad = B * L
+    wneg_p = jnp.zeros((n_pad,), jnp.float32).at[:n_tot].set(wneg_sorted)
+    blocks = wneg_p.reshape(B, L)
+    within_incl = jnp.cumsum(blocks, axis=1)
+    block_sums = within_incl[:, -1]
+    within_excl = (within_incl - blocks).reshape(-1)
+    # index n_pad is reachable only when hi == n_tot == n_pad: zero
+    # within-block prefix, block id B (whose base is the full W_neg)
+    flat_within = jnp.concatenate(
+        [within_excl, jnp.zeros((1,), jnp.float32)]
+    )
+    num_within = jnp.sum(wpos * 0.5 * (flat_within[lo] + flat_within[hi]))
+    # per-block positive weight, CHUNKED like _prf_counts: one device
+    # segment_sum accumulates in f32 and saturates at 2^24 if enough
+    # tied positives land in a single block; per-chunk partials stay
+    # exact and sum in float64 on host (each fetch is B-sized)
+    ids = jnp.concatenate([lo // L, hi // L])
+    wps = jnp.concatenate([wpos, wpos])
+    seg64 = np.zeros(B + 1, np.float64)
+    for c0 in range(0, 2 * n_tot, _COUNT_CHUNK):
+        c1 = min(c0 + _COUNT_CHUNK, 2 * n_tot)
+        seg64 += np.asarray(
+            jax.ops.segment_sum(
+                wps[c0:c1], ids[c0:c1], num_segments=B + 1
+            ),
+            np.float64,
+        )
+    bases = np.concatenate(
+        [[0.0], np.cumsum(np.asarray(block_sums, np.float64))]
+    )
+    num = float(num_within) + 0.5 * float(seg64 @ bases)
+    W_neg = float(bases[-1])
+    W_pos = float(jnp.sum(wpos))
+    denom = W_pos * W_neg
     if denom <= 0:
         raise ValueError("Only one class present after weighting")
-    return float(num) / denom
+    return num / denom
 
 
 def confusion_matrix(y_true, y_pred, *, labels=None, sample_weight=None,

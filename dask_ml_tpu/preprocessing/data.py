@@ -115,12 +115,22 @@ def _hist_quantiles(x, mask, probs, *, bins=4096, refinements=3):
             # widened one bin each side — fp32 edge arithmetic at large
             # scales (lo ~ 1e9, ulp 64) can otherwise round the window
             # past the true quantile region and exclude the bulk.  With
-            # no interior probs the window is irrelevant (endpoints are
-            # exact); keep it degenerate-safe at the full span.
+            # no interior probs the sentinel fillers would INVERT the
+            # window (bmin=bins-1 > bmax=0), so fall back to the genuine
+            # full span [lo_1, lo_1 + width_1] — the window is unused for
+            # the final values then (endpoints are exact) but must stay a
+            # valid range for the next pass's histogram.
+            has_interior = jnp.any(interior)
             bmin = jnp.min(jnp.where(interior, b, bins - 1))
             bmax = jnp.max(jnp.where(interior, b, 0))
-            nlo = lo_1 + (bmin.astype(x.dtype) - 1.0) * binw
-            nhi = lo_1 + (bmax.astype(x.dtype) + 2.0) * binw
+            nlo = jnp.where(
+                has_interior,
+                lo_1 + (bmin.astype(x.dtype) - 1.0) * binw, lo_1,
+            )
+            nhi = jnp.where(
+                has_interior,
+                lo_1 + (bmax.astype(x.dtype) + 2.0) * binw, lo_1 + width_1,
+            )
             return val, nlo, nhi
 
         vals, nlo, nhi = jax.vmap(

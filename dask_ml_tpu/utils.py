@@ -104,6 +104,14 @@ def svd_flip(u, v, u_based_decision: bool = True):
     return u, v
 
 
+def check_max_iter(max_iter):
+    """Reject non-positive epoch budgets up front: every epoch-loop
+    estimator reads the loop variable after the loop, so ``max_iter=0``
+    would otherwise surface as an unbound-variable crash mid-fit."""
+    if max_iter < 1:
+        raise ValueError(f"max_iter must be >= 1, got {max_iter}")
+
+
 def draw_seed(random_state, low=0, high=2**31 - 1, size=None):
     """Draw integer seed(s) from a numpy RandomState-compatible source.
 

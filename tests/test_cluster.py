@@ -382,3 +382,29 @@ class TestMiniBatchKMeans:
         X = rng.normal(size=(3, 2)).astype(np.float32)
         with pytest.raises(ValueError, match="n_samples"):
             dc.MiniBatchKMeans(n_clusters=8).partial_fit(X)
+
+
+class TestAdvisorRound2Fixes:
+    def test_minibatch_max_iter_zero_raises(self, rng, mesh):
+        X = rng.normal(size=(64, 4)).astype(np.float32)
+        with pytest.raises(ValueError, match="max_iter"):
+            dc.MiniBatchKMeans(n_clusters=2, max_iter=0).fit(X)
+
+    def test_minibatch_counts_are_int32(self, rng, mesh):
+        import jax.numpy as jnp
+
+        X = rng.normal(size=(256, 4)).astype(np.float32)
+        mbk = dc.MiniBatchKMeans(n_clusters=3, random_state=0)
+        mbk.partial_fit(X)
+        # int32 counts stay exact to 2^31; a data-dtype (f32/bf16) count
+        # would silently freeze the 1/n_c decay at 2^24 (bf16: 256)
+        assert mbk._counts.dtype == jnp.int32
+        assert int(mbk._counts.sum()) == 256
+
+    def test_sgd_max_iter_zero_raises(self, rng, mesh):
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X = rng.normal(size=(64, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        with pytest.raises(ValueError, match="max_iter"):
+            SGDClassifier(max_iter=0).fit(X, y)

@@ -553,3 +553,32 @@ class TestExtraRegressionMetrics:
                      "median_absolute_error", "explained_variance_score"):
             assert getattr(dm, name)(t, p) == pytest.approx(
                 getattr(skm, name)(t, p), rel=1e-4), name
+
+
+class TestAdvisorRound2Fixes:
+    """Pins for the round-2 advisor findings (ADVICE.md)."""
+
+    def test_roc_auc_multiblock_prefix_matches_sklearn(self, rng, mesh, monkeypatch):
+        # shrink the two-level prefix-sum block so a small input spans
+        # many blocks — exercises the f64 block-base assembly end to end
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu.metrics import classification as cl
+
+        monkeypatch.setattr(cl, "_AUC_BLOCK", 64)
+        t = rng.randint(0, 2, size=1000)
+        s = np.round(rng.normal(size=1000) + t, 1)  # heavy ties
+        w = rng.rand(1000)
+        got = cl.roc_auc_score(t, s, sample_weight=w)
+        assert got == pytest.approx(
+            skm.roc_auc_score(t, s, sample_weight=w), abs=1e-6)
+        # unweighted too
+        assert cl.roc_auc_score(t, s) == pytest.approx(
+            skm.roc_auc_score(t, s), abs=1e-6)
+
+    def test_explicit_labels_with_absent_pos_label_raises(self, mesh):
+        from dask_ml_tpu import metrics as dm
+
+        with pytest.raises(ValueError, match="not a valid label"):
+            dm.precision_score([0, 0, 1], [0, 1, 1], labels=[0, 1],
+                               pos_label=2)

@@ -317,3 +317,36 @@ class TestNormalizer:
 
         with pytest.raises(ValueError, match="norm"):
             Normalizer(norm="l3").fit(np.ones((3, 2), np.float32))
+
+
+class TestHistQuantileWindowFallback:
+    def test_endpoint_only_probs_survive_refinement(self, rng, mesh):
+        # probs with NO interior entries used to invert the refinement
+        # window (bmin > bmax); the window must stay the genuine full
+        # span so every pass's histogram remains valid
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.preprocessing.data import _hist_quantiles
+
+        x = rng.normal(size=(512, 3)).astype(np.float32) * 100
+        mask = np.ones(512, np.float32)
+        vals = np.asarray(_hist_quantiles(
+            jnp.asarray(x), jnp.asarray(mask),
+            jnp.asarray([0.0, 1.0], np.float32)))
+        np.testing.assert_allclose(vals[0], x.min(axis=0), rtol=1e-6)
+        np.testing.assert_allclose(vals[1], x.max(axis=0), rtol=1e-6)
+
+    def test_mixed_probs_interior_still_refined(self, rng, mesh):
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.preprocessing.data import _hist_quantiles
+
+        # outlier-heavy column: refinement must still resolve the median
+        x = rng.normal(size=(4096, 1)).astype(np.float32)
+        x[0, 0] = 1e9
+        mask = np.ones(4096, np.float32)
+        vals = np.asarray(_hist_quantiles(
+            jnp.asarray(x), jnp.asarray(mask),
+            jnp.asarray([0.0, 0.5, 1.0], np.float32)))
+        med = np.median(x[:, 0])
+        assert abs(vals[1, 0] - med) < 2e-3
