@@ -9,14 +9,17 @@ are ``lax.while_loop``s, and ADMM's per-chunk local L-BFGS runs inside
 ``shard_map`` with a single psum per consensus round.
 """
 
-from .families import Logistic, Normal, Poisson  # noqa: F401
+from .families import Logistic, Normal, Poisson, multinomial  # noqa: F401
 from .regularizers import L1, L2, ElasticNet, get_regularizer  # noqa: F401
 from .algorithms import (  # noqa: F401
+    DISPATCH_COUNTS,
     admm,
     gradient_descent,
     lbfgs,
     newton,
+    packed_solve,
     proximal_grad,
+    reset_dispatch_counts,
 )
 from .lbfgs_core import lbfgs_minimize  # noqa: F401
 
@@ -24,6 +27,7 @@ __all__ = [
     "Logistic",
     "Normal",
     "Poisson",
+    "multinomial",
     "L1",
     "L2",
     "ElasticNet",
@@ -33,5 +37,8 @@ __all__ = [
     "lbfgs",
     "newton",
     "proximal_grad",
+    "packed_solve",
+    "DISPATCH_COUNTS",
+    "reset_dispatch_counts",
     "lbfgs_minimize",
 ]

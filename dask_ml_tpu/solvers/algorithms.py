@@ -66,6 +66,22 @@ def _param_dtype(x):
     return jnp.promote_types(x.dtype, jnp.float32)
 
 
+def _pdim(x, family):
+    """Parameter-vector length: features × the family's parameters per
+    feature (1 for scalar-response families; K for multinomial softmax,
+    whose flat beta reshapes to (features, K) inside the loss)."""
+    return x.shape[1] * int(getattr(family, "params_per_feature", 1))
+
+
+#: Python-level solver dispatch counter (observability for the packed
+#: OvR path: a K-class fit must cost O(1) dispatches, not K).
+DISPATCH_COUNTS = {"solves": 0}
+
+
+def reset_dispatch_counts():
+    DISPATCH_COUNTS["solves"] = 0
+
+
 def _make_objective(family, reg, x, y, mask, lamduh):
     """Total objective as a traceable closure over THIS trace's arrays.
 
@@ -112,7 +128,8 @@ def lbfgs(X, y, *, family: type[Family] = Logistic, regularizer=L2,
             "Use proximal_grad or admm for l1/elastic_net."
         )
     x, yv, mask = _prep(X, y)
-    beta0 = jnp.zeros(x.shape[1], dtype=_param_dtype(x))
+    DISPATCH_COUNTS["solves"] += 1
+    beta0 = jnp.zeros(_pdim(x, family), dtype=_param_dtype(x))
     beta, n_it = _lbfgs_run(
         x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
         jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
@@ -163,7 +180,8 @@ def gradient_descent(X, y, *, family: type[Family] = Logistic,
     if lamduh and not reg.smooth:
         raise ValueError("gradient_descent requires a smooth penalty; use proximal_grad")
     x, yv, mask = _prep(X, y)
-    beta0 = jnp.zeros(x.shape[1], dtype=_param_dtype(x))
+    DISPATCH_COUNTS["solves"] += 1
+    beta0 = jnp.zeros(_pdim(x, family), dtype=_param_dtype(x))
     beta, n_it = _gd_run(
         x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
         jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
@@ -227,7 +245,8 @@ def proximal_grad(X, y, *, family: type[Family] = Logistic, regularizer=L2,
     ``proximal_grad``): z = prox_{tλ}(β − t∇f(β))."""
     reg = get_regularizer(regularizer)
     x, yv, mask = _prep(X, y)
-    beta0 = jnp.zeros(x.shape[1], dtype=_param_dtype(x))
+    DISPATCH_COUNTS["solves"] += 1
+    beta0 = jnp.zeros(_pdim(x, family), dtype=_param_dtype(x))
     beta, n_it = _pg_run(
         x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
         jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
@@ -286,8 +305,15 @@ def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
     reg = get_regularizer(regularizer)
     if lamduh and not reg.smooth:
         raise ValueError("newton requires a smooth penalty")
+    if getattr(family, "params_per_feature", 1) > 1:
+        raise ValueError(
+            "newton needs scalar per-sample hessian weights; the "
+            "multinomial family has a KxK block hessian — use lbfgs/"
+            "gradient_descent/proximal_grad/admm"
+        )
     x, yv, mask = _prep(X, y)
-    beta0 = jnp.zeros(x.shape[1], dtype=_param_dtype(x))
+    DISPATCH_COUNTS["solves"] += 1
+    beta0 = jnp.zeros(_pdim(x, family), dtype=_param_dtype(x))
     beta, n_it = _newton_run(
         x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
         jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
@@ -306,7 +332,7 @@ def _admm_run(x, yv, mask, lamduh, rho, abstol, reltol, inner_tol, max_it,
               *, family, reg, mesh_holder, inner_iter):
     mesh = mesh_holder.mesh
     n_shards = mesh.shape[DATA_AXIS]
-    d = x.shape[1]
+    d = _pdim(x, family)
 
     def one_shard(xb, yb, mb, z_rep, beta_b, u_b):
         u0, b0 = u_b[0], beta_b[0]
@@ -400,6 +426,7 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
     reg = get_regularizer(regularizer)
     mesh = mesh or get_mesh()
     x, yv, mask = _prep(X, y)
+    DISPATCH_COUNTS["solves"] += 1
     dt = _param_dtype(x)
     beta, n_it = _admm_run(
         x, yv, mask,
@@ -412,3 +439,82 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
     # n_it stays a device scalar: converting here would block the
     # async dispatch pipeline (callers convert after ALL solves)
     return (beta, n_it) if return_n_iter else beta
+
+
+# ------------------------------------------------------- packed (vmap) --
+
+
+def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
+                 regularizer=L2, lamduh: float = 0.0, max_iter: int = 100,
+                 tol: float = 1e-5, rho: float = 1.0, abstol: float = 1e-4,
+                 reltol: float = 1e-2, inner_iter: int = 50,
+                 inner_tol: float = 1e-6, mesh=None):
+    """All K independent solves as ONE vmapped XLA program over the
+    leading axis of ``Y`` — the one-vs-rest fit issues a single dispatch
+    instead of K sequential ones (the solvers' whole-solve ``while_loop``
+    design is vmap-safe by construction: converged lanes hold their carry
+    while stragglers keep iterating).
+
+    Reference: ``dask_ml/linear_model/glm.py :: LogisticRegression``
+    dispatches per class; there is no packed equivalent to cite — this is
+    the TPU-native improvement over the reference's task-per-class plan.
+
+    Args:
+      solver: one of ``admm | lbfgs | gradient_descent | proximal_grad |
+        newton``.
+      Y: (K, padded_rows) stacked targets aligned with ``X``'s padded
+        rows (pad rows are dead via the mask).
+    Returns:
+      (betas (K, pdim), n_iters (K,)) — both device arrays; each lane
+      carries its own executed-iteration count.
+    """
+    reg = get_regularizer(regularizer)
+    x, _, mask = _prep(X, Y[0])
+    dt = _param_dtype(x)
+    Yd = jnp.asarray(Y).astype(dt)
+    if Yd.ndim != 2 or Yd.shape[1] != x.shape[0]:
+        raise ValueError(
+            f"Y must be (K, padded_rows={x.shape[0]}); got {Yd.shape}"
+        )
+    K = Yd.shape[0]
+    lam = jnp.asarray(lamduh, dt)
+    DISPATCH_COUNTS["solves"] += 1
+    if solver == "admm":
+        mesh = mesh or get_mesh()
+        mh = MeshHolder(mesh)
+
+        def one(yv):
+            return _admm_run(
+                x, yv, mask, lam, jnp.asarray(rho, dt),
+                jnp.asarray(abstol, dt), jnp.asarray(reltol, dt),
+                jnp.asarray(inner_tol, dt), jnp.int32(max_iter),
+                family=family, reg=reg, mesh_holder=mh,
+                inner_iter=inner_iter,
+            )
+
+        return jax.vmap(one)(Yd)
+    runners = {
+        "lbfgs": _lbfgs_run,
+        "gradient_descent": _gd_run,
+        "proximal_grad": _pg_run,
+        "newton": _newton_run,
+    }
+    if solver not in runners:
+        raise ValueError(f"Unknown solver {solver!r}")
+    if solver in ("lbfgs", "gradient_descent", "newton") and lamduh \
+            and not reg.smooth:
+        raise ValueError(
+            f"{solver} requires a smooth penalty; got {reg.__name__}"
+        )
+    if solver == "newton" and getattr(family, "params_per_feature", 1) > 1:
+        raise ValueError("newton does not support matrix-parameter families")
+    run = runners[solver]
+    B0 = jnp.zeros((K, _pdim(x, family)), dtype=dt)
+
+    def one(yv, b0):
+        return run(
+            x, yv, mask, b0, lam, jnp.int32(max_iter),
+            jnp.asarray(tol, dt), family=family, reg=reg,
+        )
+
+    return jax.vmap(one)(Yd, B0)

@@ -193,3 +193,113 @@ class TestNIter:
         X, y = reg_data
         lr = dlm.LinearRegression(solver="lbfgs").fit(shard_rows(X), y)
         assert lr.n_iter_.shape == (1,)
+
+
+@pytest.fixture
+def multiclass_data(rng):
+    n, d, K = 1200, 6, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    W = rng.normal(size=(d, K))
+    y = (X @ W + rng.normal(scale=0.5, size=(n, K))).argmax(1)
+    return X, y
+
+
+class TestPackedOvR:
+    """VERDICT r2 next #5: the K one-vs-rest solves run as ONE vmapped
+    program (O(1) dispatches), with parity against sklearn OvR."""
+
+    @pytest.mark.parametrize(
+        "solver", ["lbfgs", "admm", "gradient_descent", "proximal_grad"]
+    )
+    def test_single_dispatch_and_accuracy(self, multiclass_data, mesh, solver):
+        from dask_ml_tpu import solvers
+
+        X, y = multiclass_data
+        solvers.reset_dispatch_counts()
+        lr = dlm.LogisticRegression(
+            solver=solver, C=1.0, max_iter=150
+        ).fit(X, y)
+        assert solvers.DISPATCH_COUNTS["solves"] == 1
+        assert lr.betas_.shape[0] == 4
+        assert lr.n_iter_.shape == (4,)
+        acc = float((lr.predict(X) == y).mean())
+        sk = sl.LogisticRegression(C=1.0, max_iter=300).fit(X, y)
+        assert acc >= sk.score(X, y) - 0.03
+
+    def test_sharded_multiclass_single_dispatch(self, multiclass_data, mesh):
+        from dask_ml_tpu import solvers
+
+        X, y = multiclass_data
+        sX, sy = shard_rows(X), shard_rows(y.astype(np.float32))
+        solvers.reset_dispatch_counts()
+        lr = dlm.LogisticRegression(solver="lbfgs", C=1.0, max_iter=150).fit(
+            sX, sy
+        )
+        assert solvers.DISPATCH_COUNTS["solves"] == 1
+        assert float((lr.predict(sX)[: len(y)] == y).mean()) > 0.8
+
+    def test_packed_matches_sequential_loop(self, multiclass_data, mesh):
+        # the packed program must agree with K independent solves
+        from dask_ml_tpu.solvers import Logistic, lbfgs, packed_solve
+        from dask_ml_tpu.core import shard_rows as _sr
+
+        X, y = multiclass_data
+        sX = _sr(X)
+        n_pad = sX.data.shape[0]
+        classes = np.unique(y)
+        Y = np.zeros((len(classes), n_pad), np.float32)
+        for i, c in enumerate(classes):
+            Y[i, : len(y)] = (y == c)
+        betas, n_its = packed_solve(
+            "lbfgs", sX, Y, family=Logistic, lamduh=1.0, max_iter=150,
+        )
+        for i, c in enumerate(classes):
+            b, n_it = lbfgs(
+                sX, Y[i], family=Logistic, lamduh=1.0, max_iter=150,
+                return_n_iter=True,
+            )
+            # loose rtol: the batched (vmapped) gemm accumulates in a
+            # different order than K independent gemms, and converged
+            # lanes hold their carry while stragglers iterate
+            np.testing.assert_allclose(
+                np.asarray(betas[i]), np.asarray(b), rtol=5e-3, atol=1e-3
+            )
+
+
+class TestMultinomial:
+    def test_parity_with_sklearn(self, multiclass_data, mesh):
+        X, y = multiclass_data
+        ours = dlm.LogisticRegression(
+            solver="lbfgs", C=1.0, max_iter=300, multi_class="multinomial"
+        ).fit(X, y)
+        sk = sl.LogisticRegression(C=1.0, max_iter=300).fit(X, y)
+        p_ours = np.asarray(ours.predict_proba(X))
+        p_sk = sk.predict_proba(X)
+        assert np.abs(p_ours - p_sk).max() < 0.02
+        # coefs agree in the sum-to-zero gauge (softmax is shift-invariant
+        # per feature; sklearn's multinomial is centered the same way)
+        np.testing.assert_allclose(
+            np.asarray(ours.coef_) - np.asarray(ours.coef_).mean(0),
+            sk.coef_ - sk.coef_.mean(0), atol=5e-2,
+        )
+        assert ours.n_iter_.shape == (1,)
+
+    def test_binary_multinomial_uses_sigmoid_path(self, clf_data, mesh):
+        X, y = clf_data
+        lr = dlm.LogisticRegression(
+            solver="lbfgs", multi_class="multinomial", max_iter=100
+        ).fit(X, y)
+        assert lr.coef_.ndim == 1  # binary contract unchanged
+        assert float((lr.predict(X) == y).mean()) > 0.8
+
+    def test_invalid_multi_class_raises(self, clf_data, mesh):
+        X, y = clf_data
+        with pytest.raises(ValueError, match="multi_class"):
+            dlm.LogisticRegression(multi_class="bogus").fit(X, y)
+
+    def test_multinomial_newton_rejected(self, multiclass_data, mesh):
+        X, y = multiclass_data
+        with pytest.raises(ValueError, match="newton"):
+            dlm.LogisticRegression(
+                solver="newton", multi_class="multinomial"
+            ).fit(X, y)

@@ -594,3 +594,97 @@ class TestDataFrameSplit:
             scoring=["accuracy", "roc_auc"], refit="roc_auc", cv=3,
         ).fit(X, y)
         assert gs.cv_results_["mean_test_roc_auc"][gs.best_index_] > 0.8
+
+
+class TestDeviceResidentSearch:
+    """VERDICT r2 next #4: sharded data stays on device through the CV
+    searches — fold slicing by device gather, scoring by scalar fetch."""
+
+    def _tpu_est(self, **kw):
+        from dask_ml_tpu.linear_model import SGDClassifier as TpuSGD
+
+        kw.setdefault("max_iter", 30)
+        kw.setdefault("random_state", 0)
+        kw.setdefault("tol", None)
+        return TpuSGD(**kw)
+
+    def test_grid_no_host_materialization(self, clf_data, monkeypatch, mesh):
+        # transfer guard: any unshard inside the search layer is a bug on
+        # the device path (fold gathers run on device, scores are scalars)
+        import dask_ml_tpu.model_selection._search as search_mod
+
+        def _boom(a):
+            raise AssertionError("O(n) unshard on the device search path")
+
+        monkeypatch.setattr(search_mod, "unshard", _boom)
+        X, y = clf_data
+        sX, sy = shard_rows(X), shard_rows(y.astype(np.float32))
+        gs = dms.GridSearchCV(
+            self._tpu_est(), {"alpha": [1e-4, 1e-2]}, cv=3
+        ).fit(sX, sy)
+        assert gs.best_score_ > 0.5
+        # post-fit inference keeps sharded input on device too
+        gs.predict(sX)
+        assert gs.score(sX, sy) > 0.5
+
+    def test_device_path_matches_host_path(self, clf_data, mesh):
+        from sklearn.model_selection import KFold
+
+        X, y = clf_data
+        yf = y.astype(np.float32)
+        host = dms.GridSearchCV(
+            self._tpu_est(), {"alpha": [1e-4, 1e-2]}, cv=KFold(3),
+            refit=False,
+        ).fit(X, yf)
+        dev = dms.GridSearchCV(
+            self._tpu_est(), {"alpha": [1e-4, 1e-2]}, cv=KFold(3),
+            refit=False,
+        ).fit(shard_rows(X), shard_rows(yf))
+        np.testing.assert_allclose(
+            host.cv_results_["mean_test_score"],
+            dev.cv_results_["mean_test_score"], rtol=1e-4,
+        )
+
+    def test_incremental_keeps_test_split_sharded(self, clf_data, monkeypatch, mesh):
+        import dask_ml_tpu.model_selection._incremental as inc_mod
+
+        def _boom(a):
+            raise AssertionError("O(n) unshard in incremental search")
+
+        monkeypatch.setattr(inc_mod, "unshard", _boom)
+        X, y = clf_data
+        sX, sy = shard_rows(X), shard_rows(y.astype(np.float32))
+        search = dms.IncrementalSearchCV(
+            self._tpu_est(tol=1e-3), {"alpha": [1e-4, 1e-2]},
+            n_initial_parameters=2, max_iter=3, random_state=0,
+        ).fit(sX, sy, classes=[0.0, 1.0])
+        assert search.best_score_ > 0.0
+
+
+class TestPrefixCacheEviction:
+    def test_refcount_evicts_all_entries(self, clf_data, monkeypatch):
+        import dask_ml_tpu.model_selection._search as search_mod
+        from sklearn.pipeline import Pipeline
+        from sklearn.preprocessing import StandardScaler
+
+        created = []
+        orig = search_mod._OnceCache
+
+        class Spy(orig):
+            def __init__(self):
+                super().__init__()
+                created.append(self)
+
+        monkeypatch.setattr(search_mod, "_OnceCache", Spy)
+        X, y = clf_data
+        pipe = Pipeline([
+            ("sc", StandardScaler()),
+            ("clf", SGDClassifier(tol=1e-3, random_state=0)),
+        ])
+        gs = dms.GridSearchCV(
+            pipe, {"clf__alpha": [1e-4, 1e-3, 1e-2]}, cv=3, refit=False
+        ).fit(X, y)
+        assert gs.best_score_ > 0.5
+        # every (prefix, fold) entry was released by its last consumer:
+        # transformed fold data must not be pinned for the fit's lifetime
+        assert created and len(created[0]) == 0

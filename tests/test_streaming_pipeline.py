@@ -160,15 +160,21 @@ class TestReviewRegressions:
         out = cv.fit_transform(d for d in ["apple", "banana banana"])
         np.testing.assert_allclose(out.toarray(), [[1, 0], [0, 2]])
 
-    def test_multinomial_warns(self, rng):
+    def test_multinomial_is_implemented(self, rng):
+        # round 2 warned-and-fell-back to OvR; round 3 implements the true
+        # softmax family, so the fit must succeed with NO warning
+        import warnings
+
         from dask_ml_tpu.linear_model import LogisticRegression
 
         X = rng.normal(size=(90, 3)).astype(np.float32)
         y = rng.randint(0, 3, size=90)
-        with pytest.warns(UserWarning, match="multi_class"):
-            LogisticRegression(
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            lr = LogisticRegression(
                 solver="lbfgs", max_iter=5, multi_class="multinomial"
             ).fit(X, y)
+        assert lr.betas_.shape[0] == 3
 
     def test_dates_seed_does_not_alias_chunk_seed(self):
         from dask_ml_tpu.datasets import make_classification_df
