@@ -352,13 +352,29 @@ class KMeans(TransformerMixin, TPUEstimator):
             return jnp.asarray(centers, dtype=X.data.dtype)
         raise ValueError(f"Unknown init: {init!r}")
 
-    def fit(self, X, y=None):
+    def fit(self, X, y=None, sample_weight=None):
         if self.n_clusters <= 0:
             raise ValueError("n_clusters must be positive")
         X = _ingest_float(self, X)
         if X.n_samples < self.n_clusters:
             raise ValueError(
                 f"n_samples={X.n_samples} < n_clusters={self.n_clusters}"
+            )
+        valid_mask = X.mask  # pre-weighting validity, for the tol scale
+        if sample_weight is not None:
+            # the mask is the per-row weight everywhere downstream: the
+            # k-means|| sampling probabilities, the Lloyd center sums and
+            # counts, and the inertia all become their weighted (sklearn)
+            # forms by scaling it
+            from ..utils import effective_mask
+
+            X = ShardedRows(
+                data=X.data,
+                mask=effective_mask(
+                    X.mask, sample_weight=sample_weight,
+                    n_samples=X.n_samples,
+                ),
+                n_samples=X.n_samples,
             )
         key = as_key(self.random_state)
         centers = self._init_centers(X, key)
@@ -368,7 +384,9 @@ class KMeans(TransformerMixin, TPUEstimator):
         # pad rows don't inflate the threshold
         from ..core.sharded import masked_var
 
-        tol = self.tol * jnp.mean(masked_var(x, mask))  # stays on device
+        # tol from UNWEIGHTED variances: sklearn's _tolerance ignores
+        # sample_weight, so weighting must not move the stopping threshold
+        tol = self.tol * jnp.mean(masked_var(x, valid_mask))  # on device
         use_pallas = _pallas_ok(x, centers)
         with _timer("Lloyd loop", logger, logging.DEBUG):
             from ..core.mesh import get_mesh

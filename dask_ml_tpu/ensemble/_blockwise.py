@@ -140,14 +140,20 @@ class _BlockwiseBase(TPUEstimator):
         bounds = np.linspace(0, n, self.n_blocks + 1, dtype=int)
         spans = [(lo, hi) for lo, hi in zip(bounds[:-1], bounds[1:]) if hi > lo]
         members = [clone(self.estimator) for _ in spans]
-        # equal block shapes are required to stack; trim is at most
-        # n_blocks-1 rows (the linspace remainder)
-        size = min(hi - lo for lo, hi in spans)
-        los = [lo for lo, _ in spans]
-        xb = jnp.stack([jax.lax.dynamic_slice_in_dim(data, lo, size) for lo in los])
+        # equal block shapes are required to stack: pad every block to the
+        # LONGEST span and mask the filler ("no silent caps" — the old
+        # min-span trim dropped up to n_blocks-1 real rows).  Each slice
+        # window is pulled left so it stays in bounds; `valid` marks where
+        # the block's own rows sit inside its window.
+        size = max(hi - lo for lo, hi in spans)
+        sts = [min(lo, n - size) for lo, _hi in spans]
+        valid = np.zeros((len(spans), size), np.float32)
+        for b, ((lo, hi), st) in enumerate(zip(spans, sts)):
+            valid[b, lo - st: hi - st] = 1.0
+        xb = jnp.stack([jax.lax.dynamic_slice_in_dim(data, st, size) for st in sts])
         mask = jnp.stack([
-            jax.lax.dynamic_slice_in_dim(mask_full, lo, size) for lo in los
-        ]).astype(jnp.float32)
+            jax.lax.dynamic_slice_in_dim(mask_full, st, size) for st in sts
+        ]).astype(jnp.float32) * jnp.asarray(valid)
 
         is_clf = isinstance(members[0], SGDClassifier)
         if is_clf:
@@ -164,7 +170,7 @@ class _BlockwiseBase(TPUEstimator):
             enc = members[0]._encode_targets_device(ydata, mask_full)
         else:
             enc = ydata.astype(jnp.float32).reshape(-1, 1)
-        yb = jnp.stack([jax.lax.dynamic_slice_in_dim(enc, lo, size) for lo in los])
+        yb = jnp.stack([jax.lax.dynamic_slice_in_dim(enc, st, size) for st in sts])
 
         from ..linear_model._sgd import EpochStopper
 

@@ -347,7 +347,9 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
     def __init__(self, loss="log_loss", penalty="l2", alpha=1e-4,
                  l1_ratio=0.15, fit_intercept=True, max_iter=1000, tol=1e-3,
                  learning_rate="optimal", eta0=0.01, power_t=0.25,
-                 n_iter_no_change=5, random_state=None, warm_start=False):
+                 n_iter_no_change=5, random_state=None, warm_start=False,
+                 class_weight=None):
+        self.class_weight = class_weight
         self.loss = loss
         self.penalty = penalty
         self.alpha = alpha
@@ -419,7 +421,53 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
             self._state = sgd_init(n_features, k)
             self.n_features_in_ = int(n_features)
 
-    def partial_fit(self, X, y, classes=None, **kwargs):
+    def _apply_weights(self, yb, mask, sample_weight, n_real,
+                      allow_balanced=True):
+        """Fold sample/class weights into the block mask (the mask is the
+        per-row weight in every masked reduction — sklearn's weighted
+        loss for free).  The true class index is recovered from the ±1
+        OvA target matrix, so no separate padded label array is needed."""
+        cwd = getattr(self, "class_weight", None)
+        if sample_weight is None and cwd is None:
+            return mask
+        w = mask
+        if sample_weight is not None:
+            from ..utils import effective_mask
+
+            w = effective_mask(
+                w, sample_weight=sample_weight, n_samples=n_real
+            )
+        if cwd is not None:
+            K = len(self.classes_)
+            if yb.shape[1] == 1:
+                idx = (yb[:, 0] > 0).astype(jnp.int32)
+            else:
+                idx = jnp.argmax(yb, axis=1)
+            if isinstance(cwd, str):
+                if cwd != "balanced":
+                    raise ValueError(
+                        f"class_weight must be a dict or 'balanced'; got "
+                        f"{cwd!r}"
+                    )
+                if not allow_balanced:
+                    # sklearn parity: balanced needs the full label
+                    # distribution, which a stream of blocks cannot give
+                    raise ValueError(
+                        "class_weight 'balanced' is not supported for "
+                        "partial_fit"
+                    )
+                ind = jax.nn.one_hot(idx, K, dtype=jnp.float32) * mask[:, None]
+                counts = jnp.sum(ind, axis=0)
+                cw = jnp.sum(mask) / (K * jnp.maximum(counts, 1.0))
+            else:
+                cw = jnp.asarray(
+                    [float(cwd.get(c, 1.0)) for c in self.classes_.tolist()],
+                    jnp.float32,
+                )
+            w = w * cw[idx]
+        return w
+
+    def partial_fit(self, X, y, classes=None, sample_weight=None, **kwargs):
         self._validate()
         if not hasattr(self, "classes_"):
             if classes is None:
@@ -438,11 +486,16 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
         else:
             targets = self._encode_targets(np.asarray(y))
         xb, yb, mask = self._prep_block(X, targets)
+        n_real = X.n_samples if isinstance(X, ShardedRows) else len(
+            np.asarray(y))
+        mask = self._apply_weights(
+            yb, mask, sample_weight, n_real, allow_balanced=False
+        )
         self._ensure_state(xb.shape[1])
         self._loss_ = self._step_block(xb, yb, mask)
         return self
 
-    def fit(self, X, y, **kwargs):
+    def fit(self, X, y, sample_weight=None, **kwargs):
         self._validate()
         if isinstance(y, ShardedRows):
             from ..core.sharded import unshard
@@ -466,6 +519,7 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
             self._set_classes(np.unique(y))
         # Encode/pad/transfer ONCE; every epoch is then just the fused step.
         xb, yb, mask = self._prep_block(X, self._encode_targets(y))
+        mask = self._apply_weights(yb, mask, sample_weight, len(y))
         self._ensure_state(xb.shape[1])
         self.n_iter_ = _run_epochs(self, xb, yb, mask)
         return self
@@ -575,11 +629,19 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
         self._loss_ = self._step_block(xb, yb, mask)
         return self
 
-    def fit(self, X, y, **kwargs):
+    def fit(self, X, y, sample_weight=None, **kwargs):
         self._validate()
         if not self.warm_start and hasattr(self, "_state"):
             delattr(self, "_state")
         xb, yb, mask = self._prep_block(X, self._targets(y, X))
+        if sample_weight is not None:
+            from ..utils import effective_mask
+
+            n_real = X.n_samples if isinstance(X, ShardedRows) else len(
+                np.asarray(y))
+            mask = effective_mask(
+                mask, sample_weight=sample_weight, n_samples=n_real
+            )
         self._ensure_state(xb.shape[1])
         self.n_iter_ = _run_epochs(self, xb, yb, mask)
         return self

@@ -27,6 +27,7 @@ N models trained with ≪N dispatches.
 
 from __future__ import annotations
 
+import logging
 from functools import partial
 
 import numpy as np
@@ -39,6 +40,8 @@ from ..core.mesh import DATA_AXIS, MODEL_AXIS, get_mesh
 from ..linear_model._sgd import SGDClassifier, SGDRegressor, sgd_step
 
 __all__ = ["pack_key", "Cohort", "DISPATCH_STATS", "reset_dispatch_stats"]
+
+logger = logging.getLogger(__name__)
 
 # Observability: how many fused dispatches ran vs how many model-steps they
 # covered.  A packed round of M models advances models_stepped by M while
@@ -154,15 +157,28 @@ class Cohort:
         )
         mesh = get_mesh()
         M = len(self.models)
-        if mesh.shape.get(MODEL_AXIS, 1) > 1 and M % mesh.shape[MODEL_AXIS] == 0:
-            stacked = jax.tree.map(
-                lambda x: jax.device_put(x, _model_sharding(mesh, x.ndim)),
-                stacked,
-            )
-            hypers = jax.tree.map(
-                lambda x: jax.device_put(x, _model_sharding(mesh, x.ndim)),
-                hypers,
-            )
+        model_ax = mesh.shape.get(MODEL_AXIS, 1)
+        if model_ax > 1:
+            if M % model_ax == 0:
+                stacked = jax.tree.map(
+                    lambda x: jax.device_put(x, _model_sharding(mesh, x.ndim)),
+                    stacked,
+                )
+                hypers = jax.tree.map(
+                    lambda x: jax.device_put(x, _model_sharding(mesh, x.ndim)),
+                    hypers,
+                )
+            else:
+                # no silent caps: a user who built a 2-D mesh loses
+                # model-parallelism here — say so instead of quietly
+                # training replicated
+                logger.warning(
+                    "cohort of %d models does not divide the mesh model "
+                    "axis (%d); training replicated without MODEL_AXIS "
+                    "sharding — pad the cohort to a multiple of %d to "
+                    "shard it",
+                    M, model_ax, model_ax,
+                )
         return stacked, hypers
 
     def step(self, X, y):

@@ -104,6 +104,71 @@ def svd_flip(u, v, u_based_decision: bool = True):
     return u, v
 
 
+def effective_mask(mask, y_padded=None, *, sample_weight=None,
+                   class_weight=None, classes=None, n_samples=None):
+    """Fold per-row weights into a validity mask.
+
+    The pad+mask discipline makes every masked reduction a weighted
+    reduction for free: the mask IS a multiplicative per-row weight, so
+    ``sample_weight`` and ``class_weight`` simply scale it (pad rows stay
+    at 0).  sklearn semantics throughout: ``'balanced'`` uses
+    ``n / (K * count_k)`` with UNWEIGHTED counts; a class-weight dict
+    defaults absent classes to 1.0.
+
+    Args:
+      mask: (padded_n,) validity/weight vector (device).
+      y_padded: (padded_n,) raw label values (device) — required for
+        ``class_weight``.
+      sample_weight: host (n_samples,) per-row weights, or None.
+      class_weight: dict {label: weight} or ``'balanced'`` or None.
+      classes: label inventory (required for ``class_weight``).
+      n_samples: true row count (defaults to ``len(sample_weight)``).
+    Returns the weighted mask (device, same shape as ``mask``).
+    """
+    w = mask
+    if sample_weight is not None:
+        sw = np.asarray(sample_weight, np.float32).ravel()
+        n = int(n_samples) if n_samples is not None else sw.shape[0]
+        if sw.shape[0] != n:
+            raise ValueError(
+                f"sample_weight has {sw.shape[0]} entries for {n} samples"
+            )
+        pad = int(mask.shape[0]) - sw.shape[0]
+        if pad < 0:
+            raise ValueError(
+                f"sample_weight longer ({sw.shape[0]}) than padded rows "
+                f"({mask.shape[0]})"
+            )
+        if pad:
+            sw = np.pad(sw, (0, pad))
+        w = w * jnp.asarray(sw)
+    if class_weight is not None:
+        if y_padded is None or classes is None:
+            raise ValueError("class_weight requires labels and classes")
+        cls_np = np.asarray(classes)
+        cls = jnp.asarray(cls_np, y_padded.dtype)
+        ind = (
+            (y_padded[None, :] == cls[:, None]).astype(jnp.float32)
+            * mask[None, :]
+        )
+        if isinstance(class_weight, str):
+            if class_weight != "balanced":
+                raise ValueError(
+                    f"class_weight must be a dict or 'balanced'; got "
+                    f"{class_weight!r}"
+                )
+            counts = jnp.sum(ind, axis=1)
+            total = jnp.sum(mask)
+            cw = total / (len(cls_np) * jnp.maximum(counts, 1.0))
+        else:
+            cw = jnp.asarray(
+                [float(class_weight.get(c, 1.0)) for c in cls_np.tolist()],
+                jnp.float32,
+            )
+        w = w * jnp.sum(cw[:, None] * ind, axis=0)
+    return w
+
+
 def check_max_iter(max_iter):
     """Reject non-positive epoch budgets up front: every epoch-loop
     estimator reads the loop variable after the loop, so ``max_iter=0``

@@ -408,3 +408,29 @@ class TestAdvisorRound2Fixes:
         y = (X[:, 0] > 0).astype(np.float32)
         with pytest.raises(ValueError, match="max_iter"):
             SGDClassifier(max_iter=0).fit(X, y)
+
+
+class TestKMeansSampleWeight:
+    def test_integer_weights_equal_duplication(self, rng, mesh):
+        import sklearn.cluster as skc
+
+        n = 160
+        X = rng.normal(size=(n, 3)).astype(np.float32) + np.repeat(
+            np.eye(3, dtype=np.float32) * 6, n // 3 + 1, axis=0
+        )[:n]
+        sw = rng.randint(1, 4, size=n).astype(np.float64)
+        init = X[:3].copy()
+        ours = dc.KMeans(n_clusters=3, init=init, max_iter=50, tol=1e-6).fit(
+            X, sample_weight=sw
+        )
+        dup = dc.KMeans(n_clusters=3, init=init, max_iter=50, tol=1e-6).fit(
+            np.repeat(X, sw.astype(int), axis=0)
+        )
+        np.testing.assert_allclose(
+            np.asarray(ours.cluster_centers_),
+            np.asarray(dup.cluster_centers_), rtol=1e-4, atol=1e-4,
+        )
+        sk = skc.KMeans(n_clusters=3, init=init, n_init=1, max_iter=50).fit(
+            X, sample_weight=sw
+        )
+        assert ours.inertia_ == pytest.approx(sk.inertia_, rel=1e-3)

@@ -303,3 +303,94 @@ class TestMultinomial:
             dlm.LogisticRegression(
                 solver="newton", multi_class="multinomial"
             ).fit(X, y)
+
+
+class TestSampleClassWeights:
+    """VERDICT r2 next #6: weights thread through the masked reductions."""
+
+    def _imbalanced(self, rng, n=600, d=5, noisy=False):
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w = rng.normal(size=d)
+        if noisy:
+            p = 1 / (1 + np.exp(-(X @ w + 1.2)))
+            return X, (rng.uniform(size=n) < p).astype(np.float32)
+        return X, (X @ w + 1.2 > 0).astype(np.float32)  # skewed positive
+
+    def test_logreg_balanced_parity_with_sklearn(self, rng, mesh):
+        # noisy labels: a separable set makes the optimum ill-conditioned
+        # and amplifies solver-tolerance differences
+        X, y = self._imbalanced(rng, noisy=True)
+        ours = dlm.LogisticRegression(
+            solver="lbfgs", C=1.0, max_iter=500, tol=1e-8,
+            class_weight="balanced",
+        ).fit(X, y)
+        sk = sl.LogisticRegression(
+            C=1.0, max_iter=500, tol=1e-8, class_weight="balanced"
+        ).fit(X, y)
+        np.testing.assert_allclose(
+            np.asarray(ours.coef_), sk.coef_[0], rtol=5e-2, atol=2e-2
+        )
+        np.testing.assert_allclose(
+            float(ours.intercept_), sk.intercept_[0], rtol=5e-2, atol=2e-2
+        )
+
+    def test_logreg_integer_weights_equal_duplication(self, rng, mesh):
+        X, y = self._imbalanced(rng, n=200)
+        sw = rng.randint(1, 4, size=200)
+        Xd = np.repeat(X, sw, axis=0)
+        yd = np.repeat(y, sw)
+        a = dlm.LogisticRegression(solver="lbfgs", C=1.0, max_iter=300).fit(
+            X, y, sample_weight=sw
+        )
+        b = dlm.LogisticRegression(solver="lbfgs", C=1.0, max_iter=300).fit(
+            Xd, yd
+        )
+        np.testing.assert_allclose(
+            np.asarray(a.coef_), np.asarray(b.coef_), rtol=1e-3, atol=1e-4
+        )
+
+    def test_logreg_class_weight_dict_shifts_boundary(self, rng, mesh):
+        X, y = self._imbalanced(rng)
+        plain = dlm.LogisticRegression(solver="lbfgs", max_iter=200).fit(X, y)
+        up = dlm.LogisticRegression(
+            solver="lbfgs", max_iter=200, class_weight={0.0: 10.0, 1.0: 1.0}
+        ).fit(X, y)
+        # upweighting the minority class must increase its recall
+        minority_recall = lambda m: float(  # noqa: E731
+            ((np.asarray(m.predict(X)) == 0) & (y == 0)).sum()
+        ) / max((y == 0).sum(), 1)
+        assert minority_recall(up) >= minority_recall(plain)
+
+    def test_linear_regression_sample_weight(self, rng, mesh):
+        n, d = 200, 4
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (X @ rng.normal(size=d)).astype(np.float32)
+        sw = rng.randint(1, 4, size=n)
+        a = dlm.LinearRegression(solver="lbfgs", max_iter=300).fit(
+            X, y, sample_weight=sw
+        )
+        b = dlm.LinearRegression(solver="lbfgs", max_iter=300).fit(
+            np.repeat(X, sw, axis=0), np.repeat(y, sw)
+        )
+        np.testing.assert_allclose(
+            np.asarray(a.coef_), np.asarray(b.coef_), rtol=1e-3, atol=1e-3
+        )
+
+    def test_string_labels_with_sample_weight(self, rng, mesh):
+        # host string labels must survive the weighted path (no device cast)
+        n, d = 200, 4
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = np.where(X[:, 0] > 0, "dog", "cat")
+        sw = rng.rand(n).astype(np.float32) + 0.5
+        lr = dlm.LogisticRegression(
+            solver="lbfgs", max_iter=100, class_weight="balanced"
+        ).fit(X, y, sample_weight=sw)
+        assert set(np.asarray(lr.predict(X)).tolist()) <= {"cat", "dog"}
+
+    def test_sgd_regressor_rejects_short_sample_weight(self, rng, mesh):
+        from dask_ml_tpu.linear_model import SGDRegressor
+
+        X = rng.normal(size=(100, 4)).astype(np.float32)
+        y = X[:, 0].astype(np.float32)
+        with pytest.raises(ValueError, match="sample_weight"):
+            SGDRegressor(max_iter=5).fit(X, y, sample_weight=np.ones(50))

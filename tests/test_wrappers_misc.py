@@ -420,3 +420,65 @@ class TestBlockwiseParallelFits:
         with use_mesh(device_mesh(8, model_axis=4)):
             BlockwiseVotingRegressor(MeshSpy(), n_blocks=4).fit(X, np.zeros(80))
         assert seen and all(s == {"data": 2, "model": 4} for s in seen)
+
+
+class TestPackedEnsembleNoSilentCaps:
+    def test_ragged_tail_rows_are_kept(self, rng, mesh, monkeypatch):
+        # n chosen so linspace spans are UNEQUAL (307 over 4 blocks:
+        # 76/77/77/77); the packed path must mask-pad, not trim rows —
+        # the total mask weight entering the epoch program must equal n
+        from dask_ml_tpu.ensemble import _blockwise as bw
+        from dask_ml_tpu.linear_model import SGDClassifier as TpuSGD
+
+        n = 307
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        seen = {}
+        orig = bw._ensemble_epoch
+
+        def spy(states, xb, yb, mask, hypers, **kw):
+            seen["mask_total"] = float(np.asarray(mask).sum())
+            return orig(states, xb, yb, mask, hypers, **kw)
+
+        monkeypatch.setattr(bw, "_ensemble_epoch", spy)
+        BlockwiseVotingClassifier(
+            TpuSGD(max_iter=2, random_state=0), n_blocks=4
+        ).fit(X, y, classes=[0.0, 1.0])
+        assert seen["mask_total"] == n
+
+    def test_packed_parity_on_ragged_blocks(self, rng, mesh):
+        from dask_ml_tpu.linear_model import SGDClassifier as TpuSGD
+
+        n = 307
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        ens = BlockwiseVotingClassifier(
+            TpuSGD(max_iter=20, random_state=0), n_blocks=4
+        ).fit(X, y, classes=[0.0, 1.0])
+        assert len(ens.estimators_) == 4
+        assert ens.score(X, y) > 0.8
+
+
+class TestCohortModelAxisSkipLogs:
+    def test_warning_logged_when_not_divisible(self, rng, caplog):
+        import logging
+
+        import jax
+        from jax.sharding import Mesh
+
+        from dask_ml_tpu.core.mesh import use_mesh
+        from dask_ml_tpu.linear_model import SGDClassifier as TpuSGD
+        from dask_ml_tpu.model_selection._packing import Cohort
+
+        devs = np.array(jax.devices()[:8]).reshape(4, 2)
+        mesh2d = Mesh(devs, ("data", "model"))
+        X = rng.normal(size=(64, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        models = [TpuSGD(alpha=a, random_state=0) for a in (1e-4, 1e-3, 1e-2)]
+        with use_mesh(mesh2d):
+            cohort = Cohort(models, classes=[0.0, 1.0])
+            with caplog.at_level(
+                logging.WARNING, logger="dask_ml_tpu.model_selection._packing"
+            ):
+                cohort.step(X, y)
+        assert any("MODEL_AXIS" in r.message for r in caplog.records)
