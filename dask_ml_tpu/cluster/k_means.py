@@ -411,8 +411,8 @@ class KMeans(TransformerMixin, TPUEstimator):
         labels, _ = _assign(X.data, X.mask, self.cluster_centers_)
         return labels[: X.n_samples]
 
-    def fit_predict(self, X, y=None):
-        return self.fit(X).labels_
+    def fit_predict(self, X, y=None, sample_weight=None):
+        return self.fit(X, sample_weight=sample_weight).labels_
 
     def transform(self, X):
         """Distances to each center (reference semantic)."""

@@ -68,9 +68,123 @@ class TruncatedSVD(TransformerMixin, TPUEstimator):
         return transformed[:n]
 
     def transform(self, X):
+        import scipy.sparse
+
+        if scipy.sparse.issparse(X):
+            # sparse projection on host: n×d stays sparse, only the n×k
+            # result densifies (the reference consumes sparse natively in
+            # ``dask_ml/decomposition/truncated_svd.py``)
+            import numpy as np
+
+            return np.asarray(X @ np.asarray(self.components_).T)
         x, _ = _masked_or_plain(X)
         return _like_input(X, x @ self.components_.T)
 
     def inverse_transform(self, X):
         x, _ = _masked_or_plain(X)
         return _like_input(X, x @ self.components_)
+
+    def fit_streamed(self, blocks, n_features=None):
+        """Fit from a RE-ITERABLE stream of sparse/dense row blocks without
+        ever materializing the dense corpus (VERDICT r2 next #9).
+
+        ``blocks`` is a zero-argument callable returning a fresh iterator
+        of row blocks (scipy.sparse or ndarray, each ``(b, n_features)``)
+        — e.g. ``lambda: vectorizer.stream_transform(corpus)``.  The
+        randomized range finder runs ``n_iter`` passes of ``A^T A`` over
+        the stream (each block contributes ``B^T (B Q)``; blocks stay
+        sparse, so peak dense memory is ``O(n_features x sketch)``, never
+        ``O(n_rows x n_features)``), then one final pass accumulates the
+        small ``(AQ)^T AQ`` Gram whose eigendecomposition yields the
+        components, singular values, and explained variance — no pass
+        stores anything n_rows-sized.
+
+        Reference: ``dask_ml/decomposition/truncated_svd.py`` fits lazy
+        sparse dask arrays; this is the streaming twin for corpora that
+        never exist as one array.
+        """
+        import numpy as np
+        import scipy.sparse
+
+        k = self.n_components
+        oversample = 10
+        first = None
+        if n_features is None:
+            it = blocks()
+            first = next(iter(it), None)
+            if first is None:
+                raise ValueError("empty block stream")
+            n_features = first.shape[1]
+        d = int(n_features)
+        if not 0 < k < d:
+            raise ValueError(
+                f"n_components must be in (0, n_features={d}); got {k}"
+            )
+        ell = min(k + oversample, d)
+        from ..utils import check_random_state
+
+        rng = check_random_state(self.random_state)
+        Q = rng.normal(size=(d, ell)).astype(np.float32)
+
+        def _mm(B, C):
+            out = B @ C  # scipy sparse @ dense -> dense; ndarray works too
+            return np.asarray(out, dtype=np.float64)
+
+        n_rows = 0
+        col_sum = np.zeros(d, np.float64)
+        col_sumsq = np.zeros(d, np.float64)
+        passes = max(int(self.n_iter), 1)
+        for p in range(passes):
+            H = np.zeros((d, ell), np.float64)
+            for B in blocks():
+                Y = _mm(B, Q)
+                H += np.asarray(B.T @ Y, dtype=np.float64)
+                if p == 0:
+                    n_rows += B.shape[0]
+                    if scipy.sparse.issparse(B):
+                        col_sum += np.asarray(B.sum(axis=0)).ravel()
+                        col_sumsq += np.asarray(
+                            B.multiply(B).sum(axis=0)
+                        ).ravel()
+                    else:
+                        Bd = np.asarray(B, np.float64)
+                        col_sum += Bd.sum(axis=0)
+                        col_sumsq += (Bd * Bd).sum(axis=0)
+            # re-orthonormalize between passes (the stability trick behind
+            # power_iteration_normalizer='QR')
+            Q, _ = np.linalg.qr(H)
+            Q = Q.astype(np.float32)
+        if n_rows < 1:
+            raise ValueError("empty block stream")
+
+        # final pass: the l x l Gram of AQ plus its column means
+        M = np.zeros((ell, ell), np.float64)
+        w_sum = np.zeros(ell, np.float64)
+        for B in blocks():
+            W = _mm(B, Q)
+            M += W.T @ W
+            w_sum += W.sum(axis=0)
+        evals, G = np.linalg.eigh(M)  # ascending
+        order = np.argsort(evals)[::-1][:k]
+        s = np.sqrt(np.maximum(evals[order], 0.0))
+        V = (Q @ G[:, order]).T  # (k, d) right singular vectors
+        # deterministic signs, same convention as the dense path
+        # (svd_flip u_based_decision=False: sign of each row's max-|.|)
+        max_abs = np.argmax(np.abs(V), axis=1)
+        signs = np.sign(V[np.arange(V.shape[0]), max_abs])
+        signs[signs == 0] = 1.0
+        V = V * signs[:, None]
+
+        mean_t = (G[:, order].T @ (w_sum / n_rows)) * signs
+        exp_var = np.maximum(s**2 / n_rows - mean_t**2, 0.0)
+        full_var = float(
+            np.sum(col_sumsq / n_rows - (col_sum / n_rows) ** 2)
+        )
+        self.components_ = jnp.asarray(V.astype(np.float32))
+        self.singular_values_ = jnp.asarray(s.astype(np.float32))
+        self.explained_variance_ = jnp.asarray(exp_var.astype(np.float32))
+        self.explained_variance_ratio_ = jnp.asarray(
+            (exp_var / max(full_var, 1e-30)).astype(np.float32)
+        )
+        self.n_features_in_ = d
+        return self

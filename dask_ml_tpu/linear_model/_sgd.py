@@ -443,28 +443,26 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
                 idx = (yb[:, 0] > 0).astype(jnp.int32)
             else:
                 idx = jnp.argmax(yb, axis=1)
-            if isinstance(cwd, str):
-                if cwd != "balanced":
-                    raise ValueError(
-                        f"class_weight must be a dict or 'balanced'; got "
-                        f"{cwd!r}"
-                    )
-                if not allow_balanced:
-                    # sklearn parity: balanced needs the full label
-                    # distribution, which a stream of blocks cannot give
-                    raise ValueError(
-                        "class_weight 'balanced' is not supported for "
-                        "partial_fit"
-                    )
-                ind = jax.nn.one_hot(idx, K, dtype=jnp.float32) * mask[:, None]
-                counts = jnp.sum(ind, axis=0)
-                cw = jnp.sum(mask) / (K * jnp.maximum(counts, 1.0))
-            else:
-                cw = jnp.asarray(
-                    [float(cwd.get(c, 1.0)) for c in self.classes_.tolist()],
-                    jnp.float32,
+            if cwd == "balanced" and not allow_balanced:
+                # sklearn parity: balanced needs the full label
+                # distribution, which a stream of blocks cannot give
+                raise ValueError(
+                    "class_weight 'balanced' is not supported for "
+                    "partial_fit"
                 )
-            w = w * cw[idx]
+            if isinstance(cwd, dict):
+                # keys are original labels; effective_mask works on the
+                # recovered class INDICES, so re-key by position
+                cwd = {
+                    i: float(cwd.get(c, 1.0))
+                    for i, c in enumerate(self.classes_.tolist())
+                }
+            from ..utils import effective_mask
+
+            w = effective_mask(
+                w, idx.astype(jnp.float32), class_weight=cwd,
+                classes=np.arange(K),
+            )
         return w
 
     def partial_fit(self, X, y, classes=None, sample_weight=None, **kwargs):
@@ -486,8 +484,8 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
         else:
             targets = self._encode_targets(np.asarray(y))
         xb, yb, mask = self._prep_block(X, targets)
-        n_real = X.n_samples if isinstance(X, ShardedRows) else len(
-            np.asarray(y))
+        n_real = X.n_samples if isinstance(X, ShardedRows) else int(
+            np.asarray(X).shape[0])
         mask = self._apply_weights(
             yb, mask, sample_weight, n_real, allow_balanced=False
         )
@@ -637,8 +635,8 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
         if sample_weight is not None:
             from ..utils import effective_mask
 
-            n_real = X.n_samples if isinstance(X, ShardedRows) else len(
-                np.asarray(y))
+            n_real = X.n_samples if isinstance(X, ShardedRows) else int(
+                np.asarray(X).shape[0])
             mask = effective_mask(
                 mask, sample_weight=sample_weight, n_samples=n_real
             )

@@ -188,21 +188,10 @@ class LogisticRegression(ClassifierMixin, _GLM):
                 # host labels can be strings or big ints that a device
                 # cast would corrupt: resolve the per-row class weight on
                 # host and fold it into sample_weight
-                if isinstance(self.class_weight, str):
-                    if self.class_weight != "balanced":
-                        raise ValueError(
-                            "class_weight must be a dict or 'balanced'; "
-                            f"got {self.class_weight!r}"
-                        )
-                    _, counts = np.unique(yv, return_counts=True)
-                    cw = yv.shape[0] / (len(self.classes_) * counts)
-                else:
-                    cw = np.asarray([
-                        float(self.class_weight.get(c, 1.0))
-                        for c in self.classes_.tolist()
-                    ])
-                row_w = cw[np.searchsorted(self.classes_, yv)].astype(
-                    np.float32
+                from ..utils import host_class_weight_rows
+
+                row_w = host_class_weight_rows(
+                    self.class_weight, self.classes_, yv
                 )
                 if sample_weight is not None:
                     row_w = row_w * np.asarray(sample_weight, np.float32)

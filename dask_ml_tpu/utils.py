@@ -169,6 +169,29 @@ def effective_mask(mask, y_padded=None, *, sample_weight=None,
     return w
 
 
+def host_class_weight_rows(class_weight, classes, yv):
+    """Per-row class weights resolved ON HOST — the twin of
+    :func:`effective_mask`'s device class-weight branch for label arrays
+    that cannot cross to device (strings, big ints).  Same sklearn
+    semantics: ``'balanced'`` is ``n / (K * count_k)`` with unweighted
+    counts; dict keys default to 1.0.  Keep the two branches in sync."""
+    classes = np.asarray(classes)
+    yv = np.asarray(yv)
+    if isinstance(class_weight, str):
+        if class_weight != "balanced":
+            raise ValueError(
+                f"class_weight must be a dict or 'balanced'; got "
+                f"{class_weight!r}"
+            )
+        _, counts = np.unique(yv, return_counts=True)
+        cw = yv.shape[0] / (len(classes) * counts)
+    else:
+        cw = np.asarray(
+            [float(class_weight.get(c, 1.0)) for c in classes.tolist()]
+        )
+    return cw[np.searchsorted(classes, yv)].astype(np.float32)
+
+
 def check_max_iter(max_iter):
     """Reject non-positive epoch budgets up front: every epoch-loop
     estimator reads the loop variable after the loop, so ``max_iter=0``

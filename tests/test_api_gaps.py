@@ -130,11 +130,18 @@ class TestMulticlassLogistic:
         assert ours_acc >= theirs_acc - 0.03
 
     def test_inert_params_warn(self, rng):
+        # class_weight is REAL since round 3 (no warning); warm_start is
+        # the one remaining accepted-inert param (reference behavior)
         from dask_ml_tpu.linear_model import LogisticRegression
 
         X = rng.normal(size=(60, 3)).astype(np.float32)
         y = (X[:, 0] > 0).astype(int)
-        with pytest.warns(UserWarning, match="class_weight"):
+        with pytest.warns(UserWarning, match="warm_start"):
+            LogisticRegression(warm_start=True, max_iter=5).fit(X, y)
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
             LogisticRegression(class_weight="balanced", max_iter=5).fit(X, y)
 
     def test_single_class_raises(self, rng):

@@ -244,3 +244,95 @@ class TestReviewRegressions:
         X = rng.normal(size=(50, 6)).astype(np.float64)
         p = dd.PCA(n_components=1.0, svd_solver="full").fit(X)
         assert p.n_components_ == p.components_.shape[0] <= 6
+
+
+class TestStreamedTruncatedSVD:
+    """VERDICT r2 next #9: sparse stream -> SVD without densifying the
+    corpus; peak dense memory is O(n_features * sketch)."""
+
+    def _sparse_blocks(self, rng, n=1200, d=300, block=100, density=0.05):
+        import scipy.sparse
+
+        rows = []
+        for lo in range(0, n, block):
+            b = min(block, n - lo)
+            rows.append(scipy.sparse.random(
+                b, d, density=density, random_state=lo + 1, dtype=np.float32,
+                format="csr",
+            ))
+        return rows
+
+    def test_parity_with_dense_fit(self, rng, mesh):
+        # low-rank + noise: a separated spectrum is what sketching can
+        # recover accurately (a flat random spectrum is adversarial for
+        # ANY randomized method, dense or streamed)
+        import scipy.sparse
+
+        from dask_ml_tpu.decomposition import TruncatedSVD
+
+        n, d, r = 1200, 300, 8
+        latent = rng.normal(size=(n, r)) * np.linspace(10, 2, r)
+        dense_np = (
+            latent @ rng.normal(size=(r, d)) + 0.01 * rng.normal(size=(n, d))
+        ).astype(np.float32)
+        blocks = [
+            scipy.sparse.csr_matrix(dense_np[lo: lo + 100])
+            for lo in range(0, n, 100)
+        ]
+        dense = dense_np
+        streamed = TruncatedSVD(
+            n_components=5, n_iter=7, random_state=0
+        ).fit_streamed(lambda: iter(blocks))
+        ref = TruncatedSVD(
+            n_components=5, algorithm="tsqr"
+        ).fit(dense)
+        np.testing.assert_allclose(
+            np.asarray(streamed.singular_values_),
+            np.asarray(ref.singular_values_), rtol=1e-2,
+        )
+        # subspace parity (signs already canonicalized on both paths)
+        np.testing.assert_allclose(
+            np.abs(np.asarray(streamed.components_)),
+            np.abs(np.asarray(ref.components_)), atol=5e-2,
+        )
+        np.testing.assert_allclose(
+            np.asarray(streamed.explained_variance_),
+            np.asarray(ref.explained_variance_), rtol=5e-2,
+        )
+
+    def test_bounded_peak_memory(self, rng, mesh):
+        import tracemalloc
+
+        from dask_ml_tpu.decomposition import TruncatedSVD
+
+        n, d = 4000, 2000
+        blocks = self._sparse_blocks(rng, n=n, d=d, block=200, density=0.01)
+        dense_bytes = n * d * 4
+        tracemalloc.start()
+        TruncatedSVD(n_components=8, n_iter=4, random_state=0).fit_streamed(
+            lambda: iter(blocks)
+        )
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        # the whole fit must stay well under one dense corpus copy
+        assert peak < dense_bytes / 2, (peak, dense_bytes)
+
+    def test_text_pipeline_end_to_end(self, mesh):
+        from dask_ml_tpu.decomposition import TruncatedSVD
+        from dask_ml_tpu.feature_extraction.text import HashingVectorizer
+
+        docs = [f"word{i % 7} token{i % 13} common text" for i in range(500)]
+        vec = HashingVectorizer(n_features=4096)
+        svd = TruncatedSVD(n_components=4, n_iter=4, random_state=0)
+        svd.fit_streamed(
+            lambda: vec.stream_transform(docs), n_features=4096
+        )
+        assert np.asarray(svd.components_).shape == (4, 4096)
+        emb = svd.transform(vec.transform(docs[:50]))
+        assert np.asarray(emb).shape == (50, 4)
+
+    def test_empty_stream_raises(self, mesh):
+        from dask_ml_tpu.decomposition import TruncatedSVD
+
+        with pytest.raises(ValueError, match="empty"):
+            TruncatedSVD(n_components=2).fit_streamed(lambda: iter([]))
