@@ -31,6 +31,16 @@ CPU processes (tests, the driver's multi-host dryrun) get cross-process
 collectives via jaxlib's Gloo transport, the direct analogue of the
 reference's ``distributed.utils_test.gen_cluster`` fake-cluster harness:
 a REAL protocol stack over localhost.
+
+Multi-controller ordering contract: every process must issue the SAME
+device computations in the SAME order, or collectives deadlock.  The
+packed adaptive search satisfies this (one lockstep cohort per round —
+see ``model_selection/_incremental.py :: train_cohort``), and is the
+supported cross-host search plane.  ``HyperbandSearchCV``'s concurrent
+brackets interleave dispatches nondeterministically across threads and
+must therefore stay on a single controller: run Hyperband per-host on
+host-local meshes, or run its brackets sequentially, when spanning
+processes.
 """
 
 from __future__ import annotations

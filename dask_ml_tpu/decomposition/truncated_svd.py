@@ -108,13 +108,19 @@ class TruncatedSVD(TransformerMixin, TPUEstimator):
 
         k = self.n_components
         oversample = 10
-        first = None
+        first_iter = None
         if n_features is None:
-            it = blocks()
-            first = next(iter(it), None)
+            # peek one block for the width; the partially-consumed
+            # iterator (first block re-chained) serves as pass 0's source
+            # so the peeked block's work is not thrown away
+            import itertools
+
+            it = iter(blocks())
+            first = next(it, None)
             if first is None:
                 raise ValueError("empty block stream")
             n_features = first.shape[1]
+            first_iter = itertools.chain([first], it)
         d = int(n_features)
         if not 0 < k < d:
             raise ValueError(
@@ -136,7 +142,10 @@ class TruncatedSVD(TransformerMixin, TPUEstimator):
         passes = max(int(self.n_iter), 1)
         for p in range(passes):
             H = np.zeros((d, ell), np.float64)
-            for B in blocks():
+            src = first_iter if (p == 0 and first_iter is not None) \
+                else blocks()
+            first_iter = None
+            for B in src:
                 Y = _mm(B, Q)
                 H += np.asarray(B.T @ Y, dtype=np.float64)
                 if p == 0:

@@ -430,19 +430,11 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
         cwd = getattr(self, "class_weight", None)
         if sample_weight is None and cwd is None:
             return mask
-        w = mask
-        if sample_weight is not None:
-            from ..utils import effective_mask
+        from ..utils import effective_mask
 
-            w = effective_mask(
-                w, sample_weight=sample_weight, n_samples=n_real
-            )
+        idx = None
+        classes = None
         if cwd is not None:
-            K = len(self.classes_)
-            if yb.shape[1] == 1:
-                idx = (yb[:, 0] > 0).astype(jnp.int32)
-            else:
-                idx = jnp.argmax(yb, axis=1)
             if cwd == "balanced" and not allow_balanced:
                 # sklearn parity: balanced needs the full label
                 # distribution, which a stream of blocks cannot give
@@ -457,13 +449,19 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
                     i: float(cwd.get(c, 1.0))
                     for i, c in enumerate(self.classes_.tolist())
                 }
-            from ..utils import effective_mask
-
-            w = effective_mask(
-                w, idx.astype(jnp.float32), class_weight=cwd,
-                classes=np.arange(K),
-            )
-        return w
+            if yb.shape[1] == 1:
+                idx = (yb[:, 0] > 0).astype(jnp.float32)
+            else:
+                idx = jnp.argmax(yb, axis=1).astype(jnp.float32)
+            classes = np.arange(len(self.classes_))
+        # ONE call: effective_mask builds class indicators from the
+        # ORIGINAL mask, so balanced counts stay unweighted and sample
+        # weights apply exactly once (chaining two calls would square
+        # them — the indicator would be built from the weighted mask)
+        return effective_mask(
+            mask, idx, sample_weight=sample_weight, class_weight=cwd,
+            classes=classes, n_samples=n_real,
+        )
 
     def partial_fit(self, X, y, classes=None, sample_weight=None, **kwargs):
         self._validate()
@@ -569,6 +567,39 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
         return np.asarray(self._state["intercept"])
 
     def score(self, X, y):
+        """Mean accuracy.  All-device inputs score as ONE replicated
+        scalar fetch — the only legal form when the arrays span processes
+        (a multi-host global array cannot be pulled to host row-wise, and
+        even single-host it avoids the O(n) transfer)."""
+        from ..core.sharded import ShardedRows as _SR
+
+        cls_np = self.classes_
+        f32_exact = (
+            np.issubdtype(cls_np.dtype, np.number)
+            and np.array_equal(
+                cls_np.astype(np.float32).astype(cls_np.dtype), cls_np
+            )
+        )
+        if isinstance(X, _SR) and isinstance(y, _SR) and f32_exact:
+            # the f32-exactness guard matters: int labels past 2^24 would
+            # collide after the cast and silently score wrong — those
+            # fall through to the host path instead
+            md = (X.data.astype(jnp.float32) @ self._state["coef"]
+                  + self._state["intercept"])
+            if md.shape[1] == 1:
+                idx = (md[:, 0] > 0).astype(jnp.int32)
+            else:
+                idx = jnp.argmax(md, axis=1).astype(jnp.int32)
+            cls = jnp.asarray(cls_np.astype(np.float32))
+            # equality on VALUES (not searchsorted ranks): a y label
+            # outside classes_ counts as a miss, same as the host path
+            hit = (
+                (cls[idx] == y.data.astype(jnp.float32)).astype(jnp.float32)
+                * X.mask
+            )
+            return float(
+                jnp.sum(hit) / jnp.maximum(jnp.sum(X.mask), 1.0)
+            )
         from ..metrics import accuracy_score
 
         return accuracy_score(y, self.predict(X))

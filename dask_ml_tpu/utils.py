@@ -183,8 +183,12 @@ def host_class_weight_rows(class_weight, classes, yv):
                 f"class_weight must be a dict or 'balanced'; got "
                 f"{class_weight!r}"
             )
-        _, counts = np.unique(yv, return_counts=True)
-        cw = yv.shape[0] / (len(classes) * counts)
+        # align counts to the FULL class inventory: a class absent from
+        # this yv must not shift (or overrun) the weight table
+        uniq, counts_u = np.unique(yv, return_counts=True)
+        counts = np.zeros(len(classes))
+        counts[np.searchsorted(classes, uniq)] = counts_u
+        cw = yv.shape[0] / (len(classes) * np.maximum(counts, 1.0))
     else:
         cw = np.asarray(
             [float(class_weight.get(c, 1.0)) for c in classes.tolist()]

@@ -17,9 +17,57 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 class TestMultihost:
     def test_two_process_admm_and_lloyd(self):
+        outs = []
         for rc, out in spawn_group(2, 4, timeout_s=240):
             assert rc == 0, out
             assert "multihost OK" in out
+            outs.append(out)
+        # cross-host packed search (VERDICT r2 next #3): the worker runs a
+        # 4-model IncrementalSearchCV with the cohort's MODEL_AXIS spanning
+        # both processes; every dispatch must step the whole cohort and
+        # both processes must agree on every score
+        import ast
+        import re
+
+        parsed = []
+        for out in outs:
+            m = re.search(r"search_scores=(\[[^\]]*\])", out)
+            assert m, out
+            parsed.append(ast.literal_eval(m.group(1)))
+            s = re.search(r"dispatch_stats=(\{[^}]*\})", out)
+            stats = ast.literal_eval(s.group(1))
+            assert stats["models_stepped"] == 4 * stats["dispatches"], stats
+        assert parsed[0] == parsed[1]  # identical across processes
+
+        # identical to single-host: the same global dataset on one
+        # process's 8-device mesh must produce the same scores
+        import numpy as np
+
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.core.mesh import device_mesh, use_mesh
+        from dask_ml_tpu.linear_model import SGDClassifier
+        from dask_ml_tpu.model_selection import IncrementalSearchCV
+
+        n_per, d = 400, 6
+        rng = np.random.RandomState(0)
+        w_true = rng.normal(size=d).astype(np.float32)
+        Xg = np.vstack([
+            np.random.RandomState(100 + pid).normal(
+                size=(n_per, d)).astype(np.float32)
+            for pid in range(2)
+        ])
+        yg = (Xg @ w_true > 0).astype(np.float32)
+        mesh2 = device_mesh(8, model_axis=2)
+        with use_mesh(mesh2):
+            search = IncrementalSearchCV(
+                SGDClassifier(random_state=0, tol=None),
+                {"alpha": [1e-5, 1e-4, 1e-3, 1e-2]},
+                n_initial_parameters="grid", max_iter=3, patience=False,
+                random_state=0,
+            ).fit(shard_rows(Xg, mesh2), shard_rows(yg, mesh2),
+                  classes=[0.0, 1.0])
+        single = [round(s, 6) for s in search.cv_results_["test_score"]]
+        np.testing.assert_allclose(single, parsed[0], atol=1e-4)
 
     def test_graft_entry_dryrun_multihost(self):
         # the driver-facing wrapper end-to-end
