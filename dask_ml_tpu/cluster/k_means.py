@@ -366,16 +366,9 @@ class KMeans(TransformerMixin, TPUEstimator):
             # k-means|| sampling probabilities, the Lloyd center sums and
             # counts, and the inertia all become their weighted (sklearn)
             # forms by scaling it
-            from ..utils import effective_mask
+            from ..utils import reweight_rows
 
-            X = ShardedRows(
-                data=X.data,
-                mask=effective_mask(
-                    X.mask, sample_weight=sample_weight,
-                    n_samples=X.n_samples,
-                ),
-                n_samples=X.n_samples,
-            )
+            X = reweight_rows(X, sample_weight=sample_weight)
         key = as_key(self.random_state)
         centers = self._init_centers(X, key)
 

@@ -89,16 +89,9 @@ class _GLM(TPUEstimator):
         self.n_features_in_ = X.data.shape[1]
         Xi = add_intercept(X) if self.fit_intercept else X
         if sample_weight is not None:
-            from ..utils import effective_mask
+            from ..utils import reweight_rows
 
-            Xi = ShardedRows(
-                data=Xi.data,
-                mask=effective_mask(
-                    Xi.mask, sample_weight=sample_weight,
-                    n_samples=Xi.n_samples,
-                ),
-                n_samples=Xi.n_samples,
-            )
+            Xi = reweight_rows(Xi, sample_weight=sample_weight)
         beta, n_it = self._solve(Xi, y)
         # sklearn contract: iteration count(s) of the solver run(s);
         # converted only now, after the solve is dispatched
@@ -182,38 +175,28 @@ class LogisticRegression(ClassifierMixin, _GLM):
             # weights scale the mask: every masked reduction in the
             # solvers becomes the sklearn weighted loss (VERDICT r2
             # missing #6 — the mask machinery IS the per-row weight)
-            from ..utils import effective_mask
+            from ..utils import host_class_weight_rows, reweight_rows
 
             if self.class_weight is not None and yv is not None:
                 # host labels can be strings or big ints that a device
                 # cast would corrupt: resolve the per-row class weight on
                 # host and fold it into sample_weight
-                from ..utils import host_class_weight_rows
-
                 row_w = host_class_weight_rows(
                     self.class_weight, self.classes_, yv
                 )
                 if sample_weight is not None:
                     row_w = row_w * np.asarray(sample_weight, np.float32)
-                wmask = effective_mask(
-                    Xi.mask, sample_weight=row_w, n_samples=Xi.n_samples
-                )
+                Xi = reweight_rows(Xi, sample_weight=row_w)
             elif self.class_weight is not None:
                 # device labels are numeric by construction: count and
                 # weight classes on device, no label round-trip
-                wmask = effective_mask(
-                    Xi.mask, y.data, sample_weight=sample_weight,
+                Xi = reweight_rows(
+                    Xi, sample_weight=sample_weight,
                     class_weight=self.class_weight, classes=self.classes_,
-                    n_samples=Xi.n_samples,
+                    y_padded=y.data,
                 )
             else:
-                wmask = effective_mask(
-                    Xi.mask, sample_weight=sample_weight,
-                    n_samples=Xi.n_samples,
-                )
-            Xi = ShardedRows(
-                data=Xi.data, mask=wmask, n_samples=Xi.n_samples
-            )
+                Xi = reweight_rows(Xi, sample_weight=sample_weight)
 
         def _indicator(cls):
             """0/1 target for one-vs-rest, built where y lives (device
@@ -230,10 +213,21 @@ class LogisticRegression(ClassifierMixin, _GLM):
         K = len(self.classes_)
         self._multinomial = False
         if K == 2:
-            # binary: one sigmoid solve (a 2-class softmax is the same
-            # model reparameterized, so 'multinomial' takes this path too)
+            # binary: one sigmoid solve.  'multinomial' with 2 classes is
+            # the SAME loss reparameterized (w = w1 - w0) but the softmax
+            # penalty ||w0||² + ||w1||² equals ||w||²/2 at the symmetric
+            # optimum — i.e. the sigmoid fit at HALF the penalty — so
+            # sklearn parity needs lamduh/2 on that path
             y01 = _indicator(self.classes_[1])
-            beta, n_it = self._solve(Xi, y01)
+            if self.multi_class == "multinomial":
+                kwargs = self._solver_call_kwargs()
+                kwargs["lamduh"] = kwargs["lamduh"] / 2.0
+                beta, n_it = _SOLVERS[self.solver](
+                    Xi, y01, return_n_iter=True, family=self.family,
+                    **kwargs,
+                )
+            else:
+                beta, n_it = self._solve(Xi, y01)
             self.betas_ = beta[None, :]
             n_iter_runs = [n_it]
         elif self.multi_class == "multinomial":

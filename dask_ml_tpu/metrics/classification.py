@@ -315,11 +315,9 @@ def roc_auc_score(y_true, y_score, sample_weight=None):
     flat_within = jnp.concatenate(
         [within_excl, jnp.zeros((1,), jnp.float32)]
     )
-    num_within = jnp.sum(wpos * 0.5 * (flat_within[lo] + flat_within[hi]))
-    # per-block positive weight, CHUNKED like _prf_counts: one device
-    # segment_sum accumulates in f32 and saturates at 2^24 if enough
-    # tied positives land in a single block; per-chunk partials stay
-    # exact and sum in float64 on host (each fetch is B-sized)
+    # EVERY n-length accumulation is chunked with float64 host combines —
+    # a single f32 device sum saturates at 2^24 accumulated unit weight,
+    # the exact regime this two-level path exists for
     ids = jnp.concatenate([lo // L, hi // L])
     wps = jnp.concatenate([wpos, wpos])
     seg64 = np.zeros(B + 1, np.float64)
@@ -331,12 +329,18 @@ def roc_auc_score(y_true, y_score, sample_weight=None):
             ),
             np.float64,
         )
+    num_within64 = 0.0
+    W_pos = 0.0
+    half_inner = wpos * 0.5 * (flat_within[lo] + flat_within[hi])
+    for c0 in range(0, n_tot, _COUNT_CHUNK):
+        c1 = min(c0 + _COUNT_CHUNK, n_tot)
+        num_within64 += float(jnp.sum(half_inner[c0:c1]))
+        W_pos += float(jnp.sum(wpos[c0:c1]))
     bases = np.concatenate(
         [[0.0], np.cumsum(np.asarray(block_sums, np.float64))]
     )
-    num = float(num_within) + 0.5 * float(seg64 @ bases)
+    num = num_within64 + 0.5 * float(seg64 @ bases)
     W_neg = float(bases[-1])
-    W_pos = float(jnp.sum(wpos))
     denom = W_pos * W_neg
     if denom <= 0:
         raise ValueError("Only one class present after weighting")

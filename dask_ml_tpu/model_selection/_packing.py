@@ -62,6 +62,11 @@ def pack_key(model):
     for stacking them under vmap with zero recompilation.
     """
     if isinstance(model, (SGDClassifier, SGDRegressor)):
+        if getattr(model, "class_weight", None) is not None:
+            # the packed step applies ONE shared mask to the whole
+            # cohort; per-model class weights would be silently dropped —
+            # weighted models train singly (correct, unpacked)
+            return None
         return (
             type(model).__name__,
             model.loss,

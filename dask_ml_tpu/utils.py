@@ -169,6 +169,25 @@ def effective_mask(mask, y_padded=None, *, sample_weight=None,
     return w
 
 
+def reweight_rows(X, *, sample_weight=None, class_weight=None,
+                  classes=None, y_padded=None):
+    """Return ``X`` (ShardedRows) with per-row weights folded into its
+    mask via :func:`effective_mask` — the one place estimators rebuild a
+    weighted ShardedRows, so the weighting contract cannot drift between
+    them.  No-op (same object) when no weights are given."""
+    if sample_weight is None and class_weight is None:
+        return X
+    return ShardedRows(
+        data=X.data,
+        mask=effective_mask(
+            X.mask, y_padded, sample_weight=sample_weight,
+            class_weight=class_weight, classes=classes,
+            n_samples=X.n_samples,
+        ),
+        n_samples=X.n_samples,
+    )
+
+
 def host_class_weight_rows(class_weight, classes, yv):
     """Per-row class weights resolved ON HOST — the twin of
     :func:`effective_mask`'s device class-weight branch for label arrays

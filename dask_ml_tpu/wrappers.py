@@ -86,12 +86,17 @@ class ParallelPostFit(TPUEstimator):
         fn = getattr(est, method)
         if isinstance(X, ShardedRows):
             if isinstance(est, TPUEstimator):
-                # device-native: ONE sharded XLA program; only the
-                # RESULT is fetched, chunk by chunk
-                res = fn(X)
-                data = res.data if isinstance(res, ShardedRows) else res
+                # device-native: chunk the INPUT as device views so each
+                # chunk's inference (and its host fetch, e.g. predict's
+                # label gather) is chunk-sized — calling fn on the whole
+                # X would materialize the full O(n) result before the
+                # loop, the exact large-fetch hazard this method avoids
                 for lo, hi in _partial._row_chunks(X.n_samples, chunk_size):
-                    yield np.asarray(data[lo:hi])
+                    xb = ShardedRows(
+                        data=X.data[lo:hi], mask=X.mask[lo:hi],
+                        n_samples=hi - lo,
+                    )
+                    yield np.asarray(fn(xb))
                 return
             # host estimator: fetch INPUT rows chunkwise — never the
             # whole array at once (large D2H fetches can wedge a relayed

@@ -394,3 +394,33 @@ class TestSampleClassWeights:
         y = X[:, 0].astype(np.float32)
         with pytest.raises(ValueError, match="sample_weight"):
             SGDRegressor(max_iter=5).fit(X, y, sample_weight=np.ones(50))
+
+
+class TestBinaryMultinomialPenalty:
+    def test_binary_multinomial_equals_sigmoid_at_double_C(self, clf_data, mesh):
+        # 2-class softmax == sigmoid at half the penalty (w0 = -w1 splits
+        # the norm): the multinomial path must solve at lamduh/2
+        X, y = clf_data
+        mn = dlm.LogisticRegression(
+            solver="lbfgs", C=1.0, max_iter=300, tol=1e-8,
+            multi_class="multinomial",
+        ).fit(X, y)
+        sig2c = dlm.LogisticRegression(
+            solver="lbfgs", C=2.0, max_iter=300, tol=1e-8,
+        ).fit(X, y)
+        np.testing.assert_allclose(
+            np.asarray(mn.coef_), np.asarray(sig2c.coef_),
+            rtol=1e-3, atol=1e-4,
+        )
+
+
+class TestPackedExcludesClassWeight:
+    def test_class_weighted_sgd_not_packed(self, mesh):
+        from dask_ml_tpu.linear_model import SGDClassifier as TpuSGD
+        from dask_ml_tpu.model_selection._packing import pack_key
+
+        assert pack_key(TpuSGD()) is not None
+        # one shared cohort mask cannot express per-model class weights:
+        # weighted models must train singly, not silently unweighted
+        assert pack_key(TpuSGD(class_weight={0.0: 2.0})) is None
+        assert pack_key(TpuSGD(class_weight="balanced")) is None
