@@ -290,17 +290,35 @@ class BaseIncrementalSearchCV(TPUEstimator):
             for j in range(n_calls):
                 Xb, yb = blocks[(calls0 + j) % n_blocks]
                 cohort.step(Xb, yb)
+            # packed scoring: with the default (accuracy) scorer the whole
+            # cohort scores as ONE vmapped dispatch + one (M,) fetch,
+            # instead of M separate model.score round-trips — and it is
+            # the multi-controller-safe form (single collective program).
+            packed_scores = None
+            if self.scoring is None:
+                try:
+                    t0s = time.time()
+                    packed_scores = cohort.packed_accuracy(X_test, y_test)
+                    packed_score_time = (
+                        (time.time() - t0s) / max(len(idents), 1)
+                    )
+                except (TypeError, ValueError):
+                    packed_scores = None  # non-classifier/custom: fall back
             cohort.finalize()
             # train_one semantics: partial_fit_time is the duration of ONE
             # model's ONE block call — amortize the cohort-wide wall time
             # over (models x calls) so packed and unpacked timings compare
             pf_time = (time.time() - t0) / max(n_calls * len(idents), 1)
-            for ident in idents:
+            for i, ident in enumerate(idents):
                 model, meta = models[ident]
                 meta = dict(meta)
                 meta["partial_fit_calls"] += n_calls
                 meta["partial_fit_time"] = pf_time
-                meta = _score((model, meta), X_test, y_test, scorer)
+                if packed_scores is not None:
+                    meta["score"] = float(packed_scores[i])
+                    meta["score_time"] = packed_score_time
+                else:
+                    meta = _score((model, meta), X_test, y_test, scorer)
                 meta["elapsed_wall_time"] = time.time() - start_time
                 models[ident] = (model, meta)
                 info[ident].append(meta)

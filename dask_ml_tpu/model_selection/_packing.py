@@ -28,7 +28,7 @@ N models trained with ≪N dispatches.
 from __future__ import annotations
 
 import logging
-from functools import partial
+from functools import lru_cache, partial
 
 import numpy as np
 
@@ -46,7 +46,8 @@ logger = logging.getLogger(__name__)
 # Observability: how many fused dispatches ran vs how many model-steps they
 # covered.  A packed round of M models advances models_stepped by M while
 # dispatches grows by 1.
-DISPATCH_STATS = {"dispatches": 0, "models_stepped": 0, "cohorts": 0}
+DISPATCH_STATS = {"dispatches": 0, "models_stepped": 0, "cohorts": 0,
+                  "score_dispatches": 0}
 
 
 def reset_dispatch_stats():
@@ -75,6 +76,36 @@ def pack_key(model):
             model.fit_intercept,
         )
     return None
+
+
+def _packed_accuracy_impl(states, xb, yb, mask):
+    """vmap of masked accuracy over the stacked model axis.
+
+    ``yb`` is the shared ±1 one-vs-all target matrix; the true class
+    index is recovered from it (binary: sign of the single column),
+    so no separate label array is threaded through."""
+    if yb.shape[1] == 1:
+        y_idx = (yb[:, 0] > 0).astype(jnp.int32)
+    else:
+        y_idx = jnp.argmax(yb, axis=1).astype(jnp.int32)
+
+    def one(state):
+        m = xb @ state["coef"] + state["intercept"]
+        if m.shape[1] == 1:
+            pred = (m[:, 0] > 0).astype(jnp.int32)
+        else:
+            pred = jnp.argmax(m, axis=1).astype(jnp.int32)
+        hit = (pred == y_idx).astype(jnp.float32) * mask
+        return jnp.sum(hit) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    return jax.vmap(one)(states)
+
+
+@lru_cache(maxsize=None)
+def _packed_accuracy_jit(rep_sharding):
+    """One jit wrapper per output sharding (i.e. per mesh) — a fresh
+    jax.jit every call would re-trace each scoring round."""
+    return jax.jit(_packed_accuracy_impl, out_shardings=rep_sharding)
 
 
 @partial(
@@ -200,6 +231,33 @@ class Cohort:
         DISPATCH_STATS["dispatches"] += 1
         DISPATCH_STATS["models_stepped"] += len(self.models)
         return self
+
+    def packed_accuracy(self, X, y):
+        """All M models' held-out accuracies as ONE vmapped program and
+        one (M,)-scalar fetch — the scoring twin of :meth:`step` (M
+        separate ``model.score`` calls cost M dispatches, each a full
+        relay round-trip on tunnelled hardware).  The output is forced
+        replicated so the fetch stays legal when the stacked model axis
+        spans processes.  Classifier cohorts only."""
+        m0 = self._m0
+        if not isinstance(m0, SGDClassifier):
+            raise TypeError("packed_accuracy requires a classifier cohort")
+        if type(m0).score is not SGDClassifier.score:
+            # a subclass with a custom score() means plain accuracy is
+            # NOT its metric — refuse so the caller falls back to
+            # per-model score() calls
+            raise TypeError(
+                "cohort models override score(); packed accuracy would "
+                "silently replace their metric"
+            )
+        xb, yb, mask = self._prep(X, y)
+        if self._stacked is None:
+            self._stacked, self._hypers = self._stack()
+        accs = _packed_accuracy_jit(NamedSharding(get_mesh(), P()))(
+            self._stacked, xb, yb, mask
+        )
+        DISPATCH_STATS["score_dispatches"] += 1
+        return np.asarray(accs)
 
     def finalize(self):
         """Write stacked state back into the individual models."""

@@ -226,3 +226,39 @@ class TestDeviceResidentSearchPath:
         )
         search.fit(shard_rows(X), y, classes=[0.0, 1.0])
         assert search.best_score_ > 0
+
+
+class TestPackedScoring:
+    def test_packed_accuracy_matches_individual_scores(self, rng, mesh):
+        import numpy as np
+
+        from dask_ml_tpu.linear_model import SGDClassifier
+        from dask_ml_tpu.model_selection._packing import Cohort
+
+        X = rng.normal(size=(512, 6)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        models = [
+            SGDClassifier(alpha=a, random_state=0, tol=None)
+            for a in (1e-5, 1e-4, 1e-3, 1e-2)
+        ]
+        cohort = Cohort(models, classes=[0.0, 1.0])
+        for _ in range(3):
+            cohort.step(X, y)
+        packed = cohort.packed_accuracy(X, y)
+        cohort.finalize()
+        individual = [m.score(X, y) for m in models]
+        np.testing.assert_allclose(packed, individual, atol=1e-6)
+
+    def test_packed_accuracy_rejects_regressor_cohort(self, rng, mesh):
+        import numpy as np
+        import pytest
+
+        from dask_ml_tpu.linear_model import SGDRegressor
+        from dask_ml_tpu.model_selection._packing import Cohort
+
+        X = rng.normal(size=(128, 4)).astype(np.float32)
+        y = X[:, 0].astype(np.float32)
+        cohort = Cohort([SGDRegressor(), SGDRegressor(alpha=1e-3)])
+        cohort.step(X, y)
+        with pytest.raises(TypeError, match="classifier"):
+            cohort.packed_accuracy(X, y)
