@@ -573,33 +573,17 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
         even single-host it avoids the O(n) transfer)."""
         from ..core.sharded import ShardedRows as _SR
 
-        cls_np = self.classes_
-        f32_exact = (
-            np.issubdtype(cls_np.dtype, np.number)
-            and np.array_equal(
-                cls_np.astype(np.float32).astype(cls_np.dtype), cls_np
-            )
-        )
-        if isinstance(X, _SR) and isinstance(y, _SR) and f32_exact:
-            # the f32-exactness guard matters: int labels past 2^24 would
-            # collide after the cast and silently score wrong — those
-            # fall through to the host path instead
+        from ..utils import classes_f32_exact, masked_device_accuracy
+
+        if (isinstance(X, _SR) and isinstance(y, _SR)
+                and classes_f32_exact(self.classes_)):
             md = (X.data.astype(jnp.float32) @ self._state["coef"]
                   + self._state["intercept"])
             if md.shape[1] == 1:
                 idx = (md[:, 0] > 0).astype(jnp.int32)
             else:
                 idx = jnp.argmax(md, axis=1).astype(jnp.int32)
-            cls = jnp.asarray(cls_np.astype(np.float32))
-            # equality on VALUES (not searchsorted ranks): a y label
-            # outside classes_ counts as a miss, same as the host path
-            hit = (
-                (cls[idx] == y.data.astype(jnp.float32)).astype(jnp.float32)
-                * X.mask
-            )
-            return float(
-                jnp.sum(hit) / jnp.maximum(jnp.sum(X.mask), 1.0)
-            )
+            return masked_device_accuracy(idx, y.data, X.mask, self.classes_)
         from ..metrics import accuracy_score
 
         return accuracy_score(y, self.predict(X))

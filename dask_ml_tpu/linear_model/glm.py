@@ -340,10 +340,25 @@ class LogisticRegression(ClassifierMixin, _GLM):
 
     def score(self, X, y):
         """Mean accuracy (reference forwards to dask accuracy_score);
-        accepts plain or ShardedRows y."""
+        accepts plain or ShardedRows y.  All-device inputs score as ONE
+        replicated scalar fetch — no O(n) label transfer (the form the
+        device-resident CV search relies on, and the only legal one for
+        multi-host global arrays)."""
         from ..core.sharded import ShardedRows as _SR
         from ..core.sharded import unshard
 
+        from ..utils import classes_f32_exact, masked_device_accuracy
+
+        if (isinstance(X, _SR) and isinstance(y, _SR)
+                and classes_f32_exact(self.classes_)):
+            Xi, eta = self._etas(X)
+            if len(self.classes_) == 2:
+                idx = (eta[:, 0] > 0).astype(jnp.int32)
+            else:
+                idx = jnp.argmax(eta, axis=1).astype(jnp.int32)
+            return masked_device_accuracy(
+                idx, y.data, Xi.mask, self.classes_
+            )
         yv = unshard(y) if isinstance(y, _SR) else np.asarray(y)
         return float((self.predict(X) == yv).mean())
 

@@ -290,6 +290,7 @@ class BaseIncrementalSearchCV(TPUEstimator):
             for j in range(n_calls):
                 Xb, yb = blocks[(calls0 + j) % n_blocks]
                 cohort.step(Xb, yb)
+            t_fit_end = time.time()  # scoring must not inflate pf_time
             # packed scoring: with the default (accuracy) scorer the whole
             # cohort scores as ONE vmapped dispatch + one (M,) fetch,
             # instead of M separate model.score round-trips — and it is
@@ -308,7 +309,7 @@ class BaseIncrementalSearchCV(TPUEstimator):
             # train_one semantics: partial_fit_time is the duration of ONE
             # model's ONE block call — amortize the cohort-wide wall time
             # over (models x calls) so packed and unpacked timings compare
-            pf_time = (time.time() - t0) / max(n_calls * len(idents), 1)
+            pf_time = (t_fit_end - t0) / max(n_calls * len(idents), 1)
             for i, ident in enumerate(idents):
                 model, meta = models[ident]
                 meta = dict(meta)

@@ -101,10 +101,13 @@ def _packed_accuracy_impl(states, xb, yb, mask):
     return jax.vmap(one)(states)
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=8)
 def _packed_accuracy_jit(rep_sharding):
     """One jit wrapper per output sharding (i.e. per mesh) — a fresh
-    jax.jit every call would re-trace each scoring round."""
+    jax.jit every call would re-trace each scoring round.  Bounded: the
+    key holds a Mesh reference, and an unbounded cache would pin every
+    mesh a long-lived process (or the test suite's per-fixture meshes)
+    ever built, executables included."""
     return jax.jit(_packed_accuracy_impl, out_shardings=rep_sharding)
 
 

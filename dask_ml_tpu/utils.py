@@ -169,6 +169,37 @@ def effective_mask(mask, y_padded=None, *, sample_weight=None,
     return w
 
 
+def classes_f32_exact(classes) -> bool:
+    """True when every class label survives a float32 round-trip — the
+    precondition for device-side label comparison (int labels past 2^24
+    would collide after the cast and silently score wrong)."""
+    classes = np.asarray(classes)
+    return bool(
+        np.issubdtype(classes.dtype, np.number)
+        and np.array_equal(
+            classes.astype(np.float32).astype(classes.dtype), classes
+        )
+    )
+
+
+def masked_device_accuracy(pred_idx, y_data, mask, classes) -> float:
+    """Masked accuracy as ONE replicated scalar fetch.
+
+    ``pred_idx``: (padded_n,) predicted class indices (device);
+    ``y_data``: (padded_n,) raw label values (device).  Comparison is on
+    VALUES — a label outside ``classes`` counts as a miss, matching the
+    host accuracy path.  The single scalar fetch is the only legal form
+    for multi-host global arrays (and avoids the O(n) transfer anywhere).
+    Callers must gate on :func:`classes_f32_exact`.
+    """
+    cls = jnp.asarray(np.asarray(classes).astype(np.float32))
+    hit = (
+        (cls[pred_idx] == y_data.astype(jnp.float32)).astype(jnp.float32)
+        * mask
+    )
+    return float(jnp.sum(hit) / jnp.maximum(jnp.sum(mask), 1.0))
+
+
 def reweight_rows(X, *, sample_weight=None, class_weight=None,
                   classes=None, y_padded=None):
     """Return ``X`` (ShardedRows) with per-row weights folded into its

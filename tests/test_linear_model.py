@@ -424,3 +424,23 @@ class TestPackedExcludesClassWeight:
         # weighted models must train singly, not silently unweighted
         assert pack_key(TpuSGD(class_weight={0.0: 2.0})) is None
         assert pack_key(TpuSGD(class_weight="balanced")) is None
+
+
+class TestDeviceScore:
+    def test_glm_device_score_matches_host(self, rng, mesh):
+        n, d = 501, 5
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        lr = dlm.LogisticRegression(solver="lbfgs", max_iter=100).fit(X, y)
+        dev = lr.score(shard_rows(X), shard_rows(y))
+        host = lr.score(X, y)
+        assert dev == pytest.approx(host, abs=1e-6)
+
+    def test_glm_device_score_multiclass(self, rng, mesh):
+        X = rng.normal(size=(600, 5)).astype(np.float32)
+        W = rng.normal(size=(5, 3))
+        y = (X @ W).argmax(1).astype(np.float32)
+        lr = dlm.LogisticRegression(solver="lbfgs", max_iter=100).fit(X, y)
+        assert lr.score(shard_rows(X), shard_rows(y)) == pytest.approx(
+            lr.score(X, y), abs=1e-6
+        )
