@@ -25,13 +25,19 @@ def _row_chunks(n: int, chunk_size: int):
         yield start, min(start + chunk_size, n)
 
 
-def fit(model, x, y=None, *, chunk_size: int = 10_000, shuffle_blocks=False,
+def fit(model, x, y=None, *, chunk_size: int | None = None, shuffle_blocks=False,
         random_state=None, **kwargs):
     """Stream row chunks of (x, y) through ``model.partial_fit`` in order.
 
     Reference: ``dask_ml/_partial.py :: fit``.  ``shuffle_blocks`` permutes
     the chunk visit order (the reference shuffles dask blocks the same way).
+    ``chunk_size`` defaults to the shared device bucket size so
+    default-chunk streams pad zero extra rows per ``partial_fit``.
     """
+    if chunk_size is None:
+        from .linear_model._sgd import DEFAULT_STREAM_CHUNK
+
+        chunk_size = DEFAULT_STREAM_CHUNK
     xv = unshard(x) if isinstance(x, ShardedRows) else np.asarray(x)
     yv = None
     if y is not None:

@@ -48,6 +48,10 @@ __all__ = ["SGDClassifier", "SGDRegressor"]
 # len(_BUCKETS)+ programs per (d, k) shape.
 _BUCKETS = (256, 1024, 4096, 16384, 65536)
 
+#: Default streaming block size: a bucket entry, so default-chunk streams
+#: pad zero extra rows per partial_fit (wrappers.Incremental, _partial.fit)
+DEFAULT_STREAM_CHUNK = _BUCKETS[3]
+
 _CLS_LOSSES = ("log_loss", "hinge", "squared_hinge", "modified_huber")
 _REG_LOSSES = ("squared_error", "huber")
 _PENALTIES = ("l2", "l1", "elasticnet", None)

@@ -156,7 +156,12 @@ class Incremental(ParallelPostFit):
     def __init__(self, estimator=None, scoring=None, shuffle_blocks=True,
                  random_state=None, assume_equal_chunks=True,
                  predict_meta=None, predict_proba_meta=None,
-                 transform_meta=None, chunk_size=10_000):
+                 transform_meta=None, chunk_size=None):
+        # chunk_size=None resolves (in _partial.fit, at use time — the
+        # sklearn init contract forbids transforming params here) to the
+        # shared device bucket size ``_sgd.DEFAULT_STREAM_CHUNK``: an
+        # off-bucket chunk pads every block up to the bucket anyway —
+        # wasted compute per partial_fit on the streaming path
         self.shuffle_blocks = shuffle_blocks
         self.random_state = random_state
         self.assume_equal_chunks = assume_equal_chunks
