@@ -121,6 +121,11 @@ class _BlockwiseBase(TPUEstimator):
         probe = clone(self.estimator)
         if y is None or pack_key(probe) is None or self.n_blocks < 2:
             return False
+        if getattr(probe, "class_weight", None) is not None:
+            # the ensemble's packed epoch applies the plain validity mask
+            # only; the threaded fallback's est.fit DOES apply weights —
+            # route weighted members there instead of dropping weights
+            return False
 
         if isinstance(X, ShardedRows):
             data = X.data.astype(jnp.float32)

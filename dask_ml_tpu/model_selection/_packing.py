@@ -63,10 +63,9 @@ def pack_key(model):
     for stacking them under vmap with zero recompilation.
     """
     if isinstance(model, (SGDClassifier, SGDRegressor)):
-        if getattr(model, "class_weight", None) is not None:
-            # the packed step applies ONE shared mask to the whole
-            # cohort; per-model class weights would be silently dropped —
-            # weighted models train singly (correct, unpacked)
+        if getattr(model, "class_weight", None) == "balanced":
+            # 'balanced' needs the full label distribution — invalid for
+            # the block-streaming plane (partial_fit raises the same way)
             return None
         return (
             type(model).__name__,
@@ -125,7 +124,9 @@ def _packed_step(states, xb, yb, mask, hypers, *, loss, penalty, schedule,
         sgd_step, loss=loss, penalty=penalty, schedule=schedule,
         fit_intercept=fit_intercept,
     )
-    return jax.vmap(step, in_axes=(0, None, None, None, 0))(
+    # mask carries the model axis: per-model class weights fold into each
+    # lane's mask (a weightless cohort passes M broadcast copies)
+    return jax.vmap(step, in_axes=(0, None, None, 0, 0))(
         states, xb, yb, mask, hypers
     )
 
@@ -162,7 +163,7 @@ class Cohort:
         self._losses = None
 
     # -- target prep (shared across the cohort: same y, same classes) ----
-    def _prep(self, X, y):
+    def _prep(self, X, y, with_weights=True):
         from ..core.sharded import ShardedRows
 
         m0 = self._m0
@@ -186,7 +187,24 @@ class Cohort:
         xb, yb, mask = m0._prep_block(X, targets)
         for m in self.models:
             m._ensure_state(xb.shape[1])
-        return xb, yb, mask
+        # per-model weighted masks: each lane's class_weight (dict) scales
+        # its own copy of the block mask, so weighted models pack too
+        n_real = (
+            X.n_samples if isinstance(X, ShardedRows)
+            else int(np.asarray(X).shape[0])
+        )
+        if with_weights and any(
+            getattr(m, "class_weight", None) is not None for m in self.models
+        ):
+            masks = jnp.stack([
+                m._apply_weights(yb, mask, None, n_real,
+                                 allow_balanced=False)
+                if getattr(m, "class_weight", None) is not None else mask
+                for m in self.models
+            ])
+        else:
+            masks = jnp.broadcast_to(mask, (len(self.models),) + mask.shape)
+        return xb, yb, masks, mask
 
     def _stack(self):
         states = [m._state for m in self.models]
@@ -222,12 +240,12 @@ class Cohort:
 
     def step(self, X, y):
         """Advance every model in the cohort by one block: ONE dispatch."""
-        xb, yb, mask = self._prep(X, y)
+        xb, yb, masks, _base = self._prep(X, y)
         if self._stacked is None:
             self._stacked, self._hypers = self._stack()
         m0 = self._m0
         self._stacked, self._losses = _packed_step(
-            self._stacked, xb, yb, mask, self._hypers,
+            self._stacked, xb, yb, masks, self._hypers,
             loss=m0.loss, penalty=m0.penalty, schedule=m0.learning_rate,
             fit_intercept=m0.fit_intercept,
         )
@@ -253,11 +271,14 @@ class Cohort:
                 "cohort models override score(); packed accuracy would "
                 "silently replace their metric"
             )
-        xb, yb, mask = self._prep(X, y)
+        # scoring is unweighted: skip building the per-lane weighted masks
+        xb, yb, _masks, base_mask = self._prep(X, y, with_weights=False)
         if self._stacked is None:
             self._stacked, self._hypers = self._stack()
+        # accuracy is unweighted by definition: score with the plain
+        # validity mask, not any lane's class-weighted one
         accs = _packed_accuracy_jit(NamedSharding(get_mesh(), P()))(
-            self._stacked, xb, yb, mask
+            self._stacked, xb, yb, base_mask
         )
         DISPATCH_STATS["score_dispatches"] += 1
         return np.asarray(accs)

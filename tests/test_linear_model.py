@@ -414,15 +414,16 @@ class TestBinaryMultinomialPenalty:
         )
 
 
-class TestPackedExcludesClassWeight:
-    def test_class_weighted_sgd_not_packed(self, mesh):
+class TestClassWeightPackingRules:
+    def test_class_weight_packing_rules(self, mesh):
         from dask_ml_tpu.linear_model import SGDClassifier as TpuSGD
         from dask_ml_tpu.model_selection._packing import pack_key
 
         assert pack_key(TpuSGD()) is not None
-        # one shared cohort mask cannot express per-model class weights:
-        # weighted models must train singly, not silently unweighted
-        assert pack_key(TpuSGD(class_weight={0.0: 2.0})) is None
+        # dict class weights pack (per-model stacked masks carry them);
+        # 'balanced' stays unpackable — it needs the full label
+        # distribution, which the block-streaming plane cannot give
+        assert pack_key(TpuSGD(class_weight={0.0: 2.0})) is not None
         assert pack_key(TpuSGD(class_weight="balanced")) is None
 
 

@@ -262,3 +262,41 @@ class TestPackedScoring:
         cohort.step(X, y)
         with pytest.raises(TypeError, match="classifier"):
             cohort.packed_accuracy(X, y)
+
+
+class TestClassWeightedPacking:
+    def test_weighted_models_pack_and_match_individual(self, rng, mesh):
+        # per-model masks: lanes with DIFFERENT class_weight dicts train
+        # packed yet match their standalone partial_fit exactly
+        import numpy as np
+
+        from dask_ml_tpu.linear_model import SGDClassifier
+        from dask_ml_tpu.model_selection._packing import Cohort, pack_key
+
+        X = rng.normal(size=(512, 5)).astype(np.float32)
+        y = (X[:, 0] + 0.8 > 0).astype(np.float32)
+        cws = [None, {0.0: 5.0, 1.0: 1.0}, {0.0: 1.0, 1.0: 3.0}, None]
+        packed_models = [
+            SGDClassifier(alpha=1e-4, random_state=0, tol=None,
+                          class_weight=cw)
+            for cw in cws
+        ]
+        assert all(pack_key(m) is not None for m in packed_models)
+        cohort = Cohort(packed_models, classes=[0.0, 1.0])
+        for _ in range(3):
+            cohort.step(X, y)
+        cohort.finalize()
+        for cw, pm in zip(cws, packed_models):
+            solo = SGDClassifier(alpha=1e-4, random_state=0, tol=None,
+                                 class_weight=cw)
+            for _ in range(3):
+                solo.partial_fit(X, y, classes=[0.0, 1.0])
+            np.testing.assert_allclose(
+                pm.coef_, solo.coef_, rtol=1e-5, atol=1e-6
+            )
+
+    def test_balanced_still_unpackable(self, mesh):
+        from dask_ml_tpu.linear_model import SGDClassifier
+        from dask_ml_tpu.model_selection._packing import pack_key
+
+        assert pack_key(SGDClassifier(class_weight="balanced")) is None

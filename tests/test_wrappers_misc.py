@@ -567,3 +567,25 @@ class TestStreamingInference:
         monkeypatch.setattr(wr, "unshard", _boom)
         outs = list(pf.predict_blocks(shard_rows(X), chunk_size=250))
         assert sum(o.shape[0] for o in outs) == 800
+
+    def test_weighted_members_use_fallback_and_keep_weights(self, rng, mesh):
+        # a class-weighted member must NOT take the packed ensemble path
+        # (which has no weight plumbing) — the threaded fallback applies
+        # the weights through est.fit
+        from dask_ml_tpu.linear_model import SGDClassifier as TpuSGD
+
+        n = 400
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (X[:, 0] + 1.0 > 0).astype(np.float32)
+        up = BlockwiseVotingClassifier(
+            TpuSGD(max_iter=40, random_state=0, tol=None,
+                   class_weight={0.0: 8.0, 1.0: 1.0}),
+            n_blocks=2,
+        ).fit(X, y, classes=[0.0, 1.0])
+        plain = BlockwiseVotingClassifier(
+            TpuSGD(max_iter=40, random_state=0, tol=None), n_blocks=2
+        ).fit(X, y, classes=[0.0, 1.0])
+        rec0 = lambda m: float(  # noqa: E731
+            ((np.asarray(m.predict(X)) == 0) & (y == 0)).sum()
+        ) / max((y == 0).sum(), 1)
+        assert rec0(up) > rec0(plain)
