@@ -340,14 +340,25 @@ class KMeans(TransformerMixin, TPUEstimator):
 
             n_sample = min(X.n_samples, max(1000, 50 * self.n_clusters))
             key, sub = jax.random.split(key)
+            # weight-proportional subsample, AND the weights travel into
+            # sklearn's k-means++ itself: sampling alone cannot exclude
+            # zero-weight rows when n_sample == n (a no-replacement draw
+            # must take everything), and k-means++ would then happily
+            # seed on a zero-weight outlier
+            p = X.mask[: X.n_samples]
+            p = p / jnp.sum(p)
             idx = jax.random.choice(
                 sub, X.n_samples, (n_sample,),
-                replace=n_sample > X.n_samples,
+                replace=n_sample > X.n_samples, p=p,
             )
             sample = np.asarray(jnp.take(X.data, idx, axis=0), dtype=np.float64)
+            w_sample = np.asarray(
+                jnp.take(X.mask[: X.n_samples], idx), dtype=np.float64
+            )
             seed = int(draw_seed(int(jax.random.randint(key, (), 0, 2**31 - 1))))
             centers, _ = kmeans_plusplus(
-                sample, self.n_clusters, random_state=seed
+                sample, self.n_clusters, sample_weight=w_sample,
+                random_state=seed,
             )
             return jnp.asarray(centers, dtype=X.data.dtype)
         raise ValueError(f"Unknown init: {init!r}")

@@ -212,12 +212,17 @@ class LogisticRegression(ClassifierMixin, _GLM):
 
         K = len(self.classes_)
         self._multinomial = False
-        if K == 2:
+        if K == 2 and not (
+            self.multi_class == "multinomial" and self.penalty != "l2"
+        ):
             # binary: one sigmoid solve.  'multinomial' with 2 classes is
-            # the SAME loss reparameterized (w = w1 - w0) but the softmax
-            # penalty ||w0||² + ||w1||² equals ||w||²/2 at the symmetric
-            # optimum — i.e. the sigmoid fit at HALF the penalty — so
-            # sklearn parity needs lamduh/2 on that path
+            # the SAME loss reparameterized (w = w1 - w0); for L2 the
+            # softmax penalty ||w0||² + ||w1||² equals ||w||²/2 at the
+            # symmetric optimum — i.e. the sigmoid fit at HALF the
+            # penalty.  That scalar equivalence is L2-ONLY (L1 of the
+            # split pair is |w|, elasticnet has no single scale), so
+            # non-L2 multinomial falls through to the true 2-class
+            # softmax solve below.
             y01 = _indicator(self.classes_[1])
             if self.multi_class == "multinomial":
                 kwargs = self._solver_call_kwargs()
@@ -248,8 +253,16 @@ class LogisticRegression(ClassifierMixin, _GLM):
             else:
                 y_idx = np.searchsorted(self.classes_, yv).astype(np.float32)
             beta_flat, n_it = self._solve(Xi, y_idx, family=fam)
-            self.betas_ = beta_flat.reshape(Xi.data.shape[1], K).T  # (K, p)
-            self._multinomial = True
+            W = beta_flat.reshape(Xi.data.shape[1], K).T  # (K, p)
+            if K == 2:
+                # non-L2 binary softmax (the L2 case took the sigmoid
+                # shortcut above): collapse to the sigmoid form — the
+                # decision function w = w1 - w0 gives the EXACT softmax
+                # posterior, and the binary coef_/predict contract holds
+                self.betas_ = (W[1] - W[0])[None, :]
+            else:
+                self.betas_ = W
+                self._multinomial = True
             # sklearn multinomial reports ONE solver run replicated per
             # class in n_iter_; keep a single honest count instead
             n_iter_runs = [n_it]

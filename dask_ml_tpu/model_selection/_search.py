@@ -288,7 +288,11 @@ class _BaseSearchCV(TPUEstimator):
         # transformed data, compute-once under the thread pool, entries
         # refcount-evicted as their last consumer finishes
         prefix_cache = _OnceCache()
-        if self.cache_cv:
+        from sklearn.pipeline import Pipeline as _Pipeline
+
+        if self.cache_cv and isinstance(self.estimator, _Pipeline):
+            # non-Pipeline estimators have no prefixes: skip the
+            # O(n_candidates) clone/set_params precompute entirely
             use_counts: dict = {}
             for params in candidates:
                 est0 = clone(self.estimator).set_params(**params)

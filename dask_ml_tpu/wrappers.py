@@ -84,6 +84,11 @@ class ParallelPostFit(TPUEstimator):
 
         est = self._postfit_estimator
         fn = getattr(est, method)
+
+        def _as_block(out):
+            # sparse estimator outputs (e.g. a transformer) stay sparse:
+            # np.asarray(csr) is a useless 0-d object array
+            return out if scipy.sparse.issparse(out) else np.asarray(out)
         if isinstance(X, ShardedRows):
             if isinstance(est, TPUEstimator):
                 # device-native: chunk the INPUT as device views so each
@@ -96,30 +101,30 @@ class ParallelPostFit(TPUEstimator):
                         data=X.data[lo:hi], mask=X.mask[lo:hi],
                         n_samples=hi - lo,
                     )
-                    yield np.asarray(fn(xb))
+                    yield _as_block(fn(xb))
                 return
             # host estimator: fetch INPUT rows chunkwise — never the
             # whole array at once (large D2H fetches can wedge a relayed
             # device, and one-piece unshard would break the bounded-
             # memory contract)
             for lo, hi in _partial._row_chunks(X.n_samples, chunk_size):
-                yield np.asarray(fn(np.asarray(X.data[lo:hi])))
+                yield _as_block(fn(np.asarray(X.data[lo:hi])))
             return
         if scipy.sparse.issparse(X):
             # sparse row slices stay sparse all the way into the
             # estimator (densifying a wide chunk defeats the purpose)
             for lo, hi in _partial._row_chunks(X.shape[0], chunk_size):
-                yield np.asarray(fn(X[lo:hi]))
+                yield _as_block(fn(X[lo:hi]))
             return
         if hasattr(X, "shape"):
             X = np.asarray(X)
             for lo, hi in _partial._row_chunks(X.shape[0], chunk_size):
-                yield np.asarray(fn(X[lo:hi]))
+                yield _as_block(fn(X[lo:hi]))
             return
         for block in X:  # iterable of row blocks, passed through AS-IS
             # (sparse blocks reach a sparse-capable estimator unchanged;
             # densify upstream for estimators that require dense)
-            yield np.asarray(fn(block))
+            yield _as_block(fn(block))
 
     def predict(self, X):
         return self._apply("predict", X)

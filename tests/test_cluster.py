@@ -434,3 +434,12 @@ class TestKMeansSampleWeight:
             X, sample_weight=sw
         )
         assert ours.inertia_ == pytest.approx(sk.inertia_, rel=1e-3)
+
+    def test_zero_weight_outlier_never_seeds_kmeanspp(self, rng, mesh):
+        X = rng.normal(size=(400, 3)).astype(np.float32)
+        X[0] = 1e6  # extreme outlier, weight 0
+        w = np.ones(400); w[0] = 0.0
+        km = dc.KMeans(
+            n_clusters=3, init="k-means++", random_state=0, max_iter=20
+        ).fit(X, sample_weight=w)
+        assert float(np.abs(np.asarray(km.cluster_centers_)).max()) < 1e3

@@ -414,6 +414,25 @@ class TestBinaryMultinomialPenalty:
         )
 
 
+    def test_binary_multinomial_l1_matches_full_penalty_sigmoid(self, clf_data, mesh):
+        # L1: the split-pair penalty minimizes to |w1-w0| in the optimal
+        # gauge, so the true binary softmax L1 fit equals the sigmoid fit
+        # at FULL lamduh (NOT half, which is the L2-only scaling)
+        X, y = clf_data
+        mn = dlm.LogisticRegression(
+            multi_class="multinomial", penalty="l1",
+            solver="proximal_grad", C=0.05, max_iter=500, tol=1e-9,
+        ).fit(X, y)
+        sig = dlm.LogisticRegression(
+            penalty="l1", solver="proximal_grad", C=0.05, max_iter=500,
+            tol=1e-9,
+        ).fit(X, y)
+        assert np.asarray(mn.coef_).shape == np.asarray(sig.coef_).shape
+        np.testing.assert_allclose(
+            np.asarray(mn.coef_), np.asarray(sig.coef_), atol=3e-2
+        )
+
+
 class TestClassWeightPackingRules:
     def test_class_weight_packing_rules(self, mesh):
         from dask_ml_tpu.linear_model import SGDClassifier as TpuSGD

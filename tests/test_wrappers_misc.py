@@ -589,3 +589,18 @@ class TestStreamingInference:
             ((np.asarray(m.predict(X)) == 0) & (y == 0)).sum()
         ) / max((y == 0).sum(), 1)
         assert rec0(up) > rec0(plain)
+
+    def test_predict_blocks_sparse_outputs_stay_sparse(self, rng, mesh):
+        import scipy.sparse
+        from sklearn.feature_extraction.text import TfidfTransformer
+
+        from dask_ml_tpu.wrappers import ParallelPostFit
+
+        counts = scipy.sparse.random(
+            300, 50, density=0.1, random_state=0, format="csr"
+        )
+        pf = ParallelPostFit(TfidfTransformer()).fit(counts)
+        outs = list(pf.predict_blocks(counts, method="transform",
+                                      chunk_size=100))
+        assert all(scipy.sparse.issparse(o) for o in outs)
+        assert sum(o.shape[0] for o in outs) == 300
