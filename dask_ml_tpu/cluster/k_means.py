@@ -340,12 +340,12 @@ class KMeans(TransformerMixin, TPUEstimator):
 
             n_sample = min(X.n_samples, max(1000, 50 * self.n_clusters))
             key, sub = jax.random.split(key)
-            # weight-proportional subsample, AND the weights travel into
-            # sklearn's k-means++ itself: sampling alone cannot exclude
-            # zero-weight rows when n_sample == n (a no-replacement draw
-            # must take everything), and k-means++ would then happily
-            # seed on a zero-weight outlier
-            p = X.mask[: X.n_samples]
+            # VALIDITY-uniform subsample + the true weights inside
+            # sklearn's k-means++.  Subsampling proportionally to the
+            # weights would weight twice (seed probability ~ w^2 d^2 vs
+            # sklearn's w d^2); a 0/1 validity draw keeps zero-weight
+            # rows out while kmeans_plusplus applies w exactly once.
+            p = (X.mask[: X.n_samples] > 0).astype(jnp.float32)
             p = p / jnp.sum(p)
             idx = jax.random.choice(
                 sub, X.n_samples, (n_sample,),

@@ -447,6 +447,9 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
                     "partial_fit"
                 )
             if isinstance(cwd, dict):
+                from ..utils import _check_class_weight_keys
+
+                _check_class_weight_keys(cwd, self.classes_)
                 # keys are original labels; effective_mask works on the
                 # recovered class INDICES, so re-key by position
                 cwd = {
@@ -639,9 +642,17 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
             self._state = sgd_init(n_features, 1)
             self.n_features_in_ = int(n_features)
 
-    def partial_fit(self, X, y, **kwargs):
+    def partial_fit(self, X, y, sample_weight=None, **kwargs):
         self._validate()
         xb, yb, mask = self._prep_block(X, self._targets(y, X))
+        if sample_weight is not None:
+            from ..utils import effective_mask
+
+            n_real = X.n_samples if isinstance(X, ShardedRows) else int(
+                np.asarray(X).shape[0])
+            mask = effective_mask(
+                mask, sample_weight=sample_weight, n_samples=n_real
+            )
         self._ensure_state(xb.shape[1])
         self._loss_ = self._step_block(xb, yb, mask)
         return self

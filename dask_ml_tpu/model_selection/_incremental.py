@@ -496,7 +496,11 @@ class BaseIncrementalSearchCV(TPUEstimator):
         X_train, X_test, y_train, y_test = train_test_split(
             X, y, test_size=test_size, random_state=self.random_state
         )
-        if not isinstance(self.estimator, TPUEstimator):
+        device_scoring_ok = self.scoring is None or isinstance(
+            self.scoring, str
+        )  # registry scorers are ShardedRows-aware; user callables may not be
+        if not (isinstance(self.estimator, TPUEstimator)
+                and device_scoring_ok):
             # host (sklearn) models score host arrays; device models keep
             # the held-out split SHARDED — unsharding here would pull it
             # to host once and re-upload it at every scoring round

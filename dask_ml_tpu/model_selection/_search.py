@@ -250,7 +250,11 @@ class _BaseSearchCV(TPUEstimator):
             Xh, yh = X, y
             n = X.n_samples
             explicit_cv = self.cv is not None and not isinstance(self.cv, int)
-            if explicit_cv and y is not None:
+            if y is not None and not isinstance(y, ShardedRows):
+                # y already lives on host: stratified defaults cost
+                # nothing — keep round-2 semantics for classifiers
+                y_split = np.asarray(y)
+            elif explicit_cv and y is not None:
                 # a user-chosen splitter may stratify on labels — that
                 # takes a host copy of y (1-D, the only O(n) fetch here)
                 y_split = np.asarray(_host(y))

@@ -104,6 +104,19 @@ def svd_flip(u, v, u_based_decision: bool = True):
     return u, v
 
 
+def _check_class_weight_keys(class_weight, classes):
+    """A dict key naming no fitted class is a typo, not a preference —
+    raise like sklearn's compute_class_weight instead of silently
+    training unweighted."""
+    known = set(np.asarray(classes).tolist())
+    unknown = [k for k in class_weight if k not in known]
+    if unknown:
+        raise ValueError(
+            f"class_weight keys {unknown!r} are not in the fitted classes "
+            f"{sorted(known)!r}"
+        )
+
+
 def effective_mask(mask, y_padded=None, *, sample_weight=None,
                    class_weight=None, classes=None, n_samples=None):
     """Fold per-row weights into a validity mask.
@@ -161,6 +174,7 @@ def effective_mask(mask, y_padded=None, *, sample_weight=None,
             total = jnp.sum(mask)
             cw = total / (len(cls_np) * jnp.maximum(counts, 1.0))
         else:
+            _check_class_weight_keys(class_weight, cls_np)
             cw = jnp.asarray(
                 [float(class_weight.get(c, 1.0)) for c in cls_np.tolist()],
                 jnp.float32,
@@ -240,6 +254,7 @@ def host_class_weight_rows(class_weight, classes, yv):
         counts[np.searchsorted(classes, uniq)] = counts_u
         cw = yv.shape[0] / (len(classes) * np.maximum(counts, 1.0))
     else:
+        _check_class_weight_keys(class_weight, classes)
         cw = np.asarray(
             [float(class_weight.get(c, 1.0)) for c in classes.tolist()]
         )

@@ -464,3 +464,19 @@ class TestDeviceScore:
         assert lr.score(shard_rows(X), shard_rows(y)) == pytest.approx(
             lr.score(X, y), abs=1e-6
         )
+
+
+class TestClassWeightValidation:
+    def test_unknown_dict_key_raises(self, clf_data, mesh):
+        X, y = clf_data
+        with pytest.raises(ValueError, match="class_weight keys"):
+            dlm.LogisticRegression(
+                solver="lbfgs", max_iter=10, class_weight={7.0: 2.0}
+            ).fit(X, y)
+
+    def test_sgd_unknown_dict_key_raises(self, clf_data, mesh):
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X, y = clf_data
+        with pytest.raises(ValueError, match="class_weight keys"):
+            SGDClassifier(max_iter=5, class_weight={"dog": 2.0}).fit(X, y)
