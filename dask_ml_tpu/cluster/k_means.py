@@ -347,9 +347,12 @@ class KMeans(TransformerMixin, TPUEstimator):
             # rows out while kmeans_plusplus applies w exactly once.
             p = (X.mask[: X.n_samples] > 0).astype(jnp.float32)
             p = p / jnp.sum(p)
+            # replace=False always: n_sample = min(n_samples, ...), so a
+            # no-replacement draw is always valid; zero-probability rows
+            # that must fill the draw are neutralized by w_sample=0 in
+            # kmeans_plusplus
             idx = jax.random.choice(
-                sub, X.n_samples, (n_sample,),
-                replace=n_sample > X.n_samples, p=p,
+                sub, X.n_samples, (n_sample,), replace=False, p=p,
             )
             sample = np.asarray(jnp.take(X.data, idx, axis=0), dtype=np.float64)
             w_sample = np.asarray(

@@ -173,13 +173,20 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
             self.n_steps_ = 0
 
     # -- streaming contract ------------------------------------------------
-    def partial_fit(self, X, y=None, **kwargs):
+    def partial_fit(self, X, y=None, sample_weight=None, **kwargs):
         """One fused device update on this block (the budget unit).
 
         Host blocks are padded to the SGD family's bucket sizes
         (``linear_model._sgd._BUCKETS``) before ingest, so a stream of
         ragged chunk sizes compiles a handful of programs, not one per
         distinct length."""
+        if sample_weight is not None:
+            raise NotImplementedError(
+                "sample_weight is not supported by the device "
+                "MiniBatchKMeans: the 1/n_c decay keeps exact int32 "
+                "counts, which fractional weights would break — use "
+                "KMeans(sample_weight=...) or duplicate rows"
+            )
         if not isinstance(X, ShardedRows):
             from ..linear_model._sgd import _bucket_pad
 
@@ -199,7 +206,13 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
         return self
 
     # -- whole-array fit ---------------------------------------------------
-    def fit(self, X, y=None):
+    def fit(self, X, y=None, sample_weight=None):
+        if sample_weight is not None:
+            raise NotImplementedError(
+                "sample_weight is not supported by the device "
+                "MiniBatchKMeans (exact int32 count decay); use "
+                "KMeans(sample_weight=...) or duplicate rows"
+            )
         check_max_iter(self.max_iter)
         X = _ingest_float(self, X)
         for attr in ("cluster_centers_", "_counts"):

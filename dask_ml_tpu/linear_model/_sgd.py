@@ -642,17 +642,22 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
             self._state = sgd_init(n_features, 1)
             self.n_features_in_ = int(n_features)
 
+    @staticmethod
+    def _weighted_mask(X, mask, sample_weight):
+        if sample_weight is None:
+            return mask
+        from ..utils import effective_mask
+
+        n_real = X.n_samples if isinstance(X, ShardedRows) else int(
+            np.asarray(X).shape[0])
+        return effective_mask(
+            mask, sample_weight=sample_weight, n_samples=n_real
+        )
+
     def partial_fit(self, X, y, sample_weight=None, **kwargs):
         self._validate()
         xb, yb, mask = self._prep_block(X, self._targets(y, X))
-        if sample_weight is not None:
-            from ..utils import effective_mask
-
-            n_real = X.n_samples if isinstance(X, ShardedRows) else int(
-                np.asarray(X).shape[0])
-            mask = effective_mask(
-                mask, sample_weight=sample_weight, n_samples=n_real
-            )
+        mask = self._weighted_mask(X, mask, sample_weight)
         self._ensure_state(xb.shape[1])
         self._loss_ = self._step_block(xb, yb, mask)
         return self
@@ -662,14 +667,7 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
         if not self.warm_start and hasattr(self, "_state"):
             delattr(self, "_state")
         xb, yb, mask = self._prep_block(X, self._targets(y, X))
-        if sample_weight is not None:
-            from ..utils import effective_mask
-
-            n_real = X.n_samples if isinstance(X, ShardedRows) else int(
-                np.asarray(X).shape[0])
-            mask = effective_mask(
-                mask, sample_weight=sample_weight, n_samples=n_real
-            )
+        mask = self._weighted_mask(X, mask, sample_weight)
         self._ensure_state(xb.shape[1])
         self.n_iter_ = _run_epochs(self, xb, yb, mask)
         return self

@@ -443,3 +443,14 @@ class TestKMeansSampleWeight:
             n_clusters=3, init="k-means++", random_state=0, max_iter=20
         ).fit(X, sample_weight=w)
         assert float(np.abs(np.asarray(km.cluster_centers_)).max()) < 1e3
+
+    def test_minibatch_sample_weight_rejected_explicitly(self, rng, mesh):
+        # silent **kwargs swallowing would train unweighted; an explicit
+        # NotImplementedError tells the user the honest truth
+        X = rng.normal(size=(64, 3)).astype(np.float32)
+        with pytest.raises(NotImplementedError, match="sample_weight"):
+            dc.MiniBatchKMeans(n_clusters=2).partial_fit(
+                X, sample_weight=np.ones(64)
+            )
+        with pytest.raises(NotImplementedError, match="sample_weight"):
+            dc.MiniBatchKMeans(n_clusters=2).fit(X, sample_weight=np.ones(64))
