@@ -1,0 +1,111 @@
+"""Property-based pins for the algebraically delicate paths: the
+two-level roc_auc prefix sum and the weight-folding helper (round 3).
+
+Bounded example counts keep the suite fast; the properties (exact sklearn
+equality under ties/weights, duplication-equivalence of integer weights)
+are the invariants hand-picked examples keep missing."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+
+@st.composite
+def _labeled_scores(draw):
+    n = draw(st.integers(min_value=4, max_value=120))
+    t = draw(st.lists(st.integers(0, 1), min_size=n, max_size=n))
+    if len(set(t)) < 2:
+        t[0], t[1] = 0, 1
+    # coarse rounding makes heavy ties likely
+    s = draw(st.lists(
+        st.integers(min_value=-5, max_value=5), min_size=n, max_size=n
+    ))
+    w = draw(st.lists(
+        st.floats(min_value=0.1, max_value=4.0, allow_nan=False),
+        min_size=n, max_size=n,
+    ))
+    return np.asarray(t), np.asarray(s, np.float32), np.asarray(w, np.float32)
+
+
+class TestRocAucProperties:
+    @settings(max_examples=30, deadline=None)
+    @given(_labeled_scores())
+    def test_matches_sklearn_under_ties_and_weights(self, tsw):
+        import sklearn.metrics as skm
+
+        from dask_ml_tpu import metrics as dm
+
+        t, s, w = tsw
+        ours = dm.roc_auc_score(t, s, sample_weight=w)
+        ref = skm.roc_auc_score(t, s, sample_weight=w)
+        assert ours == pytest.approx(ref, abs=1e-5)
+
+    @settings(max_examples=15, deadline=None)
+    @given(_labeled_scores())
+    def test_multiblock_equals_singleblock(self, tsw):
+        from dask_ml_tpu.metrics import classification as cl
+
+        t, s, w = tsw
+        one = cl.roc_auc_score(t, s, sample_weight=w)
+        saved = cl._AUC_BLOCK
+        cl._AUC_BLOCK = 8  # force many blocks (restored below)
+        try:
+            many = cl.roc_auc_score(t, s, sample_weight=w)
+        finally:
+            cl._AUC_BLOCK = saved
+        assert one == pytest.approx(many, abs=1e-6)
+
+
+class TestEffectiveMaskProperties:
+    @settings(max_examples=25, deadline=None)
+    @given(
+        # weight 0 included: a zero-weight row must drop out entirely,
+        # exactly like a row repeated zero times
+        st.lists(st.integers(0, 3), min_size=3, max_size=40),
+        st.lists(st.integers(0, 2), min_size=3, max_size=40),
+    )
+    def test_integer_weights_equal_duplication_in_weighted_mean(
+        self, sw, labels
+    ):
+        # weighted mean with integer sample weights == unweighted mean of
+        # the duplicated rows (the invariant behind every weighted fit)
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.utils import effective_mask
+
+        from hypothesis import assume
+
+        n = min(len(sw), len(labels))
+        sw, labels = np.asarray(sw[:n]), np.asarray(labels[:n], np.float32)
+        assume(sw.sum() > 0)
+        vals = labels * 2.0 - 1.0
+        mask = jnp.ones(n, jnp.float32)
+        w = effective_mask(mask, sample_weight=sw, n_samples=n)
+        weighted_mean = float((jnp.asarray(vals) * w).sum() / w.sum())
+        dup_mean = float(np.repeat(vals, sw).mean())
+        assert weighted_mean == pytest.approx(dup_mean, abs=1e-5)
+
+    @settings(max_examples=25, deadline=None)
+    @given(st.lists(st.integers(0, 2), min_size=6, max_size=60))
+    def test_balanced_classes_get_equal_total_weight(self, labels):
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.utils import effective_mask
+
+        labels = np.asarray(labels, np.float32)
+        classes = np.unique(labels)
+        if len(classes) < 2:
+            return
+        mask = jnp.ones(len(labels), jnp.float32)
+        w = effective_mask(
+            mask, jnp.asarray(labels), class_weight="balanced",
+            classes=classes,
+        )
+        w = np.asarray(w)
+        # balanced: every class's TOTAL weight equals n/K
+        totals = [w[labels == c].sum() for c in classes]
+        np.testing.assert_allclose(
+            totals, len(labels) / len(classes), rtol=1e-5
+        )
