@@ -109,3 +109,63 @@ class TestEffectiveMaskProperties:
         np.testing.assert_allclose(
             totals, len(labels) / len(classes), rtol=1e-5
         )
+
+
+class TestQuantileSketchProperties:
+    @settings(max_examples=12, deadline=None)
+    @given(
+        st.integers(min_value=0, max_value=2**31 - 1),
+        st.floats(min_value=1.0, max_value=1e6, allow_nan=False),
+    )
+    def test_sketch_tracks_exact_quantiles(self, seed, scale):
+        # FIXED shape (one jit executable across examples); data and
+        # scale vary — incl. the outlier-heavy regimes the refinement
+        # passes exist for
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.preprocessing.data import _hist_quantiles
+
+        rng = np.random.RandomState(seed)
+        x = (rng.normal(size=(2048, 2)) * np.array([1.0, scale])).astype(
+            np.float32
+        )
+        x[0, 0] = scale * 1e3  # guaranteed outlier in column 0
+        probs = np.asarray([0.0, 0.25, 0.5, 0.75, 1.0], np.float32)
+        got = np.asarray(_hist_quantiles(
+            jnp.asarray(x), jnp.ones(2048, jnp.float32), jnp.asarray(probs)
+        ))
+        want = np.quantile(x, probs, axis=0)
+        span = x.max(axis=0) - x.min(axis=0)
+        # interior quantiles within a tiny fraction of each column span
+        # (manual bound: assert_allclose cannot format an array atol)
+        err = np.abs(got[1:4] - want[1:4])
+        bound = np.maximum(span * 2e-3, 1e-4)
+        assert (err <= bound).all(), (err, bound)
+        np.testing.assert_allclose(got[0], want[0], rtol=1e-6)
+        np.testing.assert_allclose(got[4], want[4], rtol=1e-6)
+
+
+class TestPackedSolveProperties:
+    @settings(max_examples=8, deadline=None)
+    @given(st.integers(min_value=0, max_value=2**31 - 1))
+    def test_packed_equals_sequential_lbfgs(self, seed):
+        # fixed (n, d, K): one compile serves all examples; data varies
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.solvers import Logistic, lbfgs, packed_solve
+
+        rng = np.random.RandomState(seed)
+        n, d, K = 256, 4, 3
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        sX = shard_rows(X)
+        Y = np.zeros((K, sX.data.shape[0]), np.float32)
+        labels = rng.randint(0, K, n)
+        for k in range(K):
+            Y[k, :n] = labels == k
+        betas, _ = packed_solve(
+            "lbfgs", sX, Y, family=Logistic, lamduh=1.0, max_iter=60,
+        )
+        for k in range(K):
+            solo = lbfgs(sX, Y[k], family=Logistic, lamduh=1.0, max_iter=60)
+            np.testing.assert_allclose(
+                np.asarray(betas[k]), np.asarray(solo), rtol=5e-3, atol=1e-3
+            )
