@@ -123,12 +123,28 @@ def main(pid: int, nproc: int, port: str, local_devices: int = 4) -> None:
     print(f"[proc {pid}] search_scores={scores} "
           f"dispatch_stats={dict(DISPATCH_STATS)}", flush=True)
 
+    # -- flagship 4: Hyperband ON THE FLEET with sequential brackets —
+    # each bracket is one lockstep packed cohort at a time, so every
+    # process issues identical collectives (concurrent brackets would
+    # interleave nondeterministically across threads and deadlock)
+    from dask_ml_tpu.model_selection import HyperbandSearchCV
+
+    hb = HyperbandSearchCV(
+        SGDClassifier(random_state=0, tol=None),
+        {"alpha": [1e-5, 1e-4, 1e-3, 1e-2]},
+        max_iter=4, aggressiveness=2, random_state=0,
+        sequential_brackets=True,
+    )
+    hb.fit(Xs2, ys2, classes=[0.0, 1.0])
+    print(f"[proc {pid}] hyperband_best={hb.best_score_:.6f} "
+          f"n_models={hb.n_models_}", flush=True)
+
     print(f"[proc {pid}] multihost OK: acc={acc:.3f} lloyd_iters={int(n_iter)}",
           flush=True)
 
 
 def spawn_group(n_processes: int = 2, local_devices: int = 4,
-                timeout_s: int = 300):
+                timeout_s: int = 480):
     """Spawn the worker group as subprocesses and collect results.
 
     The ONE subprocess harness (used by ``__graft_entry__.dryrun_multihost``

@@ -38,9 +38,10 @@ packed adaptive search satisfies this (one lockstep cohort per round —
 see ``model_selection/_incremental.py :: train_cohort``), and is the
 supported cross-host search plane.  ``HyperbandSearchCV``'s concurrent
 brackets interleave dispatches nondeterministically across threads and
-must therefore stay on a single controller: run Hyperband per-host on
-host-local meshes, or run its brackets sequentially, when spanning
-processes.
+must therefore stay on a single controller — pass
+``HyperbandSearchCV(..., sequential_brackets=True)`` to run one lockstep
+bracket at a time, the multi-controller-legal form (exercised
+cross-process in ``core/_multihost_worker.py``).
 """
 
 from __future__ import annotations

@@ -68,9 +68,11 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
     def __init__(self, estimator, parameters, max_iter=81, aggressiveness=3,
                  test_size=None, random_state=None, scoring=None,
                  patience=False, tol=1e-3, verbose=False, prefix="",
-                 chunk_size=None, checkpoint=None):
+                 chunk_size=None, checkpoint=None,
+                 sequential_brackets=False):
         self.max_iter = max_iter
         self.aggressiveness = aggressiveness
+        self.sequential_brackets = sequential_brackets
         super().__init__(
             estimator, parameters, test_size=test_size,
             random_state=random_state, scoring=scoring, max_iter=max_iter,
@@ -132,6 +134,15 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
                 sha._fit(X_train, y_train, X_test, y_test, **fit_params)
                 for _, sha in brackets
             ]
+            if self.sequential_brackets:
+                # one bracket at a time, each a lockstep packed cohort:
+                # every process issues the same device programs in the
+                # same order — the multi-controller-legal form for
+                # Hyperband on a multi-host (global-mesh) fleet, where
+                # thread-interleaved concurrent brackets would reorder
+                # collectives across processes and deadlock
+                # (core/distributed.py module docstring)
+                return [await c for c in coros]
             return await asyncio.gather(*coros)
 
         results = asyncio.run(run_all())

@@ -688,3 +688,28 @@ class TestPrefixCacheEviction:
         # every (prefix, fold) entry was released by its last consumer:
         # transformed fold data must not be pinned for the fit's lifetime
         assert created and len(created[0]) == 0
+
+
+class TestSequentialBrackets:
+    def test_sequential_matches_concurrent(self, clf_data, mesh):
+        # same brackets, same per-bracket seeds -> identical results; only
+        # the scheduling differs (sequential is the multi-controller form)
+        from dask_ml_tpu.linear_model import SGDClassifier as TpuSGD
+
+        X, y = clf_data
+        yf = y.astype(np.float32)
+        kw = dict(
+            parameters={"alpha": [1e-5, 1e-4, 1e-3, 1e-2]},
+            max_iter=4, aggressiveness=2, random_state=0,
+        )
+        conc = dms.HyperbandSearchCV(
+            TpuSGD(random_state=0, tol=None), **kw
+        ).fit(X, yf, classes=[0.0, 1.0])
+        seq = dms.HyperbandSearchCV(
+            TpuSGD(random_state=0, tol=None), sequential_brackets=True, **kw
+        ).fit(X, yf, classes=[0.0, 1.0])
+        assert seq.best_score_ == pytest.approx(conc.best_score_, abs=1e-6)
+        assert seq.metadata_["n_models"] == conc.metadata_["n_models"]
+        assert (
+            seq.cv_results_["test_score"] == conc.cv_results_["test_score"]
+        )

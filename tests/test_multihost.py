@@ -18,7 +18,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 class TestMultihost:
     def test_two_process_admm_and_lloyd(self):
         outs = []
-        for rc, out in spawn_group(2, 4, timeout_s=240):
+        for rc, out in spawn_group(2, 4, timeout_s=480):
             assert rc == 0, out
             assert "multihost OK" in out
             outs.append(out)
@@ -38,6 +38,16 @@ class TestMultihost:
             stats = ast.literal_eval(s.group(1))
             assert stats["models_stepped"] == 4 * stats["dispatches"], stats
         assert parsed[0] == parsed[1]  # identical across processes
+
+        # sequential-bracket Hyperband (flagship 4): both processes must
+        # report the identical best score and model count — the whole
+        # point of the lockstep form is cross-controller agreement
+        hbs = []
+        for out in outs:
+            m = re.search(r"hyperband_best=([0-9.]+) n_models=(\d+)", out)
+            assert m, out
+            hbs.append((m.group(1), m.group(2)))
+        assert hbs[0] == hbs[1], hbs
 
         # identical to single-host: the same global dataset on one
         # process's 8-device mesh must produce the same scores

@@ -135,11 +135,15 @@ class TestQuantileSketchProperties:
             jnp.asarray(x), jnp.ones(2048, jnp.float32), jnp.asarray(probs)
         ))
         want = np.quantile(x, probs, axis=0)
-        span = x.max(axis=0) - x.min(axis=0)
-        # interior quantiles within a tiny fraction of each column span
-        # (manual bound: assert_allclose cannot format an array atol)
+        # bound RELATIVE TO THE IQR, not the outlier-bloated span: an
+        # unrefined sketch's error is one bin = span/4096, which for the
+        # outlier column exceeds this bound ~10x — so the test actually
+        # fails if the refinement passes stop working.  (The residual
+        # error is dominated by the rank-interpolation definition gap vs
+        # np.quantile, ~order-stat spacing, not by bin resolution.)
+        iqr = want[3] - want[1]
         err = np.abs(got[1:4] - want[1:4])
-        bound = np.maximum(span * 2e-3, 1e-4)
+        bound = iqr * 2e-2 + (x.max(axis=0) - x.min(axis=0)) * 1e-6
         assert (err <= bound).all(), (err, bound)
         np.testing.assert_allclose(got[0], want[0], rtol=1e-6)
         np.testing.assert_allclose(got[4], want[4], rtol=1e-6)
