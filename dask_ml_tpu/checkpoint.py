@@ -164,9 +164,17 @@ class SearchCheckpoint:
     search's next ``fit`` starts fresh.
     """
 
-    def __init__(self, path: str, fingerprint: str | None = None):
+    def __init__(self, path: str, fingerprint: str | None = None,
+                 keep_on_complete: bool = False):
         self.path = str(path)
         self.fingerprint = fingerprint
+        # bracket checkpoints inside a sequential Hyperband keep their
+        # final snapshot: deleting on completion would force a crash-
+        # restart to retrain every already-FINISHED bracket from scratch
+        # (the resumed policy immediately returns {} so a finished
+        # bracket replays in one no-op round); the parent search removes
+        # the files once the WHOLE fit completes
+        self.keep_on_complete = keep_on_complete
 
     def exists(self) -> bool:
         return os.path.exists(self.path)
@@ -196,6 +204,8 @@ class SearchCheckpoint:
         return snap["models"], snap["info"], snap["policy_state"], snap.get("elapsed", 0.0)
 
     def complete(self) -> None:
+        if self.keep_on_complete:
+            return
         if self.exists():
             os.unlink(self.path)
 

@@ -5,6 +5,13 @@ Hyperband bracket schedule from ``max_iter`` (+``aggressiveness``),
 instantiates one SuccessiveHalvingSearchCV per bracket, runs ALL brackets
 concurrently on one event loop, and exposes ``metadata``/``metadata_``
 (``n_models``, ``partial_fit_calls`` per bracket) — SURVEY.md §3.3.
+
+``sequential_brackets=True`` runs one bracket at a time instead — with the
+per-round lockstep dispatch in ``_incremental.run_round``, the
+multi-controller-legal form for a multi-process (multi-host) mesh, where
+thread-concurrent brackets would emit collectives in different orders on
+different processes and deadlock (``core/distributed.py``).  Concurrent
+brackets on a multi-process group are rejected with a clear error.
 """
 
 from __future__ import annotations
@@ -121,29 +128,42 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
                 test_size=self.test_size, random_state=seed,
                 scoring=self.scoring, prefix=f"{self.prefix}bracket={s}",
                 chunk_size=self.chunk_size, checkpoint=ckpt,
+                patience=self.patience, tol=self.tol,
             )
+            # a finished bracket KEEPS its final snapshot until the whole
+            # Hyperband fit completes: a crash in bracket k must not force
+            # brackets 0..k-1 to retrain (their restored policies replay
+            # as an immediate no-op round)
+            sha._ckpt_keep_on_complete = True
             brackets.append((s, sha))
         return brackets
 
     def fit(self, X, y=None, **fit_params):
+        import jax
+
+        if jax.process_count() > 1 and not self.sequential_brackets:
+            raise ValueError(
+                "concurrent Hyperband brackets interleave collectives "
+                "nondeterministically across processes and would deadlock "
+                "a multi-process mesh; pass sequential_brackets=True "
+                "(see core/distributed.py)"
+            )
         X_train, X_test, y_train, y_test = self._split(X, y)
         brackets = self._make_brackets()
 
+        def bracket_fit(sha):
+            return sha._fit(X_train, y_train, X_test, y_test, **fit_params)
+
         async def run_all():
-            coros = [
-                sha._fit(X_train, y_train, X_test, y_test, **fit_params)
-                for _, sha in brackets
-            ]
             if self.sequential_brackets:
-                # one bracket at a time, each a lockstep packed cohort:
-                # every process issues the same device programs in the
-                # same order — the multi-controller-legal form for
-                # Hyperband on a multi-host (global-mesh) fleet, where
-                # thread-interleaved concurrent brackets would reorder
-                # collectives across processes and deadlock
-                # (core/distributed.py module docstring)
-                return [await c for c in coros]
-            return await asyncio.gather(*coros)
+                # one bracket at a time (coroutines created LAZILY so a
+                # failing bracket leaves no never-awaited coroutines);
+                # with run_round's lockstep dispatch each bracket issues
+                # identical collectives on every process
+                return [await bracket_fit(sha) for _, sha in brackets]
+            return await asyncio.gather(
+                *[bracket_fit(sha) for _, sha in brackets]
+            )
 
         results = asyncio.run(run_all())
 
@@ -174,6 +194,14 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
         self._fit_failures = sum(
             getattr(sha, "_fit_failures", 0) for _, sha in brackets
         )
+        if self.checkpoint:
+            # the whole fit finished: bracket snapshots (kept on bracket
+            # completion for crash recovery) are no longer needed
+            import os as _os
+
+            for _, sha in brackets:
+                if sha.checkpoint and _os.path.exists(str(sha.checkpoint)):
+                    _os.unlink(str(sha.checkpoint))
         self._process_results(all_models, all_info)
         self.metadata_ = {
             "n_models": sum(m["n_models"] for m in meta_observed),

@@ -189,7 +189,10 @@ class BaseIncrementalSearchCV(TPUEstimator):
             return None
         from ..checkpoint import SearchCheckpoint, search_fingerprint
 
-        return SearchCheckpoint(self.checkpoint, fingerprint=search_fingerprint(self))
+        return SearchCheckpoint(
+            self.checkpoint, fingerprint=search_fingerprint(self),
+            keep_on_complete=getattr(self, "_ckpt_keep_on_complete", False),
+        )
 
     def _capture_policy_state(self):
         return {a: getattr(self, a) for a in self._policy_state_attrs}
@@ -400,19 +403,42 @@ class BaseIncrementalSearchCV(TPUEstimator):
                 with use_mesh(mesh):
                     return fn(*args)
 
+            # multi-controller lockstep: on a multi-process group EVERY
+            # process must issue device programs in the SAME order, so the
+            # round's units run sequentially in a deterministic order
+            # (sorted pack keys, then sorted single idents) instead of
+            # racing on the thread pool — collectives emitted from
+            # thread-scheduled units would interleave differently per
+            # process and deadlock the fleet
+            try:
+                import jax as _jax
+
+                lockstep = _jax.process_count() > 1
+            except Exception:
+                lockstep = False
+            packed_items = sorted(packed.items(), key=lambda kv: repr(kv[0]))
+            singles_items = sorted(singles)
+            if lockstep:
+                for (key, n_calls, _), idents in packed_items:
+                    on_mesh(run_unit, train_cohort, list(idents), idents,
+                            n_calls)
+                for ident, n_calls in singles_items:
+                    on_mesh(run_unit, train_one, [ident], ident, n_calls)
+                return
+
             futs = [
                 loop.run_in_executor(
                     pool, on_mesh, run_unit, train_cohort, list(idents),
                     idents, n_calls,
                 )
-                for (key, n_calls, _), idents in packed.items()
+                for (key, n_calls, _), idents in packed_items
             ]
             futs += [
                 loop.run_in_executor(
                     pool, on_mesh, run_unit, train_one, [ident], ident,
                     n_calls,
                 )
-                for ident, n_calls in singles
+                for ident, n_calls in singles_items
             ]
             if futs:
                 await asyncio.gather(*futs)

@@ -713,3 +713,30 @@ class TestSequentialBrackets:
         assert (
             seq.cv_results_["test_score"] == conc.cv_results_["test_score"]
         )
+
+    def test_patience_forwarded_to_brackets(self, mesh):
+        hb = dms.HyperbandSearchCV(
+            SGDClassifier(tol=None), {"alpha": [1e-4, 1e-3]},
+            max_iter=9, patience=2, tol=1e-3,
+        )
+        for _s, sha in hb._make_brackets():
+            assert sha.patience == 2 and sha.tol == 1e-3
+
+    def test_completed_fit_cleans_bracket_checkpoints(self, clf_data, mesh,
+                                                      tmp_path):
+        import os
+
+        from dask_ml_tpu.linear_model import SGDClassifier as TpuSGD
+
+        X, y = clf_data
+        ckdir = tmp_path / "hb"
+        ckdir.mkdir()
+        hb = dms.HyperbandSearchCV(
+            TpuSGD(random_state=0, tol=None), {"alpha": [1e-5, 1e-4]},
+            max_iter=4, aggressiveness=2, random_state=0,
+            sequential_brackets=True, checkpoint=str(ckdir),
+        ).fit(X, y.astype(np.float32), classes=[0.0, 1.0])
+        assert hb.best_score_ > 0.5
+        # bracket snapshots are kept while the fit runs (crash recovery)
+        # and removed once the WHOLE fit completes
+        assert not [f for f in os.listdir(ckdir) if f.endswith(".pkl")]
