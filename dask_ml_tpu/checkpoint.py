@@ -203,8 +203,11 @@ class SearchCheckpoint:
             return None
         return snap["models"], snap["info"], snap["policy_state"], snap.get("elapsed", 0.0)
 
-    def complete(self) -> None:
-        if self.keep_on_complete:
+    def complete(self, force: bool = False) -> None:
+        """Remove the snapshot of a finished search.  ``force`` overrides
+        ``keep_on_complete`` — used by a parent search (Hyperband) to clear
+        its brackets' kept snapshots once the WHOLE fit is done."""
+        if self.keep_on_complete and not force:
             return
         if self.exists():
             os.unlink(self.path)

@@ -197,11 +197,10 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
         if self.checkpoint:
             # the whole fit finished: bracket snapshots (kept on bracket
             # completion for crash recovery) are no longer needed
-            import os as _os
-
             for _, sha in brackets:
-                if sha.checkpoint and _os.path.exists(str(sha.checkpoint)):
-                    _os.unlink(str(sha.checkpoint))
+                ck = sha._checkpointer()
+                if ck is not None:
+                    ck.complete(force=True)
         self._process_results(all_models, all_info)
         self.metadata_ = {
             "n_models": sum(m["n_models"] for m in meta_observed),

@@ -119,6 +119,54 @@ class BaseIncrementalSearchCV(TPUEstimator):
     def _additional_calls(self, info):
         raise NotImplementedError
 
+    def _patience_calls(self) -> int:
+        """Resolved patience budget in partial_fit calls; 0 = disabled.
+        ``patience=True`` auto-sizes to ``max_iter // 3`` (the reference's
+        Hyperband convention for its bool form)."""
+        if not self.patience:
+            return 0
+        if self.patience is True:
+            return max(int(self.max_iter) // 3, 1)
+        return int(self.patience)
+
+    def _filter_plateaued(self, info, instructions):
+        """Drop positive instructions for models whose score has not
+        improved by ``tol`` over the last ``patience`` partial_fit calls.
+
+        Applied by the fit loop AFTER every policy's ``_additional_calls``
+        so plateau stopping works uniformly for IncrementalSearchCV, SHA,
+        Hyperband brackets and InverseDecay (reference: ``patience``/
+        ``tol`` are base-class semantics, not per-policy).
+
+        The window is measured in ``partial_fit_calls`` DISTANCE, not
+        record count: SHA appends one score record per geometrically
+        growing burst (1, 3, 9, … calls), so counting records would make
+        large patience values silent no-ops for exactly the policies this
+        filter exists to cover.
+        """
+        patience = self._patience_calls()
+        if not patience:
+            return instructions
+        out = {}
+        for ident, n_calls in instructions.items():
+            if n_calls > 0:
+                recs = info[ident]
+                edge = recs[-1]["partial_fit_calls"] - patience
+                window = [
+                    r["score"] for r in recs if r["partial_fit_calls"] > edge
+                ]
+                older = [
+                    r["score"] for r in recs if r["partial_fit_calls"] <= edge
+                ]
+                # plateaued: a full patience window exists and nothing in
+                # it beat the last pre-window score by tol
+                if older and window and all(
+                    s < older[-1] + self.tol for s in window
+                ):
+                    continue
+            out[ident] = n_calls
+        return out
+
     def _reset_policy(self):
         """Clear per-fit mutable policy state (re-fit safety)."""
 
@@ -350,6 +398,16 @@ class BaseIncrementalSearchCV(TPUEstimator):
                     singles.append((v[0], k[1]))
             return packed, singles
 
+        # multi-controller lockstep: on a multi-process group EVERY process
+        # must issue device programs in the SAME order (computed once here;
+        # used by both the retry policy and the round dispatcher)
+        try:
+            import jax as _jax
+
+            lockstep = _jax.process_count() > 1
+        except Exception:
+            lockstep = False
+
         def run_unit(fn, unit_ids, first_arg, n_calls):
             """One training unit with single-retry fault recovery.
 
@@ -360,6 +418,12 @@ class BaseIncrementalSearchCV(TPUEstimator):
             recovery (sklearn partial_fit mutates in place, so re-running
             without the snapshot would double-apply blocks).  A second
             failure propagates: persistent faults must surface, not spin.
+
+            On a multi-process group there is NO retry: an exception seen
+            by one process only would make that process re-issue the
+            unit's device programs while its peers move on — the fleet's
+            collective streams diverge and deadlock.  State is rolled back
+            and the fault propagates so every process stops loudly.
             """
             import copy
 
@@ -371,15 +435,17 @@ class BaseIncrementalSearchCV(TPUEstimator):
             try:
                 return fn(first_arg, n_calls)
             except Exception:
-                logger.warning(
-                    "training unit %s failed; retrying once from "
-                    "round-start state", unit_ids, exc_info=True,
-                )
                 with self._fit_failures_lock:
                     self._fit_failures += len(unit_ids)
                 for i in unit_ids:
                     models[i] = snapshot[i]
                     del info[i][info_snapshot[i]:]
+                if lockstep:
+                    raise
+                logger.warning(
+                    "training unit %s failed; retrying once from "
+                    "round-start state", unit_ids, exc_info=True,
+                )
                 return fn(first_arg, n_calls)
 
         async def run_round(instructions):
@@ -403,19 +469,11 @@ class BaseIncrementalSearchCV(TPUEstimator):
                 with use_mesh(mesh):
                     return fn(*args)
 
-            # multi-controller lockstep: on a multi-process group EVERY
-            # process must issue device programs in the SAME order, so the
-            # round's units run sequentially in a deterministic order
-            # (sorted pack keys, then sorted single idents) instead of
-            # racing on the thread pool — collectives emitted from
-            # thread-scheduled units would interleave differently per
-            # process and deadlock the fleet
-            try:
-                import jax as _jax
-
-                lockstep = _jax.process_count() > 1
-            except Exception:
-                lockstep = False
+            # lockstep (computed above): the round's units run sequentially
+            # in a deterministic order (sorted pack keys, then sorted
+            # single idents) instead of racing on the thread pool —
+            # collectives emitted from thread-scheduled units would
+            # interleave differently per process and deadlock the fleet
             packed_items = sorted(packed.items(), key=lambda kv: repr(kv[0]))
             singles_items = sorted(singles)
             if lockstep:
@@ -455,7 +513,9 @@ class BaseIncrementalSearchCV(TPUEstimator):
         # instructions keep a model alive without training (the policy's
         # internal step counter advances, reference semantics)
         while True:
-            instructions = self._additional_calls(dict(info))
+            instructions = self._filter_plateaued(
+                info, self._additional_calls(dict(info))
+            )
             if not instructions:
                 break
             await run_round(instructions)
@@ -573,19 +633,13 @@ class IncrementalSearchCV(BaseIncrementalSearchCV):
     """
 
     def _additional_calls(self, info):
+        # plateau stopping (patience/tol) is the base fit loop's
+        # _filter_plateaued post-pass, shared with SHA/Hyperband
         out = {}
         for ident, recs in info.items():
             calls = recs[-1]["partial_fit_calls"]
             if calls >= self.max_iter:
                 continue
-            if self.patience:
-                patience = int(self.patience)
-                scores = [r["score"] for r in recs]
-                back = max(1, patience // max(self.fits_per_score, 1))
-                if len(scores) > back:
-                    old = scores[-back - 1]
-                    if all(s < old + self.tol for s in scores[-back:]):
-                        continue  # plateaued
             out[ident] = min(self.fits_per_score, self.max_iter - calls)
         return out
 

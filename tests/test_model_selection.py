@@ -226,6 +226,27 @@ class TestSuccessiveHalving:
                 ConstantFunction(), {"value": [0.1]},
             ).fit(X, y)
 
+    def test_patience_stops_plateaued_bracket(self, clf_data):
+        # patience is a BASE-loop post-filter, so SHA brackets honor it
+        # too: constant scores plateau immediately and the winner stops
+        # long before its granted r_i budget
+        X, y = clf_data
+        kw = dict(
+            n_initial_parameters="grid", n_initial_iter=1, aggressiveness=3,
+            max_iter=81, chunk_size=50,
+        )
+        grid = {"value": [i / 10 for i in range(9)]}
+        full = dms.SuccessiveHalvingSearchCV(
+            ConstantFunction(), grid, **kw).fit(X, y)
+        stopped = dms.SuccessiveHalvingSearchCV(
+            ConstantFunction(), grid, patience=3, tol=1e-3, **kw).fit(X, y)
+        calls = lambda s: sum(  # noqa: E731
+            recs[-1]["partial_fit_calls"]
+            for recs in s.model_history_.values()
+        )
+        assert stopped.best_score_ == full.best_score_ == 0.8
+        assert calls(stopped) < calls(full)
+
 
 class TestHyperband:
     def test_bracket_params_r81(self):
@@ -721,6 +742,31 @@ class TestSequentialBrackets:
         )
         for _s, sha in hb._make_brackets():
             assert sha.patience == 2 and sha.tol == 1e-3
+
+    def test_patience_reduces_hyperband_budget(self, clf_data):
+        # behavioral, not just forwarding: plateaued models stop early in
+        # every bracket, so the observed budget drops below metadata's
+        X, y = clf_data
+        grid = {"value": [i / 10 for i in range(10)]}
+        full = dms.HyperbandSearchCV(
+            ConstantFunction(), grid, max_iter=27, random_state=0,
+            chunk_size=50,
+        ).fit(X, y)
+        stopped = dms.HyperbandSearchCV(
+            ConstantFunction(), grid, max_iter=27, random_state=0,
+            patience=2, tol=1e-3, chunk_size=50,
+        ).fit(X, y)
+        assert (
+            stopped.metadata_["partial_fit_calls"]
+            < full.metadata_["partial_fit_calls"]
+        )
+        assert stopped.best_score_ == full.best_score_
+
+    def test_patience_true_auto_sizes(self):
+        search = dms.IncrementalSearchCV(
+            ConstantFunction(), {"value": [0.1]}, max_iter=30, patience=True,
+        )
+        assert search._patience_calls() == 10
 
     def test_completed_fit_cleans_bracket_checkpoints(self, clf_data, mesh,
                                                       tmp_path):
