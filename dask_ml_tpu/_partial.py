@@ -15,7 +15,7 @@ import logging
 import numpy as np
 
 from .core.sharded import ShardedRows, unshard
-from .utils import check_random_state
+from .utils import check_chunks, check_random_state
 
 logger = logging.getLogger(__name__)
 
@@ -34,11 +34,16 @@ def fit(model, x, y=None, *, chunk_size: int | None = None, shuffle_blocks=False
     ``chunk_size`` defaults to the shared device bucket size so
     default-chunk streams pad zero extra rows per ``partial_fit``.
     """
+    xv = unshard(x) if isinstance(x, ShardedRows) else np.asarray(x)
     if chunk_size is None:
         from .linear_model._sgd import DEFAULT_STREAM_CHUNK
 
         chunk_size = DEFAULT_STREAM_CHUNK
-    xv = unshard(x) if isinstance(x, ShardedRows) else np.asarray(x)
+    else:
+        # accept dask-style (rows, cols) specs too; validates positivity
+        chunk_size = check_chunks(
+            xv.shape[0], xv.shape[1] if xv.ndim > 1 else None, chunk_size
+        )
     yv = None
     if y is not None:
         yv = unshard(y) if isinstance(y, ShardedRows) else np.asarray(y)

@@ -121,12 +121,14 @@ class BaseIncrementalSearchCV(TPUEstimator):
 
     def _patience_calls(self) -> int:
         """Resolved patience budget in partial_fit calls; 0 = disabled.
-        ``patience=True`` auto-sizes to ``max_iter // 3`` (the reference's
-        Hyperband convention for its bool form)."""
+        ``patience=True`` auto-sizes to ``max_iter // aggressiveness``
+        (the reference's Hyperband convention for its bool form; policies
+        without an aggressiveness use the Hyperband default of 3)."""
         if not self.patience:
             return 0
         if self.patience is True:
-            return max(int(self.max_iter) // 3, 1)
+            eta = int(getattr(self, "aggressiveness", 3) or 3)
+            return max(int(self.max_iter) // eta, 1)
         return int(self.patience)
 
     def _filter_plateaued(self, info, instructions):

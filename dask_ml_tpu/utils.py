@@ -79,6 +79,85 @@ def check_consistent_length(*arrays):
         raise ValueError(f"Inconsistent sample counts: {sorted(lengths)}")
 
 
+def check_chunks(n_samples, n_features=None, chunks=None):
+    """Normalize a row-block size the way the reference normalizes dask
+    chunks (reference: ``dask_ml/utils.py :: check_chunks``).
+
+    The TPU collection model has no column chunking (features live whole on
+    each shard — SURVEY §2.2 data parallelism), so ``chunks`` here is the
+    ROW-block granularity; ``_partial.fit`` normalizes its ``chunk_size``
+    through this.  Accepts ``None`` (auto: ≤ 16 blocks), an int (rows per
+    block), or a (rows, features) tuple whose feature entry must cover all
+    columns.  Returns rows-per-block as an int.
+    """
+    n_samples = int(n_samples)
+    if n_samples <= 0:
+        raise ValueError("n_samples must be positive")
+    if chunks is None:
+        return max(1, -(-n_samples // 16))
+    if isinstance(chunks, numbers.Integral):
+        chunks = int(chunks)
+        if chunks <= 0:
+            raise ValueError(f"chunks must be positive; got {chunks}")
+        return chunks
+    if isinstance(chunks, (tuple, list)) and len(chunks) == 2:
+        rows, cols = chunks
+        if n_features is not None and int(cols) != int(n_features):
+            raise ValueError(
+                f"column chunking is not supported on the TPU layout; the "
+                f"feature chunk must span all {n_features} columns, got {cols}"
+            )
+        return check_chunks(n_samples, n_features, int(rows))
+    raise ValueError(f"Unrecognized chunks: {chunks!r}")
+
+
+def check_matching_blocks(*arrays):
+    """Raise unless all sharded inputs share one row layout (reference:
+    ``dask_ml/utils.py :: check_matching_blocks`` — same-chunk check).
+
+    For :class:`ShardedRows`, "matching blocks" means identical logical
+    length, identical padded length, and identical device sharding — the
+    preconditions for zipping two collections through one shard_map.
+    Non-sharded array-likes only need matching logical length.
+    """
+    check_consistent_length(*arrays)
+    sharded = [a for a in arrays if isinstance(a, ShardedRows)]
+    if len(sharded) < 2:
+        return
+    first = sharded[0]
+    for other in sharded[1:]:
+        if other.data.shape[0] != first.data.shape[0]:
+            raise ValueError(
+                f"Mismatched padded lengths: {first.data.shape[0]} vs "
+                f"{other.data.shape[0]} — reshard with shard_rows so the "
+                f"pad+mask layouts agree"
+            )
+        if other.data.sharding != first.data.sharding:
+            raise ValueError(
+                "Mismatched device shardings: "
+                f"{first.data.sharding} vs {other.data.sharding}"
+            )
+
+
+def slice_columns(X, columns):
+    """Select columns from an array, dataframe or ShardedRows (reference:
+    ``dask_ml/utils.py :: slice_columns``).  ``None`` returns X unchanged;
+    dataframes slice by label, arrays by position."""
+    if columns is None:
+        return X
+    if isinstance(X, ShardedRows):
+        cols = np.asarray(columns)
+        if cols.dtype == bool:  # mask → positions (parity with X[:, mask])
+            cols = np.flatnonzero(cols)
+        idx = jnp.asarray(cols.astype(np.int32))
+        return ShardedRows(
+            data=X.data[:, idx], mask=X.mask, n_samples=X.n_samples
+        )
+    if hasattr(X, "iloc"):  # pandas
+        return X[list(columns)]
+    return X[:, np.asarray(columns)]
+
+
 def handle_zeros_in_scale(scale):
     """Avoid division by ~0 when scaling (constant features scale by 1).
 

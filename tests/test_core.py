@@ -97,3 +97,76 @@ def test_sharded_rows_is_frozen():
     assert isinstance(s, ShardedRows)
     with pytest.raises(Exception):
         s.n_samples = 5
+
+
+class TestChunkHelpers:
+    """Reference: ``dask_ml/utils.py :: check_chunks / check_matching_blocks /
+    slice_columns`` — the chunk-spec trio, re-done for the row-shard layout."""
+
+    def test_check_chunks_auto(self):
+        from dask_ml_tpu.utils import check_chunks
+
+        assert check_chunks(160) == 10  # <=16 blocks
+        assert check_chunks(5) == 1
+
+    def test_check_chunks_int_and_tuple(self):
+        from dask_ml_tpu.utils import check_chunks
+
+        assert check_chunks(100, 4, 25) == 25
+        assert check_chunks(100, 4, (25, 4)) == 25
+        with pytest.raises(ValueError, match="column chunking"):
+            check_chunks(100, 4, (25, 2))
+        with pytest.raises(ValueError, match="positive"):
+            check_chunks(100, 4, 0)
+
+    def test_check_matching_blocks(self):
+        from dask_ml_tpu.utils import check_matching_blocks
+
+        a = shard_rows(np.ones((20, 2), dtype=np.float32))
+        b = shard_rows(np.ones((20, 3), dtype=np.float32))
+        check_matching_blocks(a, b)  # same layout: fine
+        c = shard_rows(np.ones((21, 2), dtype=np.float32))
+        with pytest.raises(ValueError, match="[Ii]nconsistent"):
+            check_matching_blocks(a, c)
+
+    def test_slice_columns_array_and_sharded(self):
+        import pandas as pd
+
+        from dask_ml_tpu.utils import slice_columns
+
+        x = np.arange(24, dtype=np.float32).reshape(6, 4)
+        np.testing.assert_array_equal(
+            slice_columns(x, [1, 3]), x[:, [1, 3]]
+        )
+        assert slice_columns(x, None) is x
+        s = shard_rows(x)
+        out = slice_columns(s, [0, 2])
+        assert isinstance(out, ShardedRows) and out.n_samples == 6
+        np.testing.assert_array_equal(unshard(out), x[:, [0, 2]])
+        df = pd.DataFrame(x, columns=list("abcd"))
+        assert list(slice_columns(df, ["b", "d"]).columns) == ["b", "d"]
+
+    def test_slice_columns_boolean_mask(self):
+        from dask_ml_tpu.utils import slice_columns
+
+        x = np.arange(24, dtype=np.float32).reshape(6, 4)
+        mask = np.array([True, False, True, False])
+        np.testing.assert_array_equal(
+            unshard(slice_columns(shard_rows(x), mask)), x[:, mask]
+        )
+
+    def test_partial_fit_accepts_tuple_chunks(self):
+        from sklearn.linear_model import SGDClassifier as SkSGD
+
+        from dask_ml_tpu import _partial
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(60, 4).astype(np.float32)
+        y = (rng.rand(60) > 0.5).astype(np.int32)
+        m = _partial.fit(
+            SkSGD(random_state=0), x, y, chunk_size=(20, 4),
+            classes=[0, 1],
+        )
+        assert hasattr(m, "coef_")
+        with pytest.raises(ValueError, match="column chunking"):
+            _partial.fit(SkSGD(), x, y, chunk_size=(20, 2), classes=[0, 1])
