@@ -435,3 +435,69 @@ class TestConvergenceCanary:
         # drop at constant epochs (currently ~0.99 at 60 epochs)
         m = SGDClassifier(max_iter=60, tol=None, random_state=0).fit(X, y)
         assert m.score(X, y) > 0.97
+
+
+class TestMinibatchEpochs:
+    """fit(batch_size=B): epoch = one scanned program of n_pad/B minibatch
+    steps over stride interleaves (closer to sklearn's per-sample SGD than
+    the default full-batch epoch)."""
+
+    def test_minibatch_fit_matches_fullbatch_accuracy(self, rng):
+        X, y = _binary_data(rng, n=600)
+        full = SGDClassifier(max_iter=60, tol=None).fit(X, y)
+        mb = SGDClassifier(max_iter=60, tol=None, batch_size=128).fit(X, y)
+        acc_full = (full.predict(X) == y).mean()
+        acc_mb = (mb.predict(X) == y).mean()
+        assert acc_mb > 0.9
+        assert acc_mb >= acc_full - 0.03
+
+    def test_minibatch_advances_t_per_step(self, rng):
+        X, y = _binary_data(rng, n=512)
+        mb = SGDClassifier(max_iter=1, tol=None, batch_size=128).fit(X, y)
+        # 512 rows pad to a 1024 bucket -> nearest divisor split of 1024/128
+        assert mb.t_ > 1.0  # several steps in the single epoch
+        full = SGDClassifier(max_iter=1, tol=None).fit(X, y)
+        assert full.t_ == 1.0
+
+    def test_minibatch_sharded_parity(self, rng, mesh):
+        X, y = _binary_data(rng, n=640)
+        sX, sy = shard_rows(X), shard_rows(y)
+        host = SGDClassifier(max_iter=40, tol=None, batch_size=80).fit(X, y)
+        dev = SGDClassifier(max_iter=40, tol=None, batch_size=80).fit(sX, sy)
+        acc_dev = (dev.predict(X) == y).mean()
+        assert acc_dev > 0.9
+        assert abs(acc_dev - (host.predict(X) == y).mean()) < 0.05
+
+    def test_minibatch_regressor(self, rng):
+        X = rng.normal(size=(500, 6)).astype(np.float32)
+        w = rng.normal(size=6).astype(np.float32)
+        y = X @ w + 0.01 * rng.normal(size=500).astype(np.float32)
+        mb = SGDRegressor(
+            max_iter=200, tol=None, batch_size=64, learning_rate="constant",
+            eta0=0.05, penalty=None,
+        ).fit(X, y)
+        from sklearn.metrics import r2_score
+
+        assert r2_score(y, np.asarray(mb.predict(X))) > 0.95
+
+    def test_batch_size_larger_than_n_is_fullbatch(self, rng):
+        X, y = _binary_data(rng, n=300)
+        mb = SGDClassifier(max_iter=3, tol=None, batch_size=10_000).fit(X, y)
+        assert mb.t_ == 3.0  # one step per epoch: the full-batch path
+
+    def test_batch_size_validated(self, rng):
+        X, y = _binary_data(rng, n=100)
+        with pytest.raises(ValueError, match="batch_size"):
+            SGDClassifier(batch_size=0.5).fit(X, y)
+        with pytest.raises(ValueError, match="batch_size"):
+            SGDClassifier(batch_size=-128).fit(X, y)
+
+    def test_tiny_batch_size_capped_at_n_real(self, rng):
+        # n=300 bucket-pads to 1024; batch_size=2 would ask for 512
+        # minibatches, but n_mb caps at n_real (then the divisor clamp)
+        # so no minibatch is padding-only
+        X, y = _binary_data(rng, n=300)
+        mb = SGDClassifier(max_iter=2, tol=None, batch_size=2).fit(X, y)
+        n_mb = mb.t_ / 2  # steps per epoch
+        assert n_mb <= 300
+        assert (mb.predict(X) == y).mean() > 0.85
