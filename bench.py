@@ -47,7 +47,9 @@ _RESULT = {
 # appended to this JSONL file the INSTANT it is measured, fsync'd; the
 # final emit — watchdog path included — merges entries from earlier runs
 # so a crashed/wedged run's numbers survive into the next run's JSON.
-_KNOWN_SECTIONS = {"lloyd", "admm", "scatter", "streamed", "packed", "csv"}
+_KNOWN_SECTIONS = {
+    "lloyd", "admm", "tsqr", "scatter", "streamed", "packed", "csv",
+}
 ONLY_SECTIONS = {
     s.strip()
     for s in os.environ.get("DASK_ML_TPU_BENCH_ONLY", "").split(",")
@@ -668,6 +670,58 @@ def main():
         extra["admm_error"] = traceback.format_exc(limit=3)
 
     section_s["admm"] = round(time.time() - _t_sec, 1)
+    _t_sec = time.time()
+
+    # --- TSQR (north-star #3: PCA/TruncatedSVD backbone).  One shard_map
+    # program: local QR on the MXU, all_gather of d x d R factors,
+    # replicated stage-2 QR, local Q correction.  Slope-timed over chained
+    # factorizations (each iteration's input is scaled by a function of
+    # the previous R so XLA cannot parallelize or hoist them). ---
+    try:
+        if _want("tsqr") and time.time() - _START_TS < _BUDGET_S * 0.80:
+            from dask_ml_tpu.core.mesh import get_mesh as _gm
+            from dask_ml_tpu.linalg.tsqr import _MeshHolder, _tsqr_impl
+
+            nQ, dQ = (4_000_000, 64) if on_tpu else (200_000, 32)
+            mhQ = _MeshHolder(_gm())
+            Xq = jax.random.normal(
+                jax.random.PRNGKey(1), (nQ, dQ), jnp.float32)
+
+            @jax.jit
+            def tsqr_chain(n_it):
+                def one(i, x):
+                    q, r = _tsqr_impl(x, mesh_holder=mhQ)
+                    # serialize on BOTH outputs (depending only on r would
+                    # let XLA dead-code-eliminate the Q-correction gemm),
+                    # via a single-element update — a whole-array x*scale
+                    # would add a read+write pass of the same order as the
+                    # TSQR's own traffic and bias the slope
+                    eps = (jnp.abs(r[0, 0]) + jnp.abs(q[0, 0])) * 1e-30
+                    return jax.lax.dynamic_update_slice(
+                        x, x[:1, :1] + eps, (0, 0))
+
+                x = jax.lax.fori_loop(0, n_it, one, Xq)
+                return x[0, 0]
+
+            per_qr = _two_point_slope(
+                lambda n_it: float(tsqr_chain(jnp.int32(n_it))), 1, 5)
+            # traffic: read X + write Q per factorization (R is d x d,
+            # negligible); flops: ~2nd^2 local QR + 2nd^2 Q correction
+            q_gbytes = 2 * nQ * dQ * 4 / 1e9
+            q_flops = 4.0 * nQ * dQ * dQ
+            _record({
+                "workload": f"tsqr_{nQ}x{dQ}",
+                "per_qr_ms": round(per_qr * 1e3, 3),
+                "rows_per_s": round(nQ / per_qr, 1),
+                "achieved_gb_s": round(q_gbytes / per_qr, 2),
+                "bw_frac": round(q_gbytes / per_qr / peak_gb_s, 4),
+                "achieved_tflops": round(q_flops / per_qr / 1e12, 3),
+                "mfu": round(q_flops / per_qr / 1e12 / peak_tflops, 4),
+            })
+    except Exception:
+        extra["tsqr_error"] = traceback.format_exc(limit=3)
+
+    section_s["tsqr"] = round(time.time() - _t_sec, 1)
     _t_sec = time.time()
 
     # --- scatter-shaped ops (VERDICT r2 next #7): the histogram

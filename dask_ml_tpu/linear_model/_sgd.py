@@ -286,7 +286,10 @@ def _minibatch_views(est, xb, yb, mask, n_real=None):
     if bs is None:
         return None
     bs = int(bs)
-    if bs >= n_pad:
+    # full-batch cutoff on the REAL row count: a batch_size >= n_samples
+    # means one step per epoch regardless of how far the bucket padding
+    # stretched n_pad
+    if bs >= (int(n_real) if n_real is not None else n_pad):
         return None
     local = n_pad // max(_row_shard_count(xb), 1)
     n_mb = max(n_pad // bs, 1)

@@ -501,3 +501,10 @@ class TestMinibatchEpochs:
         n_mb = mb.t_ / 2  # steps per epoch
         assert n_mb <= 300
         assert (mb.predict(X) == y).mean() > 0.85
+
+    def test_batch_size_over_n_real_is_fullbatch_despite_padding(self, rng):
+        # n=300 pads to a 1024 bucket: batch_size=400 exceeds n_samples so
+        # the documented full-batch path must win over the padded count
+        X, y = _binary_data(rng, n=300)
+        mb = SGDClassifier(max_iter=3, tol=None, batch_size=400).fit(X, y)
+        assert mb.t_ == 3.0
