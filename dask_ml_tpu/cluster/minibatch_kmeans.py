@@ -42,29 +42,51 @@ logger = logging.getLogger(__name__)
 def _mbk_step(centers, counts, xb, mask):
     """One Sculley update on one batch: returns (centers, counts, inertia).
 
-    Per-center learning rate 1/n_c (cumulative count), applied as
-    ``c += (batch_sum - batch_cnt * c) / n_c_new`` — the closed form of
-    sklearn's per-sample ``c += (x - c)/n_c`` stream over the batch.
+    Per-center learning rate 1/n_c (cumulative weight mass), applied as
+    ``c += (batch_sum - batch_mass * c) / n_c_new`` — the closed form of
+    sklearn's per-sample ``c += w (x - c)/n_c`` stream over the batch.
+
+    ``mask`` doubles as the per-row weight (``reweight_rows`` folds
+    ``sample_weight`` in), so ``counts`` accumulates WEIGHT MASS, not row
+    counts.  It is a ``(2, k)`` float32 Kahan pair (hi, lo): a plain f32
+    accumulator silently stops incrementing once a center's mass passes
+    2^24 (freezing the 1/n_c decay on long partial_fit streams — the same
+    saturation this file used int32 counts against when it was
+    unweighted), while compensated summation stays accurate to ~2^48 and
+    admits fractional weights.
     """
     d2 = _sq_dists(xb, centers)
     labels = jnp.argmin(d2, axis=1)
     min_d2 = jnp.min(d2, axis=1)
     inertia = jnp.sum(min_d2 * mask)
-    onehot = jax.nn.one_hot(labels, centers.shape[0], dtype=xb.dtype) * mask[:, None]
-    bsum = jnp.dot(onehot.T, xb, precision=lax.Precision.HIGHEST)
-    # batch counts summed in f32 explicitly: with bf16 data the one-hot
-    # sum would round back to bf16 (256-row resolution) BEFORE the int
-    # cast; the center update keeps the data dtype as designed
-    bcnt32 = jnp.sum(onehot, axis=0, dtype=jnp.float32)
-    bcnt = bcnt32.astype(xb.dtype)
-    # cumulative counts live in int32: exact to 2^31, where a float32 (or
-    # worse, bf16 when the data is bf16) count would silently stop
-    # incrementing at 2^24 rows/center and freeze the 1/n_c decay
-    new_counts = counts + bcnt32.astype(jnp.int32)
-    ncf = new_counts.astype(xb.dtype)
-    inv = jnp.where(new_counts > 0, 1.0 / jnp.maximum(ncf, 1.0), 0.0)
-    new_centers = centers + (bsum - bcnt[:, None] * centers) * inv[:, None]
-    return new_centers, new_counts, inertia
+    # weights applied to the one-hot in f32: with bf16 data an
+    # xb.dtype one-hot would round the weighted rows to bf16 (256-step
+    # resolution) before the mass sum
+    oh32 = (
+        jax.nn.one_hot(labels, centers.shape[0], dtype=jnp.float32)
+        * mask.astype(jnp.float32)[:, None]
+    )
+    bmass = jnp.sum(oh32, axis=0)  # f32 batch weight mass per center
+    bsum = jnp.dot(
+        oh32.astype(xb.dtype).T, xb, precision=lax.Precision.HIGHEST
+    )
+    # Kahan add: counts = (hi, lo) += bmass
+    hi, lo = counts[0], counts[1]
+    y = bmass + lo
+    t = hi + y
+    lo = y - (t - hi)
+    hi = t
+    mass = hi + lo
+    # clamp at the smallest NORMAL f32, not an arbitrary epsilon: any
+    # larger floor silently shrinks the weighted mean for tiny (but
+    # legitimate) weight scales, while 1/subnormal would overflow to inf
+    inv32 = jnp.where(
+        mass > 0, 1.0 / jnp.maximum(mass, jnp.finfo(jnp.float32).tiny), 0.0
+    )
+    inv = inv32.astype(xb.dtype)
+    bmass_d = bmass.astype(xb.dtype)
+    new_centers = centers + (bsum - bmass_d[:, None] * centers) * inv[:, None]
+    return new_centers, jnp.stack([hi, lo]), inertia
 
 
 from functools import partial as _fpartial  # noqa: E402
@@ -161,6 +183,15 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
         raise ValueError(f"Unknown init: {self.init!r}")
 
     def _ensure_state(self, X: ShardedRows):
+        if hasattr(self, "_counts") and self._counts.ndim == 1:
+            # legacy checkpoint layout ((k,) int32 row counts, from before
+            # weight-mass accumulation): migrate to the Kahan pair — the
+            # step would otherwise silently misread counts[0]/counts[1]
+            # as the global (hi, lo) scalars
+            self._counts = jnp.stack([
+                self._counts.astype(jnp.float32),
+                jnp.zeros_like(self._counts, jnp.float32),
+            ])
         if not hasattr(self, "cluster_centers_"):
             if X.n_samples < self.n_clusters:
                 raise ValueError(
@@ -168,7 +199,8 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
                 )
             key = as_key(self.random_state)
             self.cluster_centers_ = self._init_from_block(X, key)
-            self._counts = jnp.zeros((self.n_clusters,), jnp.int32)
+            # (hi, lo) Kahan pair of cumulative weight mass per center
+            self._counts = jnp.zeros((2, self.n_clusters), jnp.float32)
             self.n_features_in_ = X.data.shape[1]
             self.n_steps_ = 0
 
@@ -179,14 +211,8 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
         Host blocks are padded to the SGD family's bucket sizes
         (``linear_model._sgd._BUCKETS``) before ingest, so a stream of
         ragged chunk sizes compiles a handful of programs, not one per
-        distinct length."""
-        if sample_weight is not None:
-            raise NotImplementedError(
-                "sample_weight is not supported by the device "
-                "MiniBatchKMeans: the 1/n_c decay keeps exact int32 "
-                "counts, which fractional weights would break — use "
-                "KMeans(sample_weight=...) or duplicate rows"
-            )
+        distinct length.  ``sample_weight`` folds into the mask (sklearn
+        semantics: weighted center means, weighted 1/n_c decay)."""
         if not isinstance(X, ShardedRows):
             from ..linear_model._sgd import _bucket_pad
 
@@ -197,6 +223,10 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
                 data=jnp.asarray(Xh), mask=jnp.asarray(mask), n_samples=n
             )
         X = _ingest_float(self, X)
+        if sample_weight is not None:
+            from ..utils import reweight_rows
+
+            X = reweight_rows(X, sample_weight=sample_weight)
         self._ensure_state(X)
         self.cluster_centers_, self._counts, inertia = _mbk_step(
             self.cluster_centers_, self._counts, X.data, X.mask
@@ -207,14 +237,15 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
 
     # -- whole-array fit ---------------------------------------------------
     def fit(self, X, y=None, sample_weight=None):
-        if sample_weight is not None:
-            raise NotImplementedError(
-                "sample_weight is not supported by the device "
-                "MiniBatchKMeans (exact int32 count decay); use "
-                "KMeans(sample_weight=...) or duplicate rows"
-            )
         check_max_iter(self.max_iter)
         X = _ingest_float(self, X)
+        if sample_weight is not None:
+            # fold weights into the mask: epoch windows then carry the
+            # per-row weight, so batch sums, the 1/n_c decay, the epoch
+            # inertia AND the init sampling are all their weighted forms
+            from ..utils import reweight_rows
+
+            X = reweight_rows(X, sample_weight=sample_weight)
         for attr in ("cluster_centers_", "_counts"):
             if hasattr(self, attr):
                 delattr(self, attr)
