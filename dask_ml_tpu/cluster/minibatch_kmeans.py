@@ -117,14 +117,50 @@ def _mbk_epoch(centers, counts, x, mask, start, *, batch_size, n_batches):
     return centers, counts, jnp.mean(inertias)
 
 
+@jax.jit
+def _reassign_starved(centers, counts, x, mask, key, ratio):
+    """Re-seed centers whose cumulative mass fell below
+    ``ratio * max(mass)`` with weight-biased random rows, resetting their
+    mass so the next batch fully replaces them (sklearn's
+    ``reassignment_ratio`` semantics, applied at epoch granularity).
+
+    The weighted sample-without-replacement is O(n log n); it runs under a
+    ``lax.cond`` so the steady state (no starving centers — the common
+    case once clustering stabilizes) pays only the cheap mass check.
+    """
+    hi, lo = counts[0], counts[1]
+    mass = hi + lo
+    starving = mass < ratio * jnp.max(mass)
+
+    def reseed(_):
+        p = mask / jnp.maximum(jnp.sum(mask), 1e-12)
+        idx = jax.random.choice(
+            key, x.shape[0], (centers.shape[0],), replace=False, p=p
+        )
+        seeds = jnp.take(x, idx, axis=0)
+        new_centers = jnp.where(starving[:, None], seeds, centers)
+        zero = jnp.zeros_like(hi)
+        new_counts = jnp.stack([
+            jnp.where(starving, zero, hi), jnp.where(starving, zero, lo)
+        ])
+        return new_centers, new_counts
+
+    return jax.lax.cond(
+        jnp.any(starving), reseed, lambda _: (centers, counts), None
+    )
+
+
 class MiniBatchKMeans(TransformerMixin, TPUEstimator):
     """Sklearn-contract minibatch k-means, state resident on device.
 
-    Parameters mirror sklearn's (``reassignment_ratio`` is accepted-inert;
-    center reassignment of empty clusters is a fit-quality nicety the
-    streaming contract does not require).  ``partial_fit`` consumes one
-    block per call — the unit of budget for ``Incremental`` and the
-    adaptive searches.
+    Parameters mirror sklearn's.  ``reassignment_ratio`` re-seeds starving
+    centers (mass below ``ratio * max(mass)``) from weight-biased random
+    rows at EPOCH granularity in ``fit`` — sklearn checks per minibatch;
+    epoch granularity keeps the scanned epoch a single fused program and
+    is enough to rescue empty clusters.  ``partial_fit`` streams never
+    reassign (each call sees one block; the caller owns the schedule).
+    ``partial_fit`` consumes one block per call — the unit of budget for
+    ``Incremental`` and the adaptive searches.
     """
 
     _checkpoint_private_attrs = ("_counts",)
@@ -259,6 +295,16 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
         bad = 0
         centers, counts = self.cluster_centers_, self._counts
         for epoch in range(self.max_iter):
+            if epoch > 0 and self.reassignment_ratio:
+                # BEFORE the epoch (sklearn reassigns before the batch
+                # update): a reseeded center is always refined by the
+                # epoch that follows, so raw random seeds can never flow
+                # into the returned cluster_centers_/labels_
+                key, sub = jax.random.split(key)
+                centers, counts = _reassign_starved(
+                    centers, counts, X.data, X.mask, sub,
+                    jnp.float32(self.reassignment_ratio),
+                )
             key, sub = jax.random.split(key)
             start = jax.random.randint(sub, (), 0, max(n - bs + 1, 1))
             centers, counts, mean_inertia = _mbk_epoch(

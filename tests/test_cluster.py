@@ -408,18 +408,26 @@ class TestAdvisorRound2Fixes:
 
         from dask_ml_tpu.cluster.minibatch_kmeans import _mbk_step
 
-        # one center, mass already past 2^24: plain f32 would absorb
-        # every +256 batch into rounding; the compensated pair keeps it
+        # one center, mass already past 2^24, +1-mass batches: a plain
+        # f32 accumulator rounds 2^24+1 back to 2^24 every step (ulp=2,
+        # ties-to-even) and freezes; the compensated lo term keeps the
+        # increments.  (+256 batches would be exactly representable and
+        # could not distinguish the two.)
         centers = jnp.zeros((1, 2), jnp.float32)
         counts = jnp.stack([
             jnp.full((1,), 2.0 ** 24, jnp.float32), jnp.zeros((1,))
         ])
-        xb = jnp.ones((256, 2), jnp.float32)
-        mask = jnp.ones((256,), jnp.float32)
+        xb = jnp.ones((1, 2), jnp.float32)
+        mask = jnp.ones((1,), jnp.float32)
         for _ in range(8):
             centers, counts, _ = _mbk_step(centers, counts, xb, mask)
         total = float(counts[0, 0]) + float(counts[1, 0])
-        assert total == 2.0 ** 24 + 8 * 256
+        assert total == 2.0 ** 24 + 8
+        # the plain-f32 control: same stream, no compensation
+        plain = jnp.full((), 2.0 ** 24, jnp.float32)
+        for _ in range(8):
+            plain = plain + jnp.float32(1.0)
+        assert float(plain) == 2.0 ** 24  # frozen — what the pair prevents
 
     def test_sgd_max_iter_zero_raises(self, rng, mesh):
         from dask_ml_tpu.linear_model import SGDClassifier
@@ -513,3 +521,26 @@ class TestKMeansSampleWeight:
         m.partial_fit(X)
         assert m._counts.shape == (2, 2)
         assert float(m._counts.sum()) == pytest.approx(64.0 + 64.0)
+
+    def test_minibatch_reassignment_rescues_empty_cluster(self, rng, mesh):
+        # both centers init at the SAME far-away point: without
+        # reassignment one cluster captures everything and the other
+        # starves forever; reassignment_ratio re-seeds it from the data
+        from sklearn.datasets import make_blobs
+
+        X, y = make_blobs(n_samples=400, centers=2, n_features=3,
+                          cluster_std=0.4, random_state=3)
+        X = X.astype(np.float32)
+        bad_init = np.full((2, 3), 50.0, np.float32)
+        stuck = dc.MiniBatchKMeans(
+            n_clusters=2, init=bad_init, max_iter=30, random_state=0,
+            reassignment_ratio=0.0,
+        ).fit(X)
+        rescued = dc.MiniBatchKMeans(
+            n_clusters=2, init=bad_init, max_iter=30, random_state=0,
+            reassignment_ratio=0.05,
+        ).fit(X)
+        from sklearn.metrics import adjusted_rand_score as ari
+
+        assert ari(y, np.asarray(rescued.labels_)) > 0.95
+        assert rescued.inertia_ < stuck.inertia_
