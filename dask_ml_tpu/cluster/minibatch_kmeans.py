@@ -342,7 +342,11 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
         d = jnp.sqrt(jnp.maximum(_sq_dists(X.data, self.cluster_centers_), 0.0))
         return d[: X.n_samples]
 
-    def score(self, X, y=None):
+    def score(self, X, y=None, sample_weight=None):
         X = _ingest_float(self, X)
+        if sample_weight is not None:
+            from ..utils import reweight_rows
+
+            X = reweight_rows(X, sample_weight=sample_weight)
         _, inertia = _assign(X.data, X.mask, self.cluster_centers_)
         return -float(inertia)

@@ -697,6 +697,9 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
             return jnp.where(z > 0, p / z, 1.0 / p.shape[1])
         return p / jnp.sum(p, axis=1, keepdims=True)
 
+    def predict_log_proba(self, X):
+        return jnp.log(self.predict_proba(X))
+
     @property
     def coef_(self):
         return np.asarray(self._state["coef"]).T  # sklearn: (K, d) / (1, d)
@@ -705,7 +708,7 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
     def intercept_(self):
         return np.asarray(self._state["intercept"])
 
-    def score(self, X, y):
+    def score(self, X, y, sample_weight=None):
         """Mean accuracy.  All-device inputs score as ONE replicated
         scalar fetch — the only legal form when the arrays span processes
         (a multi-host global array cannot be pulled to host row-wise, and
@@ -714,6 +717,18 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
 
         from ..utils import classes_f32_exact, masked_device_accuracy
 
+        if sample_weight is not None:
+            if isinstance(y, _SR):
+                # device labels stay on device — no O(n) pull
+                from ..metrics import accuracy_score
+
+                return float(accuracy_score(
+                    y, self.predict(X), sample_weight=sample_weight
+                ))
+            # host labels may be strings/objects: compare on host
+            yv = np.asarray(y)
+            hits = np.asarray(self.predict(X)) == yv
+            return float(np.average(hits, weights=np.asarray(sample_weight)))
         if (isinstance(X, _SR) and isinstance(y, _SR)
                 and classes_f32_exact(self.classes_)):
             md = (X.data.astype(jnp.float32) @ self._state["coef"]
@@ -822,7 +837,7 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
     def intercept_(self):
         return np.asarray(self._state["intercept"])
 
-    def score(self, X, y):
+    def score(self, X, y, sample_weight=None):
         from ..metrics import r2_score
 
-        return r2_score(y, self.predict(X))
+        return r2_score(y, self.predict(X), sample_weight=sample_weight)

@@ -7,6 +7,7 @@ column, and exposes ``coef_``/``intercept_``.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -346,12 +347,27 @@ class LogisticRegression(ClassifierMixin, _GLM):
         p = Logistic.predict(eta)  # per-class sigmoid, OvR-normalized
         return p / jnp.sum(p, axis=1, keepdims=True)
 
+    def predict_log_proba(self, X):
+        """Log class probabilities, in numerically stable forms: binary
+        uses ``log_sigmoid(±eta)``, multinomial ``log_softmax``; the OvR
+        path logs its normalized sigmoids."""
+        X, eta = self._etas(X)
+        eta = eta[: X.n_samples]
+        if len(self.classes_) == 2:
+            return jnp.stack([
+                jax.nn.log_sigmoid(-eta[:, 0]), jax.nn.log_sigmoid(eta[:, 0])
+            ], axis=1)
+        if getattr(self, "_multinomial", False):
+            return jax.nn.log_softmax(eta, axis=1)
+        p = Logistic.predict(eta)
+        return jnp.log(p / jnp.sum(p, axis=1, keepdims=True))
+
     def decision_function(self, X):
         X, eta = self._etas(X)
         eta = eta[: X.n_samples]
         return eta[:, 0] if len(self.classes_) == 2 else eta
 
-    def score(self, X, y):
+    def score(self, X, y, sample_weight=None):
         """Mean accuracy (reference forwards to dask accuracy_score);
         accepts plain or ShardedRows y.  All-device inputs score as ONE
         replicated scalar fetch — no O(n) label transfer (the form the
@@ -362,6 +378,19 @@ class LogisticRegression(ClassifierMixin, _GLM):
 
         from ..utils import classes_f32_exact, masked_device_accuracy
 
+        if sample_weight is not None:
+            if isinstance(y, _SR):
+                # device labels stay on device: accuracy_score consumes
+                # ShardedRows natively — no O(n) pull (multi-host safe)
+                from ..metrics import accuracy_score
+
+                return float(accuracy_score(
+                    y, self.predict(X), sample_weight=sample_weight
+                ))
+            # host labels may be strings/objects: compare on host
+            yv = np.asarray(y)
+            hits = np.asarray(self.predict(X)) == yv
+            return float(np.average(hits, weights=np.asarray(sample_weight)))
         if (isinstance(X, _SR) and isinstance(y, _SR)
                 and classes_f32_exact(self.classes_)):
             Xi, eta = self._etas(X)
@@ -383,10 +412,10 @@ class LinearRegression(RegressorMixin, _GLM):
         X, eta = self._eta(X)
         return eta[: X.n_samples]
 
-    def score(self, X, y):
+    def score(self, X, y, sample_weight=None):
         from ..metrics import r2_score
 
-        return r2_score(y, self.predict(X))
+        return r2_score(y, self.predict(X), sample_weight=sample_weight)
 
 
 class PoissonRegression(RegressorMixin, _GLM):
@@ -396,14 +425,17 @@ class PoissonRegression(RegressorMixin, _GLM):
         X, eta = self._eta(X)
         return jnp.exp(eta)[: X.n_samples]
 
-    def get_deviance(self, X, y):
+    def get_deviance(self, X, y, sample_weight=None):
         from ..core.sharded import unshard
 
         mu = np.asarray(self.predict(X))
         yv = unshard(y) if isinstance(y, ShardedRows) else np.asarray(y)
         with np.errstate(divide="ignore", invalid="ignore"):
             term = np.where(yv > 0, yv * np.log(yv / mu), 0.0)
-        return 2 * np.sum(term - (yv - mu))
+        dev = term - (yv - mu)
+        if sample_weight is not None:
+            dev = dev * np.asarray(sample_weight)
+        return 2 * np.sum(dev)
 
-    def score(self, X, y):
-        return -self.get_deviance(X, y)
+    def score(self, X, y, sample_weight=None):
+        return -self.get_deviance(X, y, sample_weight=sample_weight)

@@ -84,8 +84,8 @@ class GaussianNB(ClassifierMixin, TPUEstimator):
     def predict_log_proba(self, X):
         return jnp.log(self.predict_proba(X))
 
-    def score(self, X, y):
+    def score(self, X, y, sample_weight=None):
         from .metrics import accuracy_score
 
         pred = jnp.asarray(self.predict(X)).astype(jnp.float32)
-        return accuracy_score(y, pred)
+        return accuracy_score(y, pred, sample_weight=sample_weight)

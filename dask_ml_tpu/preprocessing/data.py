@@ -172,19 +172,52 @@ class StandardScaler(TransformerMixin, TPUEstimator):
         self.with_mean = with_mean
         self.with_std = with_std
 
+    # stream moments are device state a mid-stream checkpoint must carry
+    # (same opt-in MiniBatchKMeans/SGD use); the exact row count is the
+    # trailing-underscore n_samples_seen_, persisted automatically
+    _checkpoint_private_attrs = ("_pf_mean", "_pf_m2")
+
     def fit(self, X, y=None):
+        for a in ("_pf_mean", "_pf_m2", "n_samples_seen_"):
+            if hasattr(self, a):
+                delattr(self, a)
+        return self.partial_fit(X, y)
+
+    def partial_fit(self, X, y=None):
+        """Incremental fit over a stream of row blocks (sklearn contract,
+        absent from the reference's lazy-reduction scaler): Chan et al.
+        parallel merge of per-feature (mean, M2) device moments, so
+        ``fit`` on one array and a ``partial_fit`` stream over its blocks
+        produce identical statistics.  The merge weights come from the
+        EXACT Python-int ``n_samples_seen_`` — an f32 running count would
+        freeze at 2^24 rows and silently mis-weight every later block.
+        """
         X = _ingest_float(self, X)
         data, mask = X.data, X.mask
-        self.mean_ = masked_mean(data, mask) if self.with_mean else None
+        nb = int(X.n_samples)
+        mb = masked_mean(data, mask)
+        vb = masked_var(data, mask)
+        if not hasattr(self, "_pf_mean"):
+            self._pf_mean, self._pf_m2 = mb, vb * nb
+            self.n_samples_seen_ = nb
+        else:
+            na = self.n_samples_seen_
+            n = na + nb
+            delta = mb - self._pf_mean
+            self._pf_mean = self._pf_mean + delta * (nb / n)
+            self._pf_m2 = (
+                self._pf_m2 + vb * nb + delta * delta * (na * nb / n)
+            )
+            self.n_samples_seen_ = n
+        self.mean_ = self._pf_mean if self.with_mean else None
         if self.with_std:
-            var = masked_var(data, mask)
+            var = self._pf_m2 / max(self.n_samples_seen_, 1)
             self.var_ = var
             self.scale_ = handle_zeros_in_scale(jnp.sqrt(var))
         else:
             self.var_ = None
             self.scale_ = None
         self.n_features_in_ = data.shape[1]
-        self.n_samples_seen_ = X.n_samples
         return self
 
     def transform(self, X, y=None, copy=None):
@@ -212,11 +245,24 @@ class MinMaxScaler(TransformerMixin, TPUEstimator):
         self.copy = copy
 
     def fit(self, X, y=None):
+        for a in ("data_min_", "data_max_", "n_samples_seen_"):
+            if hasattr(self, a):
+                delattr(self, a)
+        return self.partial_fit(X, y)
+
+    def partial_fit(self, X, y=None):
+        """Incremental fit: running per-feature min/max over row blocks."""
         X = _ingest_float(self, X)
         data, mask = X.data, X.mask
         big = jnp.asarray(jnp.finfo(data.dtype).max, dtype=data.dtype)
         data_min = jnp.min(jnp.where(mask[:, None] > 0, data, big), axis=0)
         data_max = jnp.max(jnp.where(mask[:, None] > 0, data, -big), axis=0)
+        if hasattr(self, "data_min_"):
+            data_min = jnp.minimum(self.data_min_, data_min)
+            data_max = jnp.maximum(self.data_max_, data_max)
+            self.n_samples_seen_ += int(X.n_samples)
+        else:
+            self.n_samples_seen_ = int(X.n_samples)
         lo, hi = self.feature_range
         self.data_min_ = data_min
         self.data_max_ = data_max
@@ -224,7 +270,6 @@ class MinMaxScaler(TransformerMixin, TPUEstimator):
         self.scale_ = (hi - lo) / handle_zeros_in_scale(self.data_range_)
         self.min_ = lo - data_min * self.scale_
         self.n_features_in_ = data.shape[1]
-        self.n_samples_seen_ = X.n_samples
         return self
 
     def transform(self, X, y=None, copy=None):
@@ -443,15 +488,26 @@ class MaxAbsScaler(TransformerMixin, TPUEstimator):
         self.copy = copy
 
     def fit(self, X, y=None):
+        for a in ("max_abs_", "n_samples_seen_"):
+            if hasattr(self, a):
+                delattr(self, a)
+        return self.partial_fit(X, y)
+
+    def partial_fit(self, X, y=None):
+        """Incremental fit: running per-feature max |x| over row blocks."""
         X = _ingest_float(self, X)
         data, mask = X.data, X.mask
         mabs = jnp.max(
             jnp.where(mask[:, None] > 0, jnp.abs(data), 0.0), axis=0
         )
+        if hasattr(self, "max_abs_"):
+            mabs = jnp.maximum(self.max_abs_, mabs)
+            self.n_samples_seen_ += int(X.n_samples)
+        else:
+            self.n_samples_seen_ = int(X.n_samples)
         self.max_abs_ = mabs
         self.scale_ = handle_zeros_in_scale(mabs)
         self.n_features_in_ = data.shape[1]
-        self.n_samples_seen_ = X.n_samples
         return self
 
     def transform(self, X, y=None, copy=None):
