@@ -173,3 +173,61 @@ class TestPackedSolveProperties:
             np.testing.assert_allclose(
                 np.asarray(betas[k]), np.asarray(solo), rtol=5e-3, atol=1e-3
             )
+
+
+@st.composite
+def _block_splits(draw):
+    n = draw(st.integers(min_value=20, max_value=200))
+    k = draw(st.integers(min_value=1, max_value=5))
+    cuts = sorted(draw(st.lists(
+        st.integers(min_value=1, max_value=n - 1), min_size=k, max_size=k,
+    )))
+    seed = draw(st.integers(min_value=0, max_value=2**16))
+    return n, [0, *dict.fromkeys(cuts), n], seed
+
+
+@settings(max_examples=25, deadline=None)
+@given(_block_splits())
+def test_standard_scaler_partial_fit_split_invariant(case):
+    """Chan moment merging: ANY block split of a stream produces the same
+    mean_/var_ as one whole-array fit (the invariant that makes mid-
+    stream checkpoints and ragged chunk streams safe)."""
+    from dask_ml_tpu.preprocessing import StandardScaler
+
+    n, cuts, seed = case
+    X = np.random.RandomState(seed).normal(size=(n, 3)).astype(np.float32)
+    full = StandardScaler().fit(X)
+    stream = StandardScaler()
+    for lo, hi in zip(cuts[:-1], cuts[1:]):
+        if hi > lo:
+            stream.partial_fit(X[lo:hi])
+    np.testing.assert_allclose(
+        np.asarray(stream.mean_), np.asarray(full.mean_),
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(stream.var_), np.asarray(full.var_),
+        rtol=1e-3, atol=1e-5,
+    )
+
+
+@settings(max_examples=15, deadline=None)
+@given(st.integers(min_value=2, max_value=64),
+       st.integers(min_value=0, max_value=2**16))
+def test_sgd_minibatch_one_chunk_equals_fullbatch(bs_exp_seed, seed):
+    """batch_size >= n collapses to the full-batch epoch exactly (same
+    t_ and same coefficients)."""
+    from dask_ml_tpu.linear_model import SGDClassifier
+
+    rng = np.random.RandomState(seed)
+    n = bs_exp_seed * 3
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    if len(np.unique(y)) < 2:
+        y[0] = 1 - y[0]
+    a = SGDClassifier(max_iter=3, tol=None).fit(X, y)
+    b = SGDClassifier(max_iter=3, tol=None, batch_size=n).fit(X, y)
+    assert a.t_ == b.t_
+    np.testing.assert_allclose(
+        np.asarray(a.coef_), np.asarray(b.coef_), rtol=1e-6, atol=1e-7
+    )
