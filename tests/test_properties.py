@@ -1,5 +1,7 @@
 """Property-based pins for the algebraically delicate paths: the
-two-level roc_auc prefix sum and the weight-folding helper (round 3).
+two-level roc_auc prefix sum, the weight-folding helper, the quantile
+sketch, StandardScaler's Chan moment merge, and the SGD full-batch
+collapse (round 3).
 
 Bounded example counts keep the suite fast; the properties (exact sklearn
 equality under ties/weights, duplication-equivalence of integer weights)
@@ -199,8 +201,7 @@ def test_standard_scaler_partial_fit_split_invariant(case):
     full = StandardScaler().fit(X)
     stream = StandardScaler()
     for lo, hi in zip(cuts[:-1], cuts[1:]):
-        if hi > lo:
-            stream.partial_fit(X[lo:hi])
+        stream.partial_fit(X[lo:hi])  # boundaries are strictly increasing
     np.testing.assert_allclose(
         np.asarray(stream.mean_), np.asarray(full.mean_),
         rtol=1e-4, atol=1e-5,
@@ -214,13 +215,13 @@ def test_standard_scaler_partial_fit_split_invariant(case):
 @settings(max_examples=15, deadline=None)
 @given(st.integers(min_value=2, max_value=64),
        st.integers(min_value=0, max_value=2**16))
-def test_sgd_minibatch_one_chunk_equals_fullbatch(bs_exp_seed, seed):
+def test_sgd_minibatch_one_chunk_equals_fullbatch(n_third, seed):
     """batch_size >= n collapses to the full-batch epoch exactly (same
     t_ and same coefficients)."""
     from dask_ml_tpu.linear_model import SGDClassifier
 
     rng = np.random.RandomState(seed)
-    n = bs_exp_seed * 3
+    n = n_third * 3
     X = rng.normal(size=(n, 4)).astype(np.float32)
     y = (X[:, 0] > 0).astype(np.int64)
     if len(np.unique(y)) < 2:
