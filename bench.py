@@ -881,6 +881,28 @@ def main():
                 "sequential_s": round(t_seq, 3),
                 "packed_speedup": round(t_seq / max(t_packed, 1e-9), 3),
             })
+
+            # line-search strategy go/no-go (lbfgs_core docstring): the
+            # batched probe_grid is bandwidth-optimal ON PAPER for big-n
+            # solves but measured slower on compute-bound CPU; this chip
+            # ratio decides whether the sequential default flips
+            def run_ls(ls):
+                b = _lbfgs(sXp, Yp[0], family=Logistic,
+                           lamduh=1.0, max_iter=it_p, tol=0.0,
+                           line_search=ls)
+                float(b[0])
+
+            run_ls("backtrack"); run_ls("probe_grid")  # compile
+            t_bt = min(_time_once(lambda: run_ls("backtrack"))
+                       for _ in range(3))
+            t_pg = min(_time_once(lambda: run_ls("probe_grid"))
+                       for _ in range(3))
+            _record({
+                "workload": f"lbfgs_line_search_{nP}x{dP}",
+                "backtrack_s": round(t_bt, 3),
+                "probe_grid_s": round(t_pg, 3),
+                "probe_grid_speedup": round(t_bt / max(t_pg, 1e-9), 3),
+            })
     except Exception:
         extra["packed_error"] = traceback.format_exc(limit=3)
 

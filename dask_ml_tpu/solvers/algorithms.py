@@ -38,7 +38,7 @@ from ..core.compat import shard_map_unchecked
 from ..core.mesh import DATA_AXIS, MeshHolder, get_mesh
 from ..core.sharded import ShardedRows, shard_rows
 from .families import Family, Logistic
-from .lbfgs_core import _backtrack, lbfgs_minimize
+from .lbfgs_core import lbfgs_minimize, run_line_search
 from .regularizers import L2, Regularizer, get_regularizer
 
 logger = logging.getLogger(__name__)
@@ -106,16 +106,19 @@ def _converged(f_prev, f_new, tol):
 # ---------------------------------------------------------------- lbfgs --
 
 
-@partial(jax.jit, static_argnames=("family", "reg"))
-def _lbfgs_run(x, yv, mask, beta0, lamduh, max_iter, tol, *, family, reg):
+@partial(jax.jit, static_argnames=("family", "reg", "line_search"))
+def _lbfgs_run(x, yv, mask, beta0, lamduh, max_iter, tol, *, family, reg,
+               line_search="backtrack"):
     obj = _make_objective(family, reg, x, yv, mask, lamduh)
-    beta, st = lbfgs_minimize(obj, beta0, max_iter=max_iter, tol=tol)
+    beta, st = lbfgs_minimize(
+        obj, beta0, max_iter=max_iter, tol=tol, line_search=line_search
+    )
     return beta, st.k
 
 
 def lbfgs(X, y, *, family: type[Family] = Logistic, regularizer=L2,
           lamduh: float = 0.0, max_iter: int = 100, tol: float = 1e-5,
-          return_n_iter: bool = False):
+          return_n_iter: bool = False, line_search: str = "backtrack"):
     """Full-gradient L-BFGS on the total (smooth) objective.
 
     Reference: ``dask_glm/algorithms.py :: lbfgs`` (scipy driver with
@@ -133,7 +136,7 @@ def lbfgs(X, y, *, family: type[Family] = Logistic, regularizer=L2,
     beta, n_it = _lbfgs_run(
         x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
         jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
-        family=family, reg=reg,
+        family=family, reg=reg, line_search=line_search,
     )
     # n_it stays a device scalar: converting here would block the
     # async dispatch pipeline (callers convert after ALL solves)
@@ -143,8 +146,9 @@ def lbfgs(X, y, *, family: type[Family] = Logistic, regularizer=L2,
 # ---------------------------------------------------- gradient descent --
 
 
-@partial(jax.jit, static_argnames=("family", "reg"))
-def _gd_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg):
+@partial(jax.jit, static_argnames=("family", "reg", "line_search"))
+def _gd_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg,
+            line_search="backtrack"):
     obj = _make_objective(family, reg, x, yv, mask, lamduh)
     vg = jax.value_and_grad(obj)
 
@@ -155,7 +159,10 @@ def _gd_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg):
     def body(state):
         i, beta, stepsize, f_prev, _ = state
         f, g = vg(beta)
-        t, f_new, failed = _backtrack(obj, beta, f, g, -stepsize * g, 1e-4, 30)
+        # c2=None: pure Armijo — the reference gradient_descent's
+        # backtracking semantics, no curvature/expansion phase
+        t, f_new, _gn, failed = run_line_search(
+            line_search, vg, beta, f, g, -stepsize * g, 1e-4, 30, c2=None)
         beta_new = beta - t * stepsize * g
         stepsize_new = jnp.where(t > 0, stepsize * t * 2.0, stepsize * 0.5)
         return i + 1, beta_new, stepsize_new, f_new, _converged(f_prev, f_new, tol)
@@ -174,7 +181,8 @@ def _gd_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg):
 def gradient_descent(X, y, *, family: type[Family] = Logistic,
                      regularizer=L2, lamduh: float = 0.0,
                      max_iter: int = 100, tol: float = 1e-7,
-          return_n_iter: bool = False):
+                     return_n_iter: bool = False,
+                     line_search: str = "backtrack"):
     """Armijo-backtracking gradient descent (reference ``gradient_descent``)."""
     reg = get_regularizer(regularizer)
     if lamduh and not reg.smooth:
@@ -185,7 +193,7 @@ def gradient_descent(X, y, *, family: type[Family] = Logistic,
     beta, n_it = _gd_run(
         x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
         jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
-        family=family, reg=reg,
+        family=family, reg=reg, line_search=line_search,
     )
     # n_it stays a device scalar: converting here would block the
     # async dispatch pipeline (callers convert after ALL solves)
@@ -260,8 +268,9 @@ def proximal_grad(X, y, *, family: type[Family] = Logistic, regularizer=L2,
 # ------------------------------------------------------------- newton --
 
 
-@partial(jax.jit, static_argnames=("family", "reg"))
-def _newton_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg):
+@partial(jax.jit, static_argnames=("family", "reg", "line_search"))
+def _newton_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg,
+                line_search="backtrack"):
     obj = _make_objective(family, reg, x, yv, mask, lamduh)
     vg = jax.value_and_grad(obj)
     d = x.shape[1]
@@ -275,7 +284,9 @@ def _newton_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg):
             H = H + lamduh * jnp.eye(d, dtype=_param_dtype(x))
         H = H + 1e-8 * jnp.eye(d, dtype=_param_dtype(x))
         p = -jnp.linalg.solve(H, g)
-        t, f_new, failed = _backtrack(obj, beta, f, g, p, 1e-4, 30)
+        # c2=None: pure Armijo (damped-Newton semantics)
+        t, f_new, _gn, failed = run_line_search(
+            line_search, vg, beta, f, g, p, 1e-4, 30, c2=None)
         return beta + t * p, f, f_new
 
     def cond(state):
@@ -299,7 +310,7 @@ def _newton_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg):
 
 def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
            lamduh: float = 0.0, max_iter: int = 50, tol: float = 1e-8,
-          return_n_iter: bool = False):
+           return_n_iter: bool = False, line_search: str = "backtrack"):
     """Damped Newton: distributed Hessian XᵀWX (one psum-reduced gemm),
     replicated (d×d) solve (reference ``newton``)."""
     reg = get_regularizer(regularizer)
@@ -317,7 +328,7 @@ def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
     beta, n_it = _newton_run(
         x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
         jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
-        family=family, reg=reg,
+        family=family, reg=reg, line_search=line_search,
     )
     # n_it stays a device scalar: converting here would block the
     # async dispatch pipeline (callers convert after ALL solves)
@@ -327,9 +338,11 @@ def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
 # --------------------------------------------------------------- admm --
 
 
-@partial(jax.jit, static_argnames=("family", "reg", "mesh_holder", "inner_iter"))
+@partial(jax.jit, static_argnames=(
+    "family", "reg", "mesh_holder", "inner_iter", "line_search"))
 def _admm_run(x, yv, mask, lamduh, rho, abstol, reltol, inner_tol, max_it,
-              *, family, reg, mesh_holder, inner_iter):
+              *, family, reg, mesh_holder, inner_iter,
+              line_search="backtrack"):
     mesh = mesh_holder.mesh
     n_shards = mesh.shape[DATA_AXIS]
     d = _pdim(x, family)
@@ -343,7 +356,8 @@ def _admm_run(x, yv, mask, lamduh, rho, abstol, reltol, inner_tol, max_it,
             )
 
         b_new, _ = lbfgs_minimize(
-            local_obj, b0, max_iter=inner_iter, tol=inner_tol
+            local_obj, b0, max_iter=inner_iter, tol=inner_tol,
+            line_search=line_search,
         )
         b_bar = lax.psum(b_new, DATA_AXIS) / n_shards
         u_bar = lax.psum(u0, DATA_AXIS) / n_shards
@@ -412,7 +426,7 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
          lamduh: float = 0.0, rho: float = 1.0, max_iter: int = 100,
          abstol: float = 1e-4, reltol: float = 1e-2,
          inner_iter: int = 50, inner_tol: float = 1e-6, mesh=None,
-          return_n_iter: bool = False):
+         return_n_iter: bool = False, line_search: str = "backtrack"):
     """Consensus ADMM (Boyd et al. §8): per-shard local subproblems solved by
     the jit-safe L-BFGS inside ``shard_map``, consensus z through the
     regularizer's prox, scaled dual updates.
@@ -434,7 +448,7 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
         jnp.asarray(abstol, dt), jnp.asarray(reltol, dt),
         jnp.asarray(inner_tol, dt), jnp.int32(max_iter),
         family=family, reg=reg, mesh_holder=MeshHolder(mesh),
-        inner_iter=inner_iter,
+        inner_iter=inner_iter, line_search=line_search,
     )
     # n_it stays a device scalar: converting here would block the
     # async dispatch pipeline (callers convert after ALL solves)
@@ -448,7 +462,8 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
                  regularizer=L2, lamduh: float = 0.0, max_iter: int = 100,
                  tol: float = 1e-5, rho: float = 1.0, abstol: float = 1e-4,
                  reltol: float = 1e-2, inner_iter: int = 50,
-                 inner_tol: float = 1e-6, mesh=None):
+                 inner_tol: float = 1e-6, mesh=None,
+                 line_search: str = "backtrack"):
     """All K independent solves as ONE vmapped XLA program over the
     leading axis of ``Y`` — the one-vs-rest fit issues a single dispatch
     instead of K sequential ones (the solvers' whole-solve ``while_loop``
@@ -469,6 +484,15 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
       carries its own executed-iteration count.
     """
     reg = get_regularizer(regularizer)
+    if line_search != "backtrack":
+        # a lax.cond grid under vmap executes BOTH branches in every
+        # lane, so probe_grid would pay the full grid per lane per
+        # iteration — lockstep backtracking is strictly better here
+        logger.info(
+            "packed_solve forces line_search='backtrack' (requested %r): "
+            "vmapped lanes run grids in both cond branches", line_search,
+        )
+        line_search = "backtrack"
     x, _, mask = _prep(X, Y[0])
     dt = _param_dtype(x)
     Yd = jnp.asarray(Y).astype(dt)
@@ -489,7 +513,7 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
                 jnp.asarray(abstol, dt), jnp.asarray(reltol, dt),
                 jnp.asarray(inner_tol, dt), jnp.int32(max_iter),
                 family=family, reg=reg, mesh_holder=mh,
-                inner_iter=inner_iter,
+                inner_iter=inner_iter, line_search="backtrack",
             )
 
         return jax.vmap(one)(Yd)
@@ -511,10 +535,15 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
     run = runners[solver]
     B0 = jnp.zeros((K, _pdim(x, family)), dtype=dt)
 
+    # proximal_grad has its own prox backtracking and takes no knob
+    extra_kw = (
+        {} if solver == "proximal_grad" else {"line_search": line_search}
+    )
+
     def one(yv, b0):
         return run(
             x, yv, mask, b0, lam, jnp.int32(max_iter),
-            jnp.asarray(tol, dt), family=family, reg=reg,
+            jnp.asarray(tol, dt), family=family, reg=reg, **extra_kw,
         )
 
     return jax.vmap(one)(Yd, B0)

@@ -150,3 +150,48 @@ class TestRegularizers:
         assert solvers.get_regularizer("elastic_net") is ElasticNet
         with pytest.raises(ValueError, match="Unknown regularizer"):
             solvers.get_regularizer("l7")
+
+
+class TestLineSearchStrategies:
+    """Both weak-Wolfe strategies must agree on convergence quality; the
+    default stays 'backtrack' until the chip delta is measured (see
+    lbfgs_core module docstring)."""
+
+    def test_rosenbrock_probe_grid(self):
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.solvers.lbfgs_core import lbfgs_minimize
+
+        def f(z):
+            return (1 - z[0]) ** 2 + 100 * (z[1] - z[0] ** 2) ** 2
+
+        x, state = lbfgs_minimize(
+            f, jnp.asarray([-1.2, 1.0]), max_iter=400, tol=1e-6,
+            line_search="probe_grid",
+        )
+        np.testing.assert_allclose(np.asarray(x), [1.0, 1.0], atol=1e-2)
+
+    def test_strategies_agree_on_logistic(self, rng):
+        from dask_ml_tpu.solvers import Logistic, lbfgs
+
+        X = rng.normal(size=(2000, 8)).astype(np.float32)
+        w = rng.normal(size=8)
+        y = (X @ w > 0).astype(np.float32)
+        outs = {
+            ls: np.asarray(lbfgs(
+                X, y, family=Logistic, lamduh=1.0, max_iter=100, tol=1e-6,
+                line_search=ls,
+            ))
+            for ls in ("backtrack", "probe_grid")
+        }
+        np.testing.assert_allclose(
+            outs["backtrack"], outs["probe_grid"], rtol=0.05, atol=1e-3
+        )
+
+    def test_unknown_strategy_raises(self, rng):
+        from dask_ml_tpu.solvers import Logistic, lbfgs
+
+        X = rng.normal(size=(64, 3)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        with pytest.raises(ValueError, match="line_search"):
+            lbfgs(X, y, family=Logistic, line_search="bogus")
