@@ -128,7 +128,7 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
                 test_size=self.test_size, random_state=seed,
                 scoring=self.scoring, prefix=f"{self.prefix}bracket={s}",
                 chunk_size=self.chunk_size, checkpoint=ckpt,
-                patience=self.patience, tol=self.tol,
+                patience=self.patience, tol=self.tol, verbose=self.verbose,
             )
             # a finished bracket KEEPS its final snapshot until the whole
             # Hyperband fit completes: a crash in bracket k must not force

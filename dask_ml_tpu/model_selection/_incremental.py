@@ -514,12 +514,26 @@ class BaseIncrementalSearchCV(TPUEstimator):
         # adaptive loop — an EMPTY dict stops the search; zero-valued
         # instructions keep a model alive without training (the policy's
         # internal step counter advances, reference semantics)
+        round_no = 0
         while True:
             instructions = self._filter_plateaued(
                 info, self._additional_calls(dict(info))
             )
+            if self.verbose:
+                # the reference logs each adaptive decision; mirror with
+                # one INFO line per round (policy output + current best)
+                best = max(
+                    (recs[-1]["score"] for recs in info.values()),
+                    default=float("nan"),
+                )
+                active = sum(1 for v in instructions.values() if v > 0)
+                logger.info(
+                    "%s[round %d] %d/%d models continue, best score %.4f",
+                    self.prefix, round_no, active, len(info), best,
+                )
             if not instructions:
                 break
+            round_no += 1
             await run_round(instructions)
             if ckpt is not None:
                 ckpt.save(models, info, self._capture_policy_state(),

@@ -786,3 +786,41 @@ class TestSequentialBrackets:
         # bracket snapshots are kept while the fit runs (crash recovery)
         # and removed once the WHOLE fit completes
         assert not [f for f in os.listdir(ckdir) if f.endswith(".pkl")]
+
+
+class TestVerboseLogging:
+    def test_verbose_emits_round_decisions(self, clf_data, caplog):
+        import logging
+
+        X, y = clf_data
+        with caplog.at_level(
+            logging.INFO, logger="dask_ml_tpu.model_selection._incremental"
+        ):
+            dms.IncrementalSearchCV(
+                ConstantFunction(), {"value": [0.2, 0.8]},
+                n_initial_parameters="grid", max_iter=3, chunk_size=50,
+                verbose=True,
+            ).fit(X, y)
+        rounds = [r for r in caplog.records if "models continue" in r.message]
+        assert len(rounds) >= 2
+        assert "best score" in rounds[0].message
+
+    def test_silent_by_default(self, clf_data, caplog):
+        import logging
+
+        X, y = clf_data
+        with caplog.at_level(
+            logging.INFO, logger="dask_ml_tpu.model_selection._incremental"
+        ):
+            dms.IncrementalSearchCV(
+                ConstantFunction(), {"value": [0.5]},
+                n_initial_parameters="grid", max_iter=2, chunk_size=50,
+            ).fit(X, y)
+        assert not [r for r in caplog.records if "models continue" in r.message]
+
+    def test_hyperband_forwards_verbose(self):
+        hb = dms.HyperbandSearchCV(
+            SGDClassifier(tol=None), {"alpha": [1e-4]}, max_iter=9,
+            verbose=True,
+        )
+        assert all(sha.verbose for _s, sha in hb._make_brackets())
