@@ -126,6 +126,13 @@ class _BlockwiseBase(TPUEstimator):
             # only; the threaded fallback's est.fit DOES apply weights —
             # route weighted members there instead of dropping weights
             return False
+        if (getattr(probe, "learning_rate", None) == "adaptive"
+                or getattr(probe, "early_stopping", False)):
+            # the packed epoch has no per-member eta_scale decay or
+            # validation split; each member's OWN fit() implements both,
+            # so route these configs to the threaded fallback rather
+            # than silently training at fixed eta / without a holdout
+            return False
 
         if isinstance(X, ShardedRows):
             data = X.data.astype(jnp.float32)

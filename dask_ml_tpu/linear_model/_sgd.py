@@ -62,7 +62,7 @@ DEFAULT_STREAM_CHUNK = _BUCKETS[3]
 _CLS_LOSSES = ("log_loss", "hinge", "squared_hinge", "modified_huber")
 _REG_LOSSES = ("squared_error", "huber")
 _PENALTIES = ("l2", "l1", "elasticnet", None)
-_SCHEDULES = ("constant", "optimal", "invscaling")
+_SCHEDULES = ("constant", "optimal", "invscaling", "adaptive")
 
 
 def _bucket_rows(n: int) -> int:
@@ -133,6 +133,11 @@ def _regression_losses(loss: str, pred, y, epsilon):
 def _learning_rate(schedule: str, t, hyper):
     if schedule == "constant":
         return hyper["eta0"]
+    if schedule == "adaptive":
+        # sklearn semantics: eta stays at eta0 until the epoch loop sees
+        # a plateau, then divides by 5 — the division arrives as a traced
+        # eta_scale in hyper, so no recompile per adjustment
+        return hyper["eta0"] * hyper["eta_scale"]
     if schedule == "optimal":
         # sklearn's heuristic: eta = 1 / (alpha * (t0 + t)) with
         # t0 = 1 / (alpha * eta0-like init); we fold t0 into hyper.
@@ -245,6 +250,23 @@ _jitted_epoch = partial(
 )(sgd_epoch)
 
 
+@partial(jax.jit, static_argnames=("loss",))
+def _eval_loss(state, xb, yb, mask, hyper, *, loss):
+    """Masked mean loss of the CURRENT state over ``mask`` rows — the
+    per-epoch validation metric for ``early_stopping``.  This is an EXTRA
+    forward pass over all rows each epoch (~+50% epoch FLOPs on the
+    full-batch path); accepted so ``sgd_step``'s signature stays shared
+    with the packing/ensemble planes, and only paid when early_stopping
+    is on."""
+    margins = xb @ state["coef"] + state["intercept"]
+    if loss in _CLS_LOSSES:
+        ell, _ = _margin_losses(loss, margins, yb)
+    else:
+        ell, _ = _regression_losses(loss, margins, yb, hyper["epsilon"])
+    m = mask[:, None].astype(margins.dtype)
+    return jnp.sum(ell * m) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
 def _row_shard_count(arr) -> int:
     """Device count along the row axis of ``arr``'s sharding (1 when the
     array is unsharded / on one device)."""
@@ -336,6 +358,13 @@ class EpochStopper:
         self.best = min(self.best, cur)
         return False
 
+    def reset_patience(self) -> None:
+        """Clear the no-improvement counter but KEEP the best loss —
+        sklearn's adaptive-eta rule: after an eta/5 reduction the new
+        regime must still beat the pre-reduction best, or the next
+        plateau fires against it."""
+        self.bad = 0
+
 
 def _run_epochs(est, xb, yb, mask, n_real=None) -> int:
     """Epoch loop for ``fit``.
@@ -348,13 +377,39 @@ def _run_epochs(est, xb, yb, mask, n_real=None) -> int:
     interleaves of the (shard-resident) rows — closer to sklearn's
     semantics and usually faster to converge per epoch on large n.  The
     scalar epoch loss syncs to host only when a tol check is active.
+
+    ``early_stopping=True`` carves ``validation_fraction`` of the rows
+    out by MASK (a per-row Bernoulli split on device — zero data
+    movement, sharded-input safe): training runs on the remaining rows
+    and the stopping rule watches the held-out masked mean LOSS (not
+    sklearn's score — a documented divergence that serves both tasks
+    with one fused forward pass).  ``learning_rate='adaptive'`` follows
+    sklearn: on each plateau eta divides by 5 (a traced eta_scale — no
+    recompile) until it falls below 1e-6.
     """
     from ..utils import check_max_iter
 
     check_max_iter(est.max_iter)
     hyper = est._hyper()
+    adaptive = est.learning_rate == "adaptive"
+    early = bool(getattr(est, "early_stopping", False))
+    train_mask, val_mask = mask, None
+    if early:
+        from ..core.prng import as_key
+
+        frac = float(getattr(est, "validation_fraction", 0.1))
+        sel = (
+            jax.random.uniform(
+                as_key(getattr(est, "random_state", None)), (xb.shape[0],)
+            )
+            < frac
+        ).astype(mask.dtype)
+        val_mask = mask * sel
+        train_mask = mask * (1.0 - sel)
+        if float(jnp.sum(val_mask)) == 0.0:  # degenerate tiny input
+            early, train_mask, val_mask = False, mask, None
     stop = EpochStopper(est.tol, getattr(est, "n_iter_no_change", 5))
-    views = _minibatch_views(est, xb, yb, mask, n_real)
+    views = _minibatch_views(est, xb, yb, train_mask, n_real)
     for epoch in range(est.max_iter):
         if views is not None:
             xs, ys, ms = views
@@ -364,9 +419,25 @@ def _run_epochs(est, xb, yb, mask, n_real=None) -> int:
                 fit_intercept=est.fit_intercept,
             )
         else:
-            loss = est._step_block(xb, yb, mask, hyper)
-        if stop.active and stop.update(float(loss)):
-            return epoch + 1
+            loss = est._step_block(xb, yb, train_mask, hyper)
+        if stop.active:
+            monitor = (
+                _eval_loss(est._state, xb, yb, val_mask, hyper,
+                           loss=est.loss)
+                if early else loss
+            )
+            if stop.update(float(monitor)):
+                if not adaptive:
+                    return epoch + 1
+                # sklearn's adaptive rule: divide eta by 5 and keep
+                # going; stop once eta underflows 1e-6.  The stopper's
+                # best loss persists across reductions — only the
+                # patience counter resets
+                new_scale = hyper["eta_scale"] / 5.0
+                if float(new_scale) * float(hyper["eta0"]) < 1e-6:
+                    return epoch + 1
+                hyper = {**hyper, "eta_scale": new_scale}
+                stop.reset_patience()
     return est.max_iter
 
 
@@ -392,6 +463,7 @@ class _BaseSGD(TPUEstimator):
             "t0": jnp.float32(t0),
             "l1_ratio": jnp.float32(getattr(self, "l1_ratio", 0.15)),
             "epsilon": jnp.float32(getattr(self, "epsilon", 0.1)),
+            "eta_scale": jnp.float32(1.0),
         }
 
     def _validate(self):
@@ -402,6 +474,17 @@ class _BaseSGD(TPUEstimator):
             raise ValueError(
                 f"batch_size must be a positive int or None; got {bs!r}"
             )
+        if getattr(self, "early_stopping", False):
+            vf = float(getattr(self, "validation_fraction", 0.1))
+            if not 0.0 < vf < 1.0:
+                raise ValueError(
+                    f"validation_fraction must be in (0, 1); got {vf}"
+                )
+            if self.tol is None:
+                raise ValueError(
+                    "early_stopping requires a tol (the stopping rule "
+                    "compares held-out losses against it)"
+                )
         if self.penalty not in _PENALTIES:
             raise ValueError(f"penalty must be one of {_PENALTIES}")
         if self.learning_rate not in _SCHEDULES:
@@ -483,9 +566,12 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
                  l1_ratio=0.15, fit_intercept=True, max_iter=1000, tol=1e-3,
                  learning_rate="optimal", eta0=0.01, power_t=0.25,
                  n_iter_no_change=5, random_state=None, warm_start=False,
-                 class_weight=None, batch_size=None):
+                 class_weight=None, batch_size=None, early_stopping=False,
+                 validation_fraction=0.1):
         self.class_weight = class_weight
         self.batch_size = batch_size
+        self.early_stopping = early_stopping
+        self.validation_fraction = validation_fraction
         self.loss = loss
         self.penalty = penalty
         self.alpha = alpha
@@ -750,8 +836,11 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
                  l1_ratio=0.15, fit_intercept=True, max_iter=1000, tol=1e-3,
                  learning_rate="invscaling", eta0=0.01, power_t=0.25,
                  epsilon=0.1, n_iter_no_change=5, random_state=None,
-                 warm_start=False, batch_size=None):
+                 warm_start=False, batch_size=None, early_stopping=False,
+                 validation_fraction=0.1):
         self.batch_size = batch_size
+        self.early_stopping = early_stopping
+        self.validation_fraction = validation_fraction
         self.loss = loss
         self.penalty = penalty
         self.alpha = alpha

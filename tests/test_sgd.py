@@ -508,3 +508,84 @@ class TestMinibatchEpochs:
         X, y = _binary_data(rng, n=300)
         mb = SGDClassifier(max_iter=3, tol=None, batch_size=400).fit(X, y)
         assert mb.t_ == 3.0
+
+
+class TestEarlyStoppingAndAdaptive:
+    def test_early_stopping_halts_before_max_iter(self, rng):
+        X, y = _binary_data(rng, n=800)
+        es = SGDClassifier(
+            max_iter=500, tol=1e-3, early_stopping=True,
+            validation_fraction=0.2, random_state=0,
+            learning_rate="constant", eta0=0.1,
+        ).fit(X, y)
+        assert es.n_iter_ < 500
+        assert (es.predict(X) == y).mean() > 0.9
+
+    def test_early_stopping_requires_tol(self, rng):
+        X, y = _binary_data(rng, n=100)
+        with pytest.raises(ValueError, match="early_stopping requires"):
+            SGDClassifier(tol=None, early_stopping=True).fit(X, y)
+        with pytest.raises(ValueError, match="validation_fraction"):
+            SGDClassifier(
+                early_stopping=True, validation_fraction=1.5
+            ).fit(X, y)
+
+    def test_early_stopping_sharded(self, rng, mesh):
+        X, y = _binary_data(rng, n=640)
+        es = SGDClassifier(
+            max_iter=300, tol=1e-4, early_stopping=True, random_state=0,
+        ).fit(shard_rows(X), shard_rows(y))
+        assert es.n_iter_ <= 300
+        assert (es.predict(X) == y).mean() > 0.9
+
+    def test_adaptive_learning_rate_decays_and_stops(self, rng):
+        X, y = _binary_data(rng, n=400)
+        ad = SGDClassifier(
+            learning_rate="adaptive", eta0=0.5, max_iter=2000, tol=1e-3,
+            n_iter_no_change=3, random_state=0,
+        ).fit(X, y)
+        # plateau -> eta/5 cascades until 1e-6 floor: stops well short
+        assert ad.n_iter_ < 2000
+        assert (ad.predict(X) == y).mean() > 0.9
+
+    def test_adaptive_beats_fixed_tiny_eta_on_budget(self, rng):
+        # adaptive starts big and decays on plateau (tol active so the
+        # eta/5 branch actually runs); a fixed tiny eta crawls
+        X, y = _binary_data(rng, n=400)
+        ad = SGDClassifier(
+            learning_rate="adaptive", eta0=0.5, max_iter=200, tol=1e-3,
+            n_iter_no_change=3, random_state=0,
+        ).fit(X, y)
+        slow = SGDClassifier(
+            learning_rate="constant", eta0=1e-4, max_iter=200, tol=None,
+            random_state=0,
+        ).fit(X, y)
+        assert ad.n_iter_ < 200  # the decay cascade terminated the fit
+        assert (ad.predict(X) == y).mean() >= (slow.predict(X) == y).mean()
+
+    def test_regressor_early_stopping(self, rng):
+        X = rng.normal(size=(600, 6)).astype(np.float32)
+        w = rng.normal(size=6).astype(np.float32)
+        y = X @ w + 0.01 * rng.normal(size=600).astype(np.float32)
+        es = SGDRegressor(
+            max_iter=500, tol=1e-5, early_stopping=True, random_state=0,
+            learning_rate="constant", eta0=0.05, penalty=None,
+        ).fit(X, y)
+        assert es.n_iter_ < 500
+        from sklearn.metrics import r2_score
+
+        assert r2_score(y, np.asarray(es.predict(X))) > 0.9
+
+    def test_ensemble_routes_adaptive_to_member_fit(self, rng):
+        from dask_ml_tpu.ensemble import BlockwiseVotingClassifier
+
+        X, y = _binary_data(rng, n=400)
+        ens = BlockwiseVotingClassifier(
+            SGDClassifier(learning_rate="adaptive", eta0=0.5, tol=1e-3,
+                          random_state=0),
+            n_blocks=4,
+        ).fit(X, y)
+        # fell back to per-member fit (each ran its own adaptive decay)
+        assert len(ens.estimators_) == 4
+        assert all(m.n_iter_ >= 1 for m in ens.estimators_)
+        assert (np.asarray(ens.predict(X)) == y).mean() > 0.85
