@@ -144,7 +144,7 @@ def main(pid: int, nproc: int, port: str, local_devices: int = 4) -> None:
 
 
 def spawn_group(n_processes: int = 2, local_devices: int = 4,
-                timeout_s: int = 480):
+                timeout_s: int = 720):
     """Spawn the worker group as subprocesses and collect results.
 
     The ONE subprocess harness (used by ``__graft_entry__.dryrun_multihost``

@@ -18,7 +18,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 class TestMultihost:
     def test_two_process_admm_and_lloyd(self):
         outs = []
-        for rc, out in spawn_group(2, 4, timeout_s=480):
+        for rc, out in spawn_group(2, 4, timeout_s=720):
             assert rc == 0, out
             assert "multihost OK" in out
             outs.append(out)
