@@ -16,45 +16,109 @@ from .preprocessing.data import _ingest_float, _masked_or_plain
 
 @jax.jit
 def _class_moments(x, mask, onehot):
-    w = onehot * mask[:, None]  # (n, k)
-    counts = jnp.sum(w, axis=0)  # (k,)
+    w = onehot * mask[:, None]  # (n, k); mask may carry sample WEIGHTS
+    counts = jnp.sum(w, axis=0)  # (k,) weight mass per class
+    safe = jnp.maximum(counts, 1.0)  # classes absent from a batch: 0-safe
     sums = w.T @ x  # (k, d)
-    means = sums / counts[:, None]
+    means = sums / safe[:, None]
     # two-pass variance: deviations from the per-class mean (E[x²]−E[x]²
-    # catastrophically cancels in fp32 for data with large means)
-    dev = x - w @ means  # rows of the wrong class contribute 0 via w below
-    var = (w.T @ (dev ** 2)) / counts[:, None]
+    # catastrophically cancels in fp32 for data with large means).  The
+    # per-row class mean comes from the BINARY onehot — selecting through
+    # the weighted ``w`` would scale the mean by the row's weight and
+    # corrupt every weighted deviation
+    dev = x - onehot @ means
+    var = (w.T @ (dev ** 2)) / safe[:, None]
     return counts, means, var
 
 
 class GaussianNB(ClassifierMixin, TPUEstimator):
+    # stream moments a mid-stream checkpoint must carry (the exposed
+    # theta_/var_/class_count_ are trailing-underscore, saved anyway)
+    _checkpoint_private_attrs = ("_m2", "_max_var")
+
     def __init__(self, priors=None, var_smoothing=1e-9):
         self.priors = priors
         self.var_smoothing = var_smoothing
 
-    def fit(self, X, y=None):
+    def fit(self, X, y=None, sample_weight=None):
+        for a in ("classes_", "class_count_", "theta_", "_m2", "_max_var"):
+            if hasattr(self, a):
+                delattr(self, a)
+        yv = unshard(y) if isinstance(y, ShardedRows) else np.asarray(y)
+        return self.partial_fit(
+            X, yv, classes=np.unique(yv), sample_weight=sample_weight
+        )
+
+    def partial_fit(self, X, y=None, classes=None, sample_weight=None):
+        """Incremental fit over a stream of row blocks (sklearn contract):
+        per-class Chan et al. merge of (weight, mean, M2) moments, so
+        ``fit`` on one array and a ``partial_fit`` stream over its blocks
+        produce identical statistics.  ``sample_weight`` folds into the
+        mask (weighted class counts / moments, sklearn semantics)."""
         X = _ingest_float(self, X)
         yv = unshard(y) if isinstance(y, ShardedRows) else np.asarray(y)
         if yv.shape[0] != X.n_samples:
             raise ValueError("X and y have different lengths")
-        classes = np.unique(yv)
-        idx = np.searchsorted(classes, yv)
+        if not hasattr(self, "classes_"):
+            if classes is None:
+                raise ValueError(
+                    "classes must be passed on the first partial_fit call"
+                )
+            self.classes_ = np.unique(np.asarray(classes))
+            k, d = len(self.classes_), X.data.shape[1]
+            self.class_count_ = jnp.zeros((k,), jnp.float32)
+            self.theta_ = jnp.zeros((k, d), X.data.dtype)
+            self._m2 = jnp.zeros((k, d), X.data.dtype)
+            self._max_var = 0.0
+        elif classes is not None and not np.array_equal(
+            np.unique(np.asarray(classes)), self.classes_
+        ):
+            raise ValueError(
+                f"classes={np.asarray(classes).tolist()} is not the same "
+                f"as on the first call to partial_fit "
+                f"({self.classes_.tolist()})"
+            )
+        idx = np.searchsorted(self.classes_, yv)
+        bad = (idx >= len(self.classes_)) | (self.classes_[
+            np.minimum(idx, len(self.classes_) - 1)] != yv)
+        if bad.any():
+            raise ValueError(
+                f"y contains labels not in classes_: "
+                f"{np.unique(yv[bad]).tolist()}"
+            )
         idx_padded = np.zeros(X.padded, dtype=np.int64)
         idx_padded[: X.n_samples] = idx
-        onehot = jax.nn.one_hot(jnp.asarray(idx_padded), len(classes), dtype=X.data.dtype)
+        onehot = jax.nn.one_hot(
+            jnp.asarray(idx_padded), len(self.classes_), dtype=X.data.dtype
+        )
+        mask = X.mask
+        if sample_weight is not None:
+            from .utils import reweight_rows
 
-        counts, means, var = _class_moments(X.data, X.mask, onehot)
+            mask = reweight_rows(X, sample_weight=sample_weight).mask
+        nb, means_b, var_b = _class_moments(X.data, mask, onehot)
+
+        from .utils import chan_merge
+
+        n2, self.theta_, self._m2 = chan_merge(
+            self.class_count_[:, None], self.theta_, self._m2,
+            nb[:, None], means_b, var_b,
+        )
+        n = n2[:, 0]
+        self.class_count_ = n
+
         from .core.sharded import masked_var
 
-        eps = self.var_smoothing * float(jnp.max(masked_var(X.data, X.mask)))
-        self.classes_ = classes
-        self.class_count_ = counts
-        self.theta_ = means
-        self.var_ = var + eps
+        # sklearn keys var_smoothing to the largest feature variance seen
+        self._max_var = max(
+            self._max_var, float(jnp.max(masked_var(X.data, X.mask)))
+        )
+        eps = self.var_smoothing * self._max_var
+        self.var_ = self._m2 / jnp.maximum(n, 1.0)[:, None] + eps
         if self.priors is not None:
             self.class_prior_ = jnp.asarray(self.priors)
         else:
-            self.class_prior_ = counts / jnp.sum(counts)
+            self.class_prior_ = n / jnp.maximum(jnp.sum(n), 1.0)
         self.n_features_in_ = X.data.shape[1]
         return self
 

@@ -201,14 +201,13 @@ class StandardScaler(TransformerMixin, TPUEstimator):
             self._pf_mean, self._pf_m2 = mb, vb * nb
             self.n_samples_seen_ = nb
         else:
-            na = self.n_samples_seen_
-            n = na + nb
-            delta = mb - self._pf_mean
-            self._pf_mean = self._pf_mean + delta * (nb / n)
-            self._pf_m2 = (
-                self._pf_m2 + vb * nb + delta * delta * (na * nb / n)
+            from ..utils import chan_merge
+
+            _n, self._pf_mean, self._pf_m2 = chan_merge(
+                float(self.n_samples_seen_), self._pf_mean, self._pf_m2,
+                float(nb), mb, vb,
             )
-            self.n_samples_seen_ = n
+            self.n_samples_seen_ += nb
         self.mean_ = self._pf_mean if self.with_mean else None
         if self.with_std:
             var = self._pf_m2 / max(self.n_samples_seen_, 1)

@@ -158,6 +158,23 @@ def slice_columns(X, columns):
     return X[:, np.asarray(columns)]
 
 
+def chan_merge(na, ma, m2a, nb, mb, vb):
+    """Merge two (count, mean, M2) moment summaries (Chan et al. 1979) —
+    the numerically safe parallel-variance update shared by
+    ``StandardScaler.partial_fit`` (scalar count, (d,) moments) and
+    ``GaussianNB.partial_fit`` ((k,1) per-class counts, (k,d) moments).
+    Counts must broadcast against the moment arrays; zero-count sides are
+    handled (the 1-clamped denominator only engages when n == 0, where
+    every product above it is 0 too).  Returns ``(n, mean, m2)``.
+    """
+    n = na + nb
+    nsafe = jnp.maximum(n, 1.0)
+    delta = mb - ma
+    mean = ma + delta * (nb / nsafe)
+    m2 = m2a + vb * nb + delta * delta * (na * nb / nsafe)
+    return n, mean, m2
+
+
 def handle_zeros_in_scale(scale):
     """Avoid division by ~0 when scaling (constant features scale by 1).
 

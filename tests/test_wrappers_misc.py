@@ -604,3 +604,106 @@ class TestStreamingInference:
                                       chunk_size=100))
         assert all(scipy.sparse.issparse(o) for o in outs)
         assert sum(o.shape[0] for o in outs) == 300
+
+
+class TestGaussianNBPartialFit:
+    """sklearn-contract partial_fit: per-class Chan moment merges — a
+    stream of blocks must reproduce the whole-array fit exactly."""
+
+    def test_stream_matches_fit(self, rng):
+        from dask_ml_tpu.naive_bayes import GaussianNB
+
+        X = rng.normal(size=(300, 4)).astype(np.float32) * 2 + 5
+        y = rng.randint(0, 3, size=300)
+        full = GaussianNB().fit(X, y)
+        stream = GaussianNB()
+        for lo in range(0, 300, 100):
+            stream.partial_fit(X[lo:lo + 100], y[lo:lo + 100],
+                               classes=[0, 1, 2])
+        np.testing.assert_allclose(
+            np.asarray(stream.theta_), np.asarray(full.theta_), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(stream.var_), np.asarray(full.var_), rtol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(stream.class_count_), np.asarray(full.class_count_)
+        )
+
+    def test_parity_with_sklearn_stream(self, rng):
+        from sklearn.naive_bayes import GaussianNB as SkNB
+
+        from dask_ml_tpu.naive_bayes import GaussianNB
+
+        X = rng.normal(size=(240, 3)).astype(np.float32)
+        X[:120] += 2.0
+        y = np.r_[np.zeros(120, int), np.ones(120, int)]
+        ours, sk = GaussianNB(), SkNB()
+        for lo in range(0, 240, 80):
+            ours.partial_fit(X[lo:lo + 80], y[lo:lo + 80], classes=[0, 1])
+            sk.partial_fit(X[lo:lo + 80], y[lo:lo + 80], classes=[0, 1])
+        np.testing.assert_allclose(
+            np.asarray(ours.theta_), sk.theta_, rtol=1e-4, atol=1e-5
+        )
+        agree = (np.asarray(ours.predict(X)) == sk.predict(X)).mean()
+        assert agree > 0.99
+
+    def test_requires_classes_first_call(self, rng):
+        from dask_ml_tpu.naive_bayes import GaussianNB
+
+        X = rng.normal(size=(50, 3)).astype(np.float32)
+        with pytest.raises(ValueError, match="classes"):
+            GaussianNB().partial_fit(X, np.zeros(50, int))
+
+    def test_unknown_label_raises(self, rng):
+        from dask_ml_tpu.naive_bayes import GaussianNB
+
+        X = rng.normal(size=(50, 3)).astype(np.float32)
+        nb = GaussianNB().partial_fit(
+            X, np.zeros(50, int), classes=[0, 1]
+        )
+        with pytest.raises(ValueError, match="not in classes_"):
+            nb.partial_fit(X, np.full(50, 7))
+
+    def test_streams_through_incremental(self, rng):
+        from dask_ml_tpu.naive_bayes import GaussianNB
+        from dask_ml_tpu.wrappers import Incremental
+
+        X = rng.normal(size=(256, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int64)
+        X[y == 1] += 3.0
+        inc = Incremental(GaussianNB(), chunk_size=64).fit(
+            X, y, classes=[0, 1]
+        )
+        assert (np.asarray(inc.predict(X)) == y).mean() > 0.9
+
+    def test_weighted_variance_correct(self, rng):
+        # regression: the two-pass dev must select class means through the
+        # BINARY onehot, not the weighted mask (which scaled the mean by
+        # each row's weight and inflated variances ~25x)
+        from sklearn.naive_bayes import GaussianNB as SkNB
+
+        from dask_ml_tpu.naive_bayes import GaussianNB
+
+        X = (rng.normal(size=(200, 3)) + 4).astype(np.float32)
+        y = rng.randint(0, 2, 200)
+        w = rng.uniform(0.5, 3.0, 200)
+        ours = GaussianNB().fit(X, y, sample_weight=w)
+        sk = SkNB().fit(X, y, sample_weight=w)
+        np.testing.assert_allclose(
+            np.asarray(ours.var_), sk.var_, rtol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(ours.theta_), sk.theta_, rtol=1e-4
+        )
+
+    def test_classes_mismatch_on_later_call_raises(self, rng):
+        from dask_ml_tpu.naive_bayes import GaussianNB
+
+        X = rng.normal(size=(40, 2)).astype(np.float32)
+        nb = GaussianNB().partial_fit(
+            X, np.zeros(40, int), classes=[0, 1]
+        )
+        with pytest.raises(ValueError, match="not the same"):
+            nb.partial_fit(X, np.zeros(40, int), classes=[1, 2])
+        nb.partial_fit(X, np.zeros(40, int), classes=[1, 0])  # same set: ok
