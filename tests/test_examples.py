@@ -1,0 +1,26 @@
+"""Examples must keep running — they are the user-facing front door.
+
+Two fast ones run as subprocesses (fresh interpreter, the way a user
+would); the heavier ones are exercised by the suites covering the same
+paths.
+"""
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+_EXAMPLES = pathlib.Path(__file__).resolve().parents[1] / "examples"
+
+
+@pytest.mark.parametrize(
+    "script", ["streaming_out_of_core.py", "text_pipeline.py"]
+)
+def test_example_runs(script):
+    proc = subprocess.run(
+        [sys.executable, str(_EXAMPLES / script)],
+        capture_output=True, text=True, timeout=420,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "HOME": "/root"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
