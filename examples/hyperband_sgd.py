@@ -15,6 +15,7 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np  # noqa: E402
+from scipy.stats import loguniform  # noqa: E402
 
 from dask_ml_tpu.core import shard_rows  # noqa: E402
 from dask_ml_tpu.linear_model import SGDClassifier  # noqa: E402
@@ -26,7 +27,9 @@ y = (X @ rng.normal(size=12) > 0).astype(np.float32)
 
 search = HyperbandSearchCV(
     SGDClassifier(tol=None),
-    {"alpha": [1e-5, 1e-4, 1e-3, 1e-2], "eta0": [0.01, 0.1, 0.5]},
+    # a continuous distribution: Hyperband samples as many configs as
+    # its largest bracket asks for without exhausting a finite grid
+    {"alpha": loguniform(1e-6, 1e-1), "eta0": [0.01, 0.1, 0.5]},
     max_iter=27, random_state=0, verbose=True,
 )
 search.fit(shard_rows(X), shard_rows(y), classes=[0.0, 1.0])

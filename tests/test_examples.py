@@ -4,6 +4,7 @@ Two fast ones run as subprocesses (fresh interpreter, the way a user
 would); the heavier ones are exercised by the suites covering the same
 paths.
 """
+import os
 import pathlib
 import subprocess
 import sys
@@ -21,6 +22,6 @@ def test_example_runs(script):
         [sys.executable, str(_EXAMPLES / script)],
         capture_output=True, text=True, timeout=420,
         env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
-             "HOME": "/root"},
+             "HOME": os.environ.get("HOME", "/tmp")},
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
