@@ -140,8 +140,14 @@ class KFold:
 
 
 def train_test_split(*arrays, test_size=None, train_size=None, random_state=None,
-                     shuffle=True, blockwise=True, **options):
-    """Split each array into train/test (reference ``train_test_split``)."""
+                     shuffle=True, blockwise=True, stratify=None, **options):
+    """Split each array into train/test (reference ``train_test_split``).
+
+    ``stratify`` takes a HOST label array (sklearn semantics: class
+    proportions preserved in both splits).  Sharded label arrays are
+    rejected with guidance — stratified selection needs the full label
+    vector on host, an O(n) pull the sharded path refuses implicitly.
+    """
     if not arrays:
         raise ValueError("At least one array required")
     if options:
@@ -151,7 +157,26 @@ def train_test_split(*arrays, test_size=None, train_size=None, random_state=None
         if _n_samples(a) != n:
             raise ValueError("All arrays must have the same length")
     n_train, n_test = _resolve_sizes(n, train_size, test_size)
-    if shuffle:
+    if stratify is not None:
+        if isinstance(stratify, ShardedRows):
+            raise ValueError(
+                "stratify requires host labels (an O(n) pull for sharded "
+                "arrays): pass the original host label array, or use "
+                "sklearn's StratifiedKFold via the CV searches"
+            )
+        if not shuffle:
+            raise ValueError("stratify requires shuffle=True")
+        from sklearn.model_selection import StratifiedShuffleSplit
+
+        sss = StratifiedShuffleSplit(
+            n_splits=1, train_size=n_train, test_size=n_test,
+            random_state=random_state,
+        )
+        train_idx, test_idx = next(
+            sss.split(np.zeros((n, 1)), np.asarray(stratify))
+        )
+        train_idx, test_idx = np.sort(train_idx), np.sort(test_idx)
+    elif shuffle:
         rng = check_random_state(random_state)
         perm = rng.permutation(n)
         train_idx = np.sort(perm[:n_train])

@@ -824,3 +824,49 @@ class TestVerboseLogging:
             verbose=True,
         )
         assert all(sha.verbose for _s, sha in hb._make_brackets())
+
+
+class TestStratifiedSplit:
+    def test_stratify_preserves_proportions(self, rng):
+        X = rng.normal(size=(300, 3)).astype(np.float32)
+        y = np.r_[np.zeros(270), np.ones(30)]  # 10% minority
+        Xtr, Xte, ytr, yte = dms.train_test_split(
+            X, y, stratify=y, test_size=0.2, random_state=0
+        )
+        assert yte.mean() == pytest.approx(0.1, abs=0.02)
+        assert ytr.mean() == pytest.approx(0.1, abs=0.02)
+        # sharded X with host stratify labels also works
+        sXtr, sXte, ytr2, yte2 = dms.train_test_split(
+            shard_rows(X), y, stratify=y, test_size=0.2, random_state=0
+        )
+        assert isinstance(sXtr, ShardedRows)
+        assert yte2.mean() == pytest.approx(0.1, abs=0.02)
+
+    def test_stratify_rejects_sharded_labels(self, rng):
+        X = rng.normal(size=(80, 2)).astype(np.float32)
+        y = (rng.rand(80) > 0.5).astype(np.float32)
+        with pytest.raises(ValueError, match="host labels"):
+            dms.train_test_split(X, y, stratify=shard_rows(y))
+        with pytest.raises(ValueError, match="shuffle"):
+            dms.train_test_split(X, y, stratify=y, shuffle=False)
+
+
+class TestNBCheckpointRoundtrip:
+    def test_mid_stream_checkpoint_exact(self, rng, tmp_path):
+        from dask_ml_tpu.checkpoint import load_estimator, save_estimator
+        from dask_ml_tpu.naive_bayes import GaussianNB
+
+        X = rng.normal(size=(200, 3)).astype(np.float32)
+        y = rng.randint(0, 2, 200)
+        nb = GaussianNB().partial_fit(X[:100], y[:100], classes=[0, 1])
+        p = str(tmp_path / "nb.ckpt")
+        save_estimator(nb, p)
+        nb2 = load_estimator(p)
+        nb2.partial_fit(X[100:], y[100:])
+        full = GaussianNB().fit(X, y)
+        np.testing.assert_allclose(
+            np.asarray(nb2.theta_), np.asarray(full.theta_), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(nb2.var_), np.asarray(full.var_), rtol=1e-4
+        )
