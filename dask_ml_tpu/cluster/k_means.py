@@ -25,7 +25,7 @@ from ..base import TPUEstimator, TransformerMixin
 from ..core.prng import as_key
 from ..core.sharded import ShardedRows, unshard
 from ..preprocessing.data import _ingest_float as _ingest_float_any
-from ..utils import _timer
+from ..utils import _timer, safe_denominator
 
 logger = logging.getLogger(__name__)
 
@@ -66,9 +66,8 @@ def _lloyd_step(x, mask, centers):
     # next round's argmin, so both TPU paths must accumulate identically
     sums = jnp.dot(onehot.T, x, precision=jax.lax.Precision.HIGHEST)  # (k, d)
     counts = jnp.sum(onehot, axis=0)  # (k,)
-    new_centers = jnp.where(
-        counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centers
-    )
+    safe = safe_denominator(counts)[:, None]
+    new_centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
     shift = jnp.sum((new_centers - centers) ** 2)
     return new_centers, inertia, shift
 
@@ -88,9 +87,8 @@ def _lloyd_step_pallas(x, mask, centers, mesh):
         sums = lax.psum(sums, DATA_AXIS)
         counts = lax.psum(counts, DATA_AXIS)
         inertia = lax.psum(inertia, DATA_AXIS)
-        new_centers = jnp.where(
-            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), c
-        )
+        safe = safe_denominator(counts)[:, None]
+        new_centers = jnp.where(counts[:, None] > 0, sums / safe, c)
         shift = jnp.sum((new_centers - c) ** 2)
         return new_centers, inertia, shift
 

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 
 from ..base import ClassifierMixin, RegressorMixin, TPUEstimator
 from ..core.sharded import ShardedRows
+from ..utils import safe_denominator
 
 __all__ = ["SGDClassifier", "SGDRegressor"]
 
@@ -179,7 +180,7 @@ def sgd_step(state, xb, yb, mask, hyper, *, loss, penalty, schedule,
     else:
         ell, dmarg = _regression_losses(loss, margins, yb, hyper["epsilon"])
     m = mask[:, None].astype(margins.dtype)
-    count = jnp.maximum(jnp.sum(mask), 1.0)
+    count = safe_denominator(jnp.sum(mask))
     mean_loss = jnp.sum(ell * m) / count
     dmarg = dmarg * m / count
     gcoef = xb.T @ dmarg  # [d, K] — the other MXU gemm
@@ -239,7 +240,7 @@ def sgd_epoch(state, xs, ys, ms, hyper, *, loss, penalty, schedule,
     # row-count-weighted mean: bucket padding makes minibatches carry
     # unequal numbers of real rows, and an unweighted mean would deflate
     # the epoch loss the tol stopper compares
-    total = jnp.maximum(jnp.sum(counts), 1.0)
+    total = safe_denominator(jnp.sum(counts))
     return state, jnp.sum(losses * counts) / total
 
 
@@ -264,7 +265,7 @@ def _eval_loss(state, xb, yb, mask, hyper, *, loss):
     else:
         ell, _ = _regression_losses(loss, margins, yb, hyper["epsilon"])
     m = mask[:, None].astype(margins.dtype)
-    return jnp.sum(ell * m) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(ell * m) / safe_denominator(jnp.sum(mask))
 
 
 def _row_shard_count(arr) -> int:

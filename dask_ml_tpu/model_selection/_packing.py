@@ -95,7 +95,9 @@ def _packed_accuracy_impl(states, xb, yb, mask):
         else:
             pred = jnp.argmax(m, axis=1).astype(jnp.int32)
         hit = (pred == y_idx).astype(jnp.float32) * mask
-        return jnp.sum(hit) / jnp.maximum(jnp.sum(mask), 1.0)
+        from ..utils import safe_denominator
+
+        return jnp.sum(hit) / safe_denominator(jnp.sum(mask))
 
     return jax.vmap(one)(states)
 

@@ -18,7 +18,9 @@ from .preprocessing.data import _ingest_float, _masked_or_plain
 def _class_moments(x, mask, onehot):
     w = onehot * mask[:, None]  # (n, k); mask may carry sample WEIGHTS
     counts = jnp.sum(w, axis=0)  # (k,) weight mass per class
-    safe = jnp.maximum(counts, 1.0)  # classes absent from a batch: 0-safe
+    from .utils import safe_denominator
+
+    safe = safe_denominator(counts)
     sums = w.T @ x  # (k, d)
     means = sums / safe[:, None]
     # two-pass variance: deviations from the per-class mean (E[x²]−E[x]²
@@ -114,11 +116,13 @@ class GaussianNB(ClassifierMixin, TPUEstimator):
             self._max_var, float(jnp.max(masked_var(X.data, X.mask)))
         )
         eps = self.var_smoothing * self._max_var
-        self.var_ = self._m2 / jnp.maximum(n, 1.0)[:, None] + eps
+        from .utils import safe_denominator as _sd
+
+        self.var_ = self._m2 / _sd(n)[:, None] + eps
         if self.priors is not None:
             self.class_prior_ = jnp.asarray(self.priors)
         else:
-            self.class_prior_ = n / jnp.maximum(jnp.sum(n), 1.0)
+            self.class_prior_ = n / _sd(jnp.sum(n))
         self.n_features_in_ = X.data.shape[1]
         return self
 

@@ -158,6 +158,18 @@ def slice_columns(X, columns):
     return X[:, np.asarray(columns)]
 
 
+def safe_denominator(x):
+    """0-safe divisor that PRESERVES fractional weight masses.
+
+    ``maximum(x, 1)`` silently shrinks any mean whose total mass is in
+    (0, 1) — the mask doubles as the per-row weight throughout this
+    framework, so sub-unit masses are legitimate (caught by the NB
+    weighted-stream and sub-unit-KMeans property tests).  The kept branch
+    is never 0, so the division is always finite.
+    """
+    return jnp.where(x > 0, x, 1.0)
+
+
 def chan_merge(na, ma, m2a, nb, mb, vb):
     """Merge two (count, mean, M2) moment summaries (Chan et al. 1979) —
     the numerically safe parallel-variance update shared by
@@ -168,7 +180,7 @@ def chan_merge(na, ma, m2a, nb, mb, vb):
     every product above it is 0 too).  Returns ``(n, mean, m2)``.
     """
     n = na + nb
-    nsafe = jnp.maximum(n, 1.0)
+    nsafe = safe_denominator(n)
     delta = mb - ma
     mean = ma + delta * (nb / nsafe)
     m2 = m2a + vb * nb + delta * delta * (na * nb / nsafe)
@@ -268,7 +280,7 @@ def effective_mask(mask, y_padded=None, *, sample_weight=None,
                 )
             counts = jnp.sum(ind, axis=1)
             total = jnp.sum(mask)
-            cw = total / (len(cls_np) * jnp.maximum(counts, 1.0))
+            cw = total / (len(cls_np) * safe_denominator(counts))
         else:
             _check_class_weight_keys(class_weight, cls_np)
             cw = jnp.asarray(
@@ -307,7 +319,7 @@ def masked_device_accuracy(pred_idx, y_data, mask, classes) -> float:
         (cls[pred_idx] == y_data.astype(jnp.float32)).astype(jnp.float32)
         * mask
     )
-    return float(jnp.sum(hit) / jnp.maximum(jnp.sum(mask), 1.0))
+    return float(jnp.sum(hit) / safe_denominator(jnp.sum(mask)))
 
 
 def reweight_rows(X, *, sample_weight=None, class_weight=None,

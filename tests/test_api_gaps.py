@@ -381,3 +381,20 @@ class TestContractGapsRound3:
             np.asarray(b.var_), np.asarray(full.var_), rtol=1e-4
         )
         assert b.n_samples_seen_ == 300
+
+    def test_balanced_class_weight_sub_unit_mask_mass(self, rng):
+        # regression: the balanced branch clamped per-class weight mass
+        # to 1, shrinking balanced weights whenever mass < 1
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.utils import effective_mask
+
+        y_idx = jnp.asarray(np.r_[np.zeros(30), np.ones(10)], jnp.float32)
+        tiny = jnp.full((40,), 1e-3, jnp.float32)  # mask IS the weight
+        m = effective_mask(
+            tiny, y_idx, class_weight="balanced", classes=[0, 1],
+            n_samples=40,
+        )
+        m = np.asarray(m)
+        # balanced: minority rows upweighted by exactly count ratio 3x
+        assert m[39] / m[0] == pytest.approx(3.0, rel=1e-4)

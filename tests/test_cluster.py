@@ -544,3 +544,22 @@ class TestKMeansSampleWeight:
 
         assert ari(y, np.asarray(rescued.labels_)) > 0.95
         assert rescued.inertia_ < stuck.inertia_
+
+    def test_sub_unit_weight_mass_centers_exact(self, rng, mesh):
+        # regression: maximum(mass, 1.0) denominators silently shrank
+        # centers whenever a cluster's total weight mass was < 1
+        import sklearn.cluster as skc
+
+        X = rng.normal(size=(300, 3)).astype(np.float32) + np.repeat(
+            np.eye(3, dtype=np.float32) * 6, 100, axis=0
+        )
+        w = np.full(300, 1e-3)  # per-cluster mass ~0.1
+        init = X[[0, 100, 200]].copy()
+        ours = dc.KMeans(n_clusters=3, init=init, max_iter=50,
+                         tol=1e-9).fit(X, sample_weight=w)
+        sk = skc.KMeans(n_clusters=3, init=init, n_init=1,
+                        max_iter=50).fit(X, sample_weight=w)
+        np.testing.assert_allclose(
+            np.asarray(ours.cluster_centers_), sk.cluster_centers_,
+            atol=1e-4,
+        )

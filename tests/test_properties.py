@@ -232,3 +232,75 @@ def test_sgd_minibatch_one_chunk_equals_fullbatch(n_third, seed):
     np.testing.assert_allclose(
         np.asarray(a.coef_), np.asarray(b.coef_), rtol=1e-6, atol=1e-7
     )
+
+
+@settings(max_examples=20, deadline=None)
+@given(_block_splits())
+def test_gaussian_nb_weighted_stream_split_invariant(case):
+    """Per-class Chan merges: ANY weighted block split reproduces the
+    whole-array weighted fit (theta_, var_, class_count_)."""
+    from dask_ml_tpu.naive_bayes import GaussianNB
+
+    n, cuts, seed = case
+    r = np.random.RandomState(seed)
+    X = (r.normal(size=(n, 3)) * 2 + 3).astype(np.float32)
+    y = r.randint(0, 3, size=n)
+    w = r.uniform(0.25, 4.0, size=n)
+    full = GaussianNB().fit(X, y, sample_weight=w)
+    stream = GaussianNB()
+    for lo, hi in zip(cuts[:-1], cuts[1:]):
+        stream.partial_fit(X[lo:hi], y[lo:hi], classes=[0, 1, 2],
+                           sample_weight=w[lo:hi])
+    np.testing.assert_allclose(
+        np.asarray(stream.theta_), np.asarray(full.theta_),
+        rtol=2e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(stream.var_), np.asarray(full.var_),
+        rtol=2e-3, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(stream.class_count_), np.asarray(full.class_count_),
+        rtol=1e-5,
+    )
+
+
+@settings(max_examples=30, deadline=None)
+@given(st.integers(0, 2**16))
+def test_chan_merge_associative(seed):
+    """(A+B)+C == A+(B+C) for the shared moment-merge helper."""
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.utils import chan_merge
+
+    r = np.random.RandomState(seed)
+
+    def summarize(x):
+        n = float(x.shape[0])
+        m = x.mean(0)
+        v = x.var(0)
+        return n, jnp.asarray(m, jnp.float32), jnp.asarray(v * n, jnp.float32)
+
+    parts = [r.normal(size=(r.randint(2, 40), 4)).astype(np.float32) + 2
+             for _ in range(3)]
+    summaries = [summarize(p) for p in parts]
+
+    def merge(a, b):
+        na, ma, m2a = a
+        nb, mb, vbn = b
+        # chan_merge takes (count_b, mean_b, var_b); recover var from M2
+        n, m, m2 = chan_merge(na, ma, m2a, nb, mb, vbn / max(nb, 1.0))
+        return n, m, m2
+
+    left = merge(merge(summaries[0], summaries[1]), summaries[2])
+    right = merge(summaries[0], merge(summaries[1], summaries[2]))
+    np.testing.assert_allclose(np.asarray(left[1]), np.asarray(right[1]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(left[2]), np.asarray(right[2]),
+                               rtol=1e-4, atol=1e-4)
+    # and both equal the direct whole-array summary
+    whole = summarize(np.concatenate(parts))
+    np.testing.assert_allclose(np.asarray(left[1]), np.asarray(whole[1]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(left[2]), np.asarray(whole[2]),
+                               rtol=1e-3, atol=1e-3)
