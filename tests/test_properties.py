@@ -304,3 +304,22 @@ def test_chan_merge_associative(seed):
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(left[2]), np.asarray(whole[2]),
                                rtol=1e-3, atol=1e-3)
+
+
+@settings(max_examples=15, deadline=None)
+@given(st.integers(2, 90), st.integers(2, 70), st.integers(1, 6),
+       st.integers(0, 2**16))
+def test_ring_pairwise_any_shapes(n1, n2, d, seed):
+    """The ppermute ring must match sklearn for ARBITRARY (odd,
+    non-divisible) row counts on both sides — the pad+mask discipline
+    under rotation is the delicate part."""
+    from sklearn.metrics.pairwise import euclidean_distances as sk_euc
+
+    from dask_ml_tpu.core import shard_rows
+    from dask_ml_tpu.metrics import euclidean_distances
+
+    r = np.random.RandomState(seed)
+    X = r.normal(size=(n1, d)).astype(np.float32)
+    Y = r.normal(size=(n2, d)).astype(np.float32)
+    ours = np.asarray(euclidean_distances(shard_rows(X), shard_rows(Y)))
+    np.testing.assert_allclose(ours, sk_euc(X, Y), rtol=1e-3, atol=1e-4)
