@@ -323,3 +323,27 @@ def test_ring_pairwise_any_shapes(n1, n2, d, seed):
     Y = r.normal(size=(n2, d)).astype(np.float32)
     ours = np.asarray(euclidean_distances(shard_rows(X), shard_rows(Y)))
     np.testing.assert_allclose(ours, sk_euc(X, Y), rtol=1e-3, atol=1e-4)
+
+
+@settings(max_examples=15, deadline=None)
+@given(st.integers(10, 200), st.integers(1, 9), st.integers(0, 2**16))
+def test_tsqr_orthonormal_reconstructs(n, d, seed):
+    """TSQR on ANY tall shape (odd row counts, non-divisible shards):
+    Q^T Q = I, X = Q R, R upper-triangular."""
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.core import shard_rows, unshard
+    from dask_ml_tpu.linalg.tsqr import tsqr
+
+    if n < d:
+        n = d + 10
+    r = np.random.RandomState(seed)
+    X = r.normal(size=(n, d)).astype(np.float32)
+    s = shard_rows(X)
+    q, rr = tsqr(s)
+    qh = np.asarray(q)[: n]  # unpad rows
+    rr = np.asarray(rr)
+    np.testing.assert_allclose(qh.T @ qh, np.eye(d), atol=5e-4)
+    np.testing.assert_allclose(qh @ rr, X, atol=5e-4)
+    # upper-triangular up to fp noise
+    assert np.abs(np.tril(rr, -1)).max() < 1e-4
