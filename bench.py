@@ -85,22 +85,32 @@ _RUN_ID = f"{os.getpid()}-{int(_START_TS)}"
 
 
 def _load_prior_partial():
-    """Entries persisted by PREVIOUS bench runs (this run's are live)."""
+    """Entries persisted by PREVIOUS bench runs (this run's are live).
+
+    Reads the append log plus the git-TRACKED chip-evidence snapshot
+    (bench_chip_evidence.jsonl) so a cleaned workspace cannot erase chip
+    numbers; entries are sorted by their recorded ``ts`` so the merge's
+    newest-first pass is order-independent across files (a stale partial
+    log must not shadow newer committed evidence, or vice versa)."""
     prior = []
-    try:
-        with open(_PARTIAL_PATH) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if rec.get("run_id") != _RUN_ID:
-                    prior.append(rec)
-    except OSError:
-        pass
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in (os.path.join(here, "bench_chip_evidence.jsonl"),
+                 _PARTIAL_PATH):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("run_id") != _RUN_ID:
+                        prior.append(rec)
+        except OSError:
+            pass
+    prior.sort(key=lambda r: r.get("ts", 0.0))
     return prior
 
 
