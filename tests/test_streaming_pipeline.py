@@ -251,3 +251,59 @@ class TestStreamedBlocksFit:
         assert not np.allclose(
             np.asarray(b[0][0].data), np.asarray(b[1][0].data)
         )
+
+
+class TestKitchenSinkPipeline:
+    """The realistic dask-ml user journey end to end: pandas DataFrame →
+    Categorizer → DummyEncoder → StandardScaler → LogisticRegression,
+    searched with GridSearchCV — every stage a dask_ml_tpu component."""
+
+    def test_dataframe_to_glm_grid_search(self, rng):
+        import pandas as pd
+        from sklearn.pipeline import Pipeline
+
+        from dask_ml_tpu.linear_model import LogisticRegression
+        from dask_ml_tpu.model_selection import GridSearchCV
+        from dask_ml_tpu.preprocessing import (
+            Categorizer,
+            DummyEncoder,
+            StandardScaler,
+        )
+
+        n = 400
+        city = rng.choice(["nyc", "sf", "tok"], size=n)
+        xnum = rng.normal(size=n).astype(np.float32)
+        # signal: city=sf shifts the decision strongly
+        logits = 2.0 * xnum + 3.0 * (city == "sf") - 1.0
+        y = (logits + 0.3 * rng.normal(size=n) > 0).astype(int)
+        df = pd.DataFrame({"city": city, "xnum": xnum})
+
+        class ToFloat32:
+            """pandas → float32 array at the device boundary."""
+
+            def fit(self, X, y=None):
+                return self
+
+            def transform(self, X):
+                return np.asarray(X, dtype=np.float32)
+
+            def fit_transform(self, X, y=None):
+                return self.transform(X)
+
+            def get_params(self, deep=True):
+                return {}
+
+            def set_params(self, **kw):
+                return self
+
+        pipe = Pipeline([
+            ("cat", Categorizer()),
+            ("dum", DummyEncoder()),
+            ("asf", ToFloat32()),
+            ("sc", StandardScaler()),
+            ("clf", LogisticRegression(max_iter=60)),
+        ])
+        gs = GridSearchCV(pipe, {"clf__C": [0.1, 1.0, 10.0]}, cv=3).fit(df, y)
+        assert gs.best_score_ > 0.85
+        pred = np.asarray(gs.predict(df))
+        assert (pred == y).mean() > 0.85
