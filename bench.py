@@ -223,6 +223,85 @@ def _compact_partial():
         pass
 
 
+_FULL_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_FULL.json"
+)
+
+# One number per workload on the compact line, first match wins.
+_HEADLINE_KEYS = (
+    "rows_per_s", "per_round_ms", "per_eval_ms", "per_qr_ms",
+    "per_step_ms", "parse_mb_s", "packed_speedup", "speedup",
+)
+
+
+def _compact_line(result):
+    """Final stdout line guaranteed to fit the driver's 2000-char stdout
+    tail (round-3 postmortem: the full JSON outgrew the tail and the
+    round's official record became an unparseable truncated string —
+    BENCH_r03.json :: parsed == null).  The FULL payload is written to
+    BENCH_FULL.json; this line carries the headline metric plus one
+    number per workload."""
+    extra = result.get("extra", {})
+    ws = []
+    for w in extra.get("workloads", []):
+        ent = {"w": w.get("workload"),
+               "p": w.get("platform", extra.get("platform"))}
+        for k in _HEADLINE_KEYS:
+            if k in w:
+                ent[k] = w[k]
+                break
+        if w.get("from_partial"):
+            ent["carried"] = True
+        ws.append(ent)
+    compact = {
+        "metric": result.get("metric"),
+        "value": result.get("value"),
+        "unit": result.get("unit"),
+        "vs_baseline": result.get("vs_baseline"),
+        "extra": {
+            "platform": extra.get("platform"),
+            "n_devices": extra.get("n_devices"),
+            "timed_out": extra.get("timed_out", False),
+            "headline_platform": extra.get("headline_platform"),
+            "full_payload": "BENCH_FULL.json",
+            "workloads": ws,
+        },
+    }
+    if extra.get("full_payload_write_failed"):
+        compact["extra"]["full_payload_write_failed"] = True
+    line = json.dumps(compact)
+    while len(line) > 1900 and ws:
+        ws.pop()
+        compact["extra"]["workloads_truncated"] = True
+        line = json.dumps(compact)
+    return line
+
+
+def _emit_final(result):
+    """Write the full payload to BENCH_FULL.json (temp + rename, so a
+    kill or ENOSPC mid-write cannot leave a truncated file masquerading
+    as this run's record), then print the compact line — flagged if the
+    full write failed, so the pointer is never silently stale."""
+    tmp = _FULL_PATH + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, _FULL_PATH)
+    except Exception:
+        result.setdefault("extra", {})["full_payload_write_failed"] = True
+    try:
+        print(_compact_line(result), flush=True)
+    except Exception:
+        print(json.dumps({"metric": result.get("metric", "bench"),
+                          "value": result.get("value", 0.0),
+                          "unit": result.get("unit", ""),
+                          "vs_baseline": result.get("vs_baseline", 0.0),
+                          "extra": {"emit_error": True}}), flush=True)
+
+
 def _emit_and_exit():
     # every step guarded: this runs in the watchdog thread while the main
     # thread may be mutating _RESULT['extra'] mid-dict-insert — an
@@ -237,7 +316,7 @@ def _emit_and_exit():
         try:
             import copy
 
-            print(json.dumps(copy.deepcopy(_RESULT)), flush=True)
+            _emit_final(copy.deepcopy(_RESULT))
             break
         except Exception:
             time.sleep(0.05)
@@ -328,7 +407,7 @@ def main():
             _merge_and_finalize()
         except Exception:
             pass
-        print(json.dumps(result))
+        _emit_final(result)
         return
 
     import numpy as np
@@ -959,7 +1038,7 @@ def main():
         _merge_and_finalize()
     except Exception:
         extra["merge_error"] = traceback.format_exc(limit=2)
-    print(json.dumps(result))
+    _emit_final(result)
     try:
         _compact_partial()
     except Exception:

@@ -265,7 +265,9 @@ class SpectralClustering(TPUEstimator):
                 tile = self.affinity
             elif self.affinity == "rbf":
                 g = self.gamma if self.gamma is not None else 1.0 / X.data.shape[1]
-                tile = pw._BoundTile(pw._rbf_tile, gamma=float(g))
+                # X-vs-X self ring: _SelfTile pins the exact diagonal so
+                # the cancellation guard never fires on self-pairs
+                tile = pw._SelfTile("rbf", gamma=float(g))
             elif self.affinity == "polynomial":
                 g = self.gamma if self.gamma is not None else 1.0 / X.data.shape[1]
                 tile = pw._BoundTile(

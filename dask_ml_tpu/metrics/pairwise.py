@@ -61,8 +61,13 @@ def _ring_impl(x, y, *, mesh_holder, fn):
 
         def body(carry, s):
             y_cur, out = carry
-            tile = fn(x_l, y_cur)  # (n_l, m_l) — local MXU gemm
             col = ((i - s) % n_shards) * m_l  # block y_cur came from
+            if getattr(fn, "takes_offsets", False):
+                # offset-aware tiles (X-vs-X self rings) get GLOBAL row/col
+                # offsets so they can pin exact self-pairs on the diagonal
+                tile = fn(x_l, y_cur, i * x_l.shape[0], col)
+            else:
+                tile = fn(x_l, y_cur)  # (n_l, m_l) — local MXU gemm
             out = jax.lax.dynamic_update_slice(out, tile, (0, col))
             y_cur = jax.lax.ppermute(y_cur, DATA_AXIS, perm)
             return (y_cur, out), None
@@ -99,6 +104,85 @@ def _sq_euclidean(x, y, precision=None):
     return jnp.maximum(d2, 0.0)
 
 
+# Entries with d² below _SAFE_TAU·(‖x‖²+‖y‖²) are recomputed with the
+# exact (x−y)² form: the ‖x‖²+‖y‖²−2x·y expansion carries absolute error
+# ~c·eps32·(‖x‖²+‖y‖²) (c grows like the accumulation depth), so for
+# near-duplicate rows the cancellation error dominates the true distance
+# — sqrt(d²) can come out ~1e-3 when the truth is 1e-6.  τ=1e-2 keeps
+# the post-sqrt relative error of UNflagged entries under ~1e-4·√d.
+_SAFE_TAU = 1e-2
+
+
+def _row_chunked(x, y, tile_fn):
+    """Apply ``tile_fn(x_chunk, y) -> (chunk, m)`` over row chunks of x,
+    bounding the (chunk, m, d) broadcast cube to ~64MB instead of
+    materializing (n, m, d).  Shared by the L1 tile and the exact
+    euclidean recompute."""
+    m, d = y.shape[0], x.shape[1]
+    n = x.shape[0]
+    if n == 0 or m == 0:
+        return jnp.zeros((n, m), dtype=x.dtype)
+    chunk = max(int(16_000_000 / max(m * d, 1)), 1)
+    chunk = min(chunk, n)
+    pad = (-n) % chunk
+    xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+    # lax.map (not a Python loop) keeps the traced graph O(1) in the
+    # chunk count — at (50k x 50k x 128) the chunk count is ~25,000 and
+    # an unrolled loop would explode compile time/memory.
+    out = jax.lax.map(
+        lambda xb: tile_fn(xb, y), xp.reshape(-1, chunk, d)
+    )
+    return out.reshape(-1, m)[:n]
+
+
+def _exact_sq_chunked(x, y, d2, flagged):
+    """Replace flagged entries of d2 with the exact Σ(x−y)² form."""
+    ex = _row_chunked(
+        x, y,
+        lambda xb, yy: jnp.sum((xb[:, None, :] - yy[None, :, :]) ** 2,
+                               axis=-1),
+    )
+    return jnp.where(flagged, ex, d2)
+
+
+@partial(jax.jit, static_argnames=("self_pairs",))
+def _sq_euclidean_safe(x, y, row0=0, col0=0, self_pairs=False):
+    """Cancellation-guarded squared distances for VALUE consumers
+    (``euclidean_distances``, ``rbf_kernel``): gemm expansion at HIGHEST
+    precision, then an exact recompute of any tile whose entries fall in
+    the cancellation regime (the sklearn float32 mitigation, done the XLA
+    way: ``lax.cond`` skips the exact pass entirely when no entry is
+    flagged, so well-separated data keeps pure-MXU speed).  ARGMIN
+    consumers keep ``_sq_euclidean_hi`` — a wrong small distance cannot
+    flip an argmin between near-duplicates.
+
+    ``self_pairs=True`` declares x and y to be row blocks of THE SAME
+    matrix, with ``row0``/``col0`` their global offsets (0 for the
+    replicated Y=None call; ring steps pass their block offsets): the
+    global diagonal is pinned to exactly 0 and excluded from flagging,
+    so self-distance calls keep the gemm fast path instead of always
+    tripping the d²≈0 diagonal."""
+    x_norm = jnp.sum(x * x, axis=1, keepdims=True)
+    y_norm = jnp.sum(y * y, axis=1, keepdims=True).T
+    scale = x_norm + y_norm
+    d2 = scale - 2.0 * jnp.dot(x, y.T, precision=jax.lax.Precision.HIGHEST)
+    d2 = jnp.maximum(d2, 0.0)
+    if x.shape[0] == 0 or y.shape[0] == 0:
+        return d2
+    flagged = d2 < _SAFE_TAU * scale
+    if self_pairs:
+        ii = row0 + jax.lax.broadcasted_iota(jnp.int32, d2.shape, 0)
+        jj = col0 + jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
+        diag = ii == jj
+        d2 = jnp.where(diag, 0.0, d2)
+        flagged = flagged & ~diag
+    return jax.lax.cond(
+        jnp.any(flagged),
+        lambda: _exact_sq_chunked(x, y, d2, flagged),
+        lambda: d2,
+    )
+
+
 def _sq_euclidean_hi(x, y):
     """HIGHEST-precision distances for ARGMIN consumers (KMeans
     assignment, kNN graphs, argmin_min): the TPU MXU's default precision
@@ -108,28 +192,17 @@ def _sq_euclidean_hi(x, y):
     return _sq_euclidean(x, y, precision=jax.lax.Precision.HIGHEST)
 
 def _euclid_tile(x, y):
-    return jnp.sqrt(_sq_euclidean(x, y))
+    return jnp.sqrt(_sq_euclidean_safe(x, y))
 
 
 def _manhattan_tile(x, y):
-    """L1 distances, chunked over rows of x: |x-y| has no gemm form, so
-    the (tile, m, d) broadcast is bounded to ~64MB per chunk instead of
-    materializing the full (n, m, d) cube."""
-    m = y.shape[0]
-    d = x.shape[1]
-    chunk = max(int(16_000_000 / max(m * d, 1)), 1)
-    chunk = min(chunk, max(x.shape[0], 1))  # never pad past the real rows
-
-    def one(lo):
-        xb = jax.lax.dynamic_slice_in_dim(x, lo, chunk)
-        return jnp.sum(jnp.abs(xb[:, None, :] - y[None, :, :]), axis=-1)
-
-    n = x.shape[0]
-    pad = (-n) % chunk
-    if pad:
-        x = jnp.pad(x, ((0, pad), (0, 0)))
-    outs = [one(lo) for lo in range(0, x.shape[0], chunk)]
-    return jnp.concatenate(outs, axis=0)[:n]
+    """L1 distances: |x-y| has no gemm form, so go through the bounded
+    row-chunked broadcast."""
+    return _row_chunked(
+        x, y,
+        lambda xb, yy: jnp.sum(jnp.abs(xb[:, None, :] - yy[None, :, :]),
+                               axis=-1),
+    )
 
 
 def _cosine_tile(x, y):
@@ -142,12 +215,14 @@ def euclidean_distances(X, Y=None, squared: bool = False):
     """Row-sharded ‖x−y‖ distances (reference ``euclidean_distances``).
     Sharded×sharded inputs route through the ppermute ring."""
     if Y is not None and _both_sharded(X, Y):
-        return ring_pairwise(
-            X, Y, _sq_euclidean if squared else _euclid_tile
-        )
+        if Y is X:  # self ring: pin the global diagonal
+            tile = _SelfTile("sq" if squared else "euclid")
+        else:
+            tile = _sq_euclidean_safe if squared else _euclid_tile
+        return ring_pairwise(X, Y, tile)
     x, n = _data_of(X)
     y, m = (x, n) if Y is None else _data_of(Y)
-    d2 = _sq_euclidean(x, y)
+    d2 = _sq_euclidean_safe(x, y, self_pairs=Y is None)
     out = d2 if squared else jnp.sqrt(d2)
     return out[:n, :m]
 
@@ -251,19 +326,54 @@ class _BoundTile:
         )
 
 
+class _SelfTile:
+    """Offset-aware ring tile for X-vs-X calls (``takes_offsets``
+    protocol in ``_ring_impl``): routes through ``_sq_euclidean_safe``
+    with global offsets so exact self-pairs on the diagonal are pinned
+    to 0 and never trip the cancellation recompute.  Hashable by value
+    like ``_BoundTile`` so the compiled ring caches per (post, params)."""
+
+    takes_offsets = True
+
+    def __init__(self, post, **params):
+        self.post = post  # 'sq' | 'euclid' | 'rbf'
+        self.params = tuple(sorted(params.items()))
+
+    def __call__(self, x, y, row0, col0):
+        d2 = _sq_euclidean_safe(x, y, row0, col0, self_pairs=True)
+        if self.post == "sq":
+            return d2
+        if self.post == "euclid":
+            return jnp.sqrt(d2)
+        return jnp.exp(-dict(self.params)["gamma"] * d2)
+
+    def __hash__(self):
+        return hash((type(self), self.post, self.params))
+
+    def __eq__(self, other):
+        return (
+            type(other) is _SelfTile
+            and other.post == self.post
+            and other.params == self.params
+        )
+
+
 def _rbf_tile(x, y, gamma):
-    return jnp.exp(-gamma * _sq_euclidean(x, y))
+    return jnp.exp(-gamma * _sq_euclidean_safe(x, y))
 
 
 def rbf_kernel(X, Y=None, gamma=None):
     if Y is not None and _both_sharded(X, Y):
         g = 1.0 / X.data.shape[1] if gamma is None else gamma
-        return ring_pairwise(X, Y, _BoundTile(_rbf_tile, gamma=float(g)))
+        tile = (_SelfTile("rbf", gamma=float(g)) if Y is X
+                else _BoundTile(_rbf_tile, gamma=float(g)))
+        return ring_pairwise(X, Y, tile)
     x, n = _data_of(X)
     y, m = (x, n) if Y is None else _data_of(Y)
     if gamma is None:
         gamma = 1.0 / x.shape[1]
-    return jnp.exp(-gamma * _sq_euclidean(x, y))[:n, :m]
+    d2 = _sq_euclidean_safe(x, y, self_pairs=Y is None)
+    return jnp.exp(-gamma * d2)[:n, :m]
 
 
 def sigmoid_kernel(X, Y=None, gamma=None, coef0: float = 1.0):
