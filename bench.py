@@ -116,6 +116,11 @@ def _load_prior_partial():
 
 _PRIOR = _load_prior_partial()
 
+# Workload names whose definition/units changed; their old records must
+# not be carried forward next to the redefined entry (r4: csv parse_mb_s
+# went from output-array bytes/s to file-text bytes/s with a new size).
+_RETIRED_WORKLOADS = {"csv_ingest_200000x32", "csv_ingest_50000x32"}
+
 
 def _persist(rec):
     rec = dict(rec)
@@ -155,6 +160,8 @@ def _merge_and_finalize():
                     extra.setdefault("carried_extra", {}).setdefault(k, v)
             continue
         name = rec.get("workload")
+        if name in _RETIRED_WORKLOADS:
+            continue
         if name and name not in have:
             carried = {k: v for k, v in rec.items() if k != "run_id"}
             carried["from_partial"] = True
@@ -200,6 +207,8 @@ def _compact_partial():
         # same chip-only policy for extras as for workloads: a
         # CPU-measured speedup ratio must not masquerade as chip evidence
         if rec.get("platform") in (None, "cpu"):
+            continue
+        if rec.get("workload") in _RETIRED_WORKLOADS:
             continue
         if "_extra" in rec:
             key = ("_extra", tuple(sorted(rec["_extra"])))
@@ -1004,20 +1013,35 @@ def main():
 
             from dask_ml_tpu.io import stream_csv_blocks
 
-            rows_csv, dcsv = (200_000, 32) if on_tpu else (50_000, 32)
-            arr = rng.rand(rows_csv, dcsv).astype(np.float32)
+            # ~300MB of realistic float text (a formatted block repeated)
+            # so parse throughput is sustained, not startup-dominated —
+            # the r3 number (40 MB/s on a 12MB file) was mostly open+
+            # index cost.  Throughput is FILE TEXT MB/s (what a parser
+            # is judged on), not output-array bytes.
+            dcsv = 32
+            block_arr = rng.rand(2000, dcsv).astype(np.float32)
+            block_txt = "\n".join(
+                ",".join(f"{v:.6g}" for v in row) for row in block_arr
+            ) + "\n"
+            target_bytes = int(300e6)
+            reps = max(1, target_bytes // len(block_txt))
+            rows_csv = 2000 * reps
             with tempfile.NamedTemporaryFile(
                 suffix=".csv", delete=False
             ) as f:
                 csv_path = f.name
             try:
-                pd.DataFrame(arr).to_csv(
-                    csv_path, index=False, header=False)
-                t0 = time.perf_counter()
-                n_parsed = 0
-                for blk in stream_csv_blocks(csv_path, 16384):
-                    n_parsed += blk.shape[0]
-                dt = time.perf_counter() - t0
+                with open(csv_path, "w") as f:
+                    for _ in range(reps):
+                        f.write(block_txt)
+                file_bytes = os.path.getsize(csv_path)
+                best_dt, n_parsed = float("inf"), 0
+                for _ in range(2):  # 2nd pass = warm page cache
+                    t0 = time.perf_counter()
+                    n_parsed = 0
+                    for blk in stream_csv_blocks(csv_path, 65536):
+                        n_parsed += blk.shape[0]
+                    best_dt = min(best_dt, time.perf_counter() - t0)
             finally:
                 try:
                     os.unlink(csv_path)
@@ -1025,9 +1049,10 @@ def main():
                     pass
             _record({
                 "workload": f"csv_ingest_{rows_csv}x{dcsv}",
-                "rows_per_s": round(n_parsed / max(dt, 1e-9), 1),
+                "file_mb": round(file_bytes / 1e6, 1),
+                "rows_per_s": round(n_parsed / max(best_dt, 1e-9), 1),
                 "parse_mb_s": round(
-                    n_parsed * dcsv * 4 / max(dt, 1e-9) / 1e6, 1),
+                    file_bytes / max(best_dt, 1e-9) / 1e6, 1),
             })
     except Exception:
         extra["csv_error"] = traceback.format_exc(limit=3)

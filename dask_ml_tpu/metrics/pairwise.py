@@ -187,8 +187,10 @@ def _sq_euclidean_hi(x, y):
     """HIGHEST-precision distances for ARGMIN consumers (KMeans
     assignment, kNN graphs, argmin_min): the TPU MXU's default precision
     truncates fp32 operands to bf16, flipping labels near cluster
-    boundaries.  Kernel consumers (rbf/exp, sqrt outputs) keep the fast
-    default — their outputs are smooth in the distance."""
+    boundaries.  VALUE consumers (``euclidean_distances``,
+    ``rbf_kernel``) route through ``_sq_euclidean_safe`` instead, which
+    is also HIGHEST plus a cancellation guard; only internal hot loops
+    that tolerate bf16 error (e.g. solver gemms) use the fast default."""
     return _sq_euclidean(x, y, precision=jax.lax.Precision.HIGHEST)
 
 def _euclid_tile(x, y):

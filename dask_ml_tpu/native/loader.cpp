@@ -66,14 +66,19 @@ int read_file(const char* path, FileBuf& buf) {
     return 0;
 }
 
-// Offsets of line starts for every non-empty line.
+// Offsets of line starts for every non-empty line.  memchr (SIMD in
+// libc) instead of a byte loop: the index scan is ~5% of parse time on
+// a 60MB file with the fast field parser, and this makes it ~free.
 void line_starts(const FileBuf& buf, std::vector<size_t>& starts) {
-    size_t i = 0;
     const size_t n = buf.size;
+    // reserve from an estimated line length to avoid regrowth copies
+    starts.reserve(n / 32 + 16);
+    size_t i = 0;
     while (i < n) {
         starts.push_back(i);
-        while (i < n && buf.data[i] != '\n') i++;
-        i++;  // past '\n'
+        const char* nl = static_cast<const char*>(
+            std::memchr(buf.data + i, '\n', n - i));
+        i = nl ? static_cast<size_t>(nl - buf.data) + 1 : n;
         // swallow blank trailing lines
         while (i < n && (buf.data[i] == '\n' || buf.data[i] == '\r')) i++;
     }
@@ -84,6 +89,86 @@ long count_cols(const char* line, const char* end) {
     for (const char* p = line; p < end && *p != '\n'; p++)
         if (*p == ',') cols++;
     return cols;
+}
+
+// Powers of ten exactly representable in double (10^0..10^22).
+const double kPow10[] = {
+    1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,  1e8,  1e9,  1e10,
+    1e11, 1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21,
+    1e22,
+};
+
+// Fast decimal float parse (Clinger's fast path): uint64 mantissa plus a
+// power-of-ten scale, both exact in double, one multiply/divide, cast to
+// float.  strtof is locale-aware and ~100 MB/s; this path parses typical
+// numeric CSV at several hundred MB/s on one core — which matters here
+// because the deploy host exposes a SINGLE core (nproc=1), so the thread
+// fan-out can't buy anything.  Returns false (caller falls back to
+// strtof) on: >19 significant digits, |decimal exponent| > 22 after
+// fraction adjustment, mantissa >= 2^53, or non-numeric forms
+// (inf/nan/hex).  The double is correctly rounded, so the float cast is
+// within 1 ulp of strtof (double-rounding ties), which is below the
+// noise floor of float32 CSV round-trips.
+inline bool parse_f32_fast(const char*& p, const char* eol, float* out) {
+    const char* s = p;
+    bool neg = false;
+    if (s < eol && (*s == '+' || *s == '-')) {
+        neg = (*s == '-');
+        s++;
+    }
+    uint64_t mant = 0;
+    int digs = 0, frac_digits = 0;
+    bool any = false;
+    while (s < eol && *s >= '0' && *s <= '9') {
+        if (++digs > 19) return false;
+        mant = mant * 10 + static_cast<uint64_t>(*s - '0');
+        any = true;
+        s++;
+    }
+    if (s < eol && *s == '.') {
+        s++;
+        while (s < eol && *s >= '0' && *s <= '9') {
+            if (++digs > 19) return false;
+            mant = mant * 10 + static_cast<uint64_t>(*s - '0');
+            frac_digits++;
+            any = true;
+            s++;
+        }
+    }
+    if (!any) return false;
+    // "0x1A" / "0X..": the bare-zero mantissa parsed so far is really a
+    // hex prefix — punt to strtof rather than return 0 and strand p at 'x'
+    if (s < eol && (*s == 'x' || *s == 'X')) return false;
+    int exp10 = -frac_digits;
+    if (s < eol && (*s == 'e' || *s == 'E')) {
+        s++;
+        bool eneg = false;
+        if (s < eol && (*s == '+' || *s == '-')) {
+            eneg = (*s == '-');
+            s++;
+        }
+        int e = 0;
+        bool eany = false;
+        while (s < eol && *s >= '0' && *s <= '9') {
+            if (e < 1000) e = e * 10 + (*s - '0');
+            eany = true;
+            s++;
+        }
+        if (!eany) return false;
+        exp10 += eneg ? -e : e;
+    }
+    if (mant >> 53) return false;
+    double v;
+    if (exp10 >= 0) {
+        if (exp10 > 22) return false;
+        v = static_cast<double>(mant) * kPow10[exp10];
+    } else {
+        if (exp10 < -22) return false;
+        v = static_cast<double>(mant) / kPow10[-exp10];
+    }
+    *out = static_cast<float>(neg ? -v : v);
+    p = s;
+    return true;
 }
 
 // Parse rows [r0, r1) into out (already offset by caller).  Each field
@@ -106,13 +191,15 @@ void parse_rows(const FileBuf& buf, const std::vector<size_t>& starts,
                 *err = -EINVAL;
                 return;
             }
-            char* next = nullptr;
-            row[c] = std::strtof(p, &next);
-            if (next == p || next > eol) {  // malformed field or ran past line
-                *err = -EINVAL;
-                return;
+            if (!parse_f32_fast(p, eol, &row[c])) {
+                char* next = nullptr;
+                row[c] = std::strtof(p, &next);
+                if (next == p || next > eol) {  // malformed or ran past line
+                    *err = -EINVAL;
+                    return;
+                }
+                p = next;
             }
-            p = next;
         }
         while (p < eol && (*p == ',' || *p == ' ' || *p == '\t' || *p == '\r')) p++;
         if (p < eol) {  // trailing junk / extra fields

@@ -172,3 +172,65 @@ class TestNativeStreamSession:
         p.write_text("1.0,2.0\n")
         with pytest.raises(ValueError, match="block_rows"):
             next(dio.stream_csv_blocks(str(p), 0))
+
+
+class TestFastFloatParse:
+    """The C++ fast field parser (Clinger fast path) must agree with
+    Python's float() across the decimal forms numeric CSV actually
+    contains, and fall back cleanly on the forms it rejects."""
+
+    def test_adversarial_forms(self, tmp_path):
+        fields = [
+            "0", "-0", "1", "-1", "0.5", "-.5", "+.25", "3.", "1e0",
+            "1E5", "-2.5e-3", "6.02214076e23", "1e-22", "9.999999e21",
+            # fallback territory: >19 digits, big exponents, inf/nan
+            "123456789012345678901234567890", "1e300", "1e-300",
+            "-1.7976931348623157e308", "4.9e-324", "inf", "-inf", "nan",
+            "0.000000000000000000001", "1234567.1234567890123",
+            # hex floats: the fast path must punt these to strtof whole
+            "0x1A", "-0X2p1", "0x0.8p1", "7", "8", "9",
+        ]
+        assert len(fields) % 5 == 0
+        rows = [fields[i:i + 5] for i in range(0, len(fields), 5)]
+        txt = "\n".join(",".join(r) for r in rows) + "\n"
+        p = tmp_path / "adv.csv"
+        p.write_text(txt)
+        out = dio.read_csv(str(p))
+
+        def pyfloat(v):
+            try:
+                return float(v)
+            except ValueError:  # hex floats: Python needs fromhex
+                return float.fromhex(v)
+
+        expect = np.array(
+            [[np.float32(pyfloat(v)) for v in r] for r in rows],
+            dtype=np.float32)
+        np.testing.assert_array_equal(
+            np.nan_to_num(out, nan=12345.0),
+            np.nan_to_num(expect, nan=12345.0))
+
+    def test_random_float_roundtrip_property(self, tmp_path):
+        # float32 values formatted the ways writers actually format them
+        r = np.random.RandomState(3)
+        vals = np.concatenate([
+            r.normal(scale=10.0 ** r.randint(-20, 20, 500), size=500),
+            r.rand(500), np.zeros(10),
+        ]).astype(np.float32)
+        vals = vals[: (len(vals) // 4) * 4].reshape(-1, 4)
+        for fmt in ("%.6g", "%.9g", "%r", "%.17g"):
+            p = tmp_path / "r.csv"
+            if fmt == "%r":
+                txt = "\n".join(
+                    ",".join(repr(float(v)) for v in row) for row in vals)
+            else:
+                txt = "\n".join(
+                    ",".join(fmt % v for v in row) for row in vals)
+            p.write_text(txt + "\n")
+            out = dio.read_csv(str(p))
+            if fmt in ("%r", "%.9g", "%.17g"):
+                # enough digits to round-trip float32 exactly
+                np.testing.assert_array_equal(out, vals, err_msg=fmt)
+            else:
+                np.testing.assert_allclose(out, vals, rtol=1e-5,
+                                           err_msg=fmt)
