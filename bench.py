@@ -119,7 +119,8 @@ _PRIOR = _load_prior_partial()
 # Workload names whose definition/units changed; their old records must
 # not be carried forward next to the redefined entry (r4: csv parse_mb_s
 # went from output-array bytes/s to file-text bytes/s with a new size).
-_RETIRED_WORKLOADS = {"csv_ingest_200000x32", "csv_ingest_50000x32"}
+_RETIRED_WORKLOADS = {"csv_ingest_200000x32", "csv_ingest_50000x32",
+                      "csv_ingest_1040000x32"}
 
 
 def _persist(rec):
@@ -1009,8 +1010,6 @@ def main():
         if _want("csv") and time.time() - _START_TS < _BUDGET_S * 0.95:
             import tempfile
 
-            import pandas as pd
-
             from dask_ml_tpu.io import stream_csv_blocks
 
             # ~300MB of realistic float text (a formatted block repeated)
@@ -1019,7 +1018,11 @@ def main():
             # index cost.  Throughput is FILE TEXT MB/s (what a parser
             # is judged on), not output-array bytes.
             dcsv = 32
-            block_arr = rng.rand(2000, dcsv).astype(np.float32)
+            # own RandomState: the shared rng's state depends on which
+            # earlier sections ran, and the workload NAME must be stable
+            # across filtered/full runs or carry-forward mints duplicates
+            block_arr = np.random.RandomState(42).rand(
+                2000, dcsv).astype(np.float32)
             block_txt = "\n".join(
                 ",".join(f"{v:.6g}" for v in row) for row in block_arr
             ) + "\n"
@@ -1048,7 +1051,8 @@ def main():
                 except OSError:
                     pass
             _record({
-                "workload": f"csv_ingest_{rows_csv}x{dcsv}",
+                "workload": f"csv_ingest_300mb_x{dcsv}",
+                "n_rows": rows_csv,
                 "file_mb": round(file_bytes / 1e6, 1),
                 "rows_per_s": round(n_parsed / max(best_dt, 1e-9), 1),
                 "parse_mb_s": round(

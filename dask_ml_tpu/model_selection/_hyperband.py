@@ -12,6 +12,12 @@ multi-controller-legal form for a multi-process (multi-host) mesh, where
 thread-concurrent brackets would emit collectives in different orders on
 different processes and deadlock (``core/distributed.py``).  Concurrent
 brackets on a multi-process group are rejected with a clear error.
+
+This split is deliberate, not a TODO: bracket rounds serialize on the
+device mesh regardless of host-side concurrency (one SPMD program at a
+time), so concurrency only buys host/device overlap — measured at 1.53×
+wall on a single controller and shrinking with scale.  See
+docs/design.md §4 ("Pod-scale Hyperband") for the numbers.
 """
 
 from __future__ import annotations
