@@ -15,6 +15,7 @@ final weighted k-means++ runs exactly as the reference does it.
 from __future__ import annotations
 
 import logging
+from functools import partial as _fpartial
 
 import numpy as np
 
@@ -43,17 +44,46 @@ def _ingest_float(est, X):
 
 
 # the one squared-distance kernel, shared with metrics.pairwise
+from ..metrics.pairwise import _sq_euclidean  # noqa: E402
 from ..metrics.pairwise import _sq_euclidean_hi as _sq_dists  # noqa: E402
 
 
-@jax.jit
-def _lloyd_step(x, mask, centers):
+def _kmeans_mode() -> str:
+    """Precision mode for the Lloyd round, ``DASK_ML_TPU_KMEANS_PRECISION``:
+
+    - ``highest`` (default): HIGHEST-precision gemms — assignment and
+      sums bit-comparable to the fp32 reference.
+    - ``fast``: cross term at ``Precision.HIGH`` (3 bf16 passes, error
+      ~2⁻²² vs fp32's 2⁻²⁴) and the per-cluster reduce as a 3-pass
+      bf16-split gemm (both operands split: the one-hot side carries the
+      sample-weight mask).  6 MXU passes per round instead of 12; on
+      MXU-bound shapes (k ≥ ~32) this can halve round time at
+      k-means-irrelevant precision cost.  The bench adjudicates both;
+      see ops/lloyd.py for the traffic model.
+    """
+    import os
+
+    v = os.environ.get("DASK_ML_TPU_KMEANS_PRECISION", "highest").lower()
+    if v not in ("highest", "fast"):
+        raise ValueError(
+            f"DASK_ML_TPU_KMEANS_PRECISION must be 'highest' or 'fast', "
+            f"got {v!r}"
+        )
+    return v
+
+
+@_fpartial(jax.jit, static_argnames=("mode",))
+def _lloyd_step(x, mask, centers, mode="highest"):
     """One Lloyd round: assign, reduce per-cluster sums/counts, update.
 
     Returns (new_centers, inertia, shift).  Everything is gemm-shaped; with
-    sharded x the per-cluster reductions become ICI psums.
+    sharded x the per-cluster reductions become ICI psums.  ``mode`` is
+    static (see ``_kmeans_mode``).
     """
-    d2 = _sq_dists(x, centers)
+    if mode == "fast":
+        d2 = _sq_euclidean(x, centers, precision=jax.lax.Precision.HIGH)
+    else:
+        d2 = _sq_dists(x, centers)
     labels = jnp.argmin(d2, axis=1)
     # jnp.min selects the SAME element as d2[argmin] but lowers to a fused
     # reduce; a take_along_axis gather here costs ~14 ms/round on a v5e
@@ -62,9 +92,29 @@ def _lloyd_step(x, mask, centers):
     min_d2 = jnp.min(d2, axis=1)
     inertia = jnp.sum(min_d2 * mask)
     onehot = jax.nn.one_hot(labels, centers.shape[0], dtype=x.dtype) * mask[:, None]
-    # HIGHEST to match the Pallas kernel's psums gemm: centers feed the
-    # next round's argmin, so both TPU paths must accumulate identically
-    sums = jnp.dot(onehot.T, x, precision=jax.lax.Precision.HIGHEST)  # (k, d)
+    if mode == "fast":
+        # the one-hot operand carries the sample-weight mask (not
+        # bf16-exact), so BOTH operands get the hi+lo split — same
+        # decomposition as the Pallas kernel (ops.lloyd._split_bf16)
+        from ..ops.lloyd import _split_bf16
+
+        oh_hi, oh_lo = _split_bf16(onehot)
+        x_hi, x_lo = _split_bf16(x)
+
+        def _dot32(a, b):
+            return jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+        sums = (
+            _dot32(oh_hi.T, x_hi)
+            + _dot32(oh_hi.T, x_lo)
+            + _dot32(oh_lo.T, x_hi)
+        )
+    else:
+        # HIGHEST to match the Pallas kernel's psums gemm: centers feed
+        # the next round's argmin, so both TPU paths must accumulate
+        # identically
+        sums = jnp.dot(onehot.T, x,
+                       precision=jax.lax.Precision.HIGHEST)  # (k, d)
     counts = jnp.sum(onehot, axis=0)  # (k,)
     safe = safe_denominator(counts)[:, None]
     new_centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
@@ -72,7 +122,7 @@ def _lloyd_step(x, mask, centers):
     return new_centers, inertia, shift
 
 
-def _lloyd_step_pallas(x, mask, centers, mesh):
+def _lloyd_step_pallas(x, mask, centers, mesh, mode="highest"):
     """Lloyd round via the fused Pallas kernel (ops.lloyd): X streams
     through VMEM once; the three tiny reductions psum over the mesh."""
     from jax import lax
@@ -82,8 +132,10 @@ def _lloyd_step_pallas(x, mask, centers, mesh):
     from ..core.mesh import DATA_AXIS
     from ..ops import lloyd_assign_reduce
 
+    kmode = "fast" if mode == "fast" else "parity"
+
     def local(xb, mb, c):
-        sums, counts, inertia = lloyd_assign_reduce(xb, mb, c)
+        sums, counts, inertia = lloyd_assign_reduce(xb, mb, c, mode=kmode)
         sums = lax.psum(sums, DATA_AXIS)
         counts = lax.psum(counts, DATA_AXIS)
         inertia = lax.psum(inertia, DATA_AXIS)
@@ -130,12 +182,11 @@ def _pallas_ok(x, centers) -> bool:
 
 
 from ..core.mesh import MeshHolder  # noqa: E402
-from functools import partial as _fpartial  # noqa: E402
 
 
-@_fpartial(jax.jit, static_argnames=("mesh_holder", "use_pallas"))
+@_fpartial(jax.jit, static_argnames=("mesh_holder", "use_pallas", "mode"))
 def _lloyd_loop(x, mask, centers, tol, max_iter, *, mesh_holder=None,
-                use_pallas=False):
+                use_pallas=False, mode="highest"):
     """The ENTIRE Lloyd iteration as one XLA program.
 
     The reference re-enters the scheduler every round (SURVEY.md §3.2); a
@@ -149,8 +200,8 @@ def _lloyd_loop(x, mask, centers, tol, max_iter, *, mesh_holder=None,
 
     def step(x_, m_, c_):
         if use_pallas:
-            return _lloyd_step_pallas(x_, m_, c_, mesh_holder.mesh)
-        return _lloyd_step(x_, m_, c_)
+            return _lloyd_step_pallas(x_, m_, c_, mesh_holder.mesh, mode)
+        return _lloyd_step(x_, m_, c_, mode)
 
     def cond(state):
         i, _, _, shift = state
@@ -400,6 +451,7 @@ class KMeans(TransformerMixin, TPUEstimator):
                 x, mask, centers, tol.astype(x.dtype), jnp.int32(self.max_iter),
                 mesh_holder=MeshHolder(get_mesh()) if use_pallas else None,
                 use_pallas=use_pallas,
+                mode=_kmeans_mode(),
             )
             n_iter = int(n_iter_dev)
         labels, inertia = _assign(x, mask, centers)

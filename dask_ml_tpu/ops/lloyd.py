@@ -3,17 +3,28 @@
 Design: stream X through VMEM ONCE per round — per row tile, distance
 cross-term on the MXU, argmin/min on the VPU, per-cluster sums/counts and
 inertia accumulated in VMEM across the (sequential) grid; HBM traffic is
-one read of X.
+one read of X (the XLA lowering reads X twice: assign pass + reduce
+pass).
 
-Measured reality (v5e, slope-timed with result-fetch sync — see bench.py
-for why block_until_ready cannot be trusted on the axon relay): the XLA
-lowering of ``cluster.k_means._lloyd_step`` runs a 2M×50 k=8 round in
-~1.4 ms (~2 HBM passes, near roofline) while this kernel takes ~5.5 ms.
-The two fp32 ``Precision.HIGHEST`` gemms — mandatory for assignment
-parity — cost ~6 bf16 MXU passes each and are padded k=8→128 lanes, so
-the kernel is MXU-bound, not bandwidth-bound, and the single-pass design
-cannot pay off at these shapes.  Hence opt-in via ``DASK_ML_TPU_PALLAS=1``
-(``cluster.k_means._pallas_ok``); revisit for d≈128 / large-k workloads.
+Two precision modes (static ``mode`` arg):
+
+- ``"parity"`` — both gemms at ``Precision.HIGHEST`` (~6 bf16 MXU passes
+  each).  Bit-comparable to the fp32 reference, but at k=8 the MXU pads
+  k→128 lanes and the kernel is MXU-bound: measured 0.089× of XLA on a
+  2M×50 k=8 v5e round (r3 chip evidence).  Kept for the on-chip parity
+  blessing.
+- ``"fast"`` — cross term via a 3-term bf16 split (x_hi·c_hi + x_lo·c_hi
+  + x_hi·c_lo ≈ ``Precision.HIGH``, relative error ~2⁻²², comparable to
+  fp32's 2⁻²⁴ for these shapes), reduce via the same 3-term split (the
+  one-hot operand carries the sample-weight mask, so it is NOT
+  bf16-exact in general).  6 MXU passes total instead of 12.  The win
+  condition: once MXU time
+  drops below the HBM floor, the 1-pass-vs-2-pass fusion is the
+  bottleneck difference — at k≥64 (no lane-padding waste) the model
+  predicts ~1.5× over the equally-relaxed XLA step and more over the
+  HIGHEST one.  At k=8 XLA can lower the k-small argmin on the VPU and
+  still wins; the bench adjudicates per shape.
+
 Known Mosaic limit: tiles ≥4096 rows fail to compile with the separate
 (T, 1) mask input stream (fold the mask into X's trailing column if a
 larger tile is ever needed).
@@ -36,18 +47,41 @@ from jax.experimental import pallas as pl
 _TILE = 2048  # rows per grid step: x tile (2048×d f32) ≤ ~0.5 MB VMEM for d≤64
 
 
-def _kernel(x_ref, m_ref, c_ref, sums_ref, counts_ref, inertia_ref):
+def _split_bf16(a):
+    """a = hi + lo with both halves bf16-representable: hi carries the
+    top 8 mantissa bits, lo the next 8.  Exact for the top 16 of fp32's
+    24 bits; the dropped tail is ~2⁻¹⁷ relative."""
+    hi = a.astype(jnp.bfloat16)
+    lo = (a - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def _dot_f32(a, b):
+    return jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+
+def _kernel(x_ref, m_ref, c_ref, sums_ref, counts_ref, inertia_ref, *,
+            mode):
     i = pl.program_id(0)
     x = x_ref[:]  # (T, d)
     m = m_ref[:]  # (T, 1)
     c = c_ref[:]  # (k, d)
     k = c.shape[0]
 
-    cross = jnp.dot(x, c.T, preferred_element_type=jnp.float32,
-                    precision=jax.lax.Precision.HIGHEST)  # (T, k) MXU
-    # HIGHEST: the default MXU precision truncates fp32 operands to
-    # bf16, flipping argmin for rows near a cluster boundary — the
-    # assignment must match the fp32 reference, not just be close
+    if mode == "parity":
+        # HIGHEST: the default MXU precision truncates fp32 operands to
+        # bf16, flipping argmin for rows near a cluster boundary — this
+        # mode must match the fp32 reference assignment exactly
+        cross = jnp.dot(x, c.T, preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST)  # (T, k) MXU
+    else:  # fast: 3-pass bf16 split ≈ Precision.HIGH
+        x_hi, x_lo = _split_bf16(x)
+        c_hi, c_lo = _split_bf16(c)
+        cross = (
+            _dot_f32(x_hi, c_hi.T)
+            + _dot_f32(x_lo, c_hi.T)
+            + _dot_f32(x_hi, c_lo.T)
+        )
     xn = jnp.sum(x * x, axis=1, keepdims=True)
     cn = jnp.sum(c * c, axis=1)[None, :]
     d2 = xn + cn - 2.0 * cross
@@ -58,8 +92,23 @@ def _kernel(x_ref, m_ref, c_ref, sums_ref, counts_ref, inertia_ref):
     onehot = (
         labels[:, None] == jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1)
     ).astype(jnp.float32) * m
-    psums = jnp.dot(onehot.T, x, preferred_element_type=jnp.float32,
-                    precision=jax.lax.Precision.HIGHEST)  # (k, d) MXU
+    if mode == "parity":
+        psums = jnp.dot(onehot.T, x, preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST)  # (k, d) MXU
+    else:
+        # the one-hot operand carries the MASK, and the mask carries
+        # per-row sample WEIGHTS (utils.reweight_rows) — not bf16-exact
+        # in general, so it gets the hi+lo split too (3 passes, dropping
+        # only the lo·lo term ~2⁻³⁴); a bare bf16 cast here would
+        # quantize weights in the numerator while counts keep fp32
+        # weights in the denominator — a systematic center bias
+        oh_hi, oh_lo = _split_bf16(onehot)
+        x_hi, x_lo = _split_bf16(x)
+        psums = (
+            _dot_f32(oh_hi.T, x_hi)
+            + _dot_f32(oh_hi.T, x_lo)
+            + _dot_f32(oh_lo.T, x_hi)
+        )
     pcounts = jnp.sum(onehot, axis=0, keepdims=True).T  # (k, 1)
     pinertia = jnp.sum(min_d2 * m, axis=0, keepdims=True)  # (1, 1)
 
@@ -76,15 +125,20 @@ def _kernel(x_ref, m_ref, c_ref, sums_ref, counts_ref, inertia_ref):
         inertia_ref[:] = inertia_ref[:] + pinertia
 
 
-@partial(jax.jit, static_argnames=("interpret",))
-def lloyd_assign_reduce(x, mask, centers, *, interpret: bool = False):
+@partial(jax.jit, static_argnames=("interpret", "mode"))
+def lloyd_assign_reduce(x, mask, centers, *, interpret: bool = False,
+                        mode: str = "parity"):
     """One-pass per-cluster (sums, counts, inertia) for a Lloyd round.
 
-    ``x`` (n, d) float32, ``mask`` (n,) float32, ``centers`` (k, d).
+    ``x`` (n, d) float32, ``mask`` (n,) float32, ``centers`` (k, d);
+    ``mode`` is ``"parity"`` (HIGHEST gemms) or ``"fast"`` (bf16-split
+    gemms, 5 MXU passes instead of 12 — see module docstring).
     Rows are padded to the tile size inside (pad rows carry mask 0, so they
     contribute nothing).  Per-device op: the sharded caller psums the three
     outputs over the mesh.
     """
+    if mode not in ("parity", "fast"):
+        raise ValueError(f"mode must be 'parity' or 'fast', got {mode!r}")
     n, d = x.shape
     k = centers.shape[0]
     pad = (-n) % _TILE
@@ -95,7 +149,7 @@ def lloyd_assign_reduce(x, mask, centers, *, interpret: bool = False):
     grid = (x.shape[0] // _TILE,)
 
     sums, counts, inertia = pl.pallas_call(
-        _kernel,
+        partial(_kernel, mode=mode),
         grid=grid,
         in_specs=[
             pl.BlockSpec((_TILE, d), lambda i: (i, 0)),

@@ -1,6 +1,7 @@
 """Pallas kernel parity tests (interpret mode on the CPU mesh)."""
 
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 import jax
@@ -58,11 +59,81 @@ class TestLloydKernel:
         finally:
             L._TILE = orig
 
+    def test_fast_mode_matches_reference(self, rng):
+        # "fast" (bf16-split gemms) must stay within k-means-irrelevant
+        # error of the float64 reference: label-flip-free data here, so
+        # sums/inertia agree to ~1e-4 relative
+        n, d, k = 600, 9, 48
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        mask = np.ones(n, dtype=np.float32)
+        mask[-17:] = 0.0
+        centers = (x[:k] + 3.0 * rng.normal(size=(k, d))).astype(np.float32)
+        sums, counts, inertia = lloyd_assign_reduce(
+            jnp.asarray(x), jnp.asarray(mask), jnp.asarray(centers),
+            interpret=True, mode="fast",
+        )
+        esums, ecounts, einertia = _reference(x, mask, centers)
+        np.testing.assert_allclose(np.asarray(sums), esums,
+                                   rtol=2e-4, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(counts), ecounts)
+        np.testing.assert_allclose(float(inertia), einertia, rtol=2e-4)
+
+    def test_fast_mode_fractional_weights(self, rng):
+        # the mask carries SAMPLE WEIGHTS (utils.reweight_rows), which
+        # are not bf16-exact — a bare bf16 cast of the one-hot operand
+        # would bias sums vs the fp32 counts denominator (r4 review
+        # finding); the 3-pass split must keep weighted sums accurate
+        n, d, k = 500, 6, 24
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        mask = rng.uniform(0.1, 3.0, size=n).astype(np.float32)
+        mask[-11:] = 0.0
+        centers = (x[:k] + 2.0 * rng.normal(size=(k, d))).astype(np.float32)
+        sums, counts, inertia = lloyd_assign_reduce(
+            jnp.asarray(x), jnp.asarray(mask), jnp.asarray(centers),
+            interpret=True, mode="fast",
+        )
+        esums, ecounts, einertia = _reference(x, mask, centers)
+        np.testing.assert_allclose(np.asarray(sums), esums,
+                                   rtol=2e-4, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(counts), ecounts,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(float(inertia), einertia, rtol=2e-4)
+
+    def test_bad_mode_rejected(self, rng):
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        with pytest.raises(ValueError, match="mode"):
+            lloyd_assign_reduce(
+                jnp.asarray(x), jnp.ones(8, dtype=np.float32),
+                jnp.asarray(x[:2]), interpret=True, mode="banana",
+            )
+
+    def test_kmeans_fast_env_matches_highest(self, rng, monkeypatch, mesh):
+        # end-to-end: DASK_ML_TPU_KMEANS_PRECISION=fast must converge to
+        # the same clustering as highest on well-separated blobs
+        from dask_ml_tpu.cluster import KMeans
+        from dask_ml_tpu.core import shard_rows
+
+        centers_true = np.array(
+            [[0, 0, 0], [8, 8, 8], [-8, 8, -8]], dtype=np.float32)
+        X = np.concatenate([
+            c + rng.normal(scale=0.5, size=(120, 3)).astype(np.float32)
+            for c in centers_true
+        ])
+        sX = shard_rows(X)
+        km_hi = KMeans(n_clusters=3, init="random", random_state=0,
+                       max_iter=30).fit(sX)
+        monkeypatch.setenv("DASK_ML_TPU_KMEANS_PRECISION", "fast")
+        km_fast = KMeans(n_clusters=3, init="random", random_state=0,
+                         max_iter=30).fit(sX)
+        np.testing.assert_allclose(
+            np.sort(np.asarray(km_fast.cluster_centers_), axis=0),
+            np.sort(np.asarray(km_hi.cluster_centers_), axis=0),
+            rtol=1e-3, atol=1e-3)
+        assert km_fast.inertia_ == pytest.approx(km_hi.inertia_, rel=1e-3)
+
     def test_pallas_parity_on_tpu(self, rng):
         # Hardware (Mosaic-lowered) parity check — the gate that lets
         # DASK_ML_TPU_PALLAS=1 be safely enabled (cluster.k_means._pallas_ok).
-        import pytest
-
         if jax.default_backend() != "tpu":
             pytest.skip("requires a real TPU backend")
         n, d, k = 4096, 16, 8
