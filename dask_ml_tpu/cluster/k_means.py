@@ -91,31 +91,22 @@ def _lloyd_step(x, mask, centers, mode="highest"):
     # row-gathers serially
     min_d2 = jnp.min(d2, axis=1)
     inertia = jnp.sum(min_d2 * mask)
-    onehot = jax.nn.one_hot(labels, centers.shape[0], dtype=x.dtype) * mask[:, None]
-    if mode == "fast":
-        # the one-hot operand carries the sample-weight mask (not
-        # bf16-exact), so BOTH operands get the hi+lo split — same
-        # decomposition as the Pallas kernel (ops.lloyd._split_bf16)
-        from ..ops.lloyd import _split_bf16
+    # per-cluster reduce through the shared scatter policy (ops.scatter):
+    # one-hot gemm on the MXU or segment_sum, whichever the platform
+    # measurement favors.  Precision on the gemm path: HIGH in fast mode
+    # (3-pass bf16 split — Mosaic's kernel writes the same split by
+    # hand), HIGHEST otherwise (centers feed the next round's argmin).
+    # The weight mask pre-multiplies x so both strategies accumulate the
+    # same weighted rows; counts use HIGHEST so fractional sample
+    # weights are never bf16-quantized in the denominator.
+    from ..ops.scatter import bucket_sum
 
-        oh_hi, oh_lo = _split_bf16(onehot)
-        x_hi, x_lo = _split_bf16(x)
-
-        def _dot32(a, b):
-            return jnp.dot(a, b, preferred_element_type=jnp.float32)
-
-        sums = (
-            _dot32(oh_hi.T, x_hi)
-            + _dot32(oh_hi.T, x_lo)
-            + _dot32(oh_lo.T, x_hi)
-        )
-    else:
-        # HIGHEST to match the Pallas kernel's psums gemm: centers feed
-        # the next round's argmin, so both TPU paths must accumulate
-        # identically
-        sums = jnp.dot(onehot.T, x,
-                       precision=jax.lax.Precision.HIGHEST)  # (k, d)
-    counts = jnp.sum(onehot, axis=0)  # (k,)
+    k_ = centers.shape[0]
+    prec = (jax.lax.Precision.HIGH if mode == "fast"
+            else jax.lax.Precision.HIGHEST)
+    sums = bucket_sum(x * mask[:, None], labels, k_, precision=prec)
+    counts = bucket_sum(mask, labels, k_,
+                        precision=jax.lax.Precision.HIGHEST)  # (k,)
     safe = safe_denominator(counts)[:, None]
     new_centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
     shift = jnp.sum((new_centers - centers) ** 2)

@@ -122,16 +122,32 @@ def masked_sum(x, mask):
     return jnp.sum(x * m, axis=0)
 
 
+def _masked_anchor(x, m):
+    """A valid data value per feature to shift by: moments computed on
+    (x − anchor) work at the data's SPREAD scale instead of its offset
+    scale.  At offset 1e6 in f32 a raw-scale mean carries ~0.1 absolute
+    error which enters the variance as its square (2.3% var error, found
+    by an r4 adversarial property test); after shifting, the subtraction
+    x − anchor is exact for values within 2× of the anchor (Sterbenz)
+    and the residual moments are accurate to ~eps·spread."""
+    anchor = jnp.min(jnp.where(m > 0, x, jnp.inf), axis=0)
+    return jnp.where(jnp.isfinite(anchor), anchor, 0.0)
+
+
 @jax.jit
 def masked_mean(x, mask):
     m = mask.reshape(mask.shape + (1,) * (x.ndim - 1)).astype(x.dtype)
-    return jnp.sum(x * m, axis=0) / jnp.sum(m, axis=0)
+    anchor = _masked_anchor(x, m)
+    shifted = jnp.sum((x - anchor) * m, axis=0) / jnp.sum(m, axis=0)
+    return anchor + shifted
 
 
 @partial(jax.jit, static_argnames=("ddof",))
 def masked_var(x, mask, ddof=0):
     m = mask.reshape(mask.shape + (1,) * (x.ndim - 1)).astype(x.dtype)
     count = jnp.sum(m, axis=0)
-    mean = jnp.sum(x * m, axis=0) / count
-    sq = jnp.sum((x - mean) ** 2 * m, axis=0)
+    anchor = _masked_anchor(x, m)
+    xs = x - anchor
+    mean_s = jnp.sum(xs * m, axis=0) / count
+    sq = jnp.sum((xs - mean_s) ** 2 * m, axis=0)
     return sq / (count - ddof)

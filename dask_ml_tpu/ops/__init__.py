@@ -7,5 +7,6 @@ the CPU-mesh test suite exercises them without TPU hardware.
 """
 
 from .lloyd import lloyd_assign_reduce  # noqa: F401
+from .scatter import bucket_sum, scatter_strategy  # noqa: F401
 
-__all__ = ["lloyd_assign_reduce"]
+__all__ = ["lloyd_assign_reduce", "bucket_sum", "scatter_strategy"]

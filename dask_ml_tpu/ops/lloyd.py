@@ -60,6 +60,18 @@ def _dot_f32(a, b):
     return jnp.dot(a, b, preferred_element_type=jnp.float32)
 
 
+def _dot3_bf16(a, b):
+    """f32 gemm as 3 bf16 MXU passes: a_hi·b_hi + a_lo·b_hi + a_hi·b_lo
+    (drops only the lo·lo term, ~2⁻³⁴ relative) — the explicit form of
+    ``Precision.HIGH`` that Mosaic is known to lower; used for both fast
+    gemms so the XLA and Pallas fast paths share one decomposition."""
+    a_hi, a_lo = _split_bf16(a)
+    b_hi, b_lo = _split_bf16(b)
+    return (
+        _dot_f32(a_hi, b_hi) + _dot_f32(a_lo, b_hi) + _dot_f32(a_hi, b_lo)
+    )
+
+
 def _kernel(x_ref, m_ref, c_ref, sums_ref, counts_ref, inertia_ref, *,
             mode):
     i = pl.program_id(0)
@@ -75,13 +87,7 @@ def _kernel(x_ref, m_ref, c_ref, sums_ref, counts_ref, inertia_ref, *,
         cross = jnp.dot(x, c.T, preferred_element_type=jnp.float32,
                         precision=jax.lax.Precision.HIGHEST)  # (T, k) MXU
     else:  # fast: 3-pass bf16 split ≈ Precision.HIGH
-        x_hi, x_lo = _split_bf16(x)
-        c_hi, c_lo = _split_bf16(c)
-        cross = (
-            _dot_f32(x_hi, c_hi.T)
-            + _dot_f32(x_lo, c_hi.T)
-            + _dot_f32(x_hi, c_lo.T)
-        )
+        cross = _dot3_bf16(x, c.T)
     xn = jnp.sum(x * x, axis=1, keepdims=True)
     cn = jnp.sum(c * c, axis=1)[None, :]
     d2 = xn + cn - 2.0 * cross
@@ -98,17 +104,11 @@ def _kernel(x_ref, m_ref, c_ref, sums_ref, counts_ref, inertia_ref, *,
     else:
         # the one-hot operand carries the MASK, and the mask carries
         # per-row sample WEIGHTS (utils.reweight_rows) — not bf16-exact
-        # in general, so it gets the hi+lo split too (3 passes, dropping
-        # only the lo·lo term ~2⁻³⁴); a bare bf16 cast here would
-        # quantize weights in the numerator while counts keep fp32
-        # weights in the denominator — a systematic center bias
-        oh_hi, oh_lo = _split_bf16(onehot)
-        x_hi, x_lo = _split_bf16(x)
-        psums = (
-            _dot_f32(oh_hi.T, x_hi)
-            + _dot_f32(oh_hi.T, x_lo)
-            + _dot_f32(oh_lo.T, x_hi)
-        )
+        # in general, so BOTH operands get the split; a bare bf16 cast
+        # here would quantize weights in the numerator while counts
+        # keep fp32 weights in the denominator — a systematic center
+        # bias
+        psums = _dot3_bf16(onehot.T, x)
     pcounts = jnp.sum(onehot, axis=0, keepdims=True).T  # (k, 1)
     pinertia = jnp.sum(min_d2 * m, axis=0, keepdims=True)  # (1, 1)
 
@@ -132,7 +132,7 @@ def lloyd_assign_reduce(x, mask, centers, *, interpret: bool = False,
 
     ``x`` (n, d) float32, ``mask`` (n,) float32, ``centers`` (k, d);
     ``mode`` is ``"parity"`` (HIGHEST gemms) or ``"fast"`` (bf16-split
-    gemms, 5 MXU passes instead of 12 — see module docstring).
+    gemms, 6 MXU passes instead of 12 — see module docstring).
     Rows are padded to the tile size inside (pad rows carry mask 0, so they
     contribute nothing).  Per-device op: the sharded caller psums the three
     outputs over the mesh.

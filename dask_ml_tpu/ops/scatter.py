@@ -1,0 +1,71 @@
+"""Scatter-add strategy: ``segment_sum`` vs one-hot gemm, one policy.
+
+Two lowerings exist for "accumulate rows into labeled buckets" — the
+shape under the histogram quantile sketch
+(``preprocessing/data.py :: _hist_quantiles``), the k-means per-cluster
+reduce (``cluster/k_means.py :: _lloyd_step``), and GaussianNB's
+per-class moments:
+
+- ``jax.ops.segment_sum`` — an XLA scatter-add.  On CPU this wins big
+  (r3 measurement: 160× over the one-hot gemm).  On TPU scatters
+  historically lower poorly (serialized updates).
+- one-hot matmul — builds the (n, k) indicator and rides the MXU.  The
+  k-means header's historical choice on TPU.
+
+Which wins on TPU is measured, not assumed: the bench's scatter section
+records ``hist_onehot_vs_segsum_speedup`` per platform and the k=64
+Lloyd variants exercise the gemm form.  The policy here is the single
+place both consumers consult:
+
+``DASK_ML_TPU_SCATTER`` = ``segsum`` | ``onehot`` | ``auto`` (default).
+``auto`` picks ``onehot`` on TPU and ``segsum`` elsewhere, EXCEPT when
+``num_segments`` is large (> 1024): a one-hot with that many columns is
+memory-quadratic and loses everywhere (the 4096-bin sketch would build
+an (n·d, 4096·d) indicator).  The strategy is read at TRACE time.
+
+Reference analogue: dask's graph has no such choice — blockwise numpy
+``np.add.at``/``bincount`` is the only lowering (SURVEY.md §2.1 #13).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+_ONEHOT_MAX_SEGMENTS = 1024
+
+
+def scatter_strategy(num_segments: int | None = None) -> str:
+    """The platform policy, overridable via ``DASK_ML_TPU_SCATTER``."""
+    v = os.environ.get("DASK_ML_TPU_SCATTER", "auto").lower()
+    if v not in ("auto", "segsum", "onehot"):
+        raise ValueError(
+            f"DASK_ML_TPU_SCATTER must be auto|segsum|onehot, got {v!r}"
+        )
+    # the large-segment guard binds even under the env override: forcing
+    # onehot to A/B the k-means reduce must not make the 4096-bin sketch
+    # build an (n·d, d·4096) indicator — that is an OOM, not a strategy
+    if num_segments is not None and num_segments > _ONEHOT_MAX_SEGMENTS:
+        return "segsum"
+    if v != "auto":
+        return v
+    return "onehot" if jax.default_backend() == "tpu" else "segsum"
+
+
+def bucket_sum(values, ids, num_segments: int, *, precision=None):
+    """Sum ``values`` ((n,) or (n, d)) into buckets given by ``ids``.
+
+    Pre-weight ``values`` for weighted accumulation.  ``precision``
+    applies to the one-hot gemm path only (segment_sum accumulates in
+    full f32 natively, which is strictly at least as precise).
+    """
+    if scatter_strategy(num_segments) == "segsum":
+        return jax.ops.segment_sum(values, ids, num_segments=num_segments)
+    oh = jax.nn.one_hot(ids, num_segments, dtype=values.dtype)  # (n, k)
+    if values.ndim == 1:
+        return jnp.dot(oh.T, values[:, None], precision=precision,
+                       preferred_element_type=values.dtype)[:, 0]
+    return jnp.dot(oh.T, values, precision=precision,
+                   preferred_element_type=values.dtype)

@@ -148,3 +148,86 @@ class TestLloydKernel:
         np.testing.assert_allclose(np.asarray(sums), esums, rtol=1e-3, atol=1e-2)
         np.testing.assert_allclose(np.asarray(counts), ecounts)
         np.testing.assert_allclose(float(inertia), einertia, rtol=1e-3)
+
+
+class TestScatterPolicy:
+    """ops.scatter: one policy for segment_sum vs one-hot gemm, shared by
+    the quantile sketch and the k-means reduce (r3 verdict #5b)."""
+
+    def _agree(self, rng, monkeypatch, values, ids, k):
+        import jax as _jax
+
+        from dask_ml_tpu.ops import bucket_sum
+
+        outs = {}
+        for strat in ("segsum", "onehot"):
+            monkeypatch.setenv("DASK_ML_TPU_SCATTER", strat)
+            _jax.clear_caches()  # strategy is read at trace time
+            outs[strat] = np.asarray(bucket_sum(
+                jnp.asarray(values), jnp.asarray(ids), k))
+        monkeypatch.delenv("DASK_ML_TPU_SCATTER")
+        _jax.clear_caches()
+        np.testing.assert_allclose(outs["segsum"], outs["onehot"],
+                                   rtol=1e-5, atol=1e-5)
+        return outs["segsum"]
+
+    def test_strategies_agree_1d(self, rng, monkeypatch):
+        ids = rng.randint(0, 17, size=400).astype(np.int32)
+        vals = rng.normal(size=400).astype(np.float32)
+        got = self._agree(rng, monkeypatch, vals, ids, 17)
+        want = np.zeros(17, np.float32)
+        np.add.at(want, ids, vals)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_strategies_agree_2d_weighted(self, rng, monkeypatch):
+        ids = rng.randint(0, 9, size=300).astype(np.int32)
+        w = rng.uniform(0.1, 2.0, size=300).astype(np.float32)
+        x = rng.normal(size=(300, 4)).astype(np.float32)
+        got = self._agree(rng, monkeypatch, x * w[:, None], ids, 9)
+        want = np.zeros((9, 4), np.float32)
+        np.add.at(want, ids, x * w[:, None])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_large_segment_count_forces_segsum(self, monkeypatch):
+        from dask_ml_tpu.ops import scatter_strategy
+
+        assert scatter_strategy(4096) == "segsum"  # one-hot would be
+        # memory-quadratic at sketch bin counts, on every platform
+        # ...and the guard binds even when onehot is FORCED via env:
+        # A/B-ing the k-means reduce must not OOM the quantile sketch
+        monkeypatch.setenv("DASK_ML_TPU_SCATTER", "onehot")
+        assert scatter_strategy(4096) == "segsum"
+        assert scatter_strategy(64) == "onehot"
+
+    def test_bad_env_rejected(self, monkeypatch):
+        from dask_ml_tpu.ops import scatter_strategy
+
+        monkeypatch.setenv("DASK_ML_TPU_SCATTER", "matmulish")
+        with pytest.raises(ValueError, match="DASK_ML_TPU_SCATTER"):
+            scatter_strategy(8)
+
+    def test_kmeans_equal_under_both_strategies(self, rng, monkeypatch,
+                                                mesh):
+        import jax as _jax
+
+        from dask_ml_tpu.cluster import KMeans
+        from dask_ml_tpu.core import shard_rows
+
+        X = np.concatenate([
+            c + rng.normal(scale=0.4, size=(100, 3)).astype(np.float32)
+            for c in ([0, 0, 0], [6, 6, 6], [-6, 6, -6])
+        ]).astype(np.float32)
+        w = rng.uniform(0.5, 1.5, size=300).astype(np.float32)
+        sX = shard_rows(X)
+        results = {}
+        for strat in ("segsum", "onehot"):
+            monkeypatch.setenv("DASK_ML_TPU_SCATTER", strat)
+            _jax.clear_caches()
+            km = KMeans(n_clusters=3, init="random", random_state=0,
+                        max_iter=20).fit(sX, sample_weight=w)
+            results[strat] = np.asarray(km.cluster_centers_)
+        monkeypatch.delenv("DASK_ML_TPU_SCATTER")
+        _jax.clear_caches()
+        np.testing.assert_allclose(
+            np.sort(results["segsum"], axis=0),
+            np.sort(results["onehot"], axis=0), rtol=1e-4, atol=1e-4)

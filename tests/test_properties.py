@@ -347,3 +347,174 @@ def test_tsqr_orthonormal_reconstructs(n, d, seed):
     np.testing.assert_allclose(qh @ rr, X, atol=5e-4)
     # upper-triangular up to fp noise
     assert np.abs(np.tril(rr, -1)).max() < 1e-4
+
+
+class TestAdversarialNumerics:
+    """Round-4 adversarial tier (r3 verdict #8): the delicate paths under
+    hostile inputs — extreme ranges, tie-heavy columns, huge weight and
+    scale imbalance, near-singular conditioning."""
+
+    @settings(max_examples=10, deadline=None)
+    @given(st.integers(0, 2**31 - 1),
+           st.sampled_from([1e6, 1e9, 1e12]))
+    def test_sketch_extreme_ranges_ties_constants(self, seed, scale):
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.preprocessing.data import _hist_quantiles
+
+        rng = np.random.RandomState(seed)
+        n = 2048
+        x = np.empty((n, 3), np.float32)
+        x[:, 0] = rng.normal(size=n)
+        x[0, 0] = scale          # outliers BOTH signs: the window must
+        x[1, 0] = -scale         # refine from a span straddling zero
+        x[:, 1] = 3.75           # constant feature: lo == hi
+        x[:, 2] = rng.choice(     # 5 distinct values, heavy ties
+            np.array([-7.0, -1.0, 0.0, 2.5, 11.0], np.float32), size=n)
+        probs = np.asarray([0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0],
+                           np.float32)
+        got = np.asarray(_hist_quantiles(
+            jnp.asarray(x), jnp.ones(n, jnp.float32), jnp.asarray(probs)))
+        want = np.quantile(x.astype(np.float64), probs, axis=0)
+        # endpoints exact for every column
+        np.testing.assert_allclose(got[0], want[0], rtol=1e-6)
+        np.testing.assert_allclose(got[-1], want[-1], rtol=1e-6)
+        # constant column: every quantile is the constant
+        np.testing.assert_allclose(got[:, 1], 3.75, rtol=1e-6)
+        # monotone nondecreasing in p (a sketch that inverts quantile
+        # order is broken no matter the tolerance)
+        assert (np.diff(got, axis=0) >= -1e-5 * np.maximum(
+            np.abs(got[:-1]), 1.0)).all()
+        # outlier column: interior quantiles resolve to IQR accuracy
+        iqr0 = want[4, 0] - want[2, 0]
+        err0 = np.abs(got[1:-1, 0] - want[1:-1, 0])
+        assert (err0 <= iqr0 * 5e-2 + scale * 2e-6).all(), (err0, iqr0)
+        # tie column: within one inter-value gap of the true quantile
+        err2 = np.abs(got[1:-1, 2] - want[1:-1, 2])
+        assert (err2 <= 18.0 * 5e-2 + 1e-3).all(), err2
+
+    @settings(max_examples=10, deadline=None)
+    @given(st.integers(0, 2**31 - 1))
+    def test_scaler_partial_fit_huge_offset_chunks(self, seed):
+        # Chan merges at offset 1e6 with unit variance: a naive
+        # sum-of-squares accumulator loses ALL variance bits in fp32
+        # (1e12 + 1 == 1e12); the merge must keep ~3 digits
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.preprocessing import StandardScaler
+
+        rng = np.random.RandomState(seed)
+        chunks = [
+            (1e6 + rng.normal(size=(400, 3))).astype(np.float32)
+            for _ in range(3)
+        ]
+        sc = StandardScaler()
+        for c in chunks:
+            sc.partial_fit(shard_rows(c))
+        allx = np.concatenate(chunks).astype(np.float64)
+        # rtol: anchor-shifted BLOCK moments (core.sharded._masked_anchor)
+        # cut the error 10x (2.3% -> 0.24%); the residual is the merge
+        # delta between f32-STORED chunk means, quantized to ulp(1e6) =
+        # 0.0625 — the honest f32 state floor (delta² enters M2 scaled
+        # by ~n), not a computation defect
+        np.testing.assert_allclose(
+            np.asarray(sc.mean_), allx.mean(0), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sc.var_), allx.var(0), rtol=1e-2)
+
+    @settings(max_examples=10, deadline=None)
+    @given(st.integers(0, 2**31 - 1))
+    def test_gaussian_nb_partial_fit_huge_offset(self, seed):
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.naive_bayes import GaussianNB
+
+        rng = np.random.RandomState(seed)
+        y = (rng.rand(300) > 0.5).astype(np.float32)
+        nb = GaussianNB()
+        chunks = []
+        for i in range(3):
+            c = (1e6 + rng.normal(size=(300, 2))).astype(np.float32)
+            chunks.append(c)
+            nb.partial_fit(shard_rows(c), shard_rows(y),
+                           classes=[0.0, 1.0])
+        allx = np.concatenate(chunks).astype(np.float64)
+        ally = np.concatenate([y, y, y])
+        for ci, cls in enumerate([0.0, 1.0]):
+            sel = allx[ally == cls]
+            np.testing.assert_allclose(
+                np.asarray(nb.theta_)[ci], sel.mean(0), rtol=1e-6)
+            np.testing.assert_allclose(
+                np.asarray(nb.var_)[ci], sel.var(0), rtol=5e-2,
+                atol=1e-3)
+
+    @settings(max_examples=8, deadline=None)
+    @given(st.integers(0, 2**31 - 1))
+    def test_minibatch_kmeans_kahan_mass_extreme_weights(self, seed):
+        # k=1 makes assignment trivial, so the single center must equal
+        # the GLOBAL weighted mean of everything streamed — including a
+        # heavy 1e6-weight block followed by many 1e-6-weight blocks,
+        # where a plain f32 mass accumulator freezes (1e6 + 1e-6 == 1e6
+        # exactly in f32) and the late blocks would be silently dropped
+        from dask_ml_tpu.cluster import MiniBatchKMeans
+        from dask_ml_tpu.core import shard_rows
+
+        rng = np.random.RandomState(seed)
+        mbk = MiniBatchKMeans(n_clusters=1, init="random", random_state=0)
+        xs, ws = [], []
+        for i in range(6):
+            x = rng.normal(size=(256, 3)).astype(np.float32) + 2.0 * i
+            w = np.full(256, 1e6 if i == 0 else 1e-6, np.float32)
+            xs.append(x)
+            ws.append(w)
+            mbk.partial_fit(shard_rows(x), sample_weight=w)
+        allx = np.concatenate(xs).astype(np.float64)
+        allw = np.concatenate(ws).astype(np.float64)
+        want = np.average(allx, axis=0, weights=allw)
+        got = np.asarray(mbk.cluster_centers_)[0]
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+        # sub-ulp mass loss is provably invisible in the f32 centers at
+        # this ratio (the tiny blocks shift the mean by ~3e-11), so the
+        # REAL assertion is on the Kahan pair: each 2.56e-4 block
+        # increment is far below ulp(2.56e8)=16, a plain f32 accumulator
+        # freezes and the lo word stays 0 — the pair must carry the full
+        # 5*256*1e-6 of tiny mass
+        hi, lo = np.asarray(mbk._counts, np.float64)
+        total = float(hi.sum() + lo.sum())
+        expect = float(allw.sum())
+        heavy_only = 256.0 * 1e6
+        tiny = expect - heavy_only  # 1.28e-3
+        assert abs(total - expect) < 0.25 * tiny, (
+            f"Kahan pair lost the sub-ulp mass: {total} vs {expect}"
+        )
+
+    @settings(max_examples=10, deadline=None)
+    @given(st.integers(0, 2**31 - 1),
+           st.sampled_from([1e4, 1e6, 1e8]))
+    def test_tsqr_adversarial_conditioning(self, seed, cond):
+        # near-collinear + wildly scaled columns: Householder-based TSQR
+        # is backward stable, so Q must stay orthonormal REGARDLESS of
+        # conditioning, and QR must reconstruct X columnwise
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.linalg.tsqr import tsqr
+
+        rng = np.random.RandomState(seed)
+        n, d = 333, 5
+        base = rng.normal(size=(n,))
+        X = np.stack([
+            base,
+            base + rng.normal(size=n) / cond,   # collinear to 1/cond
+            rng.normal(size=n) * 1e8,           # huge scale
+            rng.normal(size=n) * 1e-8,          # tiny scale
+            rng.normal(size=n),
+        ], axis=1).astype(np.float32)
+        q, r = tsqr(shard_rows(X))
+        qh = np.asarray(q)[:n].astype(np.float64)
+        rr = np.asarray(r).astype(np.float64)
+        np.testing.assert_allclose(qh.T @ qh, np.eye(d), atol=5e-4)
+        # columnwise reconstruction: tolerance scales with column norm
+        rec = qh @ rr
+        colnorm = np.linalg.norm(X.astype(np.float64), axis=0)
+        err = np.abs(rec - X).max(axis=0)
+        assert (err <= 5e-6 * colnorm + 1e-10).all(), (err, colnorm)
+        assert np.abs(np.tril(rr, -1)).max() < 1e-4 * max(colnorm)
