@@ -985,8 +985,11 @@ def main():
             # loader -> device -> partial_fit (the reference's _partial.py
             # story end to end, not just device-born blocks).  4 distinct
             # 64MB blocks on disk cycled so the parse+transfer path runs
-            # every block while disk stays 256MB; hard time budget so a
-            # slow tunnel cannot wedge the section.
+            # every block while disk stays 256MB.  The per-loop budget
+            # bounds a SLOW tunnel (device progress is synced every
+            # block); a fully WEDGED tunnel blocks inside one sync, and
+            # the process-level watchdog (_emit_and_exit) is what bounds
+            # that — same contract as every other section.
             import tempfile
 
             from dask_ml_tpu.io import read_binary
@@ -1049,10 +1052,22 @@ def main():
             from dask_ml_tpu.solvers import Logistic, lbfgs as _lbfgs
             from dask_ml_tpu.solvers import packed_solve as _packed
 
+            from dask_ml_tpu.solvers import pack_strategy as _pack_pol
+
             nP, dP, KP = (1_000_000, 28, 4) if on_tpu else (100_000, 16, 4)
             sXp = _sr(rng.normal(size=(nP, dP)).astype(np.float32))
             Yp = (rng.rand(KP, sXp.data.shape[0]) > 0.5).astype(np.float32)
             it_p = 20
+            # what the auto policy would pick here (only meaningful when
+            # the user hasn't forced it — record the override otherwise)
+            _pack_prev = os.environ.get("DASK_ML_TPU_PACK")
+            auto_choice = (
+                _pack_pol() if _pack_prev in (None, "", "auto")
+                else f"forced:{_pack_prev}"
+            )
+            # the A/B must pin each arm explicitly — under auto the
+            # "packed" call would itself fall back on the losing platform
+            os.environ["DASK_ML_TPU_PACK"] = "packed"
 
             def run_packed():
                 B, _ = _packed("lbfgs", sXp, Yp, family=Logistic,
@@ -1067,15 +1082,30 @@ def main():
                 ]
                 float(outs[-1][0])
 
-            run_packed(); run_seq()  # compile both
-            t_packed = min(
-                _time_once(run_packed) for _ in range(3))
-            t_seq = min(_time_once(run_seq) for _ in range(3))
+            try:
+                run_packed(); run_seq()  # compile both
+                t_packed = min(
+                    _time_once(run_packed) for _ in range(3))
+                t_seq = min(_time_once(run_seq) for _ in range(3))
+            finally:
+                # restore, never leak the forced arm (or clobber a
+                # user-provided setting) past this A/B
+                if _pack_prev is None:
+                    os.environ.pop("DASK_ML_TPU_PACK", None)
+                else:
+                    os.environ["DASK_ML_TPU_PACK"] = _pack_prev
+            measured_winner = (
+                "packed" if t_packed <= t_seq else "sequential")
             _record({
                 "workload": f"packed_ovr_lbfgs_{nP}x{dP}_K{KP}",
                 "packed_s": round(t_packed, 3),
                 "sequential_s": round(t_seq, 3),
                 "packed_speedup": round(t_seq / max(t_packed, 1e-9), 3),
+                # the auto policy's pick vs what this run measured — a
+                # mismatch on chip is the signal to flip the default
+                "auto_policy": auto_choice,
+                "auto_matches_measurement": bool(
+                    auto_choice == measured_winner),
             })
 
             # line-search strategy go/no-go (lbfgs_core docstring): the

@@ -29,8 +29,6 @@ Reference analogue: dask's graph has no such choice — blockwise numpy
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 
@@ -39,11 +37,9 @@ _ONEHOT_MAX_SEGMENTS = 1024
 
 def scatter_strategy(num_segments: int | None = None) -> str:
     """The platform policy, overridable via ``DASK_ML_TPU_SCATTER``."""
-    v = os.environ.get("DASK_ML_TPU_SCATTER", "auto").lower()
-    if v not in ("auto", "segsum", "onehot"):
-        raise ValueError(
-            f"DASK_ML_TPU_SCATTER must be auto|segsum|onehot, got {v!r}"
-        )
+    from ..utils import env_choice
+
+    v = env_choice("DASK_ML_TPU_SCATTER", ("auto", "segsum", "onehot"))
     # the large-segment guard binds even under the env override: forcing
     # onehot to A/B the k-means reduce must not make the 4096-bin sketch
     # build an (n·d, d·4096) indicator — that is an OOM, not a strategy

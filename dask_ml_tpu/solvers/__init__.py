@@ -17,6 +17,7 @@ from .algorithms import (  # noqa: F401
     gradient_descent,
     lbfgs,
     newton,
+    pack_strategy,
     packed_solve,
     proximal_grad,
     reset_dispatch_counts,
@@ -37,6 +38,7 @@ __all__ = [
     "lbfgs",
     "newton",
     "proximal_grad",
+    "pack_strategy",
     "packed_solve",
     "DISPATCH_COUNTS",
     "reset_dispatch_counts",

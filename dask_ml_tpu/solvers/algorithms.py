@@ -458,6 +458,29 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
 # ------------------------------------------------------- packed (vmap) --
 
 
+def pack_strategy() -> str:
+    """How one-vs-rest multi-class solves execute,
+    ``DASK_ML_TPU_PACK`` = ``packed`` | ``sequential`` | ``auto``:
+
+    - ``packed``: all K solves as ONE vmapped XLA program.
+    - ``sequential``: K whole-solve dispatches, one per class — each
+      class stops at ITS OWN convergence instead of the pack's slowest
+      lane.
+    - ``auto`` (default): the measured per-platform winner.  On CPU,
+      vmap serializes lanes and the pack runs every lane to the slowest
+      lane's iteration count: measured ``packed_speedup 0.684`` (a 1.5×
+      LOSS, BENCH_r03 ``packed_ovr_lbfgs``) — so auto falls back to
+      sequential there.  On TPU the MXU batches the lanes; auto stays
+      packed, with the bench's packed section owning the number.
+    """
+    from ..utils import env_choice
+
+    v = env_choice("DASK_ML_TPU_PACK", ("auto", "packed", "sequential"))
+    if v != "auto":
+        return v
+    return "packed" if jax.default_backend() == "tpu" else "sequential"
+
+
 def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
                  regularizer=L2, lamduh: float = 0.0, max_iter: int = 100,
                  tol: float = 1e-5, rho: float = 1.0, abstol: float = 1e-4,
@@ -468,7 +491,10 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
     leading axis of ``Y`` — the one-vs-rest fit issues a single dispatch
     instead of K sequential ones (the solvers' whole-solve ``while_loop``
     design is vmap-safe by construction: converged lanes hold their carry
-    while stragglers keep iterating).
+    while stragglers keep iterating).  Under ``pack_strategy() ==
+    "sequential"`` (the measured CPU winner, or forced via
+    ``DASK_ML_TPU_PACK``) the same K solves run as K dispatches instead;
+    results are identical up to lane-vs-loop accumulation order.
 
     Reference: ``dask_ml/linear_model/glm.py :: LogisticRegression``
     dispatches per class; there is no packed equivalent to cite — this is
@@ -484,10 +510,12 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
       carries its own executed-iteration count.
     """
     reg = get_regularizer(regularizer)
-    if line_search != "backtrack":
+    strategy = pack_strategy()
+    if line_search != "backtrack" and strategy == "packed":
         # a lax.cond grid under vmap executes BOTH branches in every
         # lane, so probe_grid would pay the full grid per lane per
-        # iteration — lockstep backtracking is strictly better here
+        # iteration — lockstep backtracking is strictly better here.
+        # (sequential solves have no lanes; they keep the request)
         logger.info(
             "packed_solve forces line_search='backtrack' (requested %r): "
             "vmapped lanes run grids in both cond branches", line_search,
@@ -502,7 +530,20 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
         )
     K = Yd.shape[0]
     lam = jnp.asarray(lamduh, dt)
-    DISPATCH_COUNTS["solves"] += 1
+
+    def _sequential(one_fn, *extra_rows):
+        # K whole-solve dispatches (the auto fallback where vmap packing
+        # measured slower); each class converges independently
+        DISPATCH_COUNTS["solves"] += K
+        outs = [
+            one_fn(Yd[c], *(e[c] for e in extra_rows)) for c in range(K)
+        ]
+        betas = jnp.stack([b for b, _ in outs])
+        n_its = jnp.stack([n for _, n in outs])
+        return betas, n_its
+
+    if strategy == "packed":
+        DISPATCH_COUNTS["solves"] += 1
     if solver == "admm":
         mesh = mesh or get_mesh()
         mh = MeshHolder(mesh)
@@ -513,9 +554,11 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
                 jnp.asarray(abstol, dt), jnp.asarray(reltol, dt),
                 jnp.asarray(inner_tol, dt), jnp.int32(max_iter),
                 family=family, reg=reg, mesh_holder=mh,
-                inner_iter=inner_iter, line_search="backtrack",
+                inner_iter=inner_iter, line_search=line_search,
             )
 
+        if strategy == "sequential":
+            return _sequential(one)
         return jax.vmap(one)(Yd)
     runners = {
         "lbfgs": _lbfgs_run,
@@ -546,4 +589,6 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
             jnp.asarray(tol, dt), family=family, reg=reg, **extra_kw,
         )
 
+    if strategy == "sequential":
+        return _sequential(one, B0)
     return jax.vmap(one)(Yd, B0)

@@ -158,6 +158,21 @@ def slice_columns(X, columns):
     return X[:, np.asarray(columns)]
 
 
+def env_choice(name: str, allowed: tuple, default: str = "auto") -> str:
+    """Read a strategy knob from the environment with validation — the
+    shared shape behind ``DASK_ML_TPU_SCATTER`` / ``DASK_ML_TPU_PACK``
+    (each policy keeps its own platform-auto logic, but the read/validate
+    step lives once)."""
+    import os
+
+    v = os.environ.get(name, default).strip().lower()
+    if v not in allowed:
+        raise ValueError(
+            f"{name} must be {'|'.join(allowed)}, got {v!r}"
+        )
+    return v
+
+
 def safe_denominator(x):
     """0-safe divisor that PRESERVES fractional weight masses.
 

@@ -211,10 +211,14 @@ class TestPackedOvR:
     @pytest.mark.parametrize(
         "solver", ["lbfgs", "admm", "gradient_descent", "proximal_grad"]
     )
-    def test_single_dispatch_and_accuracy(self, multiclass_data, mesh, solver):
+    def test_single_dispatch_and_accuracy(self, multiclass_data, mesh,
+                                          solver, monkeypatch):
         from dask_ml_tpu import solvers
 
         X, y = multiclass_data
+        # this test pins the PACKED path specifically (auto resolves to
+        # sequential on CPU per the measured r3 number)
+        monkeypatch.setenv("DASK_ML_TPU_PACK", "packed")
         solvers.reset_dispatch_counts()
         lr = dlm.LogisticRegression(
             solver=solver, C=1.0, max_iter=150
@@ -226,10 +230,12 @@ class TestPackedOvR:
         sk = sl.LogisticRegression(C=1.0, max_iter=300).fit(X, y)
         assert acc >= sk.score(X, y) - 0.03
 
-    def test_sharded_multiclass_single_dispatch(self, multiclass_data, mesh):
+    def test_sharded_multiclass_single_dispatch(self, multiclass_data, mesh,
+                                                monkeypatch):
         from dask_ml_tpu import solvers
 
         X, y = multiclass_data
+        monkeypatch.setenv("DASK_ML_TPU_PACK", "packed")
         sX, sy = shard_rows(X), shard_rows(y.astype(np.float32))
         solvers.reset_dispatch_counts()
         lr = dlm.LogisticRegression(solver="lbfgs", C=1.0, max_iter=150).fit(
@@ -238,8 +244,10 @@ class TestPackedOvR:
         assert solvers.DISPATCH_COUNTS["solves"] == 1
         assert float((lr.predict(sX)[: len(y)] == y).mean()) > 0.8
 
-    def test_packed_matches_sequential_loop(self, multiclass_data, mesh):
+    def test_packed_matches_sequential_loop(self, multiclass_data, mesh,
+                                            monkeypatch):
         # the packed program must agree with K independent solves
+        monkeypatch.setenv("DASK_ML_TPU_PACK", "packed")
         from dask_ml_tpu.solvers import Logistic, lbfgs, packed_solve
         from dask_ml_tpu.core import shard_rows as _sr
 
@@ -480,3 +488,43 @@ class TestClassWeightValidation:
         X, y = clf_data
         with pytest.raises(ValueError, match="class_weight keys"):
             SGDClassifier(max_iter=5, class_weight={"dog": 2.0}).fit(X, y)
+
+
+class TestPackStrategy:
+    """DASK_ML_TPU_PACK auto-fallback (r3 verdict #3): the OvR execution
+    strategy follows the measured per-platform winner and both forms
+    agree numerically."""
+
+    def test_auto_is_sequential_on_cpu(self):
+        from dask_ml_tpu.solvers import pack_strategy
+
+        assert pack_strategy() == "sequential"  # measured: packed is a
+        # 1.5x LOSS on CPU (BENCH_r03 packed_speedup 0.684)
+
+    def test_bad_env_rejected(self, monkeypatch):
+        from dask_ml_tpu.solvers import pack_strategy
+
+        monkeypatch.setenv("DASK_ML_TPU_PACK", "vectorised")
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="DASK_ML_TPU_PACK"):
+            pack_strategy()
+
+    def test_sequential_matches_packed(self, multiclass_data, mesh,
+                                       monkeypatch):
+        from dask_ml_tpu import solvers
+
+        X, y = multiclass_data
+        outs = {}
+        for strat in ("packed", "sequential"):
+            monkeypatch.setenv("DASK_ML_TPU_PACK", strat)
+            solvers.reset_dispatch_counts()
+            lr = dlm.LogisticRegression(
+                solver="lbfgs", C=1.0, max_iter=150).fit(X, y)
+            outs[strat] = (np.asarray(lr.betas_),
+                           solvers.DISPATCH_COUNTS["solves"])
+        np.testing.assert_allclose(outs["packed"][0],
+                                   outs["sequential"][0],
+                                   rtol=5e-3, atol=1e-3)
+        assert outs["packed"][1] == 1
+        assert outs["sequential"][1] == len(np.unique(y))
