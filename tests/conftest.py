@@ -63,3 +63,21 @@ def xy_regression(rng):
     w = rng.normal(size=d)
     y = (X @ w + 0.05 * rng.normal(size=n)).astype(np.float32)
     return X, y
+
+
+# -- Hypothesis profiles -------------------------------------------------
+# Default = derandomized: the suite must be deterministic-green for CI /
+# the driver (r3 verdict: random draws made the suite flaky at head —
+# property tests are a DISCOVERY tool, and discovery belongs in the
+# explicit 'explore' profile, not in every CI run).
+#   HYPOTHESIS_PROFILE=explore python -m pytest tests/test_properties.py
+# runs the randomized search that has found real bugs each round.
+try:
+    from hypothesis import settings as _hyp_settings
+
+    _hyp_settings.register_profile("ci", derandomize=True)
+    _hyp_settings.register_profile("explore", derandomize=False)
+    _hyp_settings.load_profile(
+        os.environ.get("HYPOTHESIS_PROFILE", "ci"))
+except ImportError:  # pragma: no cover
+    pass

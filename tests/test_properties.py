@@ -537,3 +537,25 @@ class TestAdversarialNumerics:
         err = np.abs(rec - X).max(axis=0)
         assert (err <= 5e-6 * colnorm + 1e-10).all(), (err, colnorm)
         assert np.abs(np.tril(rr, -1)).max() < 1e-4 * max(colnorm)
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.integers(0, 2**31 - 1), st.sampled_from([1e-8, 1e-4, 1e4, 1e8]))
+def test_euclidean_scale_invariance(seed, scale):
+    """d(s·X, s·Y) == s·d(X, Y): the cancellation guard's flagging
+    threshold is RELATIVE (d² < τ·(‖x‖²+‖y‖²)), so the safe path must
+    behave identically at any uniform scale — including scales where the
+    absolute cancellation error alone would dwarf the distances."""
+    from dask_ml_tpu.core import shard_rows
+    from dask_ml_tpu.metrics import euclidean_distances
+
+    r = np.random.RandomState(seed)
+    X = r.normal(size=(33, 4)).astype(np.float32)
+    Y = np.vstack([X[:11] + 1e-6 * r.normal(size=(11, 4)).astype(np.float32),
+                   r.normal(size=(10, 4)).astype(np.float32)])
+    base = np.asarray(euclidean_distances(shard_rows(X), shard_rows(Y)))
+    scaled = np.asarray(euclidean_distances(
+        shard_rows((X * scale).astype(np.float32)),
+        shard_rows((Y * scale).astype(np.float32))))
+    np.testing.assert_allclose(scaled, base * scale, rtol=2e-3,
+                               atol=scale * 1e-6)
