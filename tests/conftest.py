@@ -14,13 +14,23 @@ import os
 # tests (e.g. the Pallas parity blessing) can run on a real chip.
 _USE_TPU = os.environ.get("DASK_ML_TPU_TEST_TPU") not in (None, "", "0")
 
+# DASK_ML_TPU_TEST_DEVICES sweeps the virtual mesh size (default 8):
+# odd counts (5, 7) are the adversarial cases for pad+mask divisibility.
+_N_DEV = int(os.environ.get("DASK_ML_TPU_TEST_DEVICES", "8"))
+
 if not _USE_TPU:
+    import re as _re
+
     os.environ["JAX_PLATFORMS"] = "cpu"  # image presets JAX_PLATFORMS=axon (TPU)
     _flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in _flags:
-        os.environ["XLA_FLAGS"] = (
-            _flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
+    # REWRITE any pre-existing count rather than skip: a stale flag from
+    # the caller's shell would silently override the sweep knob
+    _flags = _re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", _flags
+    ).strip()
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={_N_DEV}"
+    ).strip()
 
 # The image's sitecustomize imports jax at interpreter start, so jax.config
 # captured JAX_PLATFORMS=axon before this file ran — override via config too.
@@ -28,10 +38,27 @@ import jax  # noqa: E402
 
 if not _USE_TPU:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    jax.config.update("jax_num_cpu_devices", _N_DEV)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def n_devices():
+    """The harness-configured virtual device count (None in TPU mode,
+    where the physical chip count is whatever the hardware exposes)."""
+    return None if _USE_TPU else _N_DEV
+
+
+def require_devices_divisible(k: int) -> int:
+    """Skip the calling test unless the device count divides by ``k``
+    (mesh-shape-sensitive tests under the DASK_ML_TPU_TEST_DEVICES
+    sweep); returns the device count."""
+    n = len(jax.devices())
+    if n % k:
+        pytest.skip(f"needs a device count divisible by {k} (have {n})")
+    return n
 
 
 @pytest.fixture(scope="session")

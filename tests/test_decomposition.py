@@ -52,7 +52,9 @@ class TestTSQR:
         # stacked R (16 rows) recovers full rank — must factor correctly.
         X = rng.normal(size=(16, 10)).astype(np.float64)
         q, r = tsqr(shard_rows(X))
-        np.testing.assert_allclose(np.asarray(q) @ np.asarray(r), X, atol=1e-5)
+        # slice padding: 16 divides an 8-device mesh but not e.g. 5
+        qh = np.asarray(q)[:16]
+        np.testing.assert_allclose(qh @ np.asarray(r), X, atol=1e-5)
         sv = np.linalg.svd(np.asarray(r), compute_uv=False)
         np.testing.assert_allclose(sv, np.linalg.svd(X, compute_uv=False), rtol=1e-5)
 

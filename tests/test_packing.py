@@ -108,7 +108,9 @@ class TestCohort:
         # On a mesh with a nontrivial model axis the stacked state is
         # sharded over MODEL_AXIS: 2-D (model x data) parallelism.
         X, y = _data(rng, n=512)
-        mesh = device_mesh(8, model_axis=4)
+        from conftest import require_devices_divisible
+
+        mesh = device_mesh(require_devices_divisible(4), model_axis=4)
         with use_mesh(mesh):
             models = [
                 SGDClassifier(alpha=a, learning_rate="constant", eta0=0.2)

@@ -8,6 +8,8 @@ import time
 import numpy as np
 import pytest
 
+import jax
+
 from sklearn.base import BaseEstimator
 
 from dask_ml_tpu.model_selection import GridSearchCV, IncrementalSearchCV
@@ -161,7 +163,9 @@ class TestMeshPropagation:
 
         X = rng.normal(size=(40, 3))
         y = (X[:, 0] > 0).astype(int)
-        mesh = device_mesh(8, model_axis=4)
+        from conftest import require_devices_divisible
+
+        mesh = device_mesh(require_devices_divisible(4), model_axis=4)
         with use_mesh(mesh):
             GridSearchCV(MeshSpy(), {}, cv=2, n_jobs=4, refit=False).fit(X, y)
             IncrementalSearchCV(
@@ -169,4 +173,5 @@ class TestMeshPropagation:
             ).fit(X, y)
         assert seen, "no fits ran"
         for shape in seen:
-            assert dict(shape) == {"data": 2, "model": 4}, shape
+            assert dict(shape) == {"data": len(jax.devices()) // 4,
+                                   "model": 4}, shape

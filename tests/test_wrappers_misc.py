@@ -416,10 +416,14 @@ class TestBlockwiseParallelFits:
             def predict(self, X):
                 return np.zeros(len(X))
 
+        from conftest import require_devices_divisible
+
         X = rng.normal(size=(80, 3))
-        with use_mesh(device_mesh(8, model_axis=4)):
+        n_dev = require_devices_divisible(4)
+        with use_mesh(device_mesh(n_dev, model_axis=4)):
             BlockwiseVotingRegressor(MeshSpy(), n_blocks=4).fit(X, np.zeros(80))
-        assert seen and all(s == {"data": 2, "model": 4} for s in seen)
+        assert seen and all(
+            s == {"data": n_dev // 4, "model": 4} for s in seen)
 
 
 class TestPackedEnsembleNoSilentCaps:
@@ -470,6 +474,8 @@ class TestCohortModelAxisSkipLogs:
         from dask_ml_tpu.linear_model import SGDClassifier as TpuSGD
         from dask_ml_tpu.model_selection._packing import Cohort
 
+        if len(jax.devices()) < 8:
+            pytest.skip("needs >= 8 devices")
         devs = np.array(jax.devices()[:8]).reshape(4, 2)
         mesh2d = Mesh(devs, ("data", "model"))
         X = rng.normal(size=(64, 4)).astype(np.float32)
