@@ -873,19 +873,25 @@ def main():
             nbins = 256
             vals = jnp.asarray(rng.normal(size=(nS,)).astype(np.float32))
 
-            @jax.jit
-            def hist_scatter(n_it):
-                def one(i, acc):
-                    # re-bucket each round with a varying shift so XLA
-                    # cannot hoist the scatter out of the loop
-                    ids = jnp.clip(
-                        ((vals + acc[0] * 1e-20) * 42.0).astype(jnp.int32)
-                        + nbins // 2, 0, nbins - 1)
-                    hist = jax.ops.segment_sum(
-                        jnp.ones_like(vals), ids, num_segments=nbins)
-                    return acc + hist
-                return jax.lax.fori_loop(
-                    0, n_it, one, jnp.zeros((nbins,), jnp.float32))
+            def make_hist_segsum(nb, scale):
+                # shared body for every segment-sum bin count: the
+                # anti-hoist perturbation (vals + acc[0]*1e-20) forces a
+                # fresh bucketing per round so XLA cannot lift the
+                # scatter out of the loop
+                @jax.jit
+                def run(n_it):
+                    def one(i, acc):
+                        ids = jnp.clip(
+                            ((vals + acc[0] * 1e-20) * scale).astype(
+                                jnp.int32) + nb // 2, 0, nb - 1)
+                        hist = jax.ops.segment_sum(
+                            jnp.ones_like(vals), ids, num_segments=nb)
+                        return acc + hist
+                    return jax.lax.fori_loop(
+                        0, n_it, one, jnp.zeros((nb,), jnp.float32))
+                return run
+
+            hist_scatter = make_hist_segsum(nbins, 42.0)
 
             @jax.jit
             def hist_onehot(n_it):
@@ -910,10 +916,18 @@ def main():
                 return jax.lax.fori_loop(
                     0, n_it, one, jnp.zeros((1024,), jnp.float32))
 
+            # the quantile sketch's ACTUAL configuration (4096 bins,
+            # where one-hot is memory-quadratic and segsum is forced by
+            # the ops.scatter large-segment guard) — this is the number
+            # that says whether the sketch's scatter is a TPU bottleneck
+            # worth a Pallas histogram kernel
+            hist_scatter_4096 = make_hist_segsum(4096, 680.0)
+
             per_by_name = {}
             for name, fn, n_out in (
                 ("hist_segment_sum", hist_scatter, nbins),
                 ("hist_onehot_matmul", hist_onehot, nbins),
+                ("hist_segment_sum_4096", hist_scatter_4096, 4096),
                 ("mode_at_add", mode_scatter, 1024),
             ):
                 # jnp.int32 inside the lambda: consistent aval for the

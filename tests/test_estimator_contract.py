@@ -151,3 +151,40 @@ class TestDtypePreservation:
         assert yv.dtype == jnp.float32
         lr = LogisticRegression(solver="lbfgs").fit(sX, y)
         assert np.asarray(lr.coef_).dtype == np.float32
+
+
+class TestPickleRoundtrip:
+    """Fitted estimators must pickle/unpickle with predictions intact —
+    the reference's estimators are plain-pickle portable (model handoff
+    between processes/jobs), so ours must be too, device arrays and all."""
+
+    def test_fitted_estimators_roundtrip(self, rng, mesh):
+        import pickle
+
+        from dask_ml_tpu.cluster import KMeans
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.linear_model import LogisticRegression
+        from dask_ml_tpu.preprocessing import StandardScaler
+
+        X = rng.normal(size=(200, 5)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        cases = [
+            (KMeans(n_clusters=3, init="random", random_state=0),
+             (shard_rows(X),)),
+            (LogisticRegression(solver="lbfgs"),
+             (shard_rows(X), shard_rows(y))),
+            (StandardScaler(), (shard_rows(X),)),
+        ]
+        for est, args in cases:
+            est.fit(*args)
+            est2 = pickle.loads(pickle.dumps(est))
+            name = type(est).__name__
+            if hasattr(est2, "predict"):
+                np.testing.assert_array_equal(
+                    np.asarray(est.predict(X[:20])),
+                    np.asarray(est2.predict(X[:20])), err_msg=name)
+            else:
+                np.testing.assert_allclose(
+                    np.asarray(est.transform(shard_rows(X)).data),
+                    np.asarray(est2.transform(shard_rows(X)).data),
+                    err_msg=name)
