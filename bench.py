@@ -1122,6 +1122,35 @@ def main():
                     auto_choice == measured_winner),
             })
 
+            # C-sweep (the r4 grid-search fast path): K solves of the
+            # SAME (X, y) at different lamduh as one vmapped program
+            # (solvers.lambda_sweep) vs K sequential solves — the chip
+            # number behind GridSearchCV's packed path
+            from dask_ml_tpu.solvers import lambda_sweep as _lsweep
+
+            lams = np.logspace(-4, 1, 8).astype(np.float32)
+
+            def run_sweep():
+                B, _ = _lsweep("lbfgs", sXp, Yp[0], lams, family=Logistic,
+                               max_iter=it_p, tol=0.0)
+                float(B[0, 0])
+
+            def run_sweep_seq():
+                for lam in lams:
+                    b = _lbfgs(sXp, Yp[0], family=Logistic,
+                               lamduh=float(lam), max_iter=it_p, tol=0.0)
+                float(b[0])
+
+            run_sweep(); run_sweep_seq()  # compile
+            t_sw = min(_time_once(run_sweep) for _ in range(3))
+            t_sw_seq = min(_time_once(run_sweep_seq) for _ in range(3))
+            _record({
+                "workload": f"grid_sweep_lbfgs_{nP}x{dP}_K8",
+                "sweep_s": round(t_sw, 3),
+                "sequential_s": round(t_sw_seq, 3),
+                "sweep_speedup": round(t_sw_seq / max(t_sw, 1e-9), 3),
+            })
+
             # line-search strategy go/no-go (lbfgs_core docstring): the
             # batched probe_grid is bandwidth-optimal ON PAPER for big-n
             # solves but measured slower on compute-bound CPU; this chip

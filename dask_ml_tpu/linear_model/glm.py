@@ -25,7 +25,7 @@ from ..solvers import (
     newton,
     proximal_grad,
 )
-from .utils import add_intercept
+from .utils import add_intercept, binary_indicator
 
 _SOLVERS = {
     "admm": admm,
@@ -133,6 +133,39 @@ class LogisticRegression(ClassifierMixin, _GLM):
 
     family = Logistic
 
+    def _sweep_fit_binary(self, X, y, Cs):
+        """Fit ``len(Cs)`` variants differing ONLY in ``C`` as ONE
+        vmapped program (``solvers.lambda_sweep`` — the lanes share X
+        and y; the regularization strength is a traced scalar).  The
+        grid-search fast path calls this; eligibility (binary labels,
+        no sample/class weights, plain ovr) is the CALLER's job.
+
+        Returns (betas (K, p), classes (2,)).
+        """
+        from ..core.sharded import ShardedRows as _SR
+        from ..solvers import lambda_sweep
+
+        if isinstance(y, _SR):
+            yd = jnp.where(y.mask > 0, y.data, y.data[0])
+            classes = np.asarray(jnp.unique(yd))
+        else:
+            classes = np.unique(np.asarray(y))
+        if len(classes) != 2:
+            raise ValueError(
+                f"_sweep_fit_binary needs exactly 2 classes, got "
+                f"{classes.tolist()}"
+            )
+        X = _ingest_float(self, X)
+        Xi = add_intercept(X) if self.fit_intercept else X
+        y01 = binary_indicator(y, classes[1])
+        kwargs = self._solver_call_kwargs()
+        kwargs.pop("lamduh")
+        betas, _ = lambda_sweep(
+            self.solver, Xi, y01, [1.0 / float(c) for c in Cs],
+            family=self.family, **kwargs,
+        )
+        return betas, classes
+
     def fit(self, X, y=None, sample_weight=None):
         import warnings
 
@@ -200,16 +233,9 @@ class LogisticRegression(ClassifierMixin, _GLM):
                 Xi = reweight_rows(Xi, sample_weight=sample_weight)
 
         def _indicator(cls):
-            """0/1 target for one-vs-rest, built where y lives (device
-            labels never round-trip; the mask keeps pad rows inert)."""
-            if yv is not None:
-                return (yv == cls).astype(np.float32)
-            return _SR(
-                data=(y.data == jnp.asarray(cls, y.data.dtype)).astype(
-                    jnp.float32
-                ),
-                mask=y.mask, n_samples=y.n_samples,
-            )
+            """One-vs-rest target via the SHARED encoding helper
+            (linear_model.utils.binary_indicator)."""
+            return binary_indicator(yv if yv is not None else y, cls)
 
         K = len(self.classes_)
         self._multinomial = False

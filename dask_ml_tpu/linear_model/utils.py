@@ -7,6 +7,24 @@ import jax.numpy as jnp
 from ..core.sharded import ShardedRows
 
 
+def binary_indicator(y, positive_class):
+    """0/1 target for ``y == positive_class``, built where y lives
+    (device labels never round-trip; the mask keeps pad rows inert).
+    The ONE encoding shared by ``LogisticRegression.fit``'s OvR
+    indicator, the packed C-sweep, and the sweep scorer — they must
+    agree bit-for-bit or the packed grid path would score against a
+    different encoding than it fit."""
+    import numpy as np
+
+    if isinstance(y, ShardedRows):
+        return ShardedRows(
+            data=(y.data == jnp.asarray(
+                positive_class, y.data.dtype)).astype(jnp.float32),
+            mask=y.mask, n_samples=y.n_samples,
+        )
+    return (np.asarray(y) == positive_class).astype(np.float32)
+
+
 def add_intercept(X: ShardedRows) -> ShardedRows:
     """Append a ones column (zeroed on padded rows so solvers stay exact)."""
     ones = X.mask[:, None].astype(X.data.dtype)

@@ -31,6 +31,53 @@ from ..metrics.scorer import check_scoring
 from ..utils import check_random_state
 from ._split import _take as _rows  # pandas/array/ShardedRows row subset
 
+
+def _sweep_acc_kernel_make():
+    # lazy: jax import deferred to first use, kernel jitted ONCE at
+    # module scope (a per-call closure would retrace every call)
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("fit_intercept",))
+    def kernel(data, mask, y01v, B, *, fit_intercept):
+        if fit_intercept:
+            eta = data @ B[:, :-1].T + B[:, -1]  # (n, K)
+        else:
+            eta = data @ B.T
+        pred = (eta > 0).astype(jnp.float32)
+        hit = (pred == y01v[:, None]).astype(jnp.float32) * mask[:, None]
+        return jnp.sum(hit, axis=0) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    return kernel
+
+
+_SWEEP_ACC_KERNEL = None
+
+
+def _sweep_accuracy(X, y, betas, classes, fit_intercept):
+    """Per-lane accuracy for a (K, p) stack of binary GLM coefficients:
+    one gemm scores every grid candidate at once; only the (K,) accuracy
+    vector leaves the device."""
+    import jax.numpy as jnp
+
+    from ..core.sharded import shard_rows
+    from ..linear_model.utils import binary_indicator
+
+    global _SWEEP_ACC_KERNEL
+    if _SWEEP_ACC_KERNEL is None:
+        _SWEEP_ACC_KERNEL = _sweep_acc_kernel_make()
+    Xs = X if isinstance(X, ShardedRows) else shard_rows(
+        np.asarray(X, dtype=np.float32))
+    ind = binary_indicator(y, classes[1])  # the encoding fit used
+    if isinstance(ind, ShardedRows):
+        y01 = ind.data
+    else:
+        y01 = jnp.asarray(
+            np.pad(ind, (0, Xs.data.shape[0] - ind.shape[0])))
+    return _SWEEP_ACC_KERNEL(Xs.data, Xs.mask, y01, betas,
+                             fit_intercept=bool(fit_intercept))
+
 logger = logging.getLogger(__name__)
 
 
@@ -313,13 +360,53 @@ class _BaseSearchCV(TPUEstimator):
         )
         fit_failed = np.zeros(n_cand, dtype=bool)
 
+        # Fold slices computed ONCE per fold and shared across candidates
+        # — the analogue of dask's graph deduplicating the X[train_idx]
+        # nodes: re-gathering per (candidate, fold) cost ~9 eager device
+        # gathers per fit and dominated warm-search wall time (r4
+        # profile: 1.0 s of 1.5 s on a 12x3 grid).  REFCOUNTED, not a
+        # plain list: pinning every fold's train+test slices for the
+        # whole search would hold ~(cv+1)x the dataset resident (device
+        # OOM at scale); with fold-major task order below, at most
+        # ~n_workers folds are live at once — the old transient peak,
+        # dedup kept.
+        fold_lock = threading.Lock()
+        fold_cache: dict = {}
+        fold_refs = {fi: n_cand for fi in range(len(splits))}
+
+        def fold_get(fi):
+            with fold_lock:
+                if fi not in fold_cache:
+                    tr, te = splits[fi]
+                    fold_cache[fi] = (
+                        _rows(Xh, tr),
+                        _rows(yh, tr) if yh is not None else None,
+                        _rows(Xh, te),
+                        _rows(yh, te) if yh is not None else None,
+                    )
+                return fold_cache[fi]
+
+        def fold_release(fi):
+            with fold_lock:
+                fold_refs[fi] -= 1
+                if fold_refs[fi] <= 0:
+                    fold_cache.pop(fi, None)
+
+        packed_done = self._maybe_packed_glm_sweep(
+            candidates, len(splits), fold_get, fold_release, scorers,
+            fit_params, test_scores, train_scores,
+        )
+        if not packed_done:
+            # a mid-way packed fallback consumed some folds' refcounts;
+            # restore the full budget for the per-task path
+            with fold_lock:
+                fold_cache.clear()
+                for fi in fold_refs:
+                    fold_refs[fi] = n_cand
+
         def run_task(ci, fi):
             params = candidates[ci]
-            train_idx, test_idx = splits[fi]
-            Xtr = _rows(Xh, train_idx)
-            ytr = _rows(yh, train_idx) if yh is not None else None
-            Xte = _rows(Xh, test_idx)
-            yte = _rows(yh, test_idx) if yh is not None else None
+            Xtr, ytr, Xte, yte = fold_get(fi)
             est = clone(self.estimator).set_params(**params)
             tokens = self._prefix_tokens_for(est, fi)
             try:
@@ -347,9 +434,18 @@ class _BaseSearchCV(TPUEstimator):
                 # way; the last consumer's release evicts the entry
                 for tok in tokens:
                     prefix_cache.release(tok)
+                fold_release(fi)
 
-        tasks = [(ci, fi) for ci in range(n_cand) for fi in range(len(splits))]
-        n_workers = min(_resolve_n_jobs(self.n_jobs), len(tasks))
+        # FOLD-MAJOR order: all candidates of fold 0, then fold 1, ... so
+        # the refcounted fold cache retires each fold's slices before the
+        # next fold's are gathered (candidate-major order would keep
+        # every fold live for the whole search)
+        tasks = (
+            [] if packed_done
+            else [(ci, fi) for fi in range(len(splits))
+                  for ci in range(n_cand)]
+        )
+        n_workers = min(_resolve_n_jobs(self.n_jobs), max(len(tasks), 1))
         if n_workers <= 1:
             for ci, fi in tasks:
                 run_task(ci, fi)
@@ -407,6 +503,76 @@ class _BaseSearchCV(TPUEstimator):
                 best.fit(Xh, **fit_params)
             self.best_estimator_ = best
         return self
+
+    def _maybe_packed_glm_sweep(self, candidates, n_folds, fold_get,
+                                fold_release, scorers, fit_params,
+                                test_scores, train_scores):
+        """Packed fast path for the commonest grid: a binary device-native
+        LogisticRegression searched over ONLY ``C``.  All candidates of a
+        fold run as ONE vmapped solve (``solvers.lambda_sweep``) and are
+        scored with one gemm — K fits collapse from K dispatches to 1.
+        The reference builds K independent task graphs here; this is the
+        TPU-native counterpart of its graph-level dedup.
+
+        Gated on ``pack_strategy() == "packed"`` (vmap packing measured
+        SLOWER on CPU, r3 ``packed_speedup 0.684``); ineligible grids
+        fall through to the per-task path.  Returns True when it filled
+        the score arrays.
+        """
+        from ..linear_model import LogisticRegression as _LR
+        from ..solvers import pack_strategy
+
+        est = self.estimator
+        if type(est) is not _LR:
+            return False
+        if pack_strategy() != "packed":
+            return False
+        if fit_params or self.scoring is not None:
+            return False
+        if est.class_weight is not None or est.multi_class == "multinomial":
+            return False
+        if not candidates or any(set(p) != {"C"} for p in candidates):
+            return False
+        if set(scorers) != {"score"}:
+            return False
+        Cs = [p["C"] for p in candidates]
+        filled_test = np.empty((len(Cs), n_folds))
+        filled_train = (
+            np.empty_like(filled_test) if self.return_train_score else None
+        )
+        try:
+            for fi in range(n_folds):
+                Xtr, ytr, Xte, yte = fold_get(fi)
+                try:
+                    if ytr is None or yte is None:
+                        return False
+                    sweep_est = clone(est)
+                    betas, classes = sweep_est._sweep_fit_binary(
+                        Xtr, ytr, Cs)
+                    filled_test[:, fi] = np.asarray(_sweep_accuracy(
+                        Xte, yte, betas, classes, est.fit_intercept))
+                    if filled_train is not None:
+                        filled_train[:, fi] = np.asarray(_sweep_accuracy(
+                            Xtr, ytr, betas, classes, est.fit_intercept))
+                finally:
+                    # one fold live at a time: this path consumes ALL
+                    # n_cand reservations of the fold it just finished
+                    for _ in range(len(Cs)):
+                        fold_release(fi)
+        except Exception:
+            # ANY failure here (non-binary labels discovered late, a
+            # solver rejecting the config, ...) falls back to the
+            # per-candidate path, which owns the real error_score
+            # semantics and will re-raise genuine errors properly
+            logger.info(
+                "packed GLM sweep ineligible/failed; falling back to "
+                "per-candidate fits", exc_info=True,
+            )
+            return False
+        test_scores["score"][:, :] = filled_test
+        if train_scores is not None and filled_train is not None:
+            train_scores["score"][:, :] = filled_train
+        return True
 
     def _fit_candidate(self, est, Xtr, ytr, prefix_cache, tokens, fit_params):
         from sklearn.pipeline import Pipeline

@@ -17,6 +17,7 @@ from .algorithms import (  # noqa: F401
     gradient_descent,
     lbfgs,
     newton,
+    lambda_sweep,
     pack_strategy,
     packed_solve,
     proximal_grad,
@@ -38,6 +39,7 @@ __all__ = [
     "lbfgs",
     "newton",
     "proximal_grad",
+    "lambda_sweep",
     "pack_strategy",
     "packed_solve",
     "DISPATCH_COUNTS",

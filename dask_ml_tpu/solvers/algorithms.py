@@ -592,3 +592,72 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
     if strategy == "sequential":
         return _sequential(one, B0)
     return jax.vmap(one)(Yd, B0)
+
+
+def lambda_sweep(solver: str, X, y, lams, *, family: type[Family] = Logistic,
+                 regularizer=L2, max_iter: int = 100, tol: float = 1e-5,
+                 rho: float = 1.0, abstol: float = 1e-4, reltol: float = 1e-2,
+                 inner_iter: int = 50, inner_tol: float = 1e-6, mesh=None,
+                 line_search: str = "backtrack"):
+    """All K solves of the SAME (X, y) at different regularization
+    strengths as ONE vmapped program — the grid-search twin of
+    ``packed_solve`` (there the lanes differ in y, here in ``lamduh``,
+    which every runner takes as a TRACED scalar, so a hyperparameter
+    sweep is one dispatch instead of K).  No sequential fallback here:
+    the caller gates on ``pack_strategy()`` and keeps its per-candidate
+    path where packing measured slower.
+
+    Returns (betas (K, pdim), n_iters (K,)).
+    """
+    reg = get_regularizer(regularizer)
+    if line_search != "backtrack":
+        line_search = "backtrack"  # same vmap-lane rule as packed_solve
+    x, yd, mask = _prep(X, y)
+    dt = _param_dtype(x)
+    lam_v = jnp.asarray(np.asarray(lams), dt)
+    if lam_v.ndim != 1:
+        raise ValueError(f"lams must be 1-D, got shape {lam_v.shape}")
+    K = lam_v.shape[0]
+    DISPATCH_COUNTS["solves"] += 1
+    if solver == "admm":
+        mesh = mesh or get_mesh()
+        mh = MeshHolder(mesh)
+
+        def one_a(lam):
+            return _admm_run(
+                x, yd, mask, lam, jnp.asarray(rho, dt),
+                jnp.asarray(abstol, dt), jnp.asarray(reltol, dt),
+                jnp.asarray(inner_tol, dt), jnp.int32(max_iter),
+                family=family, reg=reg, mesh_holder=mh,
+                inner_iter=inner_iter, line_search=line_search,
+            )
+
+        return jax.vmap(one_a)(lam_v)
+    runners = {
+        "lbfgs": _lbfgs_run,
+        "gradient_descent": _gd_run,
+        "proximal_grad": _pg_run,
+        "newton": _newton_run,
+    }
+    if solver not in runners:
+        raise ValueError(f"Unknown solver {solver!r}")
+    if solver in ("lbfgs", "gradient_descent", "newton") \
+            and not reg.smooth and bool(np.any(np.asarray(lams))):
+        raise ValueError(
+            f"{solver} requires a smooth penalty; got {reg.__name__}"
+        )
+    if solver == "newton" and getattr(family, "params_per_feature", 1) > 1:
+        raise ValueError("newton does not support matrix-parameter families")
+    run = runners[solver]
+    B0 = jnp.zeros((K, _pdim(x, family)), dtype=dt)
+    extra_kw = (
+        {} if solver == "proximal_grad" else {"line_search": line_search}
+    )
+
+    def one(lam, b0):
+        return run(
+            x, yd, mask, b0, lam, jnp.int32(max_iter),
+            jnp.asarray(tol, dt), family=family, reg=reg, **extra_kw,
+        )
+
+    return jax.vmap(one)(lam_v, B0)

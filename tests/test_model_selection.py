@@ -2,6 +2,7 @@ import numpy as np
 import pytest
 from sklearn.linear_model import SGDClassifier
 
+import dask_ml_tpu.linear_model as dlm
 import dask_ml_tpu.model_selection as dms
 from dask_ml_tpu.core import shard_rows, unshard
 from dask_ml_tpu.core.sharded import ShardedRows
@@ -870,3 +871,99 @@ class TestNBCheckpointRoundtrip:
         np.testing.assert_allclose(
             np.asarray(nb2.var_), np.asarray(full.var_), rtol=1e-4
         )
+
+
+class TestPackedGlmGridSweep:
+    """GridSearchCV fast path: a binary LogisticRegression grid over only
+    C runs as ONE vmapped solve per fold (solvers.lambda_sweep) + one
+    scoring gemm — r4's packed-search feature.  Results must be
+    indistinguishable from the per-candidate path."""
+
+    def _data(self, rng):
+        X = rng.normal(size=(600, 8)).astype(np.float32)
+        y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(np.float32)
+        return X, {"C": np.logspace(-2, 2, 7).tolist()}, y
+
+    def test_matches_sequential_and_skips_dispatches(self, rng, mesh,
+                                                     monkeypatch):
+        from dask_ml_tpu import solvers
+
+        X, grid, y = self._data(rng)
+        results = {}
+        for strat in ("packed", "sequential"):
+            monkeypatch.setenv("DASK_ML_TPU_PACK", strat)
+            solvers.reset_dispatch_counts()
+            gs = dms.GridSearchCV(
+                dlm.LogisticRegression(solver="lbfgs", max_iter=60),
+                grid, cv=3, refit=False, return_train_score=True)
+            gs.fit(X, y)
+            results[strat] = (gs, solvers.DISPATCH_COUNTS["solves"])
+        gp, dp = results["packed"]
+        gq, dq = results["sequential"]
+        np.testing.assert_allclose(
+            gp.cv_results_["mean_test_score"],
+            gq.cv_results_["mean_test_score"], atol=1e-6)
+        np.testing.assert_allclose(
+            gp.cv_results_["mean_train_score"],
+            gq.cv_results_["mean_train_score"], atol=1e-6)
+        assert gp.best_index_ == gq.best_index_
+        assert dp == 3          # one sweep per fold
+        assert dq == 7 * 3      # one solve per (candidate, fold)
+
+    def test_sharded_inputs_take_fast_path(self, rng, mesh, monkeypatch):
+        import warnings
+
+        from dask_ml_tpu import solvers
+        from dask_ml_tpu.core import shard_rows
+
+        X, grid, y = self._data(rng)
+        monkeypatch.setenv("DASK_ML_TPU_PACK", "packed")
+        solvers.reset_dispatch_counts()
+        gs = dms.GridSearchCV(
+            dlm.LogisticRegression(solver="lbfgs", max_iter=60),
+            grid, cv=3, refit=False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # unshuffled-KFold notice
+            gs.fit(shard_rows(X), shard_rows(y))
+        assert solvers.DISPATCH_COUNTS["solves"] == 3
+        assert 0.9 < gs.best_score_ <= 1.0
+
+    def test_ineligible_grids_fall_back(self, rng, mesh, monkeypatch):
+        from dask_ml_tpu import solvers
+
+        X, grid, y = self._data(rng)
+        monkeypatch.setenv("DASK_ML_TPU_PACK", "packed")
+        # a second swept param: not a pure-C grid -> per-candidate path
+        solvers.reset_dispatch_counts()
+        gs = dms.GridSearchCV(
+            dlm.LogisticRegression(solver="lbfgs", max_iter=60),
+            {"C": [0.1, 1.0], "fit_intercept": [True, False]},
+            cv=2, refit=False)
+        gs.fit(X, y)
+        assert solvers.DISPATCH_COUNTS["solves"] == 2 * 2 * 2
+        # multiclass labels: fall back (sweep is binary-only)
+        y3 = rng.randint(0, 3, size=len(y)).astype(np.float32)
+        solvers.reset_dispatch_counts()
+        gs3 = dms.GridSearchCV(
+            dlm.LogisticRegression(solver="lbfgs", max_iter=60),
+            {"C": [0.1, 1.0]}, cv=2, refit=False)
+        gs3.fit(X, y3)
+        assert hasattr(gs3, "cv_results_")
+
+    def test_randomized_search_takes_fast_path(self, rng, mesh,
+                                               monkeypatch):
+        from scipy.stats import loguniform
+
+        from dask_ml_tpu import solvers
+
+        X, _, y = self._data(rng)
+        monkeypatch.setenv("DASK_ML_TPU_PACK", "packed")
+        solvers.reset_dispatch_counts()
+        rs = dms.RandomizedSearchCV(
+            dlm.LogisticRegression(solver="lbfgs", max_iter=60),
+            {"C": loguniform(1e-2, 1e2)}, n_iter=6, cv=2,
+            random_state=0, refit=False)
+        rs.fit(X, y)
+        assert solvers.DISPATCH_COUNTS["solves"] == 2  # one sweep/fold
+        best = float(np.max(np.asarray(rs.cv_results_["mean_test_score"])))
+        assert 0.9 < best <= 1.0
