@@ -85,6 +85,23 @@ class _GLM(TPUEstimator):
             X, y, return_n_iter=True, family=family or self.family, **kwargs
         )
 
+    def _sweep_fit_values(self, X, y, Cs):
+        """``len(Cs)`` REGRESSION fits differing only in ``C`` as one
+        vmapped program (``solvers.lambda_sweep``); the grid-search fast
+        path calls this for identity-link families.  Eligibility (no
+        sample weights) is the caller's job.  Returns betas (K, p)."""
+        from ..solvers import lambda_sweep
+
+        X = _ingest_float(self, X)
+        Xi = add_intercept(X) if self.fit_intercept else X
+        kwargs = self._solver_call_kwargs()
+        kwargs.pop("lamduh")
+        betas, _ = lambda_sweep(
+            self.solver, Xi, y, [1.0 / float(c) for c in Cs],
+            family=self.family, **kwargs,
+        )
+        return betas
+
     def fit(self, X, y=None, sample_weight=None):
         X = _ingest_float(self, X)
         self.n_features_in_ = X.data.shape[1]

@@ -32,51 +32,86 @@ from ..utils import check_random_state
 from ._split import _take as _rows  # pandas/array/ShardedRows row subset
 
 
-def _sweep_acc_kernel_make():
-    # lazy: jax import deferred to first use, kernel jitted ONCE at
+def _sweep_kernels_make():
+    # lazy: jax import deferred to first use, kernels jitted ONCE at
     # module scope (a per-call closure would retrace every call)
     import jax
     import jax.numpy as jnp
     from functools import partial
 
-    @partial(jax.jit, static_argnames=("fit_intercept",))
-    def kernel(data, mask, y01v, B, *, fit_intercept):
+    def _eta(data, B, fit_intercept):
         if fit_intercept:
-            eta = data @ B[:, :-1].T + B[:, -1]  # (n, K)
-        else:
-            eta = data @ B.T
+            return data @ B[:, :-1].T + B[:, -1]  # (n, K)
+        return data @ B.T
+
+    @partial(jax.jit, static_argnames=("fit_intercept",))
+    def acc(data, mask, y01v, B, *, fit_intercept):
+        eta = _eta(data, B, fit_intercept)
         pred = (eta > 0).astype(jnp.float32)
         hit = (pred == y01v[:, None]).astype(jnp.float32) * mask[:, None]
         return jnp.sum(hit, axis=0) / jnp.maximum(jnp.sum(mask), 1.0)
 
-    return kernel
+    @partial(jax.jit, static_argnames=("fit_intercept",))
+    def r2(data, mask, yv, B, *, fit_intercept):
+        eta = _eta(data, B, fit_intercept)
+        m = mask[:, None]
+        ss_res = jnp.sum((eta - yv[:, None]) ** 2 * m, axis=0)
+        tot = jnp.maximum(jnp.sum(mask), 1.0)
+        mean_y = jnp.sum(yv * mask) / tot
+        ss_tot = jnp.maximum(jnp.sum((yv - mean_y) ** 2 * mask), 1e-30)
+        return 1.0 - ss_res / ss_tot
+
+    return acc, r2
 
 
-_SWEEP_ACC_KERNEL = None
+_SWEEP_KERNELS = None
+
+
+def _sweep_kernels():
+    global _SWEEP_KERNELS
+    if _SWEEP_KERNELS is None:
+        _SWEEP_KERNELS = _sweep_kernels_make()
+    return _SWEEP_KERNELS
+
+
+def _sweep_x(X):
+    from ..core.sharded import shard_rows
+
+    return X if isinstance(X, ShardedRows) else shard_rows(
+        np.asarray(X, dtype=np.float32))
+
+
+def _sweep_pad(vec, n_padded):
+    import jax.numpy as jnp
+
+    if isinstance(vec, ShardedRows):
+        return vec.data
+    vec = np.asarray(vec, dtype=np.float32)
+    return jnp.asarray(np.pad(vec, (0, n_padded - vec.shape[0])))
 
 
 def _sweep_accuracy(X, y, betas, classes, fit_intercept):
     """Per-lane accuracy for a (K, p) stack of binary GLM coefficients:
     one gemm scores every grid candidate at once; only the (K,) accuracy
-    vector leaves the device."""
-    import jax.numpy as jnp
-
-    from ..core.sharded import shard_rows
+    vector leaves the device.  X is sharded ONCE; the raw labels are
+    never float-coerced (string classes flow through binary_indicator)."""
     from ..linear_model.utils import binary_indicator
 
-    global _SWEEP_ACC_KERNEL
-    if _SWEEP_ACC_KERNEL is None:
-        _SWEEP_ACC_KERNEL = _sweep_acc_kernel_make()
-    Xs = X if isinstance(X, ShardedRows) else shard_rows(
-        np.asarray(X, dtype=np.float32))
-    ind = binary_indicator(y, classes[1])  # the encoding fit used
-    if isinstance(ind, ShardedRows):
-        y01 = ind.data
-    else:
-        y01 = jnp.asarray(
-            np.pad(ind, (0, Xs.data.shape[0] - ind.shape[0])))
-    return _SWEEP_ACC_KERNEL(Xs.data, Xs.mask, y01, betas,
-                             fit_intercept=bool(fit_intercept))
+    acc, _ = _sweep_kernels()
+    Xs = _sweep_x(X)
+    y01 = _sweep_pad(binary_indicator(y, classes[1]), Xs.data.shape[0])
+    return acc(Xs.data, Xs.mask, y01, betas,
+               fit_intercept=bool(fit_intercept))
+
+
+def _sweep_r2(X, y, betas, fit_intercept):
+    """Per-lane R² for a (K, p) stack of identity-link GLM coefficients
+    (the LinearRegression default score), one gemm for all lanes."""
+    _, r2 = _sweep_kernels()
+    Xs = _sweep_x(X)
+    yv = _sweep_pad(y, Xs.data.shape[0])
+    return r2(Xs.data, Xs.mask, yv, betas,
+              fit_intercept=bool(fit_intercept))
 
 logger = logging.getLogger(__name__)
 
@@ -373,17 +408,30 @@ class _BaseSearchCV(TPUEstimator):
         fold_lock = threading.Lock()
         fold_cache: dict = {}
         fold_refs = {fi: n_cand for fi in range(len(splits))}
+        # share fold slices ONLY for device inputs: jax arrays are
+        # immutable, so candidates cannot corrupt each other.  Host numpy
+        # slices are mutable (a Pipeline step with copy=False would
+        # scale the shared Xtr in place and poison later candidates), so
+        # hosts keep the old fresh-copy-per-task behavior — numpy fancy
+        # indexing is cheap; the expensive case (eager device gathers)
+        # is exactly the ShardedRows one.
+        _fold_cacheable = isinstance(Xh, ShardedRows)
+
+        def _fold_slices(fi):
+            tr, te = splits[fi]
+            return (
+                _rows(Xh, tr),
+                _rows(yh, tr) if yh is not None else None,
+                _rows(Xh, te),
+                _rows(yh, te) if yh is not None else None,
+            )
 
         def fold_get(fi):
+            if not _fold_cacheable:
+                return _fold_slices(fi)
             with fold_lock:
                 if fi not in fold_cache:
-                    tr, te = splits[fi]
-                    fold_cache[fi] = (
-                        _rows(Xh, tr),
-                        _rows(yh, tr) if yh is not None else None,
-                        _rows(Xh, te),
-                        _rows(yh, te) if yh is not None else None,
-                    )
+                    fold_cache[fi] = _fold_slices(fi)
                 return fold_cache[fi]
 
         def fold_release(fi):
@@ -519,17 +567,21 @@ class _BaseSearchCV(TPUEstimator):
         fall through to the per-task path.  Returns True when it filled
         the score arrays.
         """
+        from ..linear_model import LinearRegression as _OLS
         from ..linear_model import LogisticRegression as _LR
-        from ..solvers import pack_strategy
+        from ..solvers import grid_pack_strategy
 
         est = self.estimator
-        if type(est) is not _LR:
+        is_clf = type(est) is _LR
+        is_reg = type(est) is _OLS  # identity link: R² scores by gemm
+        if not (is_clf or is_reg):
             return False
-        if pack_strategy() != "packed":
+        if grid_pack_strategy() != "packed":
             return False
         if fit_params or self.scoring is not None:
             return False
-        if est.class_weight is not None or est.multi_class == "multinomial":
+        if is_clf and (est.class_weight is not None
+                       or est.multi_class == "multinomial"):
             return False
         if not candidates or any(set(p) != {"C"} for p in candidates):
             return False
@@ -547,13 +599,22 @@ class _BaseSearchCV(TPUEstimator):
                     if ytr is None or yte is None:
                         return False
                     sweep_est = clone(est)
-                    betas, classes = sweep_est._sweep_fit_binary(
-                        Xtr, ytr, Cs)
-                    filled_test[:, fi] = np.asarray(_sweep_accuracy(
-                        Xte, yte, betas, classes, est.fit_intercept))
+                    if is_clf:
+                        betas, classes = sweep_est._sweep_fit_binary(
+                            Xtr, ytr, Cs)
+
+                        def sc(Xf, yf):
+                            return _sweep_accuracy(
+                                Xf, yf, betas, classes, est.fit_intercept)
+                    else:
+                        betas = sweep_est._sweep_fit_values(Xtr, ytr, Cs)
+
+                        def sc(Xf, yf):
+                            return _sweep_r2(
+                                Xf, yf, betas, est.fit_intercept)
+                    filled_test[:, fi] = np.asarray(sc(Xte, yte))
                     if filled_train is not None:
-                        filled_train[:, fi] = np.asarray(_sweep_accuracy(
-                            Xtr, ytr, betas, classes, est.fit_intercept))
+                        filled_train[:, fi] = np.asarray(sc(Xtr, ytr))
                 finally:
                     # one fold live at a time: this path consumes ALL
                     # n_cand reservations of the fold it just finished

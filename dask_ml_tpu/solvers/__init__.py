@@ -17,6 +17,7 @@ from .algorithms import (  # noqa: F401
     gradient_descent,
     lbfgs,
     newton,
+    grid_pack_strategy,
     lambda_sweep,
     pack_strategy,
     packed_solve,
@@ -39,6 +40,7 @@ __all__ = [
     "lbfgs",
     "newton",
     "proximal_grad",
+    "grid_pack_strategy",
     "lambda_sweep",
     "pack_strategy",
     "packed_solve",

@@ -481,6 +481,26 @@ def pack_strategy() -> str:
     return "packed" if jax.default_backend() == "tpu" else "sequential"
 
 
+def grid_pack_strategy() -> str:
+    """Whether GRID-SEARCH C-sweeps pack (``solvers.lambda_sweep``) —
+    ``DASK_ML_TPU_GRID_PACK`` = ``packed`` | ``sequential`` | ``auto``.
+    A separate knob from ``DASK_ML_TPU_PACK``: the two optimizations
+    have opposite signs on CPU (OvR packing loses 1.5×, the grid sweep
+    WINS 2× at small n because it also removes per-candidate
+    orchestration) and must not share one switch.  Auto follows the
+    at-scale measurement: packed on TPU, sequential on CPU (at large n
+    the CPU solve dominates and vmap serialization loses,
+    ``grid_sweep_lbfgs`` CPU: 0.626×); small-n CPU users can force
+    ``packed`` for the measured orchestration win."""
+    from ..utils import env_choice
+
+    v = env_choice("DASK_ML_TPU_GRID_PACK",
+                   ("auto", "packed", "sequential"))
+    if v != "auto":
+        return v
+    return "packed" if jax.default_backend() == "tpu" else "sequential"
+
+
 def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
                  regularizer=L2, lamduh: float = 0.0, max_iter: int = 100,
                  tol: float = 1e-5, rho: float = 1.0, abstol: float = 1e-4,
@@ -618,8 +638,10 @@ def lambda_sweep(solver: str, X, y, lams, *, family: type[Family] = Logistic,
     if lam_v.ndim != 1:
         raise ValueError(f"lams must be 1-D, got shape {lam_v.shape}")
     K = lam_v.shape[0]
-    DISPATCH_COUNTS["solves"] += 1
     if solver == "admm":
+        DISPATCH_COUNTS["solves"] += 1  # after arg validation, like
+        # every per-solver entry point — a rejected config must not
+        # skew the dispatch instrumentation
         mesh = mesh or get_mesh()
         mh = MeshHolder(mesh)
 
@@ -648,6 +670,7 @@ def lambda_sweep(solver: str, X, y, lams, *, family: type[Family] = Logistic,
         )
     if solver == "newton" and getattr(family, "params_per_feature", 1) > 1:
         raise ValueError("newton does not support matrix-parameter families")
+    DISPATCH_COUNTS["solves"] += 1
     run = runners[solver]
     B0 = jnp.zeros((K, _pdim(x, family)), dtype=dt)
     extra_kw = (

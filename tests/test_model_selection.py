@@ -891,7 +891,7 @@ class TestPackedGlmGridSweep:
         X, grid, y = self._data(rng)
         results = {}
         for strat in ("packed", "sequential"):
-            monkeypatch.setenv("DASK_ML_TPU_PACK", strat)
+            monkeypatch.setenv("DASK_ML_TPU_GRID_PACK", strat)
             solvers.reset_dispatch_counts()
             gs = dms.GridSearchCV(
                 dlm.LogisticRegression(solver="lbfgs", max_iter=60),
@@ -917,7 +917,7 @@ class TestPackedGlmGridSweep:
         from dask_ml_tpu.core import shard_rows
 
         X, grid, y = self._data(rng)
-        monkeypatch.setenv("DASK_ML_TPU_PACK", "packed")
+        monkeypatch.setenv("DASK_ML_TPU_GRID_PACK", "packed")
         solvers.reset_dispatch_counts()
         gs = dms.GridSearchCV(
             dlm.LogisticRegression(solver="lbfgs", max_iter=60),
@@ -932,7 +932,7 @@ class TestPackedGlmGridSweep:
         from dask_ml_tpu import solvers
 
         X, grid, y = self._data(rng)
-        monkeypatch.setenv("DASK_ML_TPU_PACK", "packed")
+        monkeypatch.setenv("DASK_ML_TPU_GRID_PACK", "packed")
         # a second swept param: not a pure-C grid -> per-candidate path
         solvers.reset_dispatch_counts()
         gs = dms.GridSearchCV(
@@ -957,7 +957,7 @@ class TestPackedGlmGridSweep:
         from dask_ml_tpu import solvers
 
         X, _, y = self._data(rng)
-        monkeypatch.setenv("DASK_ML_TPU_PACK", "packed")
+        monkeypatch.setenv("DASK_ML_TPU_GRID_PACK", "packed")
         solvers.reset_dispatch_counts()
         rs = dms.RandomizedSearchCV(
             dlm.LogisticRegression(solver="lbfgs", max_iter=60),
@@ -967,3 +967,53 @@ class TestPackedGlmGridSweep:
         assert solvers.DISPATCH_COUNTS["solves"] == 2  # one sweep/fold
         best = float(np.max(np.asarray(rs.cv_results_["mean_test_score"])))
         assert 0.9 < best <= 1.0
+
+    def test_linear_regression_sweep_matches_sequential(self, rng, mesh,
+                                                        monkeypatch):
+        from dask_ml_tpu import solvers
+
+        X = rng.normal(size=(500, 6)).astype(np.float32)
+        w = rng.normal(size=6).astype(np.float32)
+        y = (X @ w + 0.3 + 0.05 * rng.normal(size=500)).astype(np.float32)
+        grid = {"C": np.logspace(0, 6, 5).tolist()}
+        results = {}
+        for strat in ("packed", "sequential"):
+            monkeypatch.setenv("DASK_ML_TPU_GRID_PACK", strat)
+            solvers.reset_dispatch_counts()
+            gs = dms.GridSearchCV(
+                dlm.LinearRegression(solver="lbfgs", max_iter=80),
+                grid, cv=3, refit=False)
+            gs.fit(X, y)
+            results[strat] = (gs, solvers.DISPATCH_COUNTS["solves"])
+        gp, dp = results["packed"]
+        gq, dq = results["sequential"]
+        np.testing.assert_allclose(
+            np.asarray(gp.cv_results_["mean_test_score"]),
+            np.asarray(gq.cv_results_["mean_test_score"]), atol=1e-5)
+        assert gp.best_index_ == gq.best_index_
+        assert dp == 3 and dq == 5 * 3
+
+    def test_inplace_mutating_pipeline_is_safe(self, rng):
+        # host fold slices must be FRESH per candidate: a Pipeline step
+        # with copy=False mutates its input in place, and a shared
+        # cached slice would poison every later candidate of the fold
+        # (r4 review finding — device slices stay shared: jax arrays
+        # are immutable)
+        from sklearn.pipeline import Pipeline
+        from sklearn.preprocessing import StandardScaler
+
+        X = (rng.normal(size=(200, 4)) * 5 + 3).astype(np.float64)
+        y = (X[:, 0] > 3).astype(int)
+        pipe = Pipeline([
+            ("sc", StandardScaler(copy=False)),
+            ("clf", SGDClassifier(tol=1e-3, random_state=0)),
+        ])
+        # the same candidate twice: identical params MUST score
+        # identically; under the shared-slice bug the second run fits
+        # on already-scaled data
+        gs = dms.GridSearchCV(
+            pipe, {"clf__alpha": [1e-4, 1e-4]}, cv=2, refit=False,
+            cache_cv=False)
+        gs.fit(X, y)
+        s = np.asarray(gs.cv_results_["mean_test_score"], dtype=float)
+        np.testing.assert_allclose(s[0], s[1])
