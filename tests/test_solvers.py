@@ -12,7 +12,9 @@ from dask_ml_tpu.solvers import (
     Logistic,
     Normal,
     Poisson,
+    lambda_sweep,
     lbfgs_minimize,
+    multinomial,
 )
 
 
@@ -195,3 +197,50 @@ class TestLineSearchStrategies:
         y = (X[:, 0] > 0).astype(np.float32)
         with pytest.raises(ValueError, match="line_search"):
             lbfgs(X, y, family=Logistic, line_search="bogus")
+
+
+class TestLambdaSweep:
+    """solvers.lambda_sweep: K solves of the same (X, y) at different
+    regularization strengths as one vmapped program — each lane must
+    match the standalone solver at its lamduh."""
+
+    def _data(self, rng):
+        X = rng.normal(size=(300, 5)).astype(np.float32)
+        y = (X[:, 0] - X[:, 1] > 0).astype(np.float32)
+        return X, y
+
+    @pytest.mark.parametrize("solver", ["lbfgs", "admm",
+                                        "gradient_descent",
+                                        "proximal_grad"])
+    def test_lanes_match_standalone(self, rng, mesh, solver):
+        X, y = self._data(rng)
+        lams = [0.01, 0.1, 1.0]
+        # tol=0: every lane and every standalone run executes exactly
+        # max_iter rounds, so a convergence-criterion difference cannot
+        # masquerade as a numeric one
+        kwargs = dict(family=Logistic, max_iter=80, tol=0.0)
+        if solver == "admm":
+            kwargs["inner_iter"] = 20
+            kwargs["abstol"] = kwargs.pop("tol")
+            kwargs["reltol"] = 0.0  # Boyd rule fully disabled: every
+            # lane and standalone run does exactly max_iter rounds
+        betas, n_its = lambda_sweep(solver, X, y, lams, **kwargs)
+        assert betas.shape[0] == len(lams)
+        assert n_its.shape == (len(lams),)
+        solo_fn = getattr(solvers, solver)
+        for i, lam in enumerate(lams):
+            solo = solo_fn(X, y, lamduh=lam, **kwargs)
+            np.testing.assert_allclose(
+                np.asarray(betas[i]), np.asarray(solo),
+                rtol=5e-3, atol=2e-3,
+                err_msg=f"{solver} lane {i} (lam={lam})")
+
+    def test_newton_matrix_family_rejected(self, rng, mesh):
+        X, y = self._data(rng)
+        with pytest.raises(ValueError, match="matrix-parameter"):
+            lambda_sweep("newton", X, y, [0.1], family=multinomial(3))
+
+    def test_bad_lams_shape_rejected(self, rng, mesh):
+        X, y = self._data(rng)
+        with pytest.raises(ValueError, match="1-D"):
+            lambda_sweep("lbfgs", X, y, [[0.1, 1.0]], family=Logistic)
