@@ -240,7 +240,8 @@ _FULL_PATH = os.path.join(
 # One number per workload on the compact line, first match wins.
 _HEADLINE_KEYS = (
     "rows_per_s", "per_round_ms", "per_eval_ms", "per_qr_ms",
-    "per_step_ms", "parse_mb_s", "packed_speedup", "speedup",
+    "per_step_ms", "parse_mb_s", "packed_speedup", "sweep_speedup",
+    "probe_grid_speedup", "speedup",
 )
 
 
