@@ -184,6 +184,12 @@ def _merge_and_finalize():
             _RESULT["unit"] = "rows*iters/s (fp32, carried from chip run)"
             _RESULT["vs_baseline"] = 1.0
             extra["headline_platform"] = best.get("platform")
+            # age-stamp carried evidence so a reader of the compact line
+            # cannot mistake it for a fresh measurement (VERDICT r4
+            # weak #3)
+            if best.get("ts"):
+                extra["headline_evidence_age_days"] = round(
+                    (time.time() - best["ts"]) / 86400, 1)
 
 
 def _compact_partial():
@@ -261,6 +267,8 @@ def _compact_line(result):
             if k in w:
                 ent[k] = w[k]
                 break
+        if "decision" in w:
+            ent["d"] = w["decision"]
         if w.get("from_partial"):
             ent["carried"] = True
         ws.append(ent)
@@ -274,6 +282,8 @@ def _compact_line(result):
             "n_devices": extra.get("n_devices"),
             "timed_out": extra.get("timed_out", False),
             "headline_platform": extra.get("headline_platform"),
+            "headline_evidence_age_days": extra.get(
+                "headline_evidence_age_days"),
             "full_payload": "BENCH_FULL.json",
             "workloads": ws,
         },
@@ -346,6 +356,83 @@ def _time_once(fn):
     t0 = time.perf_counter()
     fn()
     return time.perf_counter() - t0
+
+
+def _ab_stats(fn_a, fn_b, reps=5):
+    """Interleaved A/B wall timing with dispersion, for policy
+    adjudications.  The arms alternate every rep (and the starting arm
+    flips each round) so drift — page-cache warmup, thermal, background
+    load — lands on both arms equally; each arm reports median + IQR
+    over ``reps`` samples.  A winner is declared ONLY when the arms'
+    [q1, q3] intervals are disjoint; otherwise the decision is
+    ``"undecided"`` (round-4 lesson: the same nominal workload's A/B
+    ratio swung 0.416×–0.744× across single-shot runs, and a policy
+    default was being flipped by one noisy ratio).
+
+    Returns ``(stats_a, stats_b, decision)`` where each stats dict is
+    ``{median_s, iqr_s, reps}`` and decision is ``"a" | "b" |
+    "undecided"``."""
+    fn_a(); fn_b()  # compile/warm both arms
+    ta, tb = [], []
+    for r in range(reps):
+        pair = ((fn_a, ta), (fn_b, tb))
+        if r % 2:
+            pair = pair[::-1]
+        for fn, acc in pair:
+            t0 = time.perf_counter()
+            fn()
+            acc.append(time.perf_counter() - t0)
+    sa, sb, decision = _iqr_decide(ta, tb)
+    for s in (sa, sb):
+        s["median_s"] = round(s["median_s"], 4)
+        s["iqr_s"] = round(s["iqr_s"], 4)
+    return sa, sb, decision
+
+
+def _iqr_decide(ts_a, ts_b):
+    """THE adjudication rule, shared by every A/B form (wall-time and
+    slope): per-arm median + IQR, winner only when the [q1, q3]
+    intervals are disjoint.  One implementation so the two measurement
+    styles can never drift onto different decision criteria."""
+    import numpy as np
+
+    def stats(ts):
+        q1, med, q3 = np.percentile(ts, [25, 50, 75])
+        return (
+            {"median_s": float(med), "iqr_s": float(q3 - q1),
+             "reps": len(ts)},
+            float(q1), float(q3),
+        )
+
+    sa, a1, a3 = stats(ts_a)
+    sb, b1, b3 = stats(ts_b)
+    if a3 < b1:
+        decision = "a"
+    elif b3 < a1:
+        decision = "b"
+    else:
+        decision = "undecided"
+    return sa, sb, decision
+
+
+def _slope_ab(fn_a, fn_b, lo_i, hi_i, reps=5):
+    """A/B of per-iteration SLOPES with the same interleaving/dispersion
+    discipline as ``_ab_stats``: each rep measures one two-point slope
+    per arm (arms alternate, starting arm flips), so the relay's
+    constant RTT cancels within each slope and drift cancels across
+    arms.  Returns ``(stats_a, stats_b, decision)`` with per-iteration
+    medians in ``median_s``."""
+    fn_a(hi_i); fn_b(hi_i)  # compile both
+    sl_a, sl_b = [], []
+    for r in range(reps):
+        pair = ((fn_a, sl_a), (fn_b, sl_b))
+        if r % 2:
+            pair = pair[::-1]
+        for fn, acc in pair:
+            t_lo = _time_once(lambda: fn(lo_i))
+            t_hi = _time_once(lambda: fn(hi_i))
+            acc.append(max((t_hi - t_lo) / (hi_i - lo_i), 1e-9))
+    return _iqr_decide(sl_a, sl_b)
 
 
 def _two_point_slope(fn, lo_i, hi_i, reps=3):
@@ -461,10 +548,15 @@ def main():
         # fetched runs of different iteration counts — the RTT and any
         # constant dispatch cost cancel.  tol=0 keeps the loop from
         # converging early, so the round counts are exact.
+        from dask_ml_tpu.ops.scatter import scatter_strategy
+
+        scatter = scatter_strategy(k)  # resolved OUTSIDE the jit (static)
+
         def run(n_it):
             out = _lloyd_loop(
                 s.data, s.mask, centers, jnp.float32(0.0), jnp.int32(n_it),
                 mesh_holder=mh, use_pallas=use_pallas, mode=mode,
+                scatter=scatter,
             )
             float(out[1])  # result fetch = the one reliable sync
             return int(out[2])  # rounds ACTUALLY executed (the loop may
@@ -735,17 +827,27 @@ def main():
             per = _two_point_slope(run, lo_it, hi_it, reps=reps)
             return per, last
 
-        per_outer, (_, n_it32) = slope_time(lambda n: solve(n, sXi))
-        dt2 = per_outer * admm_iters
-
         # mixed precision: same solve with a bf16 design matrix (f32
         # params/reductions) — X's HBM traffic halves, the dominant cost.
         # The entry carries its own accuracy (parity gate: a fast wrong
         # answer is not a speedup) and both runs' executed outer counts
         # (the inner L-BFGS count is adaptive and bf16 rounding can shift
         # it, so the ratio mixes work-count and bandwidth effects).
+        # INTERLEAVED slope A/B (r4 weak #2): the fp32 absolute entry and
+        # the bf16 ratio come from the same dispersion-aware measurement.
+        last = {}
+
+        def run32(n_outer):
+            last["fp32"] = solve(n_outer, sXi)
+
+        def run16(n_outer):
+            last["bf16"] = solve(n_outer, sXi16)
+
         try:
-            per16, (beta16, n_it16) = slope_time(lambda n: solve(n, sXi16))
+            s32, s16, dec16 = _slope_ab(run32, run16, lo_it, hi_it)
+            per_outer, per16 = s32["median_s"], s16["median_s"]
+            _, n_it32 = last["fp32"]
+            beta16, n_it16 = last["bf16"]
             acc16 = float(_device_acc(
                 sX2.data, sy2.data, sX2.mask,
                 jnp.asarray(beta16[:-1]), beta16[-1].astype(jnp.float32),
@@ -754,6 +856,14 @@ def main():
                 "workload": f"admm_logreg_bf16_{n2}x{d2}_{admm_iters}outer",
                 "per_outer_ms": round(per16 * 1e3, 3),
                 "vs_fp32_speedup": round(per_outer / per16, 3),
+                "stats": {
+                    "fp32": {k: round(v, 6) if isinstance(v, float) else v
+                             for k, v in s32.items()},
+                    "bf16": {k: round(v, 6) if isinstance(v, float) else v
+                             for k, v in s16.items()},
+                },
+                "decision": {"a": "fp32", "b": "bf16"}.get(
+                    dec16, "undecided"),
                 "train_accuracy": round(acc16, 4),
                 "parity_ok": bool(acc16 >= acc - 0.02),
                 # executed OUTER counts of the timed hi runs: if these
@@ -762,6 +872,9 @@ def main():
             })
         except Exception:
             extra["admm_bf16_error"] = traceback.format_exc(limit=2)
+            # the fp32 absolute entry must survive a bf16-arm failure
+            per_outer, _ = slope_time(lambda n: solve(n, sXi))
+        dt2 = per_outer * admm_iters
         # NO bw/mfu claim here: the inner L-BFGS iteration count is
         # adaptive (Wolfe-failure exit), so X-pass counts are data-
         # dependent; the roofline-accountable proxy is the
@@ -1106,10 +1219,7 @@ def main():
                 float(outs[-1][0])
 
             try:
-                run_packed(); run_seq()  # compile both
-                t_packed = min(
-                    _time_once(run_packed) for _ in range(3))
-                t_seq = min(_time_once(run_seq) for _ in range(3))
+                s_pk, s_sq, dec = _ab_stats(run_packed, run_seq)
             finally:
                 # restore, never leak the forced arm (or clobber a
                 # user-provided setting) past this A/B
@@ -1117,18 +1227,25 @@ def main():
                     os.environ.pop("DASK_ML_TPU_PACK", None)
                 else:
                     os.environ["DASK_ML_TPU_PACK"] = _pack_prev
-            measured_winner = (
-                "packed" if t_packed <= t_seq else "sequential")
+            measured_winner = {
+                "a": "packed", "b": "sequential"}.get(dec, "undecided")
             _record({
                 "workload": f"packed_ovr_lbfgs_{nP}x{dP}_K{KP}",
-                "packed_s": round(t_packed, 3),
-                "sequential_s": round(t_seq, 3),
-                "packed_speedup": round(t_seq / max(t_packed, 1e-9), 3),
+                "packed_s": s_pk["median_s"],
+                "sequential_s": s_sq["median_s"],
+                "packed_speedup": round(
+                    s_sq["median_s"] / max(s_pk["median_s"], 1e-9), 3),
+                "stats": {"packed": s_pk, "sequential": s_sq},
+                # the decision is the DISPERSION-AWARE winner: undecided
+                # when the arms' IQR intervals overlap — a default must
+                # never flip on a margin inside run-to-run noise
+                "decision": measured_winner,
                 # the auto policy's pick vs what this run measured — a
                 # mismatch on chip is the signal to flip the default
                 "auto_policy": auto_choice,
-                "auto_matches_measurement": bool(
-                    auto_choice == measured_winner),
+                "auto_matches_measurement": (
+                    None if measured_winner == "undecided"
+                    else bool(auto_choice == measured_winner)),
             })
 
             # C-sweep (the r4 grid-search fast path): K solves of the
@@ -1150,14 +1267,17 @@ def main():
                                lamduh=float(lam), max_iter=it_p, tol=0.0)
                 float(b[0])
 
-            run_sweep(); run_sweep_seq()  # compile
-            t_sw = min(_time_once(run_sweep) for _ in range(3))
-            t_sw_seq = min(_time_once(run_sweep_seq) for _ in range(3))
+            s_sw, s_sws, dec_sw = _ab_stats(run_sweep, run_sweep_seq)
             _record({
                 "workload": f"grid_sweep_lbfgs_{nP}x{dP}_K8",
-                "sweep_s": round(t_sw, 3),
-                "sequential_s": round(t_sw_seq, 3),
-                "sweep_speedup": round(t_sw_seq / max(t_sw, 1e-9), 3),
+                "sweep_s": s_sw["median_s"],
+                "sequential_s": s_sws["median_s"],
+                "sweep_speedup": round(
+                    s_sws["median_s"] / max(s_sw["median_s"], 1e-9), 3),
+                "stats": {"packed": s_sw, "sequential": s_sws},
+                "decision": {
+                    "a": "packed", "b": "sequential"}.get(
+                        dec_sw, "undecided"),
             })
 
             # line-search strategy go/no-go (lbfgs_core docstring): the
@@ -1170,16 +1290,19 @@ def main():
                            line_search=ls)
                 float(b[0])
 
-            run_ls("backtrack"); run_ls("probe_grid")  # compile
-            t_bt = min(_time_once(lambda: run_ls("backtrack"))
-                       for _ in range(3))
-            t_pg = min(_time_once(lambda: run_ls("probe_grid"))
-                       for _ in range(3))
+            s_pg, s_bt, dec_ls = _ab_stats(
+                lambda: run_ls("probe_grid"),
+                lambda: run_ls("backtrack"))
             _record({
                 "workload": f"lbfgs_line_search_{nP}x{dP}",
-                "backtrack_s": round(t_bt, 3),
-                "probe_grid_s": round(t_pg, 3),
-                "probe_grid_speedup": round(t_bt / max(t_pg, 1e-9), 3),
+                "backtrack_s": s_bt["median_s"],
+                "probe_grid_s": s_pg["median_s"],
+                "probe_grid_speedup": round(
+                    s_bt["median_s"] / max(s_pg["median_s"], 1e-9), 3),
+                "stats": {"probe_grid": s_pg, "backtrack": s_bt},
+                "decision": {
+                    "a": "probe_grid", "b": "backtrack"}.get(
+                        dec_ls, "undecided"),
             })
     except Exception:
         extra["packed_error"] = traceback.format_exc(limit=3)
