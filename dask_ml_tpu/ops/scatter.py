@@ -50,14 +50,27 @@ def scatter_strategy(num_segments: int | None = None) -> str:
     return "onehot" if jax.default_backend() == "tpu" else "segsum"
 
 
-def bucket_sum(values, ids, num_segments: int, *, precision=None):
+def bucket_sum(values, ids, num_segments: int, *, precision=None,
+               strategy: str | None = None):
     """Sum ``values`` ((n,) or (n, d)) into buckets given by ``ids``.
 
     Pre-weight ``values`` for weighted accumulation.  ``precision``
     applies to the one-hot gemm path only (segment_sum accumulates in
     full f32 natively, which is strictly at least as precise).
+
+    ``strategy``: callers inside jitted code MUST resolve
+    ``scatter_strategy`` OUTSIDE the jit and pass it through as a static
+    argument — resolving here at trace time would bake the env value
+    into the jit cache, so flipping ``DASK_ML_TPU_SCATTER`` in-process
+    (the documented A/B use case) would silently keep the stale
+    strategy.  ``None`` (eager callers) resolves at call time.  The
+    large-segment OOM guard binds either way.
     """
-    if scatter_strategy(num_segments) == "segsum":
+    if strategy is None:
+        strategy = scatter_strategy(num_segments)
+    elif num_segments > _ONEHOT_MAX_SEGMENTS:
+        strategy = "segsum"
+    if strategy == "segsum":
         return jax.ops.segment_sum(values, ids, num_segments=num_segments)
     oh = jax.nn.one_hot(ids, num_segments, dtype=values.dtype)  # (n, k)
     if values.ndim == 1:

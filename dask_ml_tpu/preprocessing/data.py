@@ -57,8 +57,9 @@ def _approx_rows_threshold() -> int:
     return int(os.environ.get("DASK_ML_TPU_EXACT_QUANTILE_MAX_ROWS", 4_000_000))
 
 
-@partial(jax.jit, static_argnames=("bins", "refinements"))
-def _hist_quantiles(x, mask, probs, *, bins=4096, refinements=3):
+@partial(jax.jit, static_argnames=("bins", "refinements", "scatter"))
+def _hist_quantiles(x, mask, probs, *, bins=4096, refinements=3,
+                    scatter="segsum"):
     """Merge-based approximate per-feature quantiles, one fused program.
 
     The ``da.percentile`` twin: per-shard histograms merge by ADDITION
@@ -106,7 +107,7 @@ def _hist_quantiles(x, mask, probs, *, bins=4096, refinements=3):
 
         counts = bucket_sum(
             (inside).ravel(), (feat_off + idx).ravel(),
-            num_segments=d * bins,
+            num_segments=d * bins, strategy=scatter,
         ).reshape(d, bins)
         cdf = jnp.cumsum(counts, axis=1)
 
@@ -174,7 +175,11 @@ def _masked_quantiles(x, mask, probs, method: str = "auto"):
     ):
         xm = jnp.where(mask[:, None] > 0, x, jnp.nan)
         return jnp.nanquantile(xm, jnp.asarray(probs), axis=0)
-    return _hist_quantiles(x, mask, jnp.asarray(probs))
+    from ..ops.scatter import scatter_strategy
+
+    # resolved OUTSIDE the jit: the env knob must key the jit cache
+    return _hist_quantiles(x, mask, jnp.asarray(probs),
+                           scatter=scatter_strategy(x.shape[1] * 4096))
 
 
 class StandardScaler(TransformerMixin, TPUEstimator):
