@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..core.compat import shard_map_unchecked as _shard_map
-from ..core.mesh import DATA_AXIS, MeshHolder, get_mesh
+from ..core.mesh import MeshHolder, data_axes, data_axes_size, get_mesh
 from ..core.sharded import ShardedRows
 
 
@@ -51,10 +51,14 @@ def _ring_impl(x, y, *, mesh_holder, fn):
     ring while each device fills its row block column-block by
     column-block."""
     mesh = mesh_holder.mesh
-    n_shards = mesh.shape[DATA_AXIS]
+    # the ring runs over EVERY data-carrying axis (('dcn','data') on a
+    # hierarchical mesh — collectives accept the axis tuple with
+    # flattened ring semantics, so cross-slice hops ride DCN)
+    row_ax = data_axes(mesh)
+    n_shards = data_axes_size(mesh)
 
     def local(x_l, y_l):
-        i = jax.lax.axis_index(DATA_AXIS)
+        i = jax.lax.axis_index(row_ax)
         m_l = y_l.shape[0]
         out0 = jnp.zeros((x_l.shape[0], n_shards * m_l), dtype=x_l.dtype)
         perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
@@ -69,7 +73,7 @@ def _ring_impl(x, y, *, mesh_holder, fn):
             else:
                 tile = fn(x_l, y_cur)  # (n_l, m_l) — local MXU gemm
             out = jax.lax.dynamic_update_slice(out, tile, (0, col))
-            y_cur = jax.lax.ppermute(y_cur, DATA_AXIS, perm)
+            y_cur = jax.lax.ppermute(y_cur, row_ax, perm)
             return (y_cur, out), None
 
         (_, out), _ = jax.lax.scan(
@@ -79,8 +83,8 @@ def _ring_impl(x, y, *, mesh_holder, fn):
 
     return _shard_map(
         local, mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
-        out_specs=P(DATA_AXIS, None),
+        in_specs=(P(row_ax, None), P(row_ax, None)),
+        out_specs=P(row_ax, None),
     )(x, y)
 
 
@@ -162,13 +166,22 @@ def _sq_euclidean_safe(x, y, row0=0, col0=0, self_pairs=False):
     global diagonal is pinned to exactly 0 and excluded from flagging,
     so self-distance calls keep the gemm fast path instead of always
     tripping the d²≈0 diagonal."""
+    if x.shape[0] == 0 or y.shape[0] == 0:
+        return jnp.zeros((x.shape[0], y.shape[0]), dtype=x.dtype)
+    # Distances are translation-invariant: center both operands by ONE
+    # shared per-feature anchor before expanding.  Data with a large mean
+    # offset (norms >> spread — exactly the cancellation-prone regime)
+    # would otherwise flag EVERY entry and permanently abandon the gemm
+    # for the chunked O(n·m·d) recompute; after centering, norms reflect
+    # spread, so the flag fires only for genuinely near-duplicate rows.
+    anchor = 0.5 * (jnp.mean(x, axis=0) + jnp.mean(y, axis=0))
+    x = x - anchor
+    y = y - anchor
     x_norm = jnp.sum(x * x, axis=1, keepdims=True)
     y_norm = jnp.sum(y * y, axis=1, keepdims=True).T
     scale = x_norm + y_norm
     d2 = scale - 2.0 * jnp.dot(x, y.T, precision=jax.lax.Precision.HIGHEST)
     d2 = jnp.maximum(d2, 0.0)
-    if x.shape[0] == 0 or y.shape[0] == 0:
-        return d2
     flagged = d2 < _SAFE_TAU * scale
     if self_pairs:
         ii = row0 + jax.lax.broadcasted_iota(jnp.int32, d2.shape, 0)
