@@ -35,7 +35,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core.compat import shard_map_unchecked
-from ..core.mesh import DATA_AXIS, MeshHolder, get_mesh
+from ..core.mesh import MeshHolder, get_mesh
 from ..core.sharded import ShardedRows, shard_rows
 from .families import Family, Logistic
 from .lbfgs_core import lbfgs_minimize, run_line_search
@@ -339,19 +339,28 @@ def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
 
 
 @partial(jax.jit, static_argnames=(
-    "family", "reg", "mesh_holder", "inner_iter", "line_search"))
+    "family", "reg", "mesh_holder", "inner_iter", "line_search",
+    "adaptive_rho"))
 def _admm_run(x, yv, mask, lamduh, rho, abstol, reltol, inner_tol, max_it,
               *, family, reg, mesh_holder, inner_iter,
-              line_search="backtrack"):
+              line_search="backtrack", adaptive_rho=True):
     mesh = mesh_holder.mesh
-    n_shards = mesh.shape[DATA_AXIS]
+    # rows shard over ('dcn', 'data') on a hierarchical multi-slice mesh
+    # (core.distributed.global_mesh(hierarchical=True)) — the psums below
+    # then span the slice boundary: XLA splits each into an ICI segment
+    # and a DCN segment from the axis tuple
+    from ..core.mesh import data_axes as _data_axes
+    from ..core.mesh import data_axes_size as _data_axes_size
+
+    row_ax = _data_axes(mesh)
+    n_shards = _data_axes_size(mesh)
     d = _pdim(x, family)
 
-    def one_shard(xb, yb, mb, z_rep, beta_b, u_b):
+    def one_shard(xb, yb, mb, z_rep, beta_b, u_b, rho_c):
         u0, b0 = u_b[0], beta_b[0]
 
         def local_obj(b):
-            return family.loss(b, xb, yb, mb) + 0.5 * rho * jnp.sum(
+            return family.loss(b, xb, yb, mb) + 0.5 * rho_c * jnp.sum(
                 (b - z_rep + u0) ** 2
             )
 
@@ -359,30 +368,31 @@ def _admm_run(x, yv, mask, lamduh, rho, abstol, reltol, inner_tol, max_it,
             local_obj, b0, max_iter=inner_iter, tol=inner_tol,
             line_search=line_search,
         )
-        b_bar = lax.psum(b_new, DATA_AXIS) / n_shards
-        u_bar = lax.psum(u0, DATA_AXIS) / n_shards
-        z_new = reg.prox(b_bar + u_bar, lamduh / (rho * n_shards))
+        b_bar = lax.psum(b_new, row_ax) / n_shards
+        u_bar = lax.psum(u0, row_ax) / n_shards
+        z_new = reg.prox(b_bar + u_bar, lamduh / (rho_c * n_shards))
         u_new = u0 + b_new - z_new
         # residual pieces
-        primal_sq = lax.psum(jnp.sum((b_new - z_new) ** 2), DATA_AXIS)
-        beta_norm_sq = lax.psum(jnp.sum(b_new ** 2), DATA_AXIS)
-        u_norm_sq = lax.psum(jnp.sum(u_new ** 2), DATA_AXIS)
+        primal_sq = lax.psum(jnp.sum((b_new - z_new) ** 2), row_ax)
+        beta_norm_sq = lax.psum(jnp.sum(b_new ** 2), row_ax)
+        u_norm_sq = lax.psum(jnp.sum(u_new ** 2), row_ax)
         return b_new[None], u_new[None], z_new, primal_sq, beta_norm_sq, u_norm_sq
 
     step = shard_map_unchecked(
         one_shard,
         mesh,
         in_specs=(
-            P(DATA_AXIS, None),  # x
-            P(DATA_AXIS),  # y
-            P(DATA_AXIS),  # mask
+            P(row_ax, None),  # x
+            P(row_ax),  # y
+            P(row_ax),  # mask
             P(),  # z
-            P(DATA_AXIS, None),  # beta per shard
-            P(DATA_AXIS, None),  # u per shard
+            P(row_ax, None),  # beta per shard
+            P(row_ax, None),  # u per shard
+            P(),  # rho (replicated scalar; part of the carry when adaptive)
         ),
         out_specs=(
-            P(DATA_AXIS, None),
-            P(DATA_AXIS, None),
+            P(row_ax, None),
+            P(row_ax, None),
             P(),
             P(),
             P(),
@@ -395,29 +405,45 @@ def _admm_run(x, yv, mask, lamduh, rho, abstol, reltol, inner_tol, max_it,
     sqrt_d = jnp.sqrt(jnp.asarray(d, _param_dtype(x)))
 
     def cond(state):
-        i, _, _, _, primal, dual, eps_pri, eps_dual = state
+        i, _, _, _, _, primal, dual, eps_pri, eps_dual = state
         return (i < max_it) & ((primal >= eps_pri) | (dual >= eps_dual))
 
     def body(state):
-        i, beta_l, u_l, z, *_ = state
+        i, beta_l, u_l, z, rho_c, *_ = state
         z_old = z
         beta_l, u_l, z, primal_sq, beta_sq, u_sq = step(
-            x, yv, mask, z, beta_l, u_l
+            x, yv, mask, z, beta_l, u_l, rho_c
         )
         primal = jnp.sqrt(primal_sq)
-        dual = rho * jnp.sqrt(n_shards * jnp.sum((z - z_old) ** 2))
+        dual = rho_c * jnp.sqrt(n_shards * jnp.sum((z - z_old) ** 2))
         eps_pri = sqrt_d * abstol + reltol * jnp.maximum(
             jnp.sqrt(beta_sq), jnp.sqrt(n_shards * 1.0) * jnp.linalg.norm(z)
         )
-        eps_dual = sqrt_d * abstol + reltol * rho * jnp.sqrt(u_sq)
-        return i + 1, beta_l, u_l, z, primal, dual, eps_pri, eps_dual
+        eps_dual = sqrt_d * abstol + reltol * rho_c * jnp.sqrt(u_sq)
+        if adaptive_rho:
+            # Boyd §3.4.1 residual balancing: a lopsided rho makes one
+            # residual stall (tiny rho → dual ≈ 0 while primal creeps;
+            # huge rho → the reverse).  Doubling/halving toward balance
+            # converges across ~6 orders of magnitude of initial rho;
+            # the scaled dual u must be rescaled by rho/rho_new.  Clamped
+            # to ±1e4 of the initial rho so a pathological run cannot
+            # drive rho to inf/0.
+            grow = primal > 10.0 * dual
+            shrink = dual > 10.0 * primal
+            rho_new = jnp.where(grow, rho_c * 2.0,
+                                jnp.where(shrink, rho_c * 0.5, rho_c))
+            rho_new = jnp.clip(rho_new, rho * 1e-4, rho * 1e4)
+            u_l = u_l * (rho_c / rho_new)
+            rho_c = rho_new
+        return i + 1, beta_l, u_l, z, rho_c, primal, dual, eps_pri, eps_dual
 
     inf = jnp.asarray(jnp.inf, _param_dtype(x))
     zero = jnp.asarray(0.0, _param_dtype(x))
     beta_l0 = jnp.zeros((n_shards, d), dtype=_param_dtype(x))
     u_l0 = jnp.zeros((n_shards, d), dtype=_param_dtype(x))
     z0 = jnp.zeros(d, dtype=_param_dtype(x))
-    init = (jnp.int32(0), beta_l0, u_l0, z0, inf, inf, zero, zero)
+    init = (jnp.int32(0), beta_l0, u_l0, z0,
+            jnp.asarray(rho, _param_dtype(x)), inf, inf, zero, zero)
     final = lax.while_loop(cond, body, init)
     return final[3], final[0]
 
@@ -426,7 +452,8 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
          lamduh: float = 0.0, rho: float = 1.0, max_iter: int = 100,
          abstol: float = 1e-4, reltol: float = 1e-2,
          inner_iter: int = 50, inner_tol: float = 1e-6, mesh=None,
-         return_n_iter: bool = False, line_search: str = "backtrack"):
+         return_n_iter: bool = False, line_search: str = "backtrack",
+         adaptive_rho: bool = True):
     """Consensus ADMM (Boyd et al. §8): per-shard local subproblems solved by
     the jit-safe L-BFGS inside ``shard_map``, consensus z through the
     regularizer's prox, scaled dual updates.
@@ -436,6 +463,12 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
     (SURVEY.md §3.1).  Here the ENTIRE solve is one XLA program: P parallel
     local L-BFGS runs + psums for consensus and residuals per round, with
     the Boyd stopping rule evaluated on device.
+
+    ``adaptive_rho`` (default on; the reference keeps rho fixed) applies
+    Boyd §3.4.1 residual balancing on device — a property-test-found
+    robustness gap: with a fixed rho 3 orders of magnitude off, the solve
+    stalled below 85% train accuracy at max_iter=150 on separable data
+    (tests/test_properties.py :: TestAdversarialSolvers).
     """
     reg = get_regularizer(regularizer)
     mesh = mesh or get_mesh()
@@ -449,6 +482,7 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
         jnp.asarray(inner_tol, dt), jnp.int32(max_iter),
         family=family, reg=reg, mesh_holder=MeshHolder(mesh),
         inner_iter=inner_iter, line_search=line_search,
+        adaptive_rho=adaptive_rho,
     )
     # n_it stays a device scalar: converting here would block the
     # async dispatch pipeline (callers convert after ALL solves)
@@ -624,8 +658,10 @@ def lambda_sweep(solver: str, X, y, lams, *, family: type[Family] = Logistic,
     ``packed_solve`` (there the lanes differ in y, here in ``lamduh``,
     which every runner takes as a TRACED scalar, so a hyperparameter
     sweep is one dispatch instead of K).  No sequential fallback here:
-    the caller gates on ``pack_strategy()`` and keeps its per-candidate
-    path where packing measured slower.
+    the grid-search caller gates on ``grid_pack_strategy()`` (NOT
+    ``pack_strategy()`` — the two knobs are deliberately separate, with
+    opposite CPU signs) and keeps its per-candidate path where packing
+    measured slower.
 
     Returns (betas (K, pdim), n_iters (K,)).
     """
