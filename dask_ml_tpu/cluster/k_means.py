@@ -72,8 +72,8 @@ def _kmeans_mode() -> str:
     return v
 
 
-@_fpartial(jax.jit, static_argnames=("mode",))
-def _lloyd_step(x, mask, centers, mode="highest"):
+@_fpartial(jax.jit, static_argnames=("mode", "scatter"))
+def _lloyd_step(x, mask, centers, mode="highest", scatter="segsum"):
     """One Lloyd round: assign, reduce per-cluster sums/counts, update.
 
     Returns (new_centers, inertia, shift).  Everything is gemm-shaped; with
@@ -104,9 +104,11 @@ def _lloyd_step(x, mask, centers, mode="highest"):
     k_ = centers.shape[0]
     prec = (jax.lax.Precision.HIGH if mode == "fast"
             else jax.lax.Precision.HIGHEST)
-    sums = bucket_sum(x * mask[:, None], labels, k_, precision=prec)
+    sums = bucket_sum(x * mask[:, None], labels, k_, precision=prec,
+                      strategy=scatter)
     counts = bucket_sum(mask, labels, k_,
-                        precision=jax.lax.Precision.HIGHEST)  # (k,)
+                        precision=jax.lax.Precision.HIGHEST,
+                        strategy=scatter)  # (k,)
     safe = safe_denominator(counts)[:, None]
     new_centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
     shift = jnp.sum((new_centers - centers) ** 2)
@@ -120,16 +122,17 @@ def _lloyd_step_pallas(x, mask, centers, mesh, mode="highest"):
     from jax.sharding import PartitionSpec as P
 
     from ..core.compat import shard_map_unchecked
-    from ..core.mesh import DATA_AXIS
+    from ..core.mesh import data_axes
     from ..ops import lloyd_assign_reduce
 
     kmode = "fast" if mode == "fast" else "parity"
+    row_ax = data_axes(mesh)
 
     def local(xb, mb, c):
         sums, counts, inertia = lloyd_assign_reduce(xb, mb, c, mode=kmode)
-        sums = lax.psum(sums, DATA_AXIS)
-        counts = lax.psum(counts, DATA_AXIS)
-        inertia = lax.psum(inertia, DATA_AXIS)
+        sums = lax.psum(sums, row_ax)
+        counts = lax.psum(counts, row_ax)
+        inertia = lax.psum(inertia, row_ax)
         safe = safe_denominator(counts)[:, None]
         new_centers = jnp.where(counts[:, None] > 0, sums / safe, c)
         shift = jnp.sum((new_centers - c) ** 2)
@@ -137,7 +140,7 @@ def _lloyd_step_pallas(x, mask, centers, mesh, mode="highest"):
 
     return shard_map_unchecked(
         local, mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+        in_specs=(P(row_ax, None), P(row_ax), P()),
         out_specs=(P(), P(), P()),
     )(x, mask, centers)
 
@@ -175,9 +178,10 @@ def _pallas_ok(x, centers) -> bool:
 from ..core.mesh import MeshHolder  # noqa: E402
 
 
-@_fpartial(jax.jit, static_argnames=("mesh_holder", "use_pallas", "mode"))
+@_fpartial(jax.jit,
+           static_argnames=("mesh_holder", "use_pallas", "mode", "scatter"))
 def _lloyd_loop(x, mask, centers, tol, max_iter, *, mesh_holder=None,
-                use_pallas=False, mode="highest"):
+                use_pallas=False, mode="highest", scatter="segsum"):
     """The ENTIRE Lloyd iteration as one XLA program.
 
     The reference re-enters the scheduler every round (SURVEY.md §3.2); a
@@ -192,7 +196,7 @@ def _lloyd_loop(x, mask, centers, tol, max_iter, *, mesh_holder=None,
     def step(x_, m_, c_):
         if use_pallas:
             return _lloyd_step_pallas(x_, m_, c_, mesh_holder.mesh, mode)
-        return _lloyd_step(x_, m_, c_, mode)
+        return _lloyd_step(x_, m_, c_, mode, scatter)
 
     def cond(state):
         i, _, _, shift = state
@@ -437,12 +441,17 @@ class KMeans(TransformerMixin, TPUEstimator):
         use_pallas = _pallas_ok(x, centers)
         with _timer("Lloyd loop", logger, logging.DEBUG):
             from ..core.mesh import get_mesh
+            from ..ops.scatter import scatter_strategy
 
+            # policy knobs resolve OUTSIDE the jit so they participate in
+            # the jit cache key (static args); resolving inside would bake
+            # the first call's env values in for the process lifetime
             centers, _, n_iter_dev = _lloyd_loop(
                 x, mask, centers, tol.astype(x.dtype), jnp.int32(self.max_iter),
                 mesh_holder=MeshHolder(get_mesh()) if use_pallas else None,
                 use_pallas=use_pallas,
                 mode=_kmeans_mode(),
+                scatter=scatter_strategy(self.n_clusters),
             )
             n_iter = int(n_iter_dev)
         labels, inertia = _assign(x, mask, centers)

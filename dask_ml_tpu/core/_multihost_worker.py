@@ -73,11 +73,14 @@ def main(pid: int, nproc: int, port: str, local_devices: int = 4) -> None:
 
     # -- flagship 2: fused Lloyd loop on the same global mesh
     from dask_ml_tpu.cluster.k_means import _lloyd_loop
+    from dask_ml_tpu.ops.scatter import scatter_strategy
 
+    _scatter = scatter_strategy(2)  # resolved OUTSIDE the jit (static):
+    # defaulting it would bake segsum in and drop the TPU onehot policy
     centers0 = np.stack([Xl[:3].mean(0), Xl[3:6].mean(0) + 2.0]).astype(np.float32)
     centers, inertia, n_iter = _lloyd_loop(
         Xs.data, Xs.mask, jnp.asarray(centers0),
-        jnp.float32(1e-4), jnp.int32(20),
+        jnp.float32(1e-4), jnp.int32(20), scatter=_scatter,
     )[:3]
     assert np.isfinite(float(inertia))
 
@@ -138,6 +141,36 @@ def main(pid: int, nproc: int, port: str, local_devices: int = 4) -> None:
     hb.fit(Xs2, ys2, classes=[0.0, 1.0])
     print(f"[proc {pid}] hyperband_best={hb.best_score_:.6f} "
           f"n_models={hb.n_models_}", flush=True)
+
+    # -- flagship 5 (round 5): the SAME ADMM + Lloyd programs over the
+    # hierarchical ('dcn', 'data', 'model') mesh with the dcn axis
+    # spanning the two processes (SURVEY.md §2.3 multi-slice mesh).  The
+    # row-shard count is identical to the flat mesh (2 dcn × 4 data = 8),
+    # so the consensus math is the same program and the results must
+    # agree with the flat-mesh fits to fp tolerance — proving the
+    # ('dcn','data') axis-tuple collectives are correct end-to-end, not
+    # just that the mesh builds.
+    set_mesh(hmesh)
+    Xh = dist.shard_rows_global(Xl, hmesh)
+    yh = dist.shard_rows_global(yl, hmesh)
+    assert Xh.n_samples == n_per * nproc
+    beta_h = admm(Xh, yh, family=Logistic, lamduh=1e-4, max_iter=50,
+                  mesh=hmesh)
+    acc_h = float(accuracy(Xh.data, yh.data, Xh.mask, beta_h))
+    assert acc_h > 0.9, f"DCN-mesh ADMM accuracy {acc_h}"
+    np.testing.assert_allclose(
+        np.asarray(beta_h), np.asarray(beta), atol=1e-4,
+        err_msg="DCN-mesh ADMM diverged from the flat-mesh solve",
+    )
+    inertia_h = _lloyd_loop(
+        Xh.data, Xh.mask, jnp.asarray(centers0),
+        jnp.float32(1e-4), jnp.int32(20), scatter=_scatter,
+    )[1]
+    np.testing.assert_allclose(
+        float(inertia_h), float(inertia), rtol=1e-5,
+        err_msg="DCN-mesh Lloyd inertia diverged from the flat-mesh loop",
+    )
+    print(f"[proc {pid}] dcn_mesh OK: acc={acc_h:.3f}", flush=True)
 
     print(f"[proc {pid}] multihost OK: acc={acc:.3f} lloyd_iters={int(n_iter)}",
           flush=True)

@@ -90,3 +90,25 @@ def use_mesh(mesh: Mesh):
 def data_axis_size(mesh: Mesh | None = None) -> int:
     mesh = mesh or get_mesh()
     return mesh.shape[DATA_AXIS]
+
+
+def data_axes(mesh: Mesh | None = None) -> tuple[str, ...]:
+    """The mesh axes rows are sharded over: ``('dcn', 'data')`` on a
+    hierarchical multi-slice mesh (``core.distributed.global_mesh(
+    hierarchical=True)``), else ``('data',)``.  shard_map programs use
+    this for in_specs/psums so their collectives span the slice
+    boundary when one exists (cross-slice segments ride DCN, the rest
+    ICI — the compiler splits them from the axis tuple)."""
+    mesh = mesh or get_mesh()
+    if "dcn" in mesh.axis_names:
+        return ("dcn", DATA_AXIS)
+    return (DATA_AXIS,)
+
+
+def data_axes_size(mesh: Mesh | None = None) -> int:
+    """Total row-shard count across every data-carrying axis."""
+    mesh = mesh or get_mesh()
+    out = 1
+    for a in data_axes(mesh):
+        out *= mesh.shape[a]
+    return out

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXIS, get_mesh
+from .mesh import data_axes, data_axes_size, get_mesh
 
 
 def pad_rows(x: np.ndarray, multiple: int):
@@ -35,8 +35,9 @@ def pad_rows(x: np.ndarray, multiple: int):
 
 
 def row_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
-    """NamedSharding that splits axis 0 over the data axis, replicates rest."""
-    spec = P(DATA_AXIS, *([None] * (ndim - 1)))
+    """NamedSharding that splits axis 0 over every data-carrying axis
+    (``('dcn', 'data')`` on a hierarchical mesh), replicates the rest."""
+    spec = P(data_axes(mesh), *([None] * (ndim - 1)))
     return NamedSharding(mesh, spec)
 
 
@@ -91,7 +92,7 @@ def shard_rows(
     if isinstance(x, ShardedRows):
         return x
     mesh = mesh or get_mesh()
-    n_shards = mesh.shape[DATA_AXIS]
+    n_shards = data_axes_size(mesh)
     x = np.asarray(x)
     if dtype is not None:
         x = x.astype(dtype)

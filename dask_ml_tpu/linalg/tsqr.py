@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..core.compat import shard_map_unchecked as _shard_map
-from ..core.mesh import DATA_AXIS, get_mesh
+from ..core.mesh import data_axes, get_mesh
 from ..core.sharded import ShardedRows
 
 
@@ -28,20 +28,24 @@ from ..core.sharded import ShardedRows
 def _tsqr_impl(x, *, mesh_holder):
     mesh = mesh_holder.mesh
     d = x.shape[1]
+    # all data-carrying axes (('dcn','data') on a hierarchical mesh):
+    # the R all_gather then spans the slice boundary over DCN
+    row_ax = data_axes(mesh)
 
     def local(xs):
         # Short shards (m < d) are fine: reduced QR then yields q1 (m, k),
         # r1 (k, d) with k = min(m, d); only the STACKED R must be tall.
         q1, r1 = jnp.linalg.qr(xs, mode="reduced")  # (m, k), (k, d)
         k = r1.shape[0]
-        r_all = jax.lax.all_gather(r1, DATA_AXIS)  # (P, k, d)
+        r_all = jax.lax.all_gather(r1, row_ax)  # (P, k, d)
         q2, r = jnp.linalg.qr(r_all.reshape(-1, d), mode="reduced")  # (P·k, d), (d, d)
-        i = jax.lax.axis_index(DATA_AXIS)
+        i = jax.lax.axis_index(row_ax)
         q2_i = jax.lax.dynamic_slice_in_dim(q2, i * k, k)
         return q1 @ q2_i, r
 
     return _shard_map(
-        local, mesh, in_specs=P(DATA_AXIS, None), out_specs=(P(DATA_AXIS, None), P())
+        local, mesh, in_specs=P(row_ax, None),
+        out_specs=(P(row_ax, None), P()),
     )(x)
 
 

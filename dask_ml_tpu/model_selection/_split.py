@@ -16,7 +16,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.mesh import DATA_AXIS, MeshHolder, get_mesh
+from ..core.mesh import MeshHolder, get_mesh
 from ..core.sharded import ShardedRows, row_sharding
 from ..utils import check_random_state
 
@@ -46,7 +46,9 @@ def _take(a, idx):
         from ..core.sharded import pad_rows
 
         mesh = get_mesh()
-        n_shards = mesh.shape[DATA_AXIS]
+        from ..core.mesh import data_axes_size
+
+        n_shards = data_axes_size(mesh)
         idx, k = pad_rows(np.asarray(idx, dtype=np.int32), n_shards)
         mask_np = np.zeros(idx.shape[0], dtype=np.float32)
         mask_np[:k] = 1.0

@@ -15,7 +15,8 @@ _EXAMPLES = pathlib.Path(__file__).resolve().parents[1] / "examples"
 
 
 @pytest.mark.parametrize(
-    "script", ["streaming_out_of_core.py", "text_pipeline.py"]
+    "script", ["streaming_out_of_core.py", "text_pipeline.py",
+               "multihost_mesh.py"]
 )
 def test_example_runs(script):
     proc = subprocess.run(

@@ -24,6 +24,11 @@ class TestMultihost:
         for rc, out in spawn_group(2, 4, timeout_s=720):
             assert rc == 0, out
             assert "multihost OK" in out
+            # flagship 5: ADMM + Lloyd over the hierarchical
+            # ('dcn','data','model') mesh with dcn spanning the two
+            # processes, parity-asserted against the flat-mesh fits
+            # inside the worker
+            assert "dcn_mesh OK" in out
             outs.append(out)
         # cross-host packed search (VERDICT r2 next #3): the worker runs a
         # 4-model IncrementalSearchCV with the cohort's MODEL_AXIS spanning
@@ -145,3 +150,49 @@ class TestGlobalMeshSingleProcess:
                 dist.shard_rows_global(np.zeros((4, 2), np.float32), m)
             finally:
                 jax.process_count = orig
+
+
+class TestHierarchicalMeshCompat:
+    """Every shard_map program now runs NATIVELY on the ('dcn','data')
+    axis tuple (``core.mesh.data_axes``): TSQR's R all_gather and the
+    pairwise ppermute ring span the slice boundary (flattened ring
+    semantics over the tuple), ADMM's psums likewise (covered by the
+    worker flagship).  This pin proves correctness of those collectives
+    on a mesh whose rows are genuinely split over BOTH axes."""
+
+    def test_programs_correct_on_dcn_mesh(self, rng):
+        import numpy as np
+
+        from conftest import require_devices_divisible
+
+        require_devices_divisible(8)
+        from dask_ml_tpu.core import use_mesh
+        from dask_ml_tpu.core import distributed as dist
+        from dask_ml_tpu.core.mesh import Mesh
+
+        devs = np.array(jax.devices()[:8]).reshape(2, 4, 1)
+        hmesh = Mesh(devs, ("dcn", "data", "model"))
+        X = rng.normal(size=(160, 6)).astype(np.float32)
+        with use_mesh(hmesh):
+            s = dist.shard_rows_global(X, hmesh)
+            # rows genuinely split over BOTH axes
+            assert "dcn" in str(s.data.sharding.spec)
+
+            from dask_ml_tpu.linalg.tsqr import tsqr
+
+            q, r = tsqr(s)
+            qh = np.asarray(q)[:160].astype(np.float64)
+            rr = np.asarray(r).astype(np.float64)
+            assert np.abs(qh @ rr - X).max() < 1e-5
+            assert np.abs(qh.T @ qh - np.eye(6)).max() < 1e-5
+
+            from sklearn.metrics.pairwise import (
+                euclidean_distances as sk_euc,
+            )
+
+            from dask_ml_tpu.metrics import euclidean_distances
+
+            Y = dist.shard_rows_global(X[:80], hmesh)
+            d_ring = np.asarray(euclidean_distances(s, Y))
+            ref = sk_euc(X.astype(np.float64), X[:80].astype(np.float64))
+            assert np.abs(d_ring - ref).max() < 1e-5
