@@ -58,8 +58,22 @@ def _sweep_kernels_make():
         ss_res = jnp.sum((eta - yv[:, None]) ** 2 * m, axis=0)
         tot = jnp.maximum(jnp.sum(mask), 1.0)
         mean_y = jnp.sum(yv * mask) / tot
-        ss_tot = jnp.maximum(jnp.sum((yv - mean_y) ** 2 * mask), 1e-30)
-        return 1.0 - ss_res / ss_tot
+        ss_tot = jnp.sum((yv - mean_y) ** 2 * mask)
+        # constant-y fold: sklearn's r2_score returns 1.0 when the fit is
+        # also perfect, else 0.0 — the clamped division would instead
+        # produce a huge negative score, diverging from the per-candidate
+        # scorer path on degenerate folds.  The constancy test is
+        # RELATIVE to y's magnitude (Σy²·1e-10 ≈ (eps32·|y|)²·n scale):
+        # an absolute epsilon would misread small-magnitude targets
+        # (std ~1e-6) as constant and hide their true R².
+        y_sq = jnp.sum(yv * yv * mask)
+        tol_deg = 1e-10 * y_sq + 1e-30
+        r2v = 1.0 - ss_res / jnp.maximum(ss_tot, 1e-30)
+        return jnp.where(
+            ss_tot > tol_deg,
+            r2v,
+            jnp.where(ss_res <= tol_deg, 1.0, 0.0),
+        )
 
     return acc, r2
 
@@ -118,6 +132,28 @@ logger = logging.getLogger(__name__)
 
 def _host(a):
     return unshard(a) if isinstance(a, ShardedRows) else a
+
+
+def _fold_classes_ok(ytr, yte) -> bool:
+    """Packed-sweep fold eligibility: train labels exactly binary AND
+    test labels a subset of them.  For sharded labels the subset check
+    runs ON DEVICE (one scalar fetch) — pulling the whole label vector
+    to host per fold would cost an O(n) relay fetch."""
+    import jax.numpy as jnp
+
+    if isinstance(ytr, ShardedRows):
+        ytr_d = jnp.where(ytr.mask > 0, ytr.data, ytr.data[0])
+        classes = jnp.unique(ytr_d)
+        if classes.shape[0] != 2:
+            return False
+        if isinstance(yte, ShardedRows):
+            ok = jnp.all((yte.mask <= 0) | jnp.isin(yte.data, classes))
+            return bool(ok)
+        return bool(np.isin(np.asarray(yte), np.asarray(classes)).all())
+    classes = np.unique(np.asarray(ytr))
+    if classes.shape[0] != 2:
+        return False
+    return bool(np.isin(np.asarray(_host(yte)), classes).all())
 
 
 class _CacheKey:
@@ -600,6 +636,17 @@ class _BaseSearchCV(TPUEstimator):
                         return False
                     sweep_est = clone(est)
                     if is_clf:
+                        # eligibility BEFORE the K-lane fit (a doomed
+                        # fold must not execute the whole vmapped solve
+                        # only to discard it): the train fold must be
+                        # exactly binary, and every test label must be
+                        # among the train classes — the packed scorer
+                        # encodes labels against the TRAIN fold's 2
+                        # classes, so an unseen test label would encode
+                        # to 0 and count as a hit whenever eta<=0 (the
+                        # per-candidate path counts it as a miss).
+                        if not _fold_classes_ok(ytr, yte):
+                            return False
                         betas, classes = sweep_est._sweep_fit_binary(
                             Xtr, ytr, Cs)
 
@@ -648,24 +695,38 @@ class _BaseSearchCV(TPUEstimator):
         # pipeline-prefix caching: walk steps; reuse cached fitted
         # transformers + transformed data while the prefix key matches
         # (``tokens[i]`` is the cumulative token for steps[0..i], built by
-        # _prefix_tokens_for so the refcount precompute stays in sync)
+        # _prefix_tokens_for so the refcount precompute stays in sync).
+        # Cached host arrays are handed to consumers as COPIES: the cache
+        # shares ONE transformed array object across candidates, so a
+        # step that mutates its input in place (the sklearn copy=False
+        # hazard) would silently poison every later candidate's view —
+        # a real order-dependent score corruption found by
+        # tests/test_search_parallel.py :: TestFoldCacheMutationSafety.
+        # Device arrays are immutable; only numpy needs the defense.
+        def _host_copy(a):
+            return a.copy() if isinstance(a, np.ndarray) else a
+
         steps = est.steps
         data = Xtr
         fitted_steps = []
+        cached_data = False  # does `data` alias a cache-shared object?
         for (name, step), token in zip(steps[:-1], tokens):
 
-            def fit_prefix(step=step, data_in=data):
+            def fit_prefix(step=step, data_in=data, shared=cached_data):
                 fitted = clone(step)
-                return fitted, fitted.fit_transform(data_in, ytr)
+                x_in = _host_copy(data_in) if shared else data_in
+                return fitted, fitted.fit_transform(x_in, ytr)
 
             fitted_step, data = prefix_cache.get_or_compute(token, fit_prefix)
             fitted_steps.append((name, fitted_step))
+            cached_data = True
         final_name, final = steps[-1]
         final = clone(final)
+        fit_x = _host_copy(data) if cached_data else data
         if ytr is not None:
-            final.fit(data, ytr, **fit_params)
+            final.fit(fit_x, ytr, **fit_params)
         else:
-            final.fit(data, **fit_params)
+            final.fit(fit_x, **fit_params)
         fitted_steps.append((final_name, final))
         est.steps = fitted_steps
         return est

@@ -175,3 +175,84 @@ class TestMeshPropagation:
         for shape in seen:
             assert dict(shape) == {"data": len(jax.devices()) // 4,
                                    "model": 4}, shape
+
+
+class MutatingScaler(BaseEstimator):
+    """A transformer that scales its input IN PLACE (the sklearn
+    ``copy=False`` hazard class): under a shared fold cache, one
+    candidate's fit would poison every later candidate's view of the
+    same fold slice."""
+
+    def fit(self, X, y=None):
+        return self
+
+    def transform(self, X):
+        X *= 2.0  # in-place: mutates whatever array object it was given
+        return X
+
+    def fit_transform(self, X, y=None):
+        return self.fit(X).transform(X)
+
+
+class TestFoldCacheMutationSafety:
+    """VERDICT r5 target: the refcounted fold cache under concurrent
+    n_jobs mutation.  Host numpy fold slices must be fresh per task
+    (mutable), so an in-place pipeline step cannot corrupt siblings;
+    results must be identical serial vs 4-way concurrent."""
+
+    def _grid(self, n_jobs):
+        from sklearn.pipeline import Pipeline
+        from sklearn.linear_model import LogisticRegression as SkLR
+
+        return GridSearchCV(
+            Pipeline([("mut", MutatingScaler()),
+                      ("clf", SkLR(max_iter=50))]),
+            {"clf__C": [0.01, 0.1, 1.0, 10.0, 100.0]},
+            cv=3, n_jobs=n_jobs, refit=False,
+            cache_cv=False,  # the mutating step must not be prefix-cached
+        )
+
+    def test_inplace_step_concurrent_matches_serial(self, rng):
+        X = rng.normal(size=(90, 4)).astype(np.float64)
+        y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(int)
+        Xa, Xb = X.copy(), X.copy()
+        a = self._grid(1).fit(Xa, y)
+        b = self._grid(4).fit(Xb, y)
+        np.testing.assert_allclose(
+            a.cv_results_["mean_test_score"],
+            b.cv_results_["mean_test_score"],
+        )
+        # the ORIGINAL arrays must also be untouched: fold slices are
+        # copies, never views into the caller's X
+        np.testing.assert_array_equal(Xa, X)
+        np.testing.assert_array_equal(Xb, X)
+
+    def test_inplace_step_with_prefix_cache_is_safe(self, rng):
+        """cache_cv=True shares fitted-prefix OUTPUTS across candidates;
+        a later in-place final step mutating the cached transformed
+        array would poison siblings.  Concurrent scores must still match
+        serial."""
+        from sklearn.pipeline import Pipeline
+        from sklearn.linear_model import LogisticRegression as SkLR
+
+        class MutatingLR(SkLR):
+            def fit(self, X, y, **kw):
+                X *= 1.0 + float(self.C)  # in-place, C-dependent
+                return super().fit(X, y, **kw)
+
+        def grid(n_jobs):
+            return GridSearchCV(
+                Pipeline([("mut", MutatingScaler()),
+                          ("clf", MutatingLR(max_iter=50))]),
+                {"clf__C": [0.01, 1.0, 100.0]},
+                cv=2, n_jobs=n_jobs, refit=False, cache_cv=True,
+            )
+
+        X = rng.normal(size=(60, 4)).astype(np.float64)
+        y = (X[:, 0] > 0).astype(int)
+        a = grid(1).fit(X.copy(), y)
+        b = grid(4).fit(X.copy(), y)
+        np.testing.assert_allclose(
+            a.cv_results_["mean_test_score"],
+            b.cv_results_["mean_test_score"],
+        )
