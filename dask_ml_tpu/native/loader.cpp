@@ -12,9 +12,11 @@
 //   dmlt_csv_dims(path, has_header, &rows, &cols)
 //   dmlt_csv_read_f32(path, has_header, row_start, rows, cols, out, n_threads)
 //   dmlt_bin_read_f32(path, offset_bytes, count, out)
-// Streaming session (file read + line index built ONCE, a background
-// worker parses blocks ahead of the consumer into a bounded ring —
-// the per-block re-scan of the naive path is O(blocks * filesize)):
+// Streaming session (WINDOWED: the file streams through a ~32 MB window
+// — never fully resident, so host memory stays bounded no matter the
+// file size; a background worker parses blocks ahead of the consumer
+// into a bounded ring).  ``rows`` comes back -1 (unknown without a full
+// pre-scan); EOF is dmlt_stream_next's rows_out = 0:
 //   dmlt_stream_open(path, has_header, block_rows, n_threads, depth,
 //                    &rows, &cols, &err) -> handle (NULL on error)
 //   dmlt_stream_next(handle, out, &rows_out)   (rows_out=0 at EOF)
@@ -74,6 +76,7 @@ void line_starts(const FileBuf& buf, std::vector<size_t>& starts) {
     // reserve from an estimated line length to avoid regrowth copies
     starts.reserve(n / 32 + 16);
     size_t i = 0;
+    while (i < n && (buf.data[i] == '\n' || buf.data[i] == '\r')) i++;
     while (i < n) {
         starts.push_back(i);
         const char* nl = static_cast<const char*>(
@@ -175,12 +178,14 @@ inline bool parse_f32_fast(const char*& p, const char* eol, float* out) {
 // parse is bounded to its own line: a row with fewer than `cols` fields
 // errors with -EINVAL instead of silently consuming values from the next
 // line (strtof treats '\n' as skippable whitespace), and trailing
-// non-delimiter bytes (extra fields) also error.
-void parse_rows(const FileBuf& buf, const std::vector<size_t>& starts,
+// non-delimiter bytes (extra fields) also error.  ``data``/``size`` are
+// any NUL-terminated text region (whole file or a streaming window).
+void parse_rows(const char* data, size_t size,
+                const std::vector<size_t>& starts,
                 size_t r0, size_t r1, long cols, float* out, int* err) {
     for (size_t r = r0; r < r1; r++) {
-        const char* p = buf.data + starts[r];
-        const char* span_end = buf.data + (r + 1 < starts.size() ? starts[r + 1] : buf.size);
+        const char* p = data + starts[r];
+        const char* span_end = data + (r + 1 < starts.size() ? starts[r + 1] : size);
         // End of THIS line's content (exclusive of '\n').
         const char* eol = p;
         while (eol < span_end && *eol != '\n') eol++;
@@ -211,7 +216,8 @@ void parse_rows(const FileBuf& buf, const std::vector<size_t>& starts,
 
 // Parse rows [r0, r1) with an inner thread fan-out (same splitting as
 // dmlt_csv_read_f32).  Returns 0 or the first worker's error.
-int parse_rows_mt(const FileBuf& buf, const std::vector<size_t>& starts,
+int parse_rows_mt(const char* data, size_t size,
+                  const std::vector<size_t>& starts,
                   size_t r0, size_t r1, long cols, float* out,
                   int n_threads) {
     int64_t rows = static_cast<int64_t>(r1 - r0);
@@ -225,8 +231,8 @@ int parse_rows_mt(const FileBuf& buf, const std::vector<size_t>& starts,
         int64_t b = std::min(rows, a + per);
         if (a >= b) break;
         threads.emplace_back([&, t, a, b] {
-            parse_rows(buf, starts, r0 + a, r0 + b, cols, out + a * cols,
-                       &errs[t]);
+            parse_rows(data, size, starts, r0 + a, r0 + b, cols,
+                       out + a * cols, &errs[t]);
         });
     }
     for (auto& th : threads) th.join();
@@ -235,11 +241,18 @@ int parse_rows_mt(const FileBuf& buf, const std::vector<size_t>& starts,
     return 0;
 }
 
+// Streaming window size.  The session's resident set is bounded by
+// ~(window + parsed-window floats + depth ring blocks) regardless of
+// file size — the whole point of the out-of-core ingest path: a 100 GB
+// CSV streams through partial_fit in tens of MB of host memory.
+constexpr size_t kStreamWindowBytes = 32u << 20;
+
 struct Stream {
-    FileBuf buf;
-    std::vector<size_t> starts;
-    size_t next_row = 0;   // worker's cursor (absolute line index)
-    size_t end_row = 0;    // one past the last data line
+    FILE* f = nullptr;
+    std::vector<char> win;  // leftover partial line + freshly read bytes
+    size_t win_len = 0;     // valid bytes in win
+    size_t consumed = 0;    // first unparsed byte
+    bool eof = false;
     long cols = 0;
     int64_t block_rows = 0;
     int n_threads = 1;
@@ -249,6 +262,7 @@ struct Stream {
         std::vector<float> data;
         int64_t rows = 0;
     };
+    Block cur;  // worker-owned accumulating block (may span windows)
     std::deque<Block> ready;
     std::mutex mu;
     std::condition_variable cv_ready;   // consumer waits: a block or EOF/err
@@ -258,26 +272,163 @@ struct Stream {
     int err = 0;
     std::thread worker;
 
+    ~Stream() {
+        if (f) std::fclose(f);
+    }
+
+    // Append up to one window of fresh bytes after the current contents.
+    // +1 spare byte so the parse can always NUL-terminate its region.
+    int refill() {
+        if (eof) return 0;
+        if (win.size() < win_len + kStreamWindowBytes + 1)
+            win.resize(win_len + kStreamWindowBytes + 1);
+        size_t got = std::fread(win.data() + win_len, 1, kStreamWindowBytes, f);
+        if (got < kStreamWindowBytes) {
+            if (std::ferror(f)) return -EIO;
+            eof = true;
+        }
+        win_len += got;
+        return 0;
+    }
+
+    // One past the last parseable byte: through the final newline, or
+    // everything once EOF is reached (last line may lack a newline).
+    size_t complete_end() const {
+        if (eof) return win_len;
+        for (size_t i = win_len; i > consumed; i--)
+            if (win[i - 1] == '\n') return i;
+        return consumed;
+    }
+
+    bool push_ready(Block&& b) {  // false = close() raced us; unwind
+        std::unique_lock<std::mutex> lk(mu);
+        cv_space.wait(lk, [&] { return ready.size() < depth || stop; });
+        if (stop) return false;
+        ready.push_back(std::move(b));
+        cv_ready.notify_one();
+        return true;
+    }
+
+    void fail(int rc) {
+        std::lock_guard<std::mutex> lk(mu);
+        err = rc;
+    }
+
     void run() {
-        while (true) {
-            size_t r0 = next_row;
-            size_t r1 = std::min(end_row, r0 + static_cast<size_t>(block_rows));
-            if (r0 >= r1) break;
-            Block b;
-            b.rows = static_cast<int64_t>(r1 - r0);
-            b.data.resize(static_cast<size_t>(b.rows) * cols);
-            int rc = parse_rows_mt(buf, starts, r0, r1, cols, b.data.data(),
-                                   n_threads);
-            std::unique_lock<std::mutex> lk(mu);
+        std::vector<size_t> starts;
+        std::vector<float> wbuf;
+        bool stopped = false;
+        while (!stopped) {
+            {
+                std::lock_guard<std::mutex> lk(mu);
+                if (stop) break;
+            }
+            size_t complete = complete_end();
+            if (complete > consumed) {
+                // index the window's complete lines.  Leading blank lines
+                // are skipped BEFORE the first push too: after a compact,
+                // a region can begin exactly at a blank line (the
+                // previous window ended on its preceding newline), and
+                // indexing it as a row would EINVAL legal CSV that the
+                // whole-file path accepts.
+                starts.clear();
+                size_t i = consumed;
+                while (i < complete && (win[i] == '\n' || win[i] == '\r'))
+                    i++;
+                while (i < complete) {
+                    starts.push_back(i);
+                    const char* nl = static_cast<const char*>(
+                        std::memchr(win.data() + i, '\n', complete - i));
+                    i = nl ? static_cast<size_t>(nl - win.data()) + 1
+                           : complete;
+                    while (i < complete &&
+                           (win[i] == '\n' || win[i] == '\r'))
+                        i++;
+                }
+                // NUL-terminate the region for the strtof fallback on the
+                // last line; the clobbered byte (the partial tail's first,
+                // or the refill spare) is restored before reuse
+                char saved = win[complete];
+                win[complete] = '\0';
+                size_t n_lines = starts.size();
+                wbuf.resize(n_lines * static_cast<size_t>(cols));
+                int rc = parse_rows_mt(win.data(), complete, starts, 0,
+                                       n_lines, cols, wbuf.data(), n_threads);
+                if (rc) {
+                    // deterministic prefix: re-parse sequentially to find
+                    // the first malformed line, deliver every FULL block
+                    // before it, then surface the error (the error path
+                    // is rare, so the one-line-at-a-time pass is free)
+                    size_t good = 0;
+                    for (; good < n_lines; good++) {
+                        int le = 0;
+                        parse_rows(win.data(), complete, starts, good,
+                                   good + 1, cols,
+                                   wbuf.data() + good * cols, &le);
+                        if (le) {
+                            rc = le;
+                            break;
+                        }
+                    }
+                    n_lines = good;
+                }
+                win[complete] = saved;
+                // slice the parsed window into ring blocks; a block may
+                // keep filling across several windows
+                size_t off = 0;
+                while (off < n_lines) {
+                    if (cur.data.empty()) {
+                        cur.data.resize(
+                            static_cast<size_t>(block_rows) * cols);
+                        cur.rows = 0;
+                    }
+                    size_t take = std::min<size_t>(
+                        n_lines - off,
+                        static_cast<size_t>(block_rows - cur.rows));
+                    std::memcpy(cur.data.data() +
+                                    static_cast<size_t>(cur.rows) * cols,
+                                wbuf.data() + off * cols,
+                                take * cols * sizeof(float));
+                    cur.rows += static_cast<int64_t>(take);
+                    off += take;
+                    if (cur.rows == block_rows) {
+                        if (!push_ready(std::move(cur))) {
+                            stopped = true;
+                            break;
+                        }
+                        cur = Block();
+                    }
+                }
+                if (rc) {
+                    // the malformed line's partial block is dropped (the
+                    // consumer gets the error, not a torn block)
+                    cur = Block();
+                    fail(rc);
+                    break;
+                }
+                consumed = complete;
+            }
+            if (stopped) break;
+            // compact: drop parsed bytes, keep the partial tail at front
+            if (consumed > 0) {
+                std::memmove(win.data(), win.data() + consumed,
+                             win_len - consumed);
+                win_len -= consumed;
+                consumed = 0;
+            }
+            if (eof) {
+                if (win_len == 0) break;  // fully drained
+                continue;  // parse the final unterminated line
+            }
+            int rc = refill();
             if (rc) {
-                err = rc;
+                fail(rc);
                 break;
             }
-            cv_space.wait(lk, [&] { return ready.size() < depth || stop; });
-            if (stop) break;
-            ready.push_back(std::move(b));
-            next_row = r1;
-            cv_ready.notify_one();
+        }
+        if (!stopped && !err && cur.rows > 0) {  // final partial block
+            cur.data.resize(static_cast<size_t>(cur.rows) * cols);
+            push_ready(std::move(cur));
         }
         std::lock_guard<std::mutex> lk(mu);
         done = true;
@@ -289,39 +440,77 @@ struct Stream {
 
 extern "C" {
 
+// Opens a WINDOWED streaming session: the file is read in ~32 MB
+// windows and never fully resident, so the session's memory is bounded
+// regardless of file size (the >HBM out-of-core contract).  ``rows`` is
+// reported as -1 — the total is unknowable without a full pre-scan,
+// which would defeat the windowing; consumers learn EOF from
+// dmlt_stream_next's rows_out = 0.
 void* dmlt_stream_open(const char* path, int has_header, int64_t block_rows,
                        int n_threads, int depth, int64_t* rows, int64_t* cols,
                        int* err) {
     auto* s = new Stream();
-    int rc = read_file(path, s->buf);
-    if (rc) {
-        *err = rc;
+    s->f = std::fopen(path, "rb");
+    if (!s->f) {
+        *err = -errno;
         delete s;
         return nullptr;
     }
-    line_starts(s->buf, s->starts);
-    size_t skip = has_header ? 1 : 0;
-    size_t n = s->starts.size();
-    if (n <= skip) {
-        *rows = 0;
-        *cols = 0;
-        *err = 0;
-        s->next_row = s->end_row = 0;
-        s->block_rows = block_rows > 0 ? block_rows : 1;
-        // no worker needed: EOF immediately
-        s->done = true;
-        return s;
-    }
-    const char* first = s->buf.data + s->starts[skip];
-    const char* end =
-        s->buf.data + (skip + 1 < n ? s->starts[skip + 1] : s->buf.size);
-    s->cols = count_cols(first, end);
-    s->next_row = skip;
-    s->end_row = n;
     s->block_rows = block_rows > 0 ? block_rows : 1;
     s->n_threads = n_threads > 0 ? n_threads : 1;
     s->depth = depth > 0 ? static_cast<size_t>(depth) : 1;
-    *rows = static_cast<int64_t>(n - skip);
+    size_t skip = has_header ? 1 : 0;
+
+    // read until the first data line is complete (its newline in the
+    // window, or EOF) so cols can be counted synchronously
+    auto count_newlines = [&](size_t upto) {
+        size_t n = 0, i = 0;
+        while (i < upto) {
+            const char* nl = static_cast<const char*>(
+                std::memchr(s->win.data() + i, '\n', upto - i));
+            if (!nl) break;
+            n++;
+            i = static_cast<size_t>(nl - s->win.data()) + 1;
+        }
+        return n;
+    };
+    for (;;) {
+        int rc = s->refill();
+        if (rc) {
+            *err = rc;
+            delete s;
+            return nullptr;
+        }
+        if (s->eof || count_newlines(s->win_len) > skip) break;
+    }
+
+    // line starts of the header (if any) + first data line (leading
+    // blank lines skipped, same as the worker's index loop)
+    std::vector<size_t> starts;
+    size_t i = 0;
+    while (i < s->win_len && (s->win[i] == '\n' || s->win[i] == '\r'))
+        i++;
+    while (i < s->win_len && starts.size() <= skip) {
+        starts.push_back(i);
+        const char* nl = static_cast<const char*>(
+            std::memchr(s->win.data() + i, '\n', s->win_len - i));
+        i = nl ? static_cast<size_t>(nl - s->win.data()) + 1 : s->win_len;
+        while (i < s->win_len &&
+               (s->win[i] == '\n' || s->win[i] == '\r'))
+            i++;
+    }
+    if (starts.size() <= skip) {  // empty or header-only file
+        *rows = 0;
+        *cols = 0;
+        *err = 0;
+        s->done = true;  // no worker: EOF immediately
+        return s;
+    }
+    const char* first = s->win.data() + starts[skip];
+    const char* end = s->win.data() + (i > starts[skip] ? i : s->win_len);
+    s->cols = count_cols(first, end);
+    s->consumed = starts[skip];  // worker parses from the first data line
+    *rows = -1;  // unknown without a full pre-scan (windowed by design)
     *cols = s->cols;
     *err = 0;
     s->worker = std::thread([s] { s->run(); });
@@ -392,7 +581,8 @@ int dmlt_csv_read_f32(const char* path, int has_header, int64_t row_start,
     line_starts(buf, starts);
     size_t skip = (has_header ? 1 : 0) + static_cast<size_t>(row_start);
     if (starts.size() < skip + rows) return -ERANGE;
-    return parse_rows_mt(buf, starts, skip, skip + rows, cols, out, n_threads);
+    return parse_rows_mt(buf.data, buf.size, starts, skip, skip + rows, cols,
+                         out, n_threads);
 }
 
 int dmlt_bin_read_f32(const char* path, int64_t offset_bytes, int64_t count,
