@@ -11,10 +11,27 @@ from __future__ import annotations
 import numpy as np
 
 from sklearn.base import BaseEstimator as _SkBase
-from sklearn.base import ClassifierMixin, RegressorMixin, TransformerMixin, clone  # noqa: F401
+from sklearn.base import (  # noqa: F401
+    ClassifierMixin,
+    ClassNamePrefixFeaturesOutMixin,
+    OneToOneFeatureMixin,
+    RegressorMixin,
+    TransformerMixin,
+    clone,
+)
 
 from .core.mesh import get_mesh
 from .core.sharded import ShardedRows, shard_rows, unshard
+
+
+class ComponentsOutMixin(ClassNamePrefixFeaturesOutMixin):
+    """sklearn's class-name-prefixed output names, bound to the fitted
+    ``components_`` row count (shared by PCA / TruncatedSVD /
+    IncrementalPCA — one definition, as sklearn does on its base)."""
+
+    @property
+    def _n_features_out(self):
+        return self.components_.shape[0]
 
 
 class TPUEstimator(_SkBase):

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..base import TPUEstimator, TransformerMixin
+from ..base import ComponentsOutMixin, TPUEstimator, TransformerMixin
 from ..core.sharded import ShardedRows, unshard
 from ..preprocessing.data import _like_input, _masked_or_plain
 from ..utils import check_array, svd_flip
@@ -51,7 +51,7 @@ def _update(components, singular_values, mean, var, n_seen, batch, *, k):
     return vt[:k], s[:k], new_mean, new_var, n_total
 
 
-class IncrementalPCA(TransformerMixin, TPUEstimator):
+class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
     def __init__(self, n_components=None, whiten=False, copy=True, batch_size=None):
         self.n_components = n_components
         self.whiten = whiten

@@ -12,16 +12,17 @@ comes out of the same compiled computation.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
-from ..base import TPUEstimator, TransformerMixin
+from ..base import ComponentsOutMixin, TPUEstimator, TransformerMixin
 from ..core.sharded import ShardedRows, masked_mean
 from ..linalg import randomized_svd, tsqr_svd
 from ..preprocessing.data import _ingest_float, _like_input, _masked_or_plain
 from ..utils import svd_flip
 
 
-class PCA(TransformerMixin, TPUEstimator):
+class PCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
     def __init__(self, n_components=None, copy=True, whiten=False,
                  svd_solver="auto", tol=0.0, iterated_power=4, random_state=None):
         self.n_components = n_components
@@ -146,3 +147,45 @@ class PCA(TransformerMixin, TPUEstimator):
         if self.whiten:
             x = x * jnp.sqrt(self.explained_variance_)
         return _like_input(X, x @ self.components_ + self.mean_)
+
+    def get_covariance(self):
+        """Model covariance (probabilistic-PCA form) — one small (d, d)
+        device gemm, replicating sklearn's formula EXACTLY, including
+        its whiten=True behavior (components rescaled by √λ before the
+        (λ−σ²) weighting — sklearn's own convention, matched so scores
+        agree elementwise in both modes)."""
+        c = self.components_
+        ev = self.explained_variance_
+        if self.whiten:
+            c = c * jnp.sqrt(ev)[:, None]
+        diff = jnp.maximum(ev - self.noise_variance_, 0.0)
+        cov = (c.T * diff) @ c
+        d = c.shape[1]
+        return cov + self.noise_variance_ * jnp.eye(d, dtype=cov.dtype)
+
+    def score_samples(self, X):
+        """Per-sample average log-likelihood under the probabilistic PCA
+        model (sklearn ``PCA.score_samples``; Tipping & Bishop 1999).
+        Computed on device: one centering, one (d, d) solve."""
+        x, _ = _masked_or_plain(X)
+        xc = x - self.mean_
+        cov = self.get_covariance()
+        d = cov.shape[0]
+        # clamp for invertibility when noise_variance_ == 0 (k == d):
+        # the model covariance is then exactly the sample covariance and
+        # a tiny jitter keeps the Cholesky well-posed
+        jitter = 1e-12 * jnp.trace(cov) / d
+        cov = cov + jitter * jnp.eye(d, dtype=cov.dtype)
+        chol = jnp.linalg.cholesky(cov)
+        sol = jax.scipy.linalg.cho_solve((chol, True), xc.T)  # (d, n)
+        mahal = jnp.sum(xc.T * sol, axis=0)
+        logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(chol)))
+        ll = -0.5 * (d * jnp.log(2.0 * jnp.pi) + logdet + mahal)
+        if isinstance(X, ShardedRows):
+            return ll[: X.n_samples]
+        return ll
+
+    def score(self, X, y=None):
+        """Mean of ``score_samples`` over the real rows (score_samples
+        already slices sharded inputs to their true row count)."""
+        return float(jnp.mean(self.score_samples(X)))

@@ -9,14 +9,14 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..base import TPUEstimator, TransformerMixin
+from ..base import ComponentsOutMixin, TPUEstimator, TransformerMixin
 from ..core.sharded import ShardedRows, masked_mean, masked_var
 from ..linalg import randomized_svd, tsqr_svd
 from ..preprocessing.data import _ingest_float, _like_input, _masked_or_plain
 from ..utils import svd_flip
 
 
-class TruncatedSVD(TransformerMixin, TPUEstimator):
+class TruncatedSVD(ComponentsOutMixin, TransformerMixin, TPUEstimator):
     def __init__(self, n_components=2, algorithm="tsqr", n_iter=5,
                  random_state=None, tol=0.0, compute=True):
         self.n_components = n_components

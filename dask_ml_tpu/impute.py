@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .base import TPUEstimator, TransformerMixin
+from .base import OneToOneFeatureMixin, TPUEstimator, TransformerMixin
 from .core.sharded import ShardedRows
 from .preprocessing.data import _ingest_float, _like_input, _masked_or_plain
 
@@ -41,7 +41,7 @@ def _column_modes(x):
     return jax.vmap(mode_1d, in_axes=1)(x)
 
 
-class SimpleImputer(TransformerMixin, TPUEstimator):
+class SimpleImputer(OneToOneFeatureMixin, TransformerMixin, TPUEstimator):
     def __init__(self, missing_values=np.nan, strategy="mean",
                  fill_value=None, copy=True, add_indicator=False):
         self.missing_values = missing_values
@@ -96,6 +96,17 @@ class SimpleImputer(TransformerMixin, TPUEstimator):
             had_missing = jnp.any(missing & (mask[:, None] > 0), axis=0)
             self.indicator_features_ = np.flatnonzero(np.asarray(had_missing))
         return self
+
+    def get_feature_names_out(self, input_features=None):
+        """sklearn contract: input names, plus ``missingindicator_<name>``
+        for each indicator column when ``add_indicator`` is on."""
+        names = super().get_feature_names_out(input_features)
+        if self.add_indicator and getattr(
+                self, "indicator_features_", None) is not None:
+            extra = [f"missingindicator_{names[i]}"
+                     for i in self.indicator_features_]
+            names = np.concatenate([names, np.asarray(extra, dtype=object)])
+        return names
 
     def transform(self, X):
         x, _ = _masked_or_plain(X)

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..base import TPUEstimator, TransformerMixin
+from ..base import OneToOneFeatureMixin, TPUEstimator, TransformerMixin
 from ..core.sharded import ShardedRows, masked_mean, masked_var
 from ..utils import check_array, handle_zeros_in_scale
 
@@ -182,7 +182,7 @@ def _masked_quantiles(x, mask, probs, method: str = "auto"):
                            scatter=scatter_strategy(x.shape[1] * 4096))
 
 
-class StandardScaler(TransformerMixin, TPUEstimator):
+class StandardScaler(OneToOneFeatureMixin, TransformerMixin, TPUEstimator):
     """Standardize features to zero mean, unit variance."""
 
     def __init__(self, copy=True, with_mean=True, with_std=True):
@@ -254,7 +254,7 @@ class StandardScaler(TransformerMixin, TPUEstimator):
         return _like_input(X, x)
 
 
-class MinMaxScaler(TransformerMixin, TPUEstimator):
+class MinMaxScaler(OneToOneFeatureMixin, TransformerMixin, TPUEstimator):
     """Scale features to a given range (default [0, 1])."""
 
     def __init__(self, feature_range=(0, 1), copy=True):
@@ -298,7 +298,7 @@ class MinMaxScaler(TransformerMixin, TPUEstimator):
         return _like_input(X, (x - self.min_) / self.scale_)
 
 
-class RobustScaler(TransformerMixin, TPUEstimator):
+class RobustScaler(OneToOneFeatureMixin, TransformerMixin, TPUEstimator):
     """Scale by median and IQR (outlier-robust)."""
 
     def __init__(self, with_centering=True, with_scaling=True, quantile_range=(25.0, 75.0), copy=True):
@@ -339,7 +339,7 @@ class RobustScaler(TransformerMixin, TPUEstimator):
         return _like_input(X, x)
 
 
-class QuantileTransformer(TransformerMixin, TPUEstimator):
+class QuantileTransformer(OneToOneFeatureMixin, TransformerMixin, TPUEstimator):
     """Map features to a uniform or normal distribution via quantiles.
 
     The reference approximates with ``da.percentile`` per chunk; here the
@@ -496,7 +496,7 @@ class PolynomialFeatures(TransformerMixin, TPUEstimator):
         return _like_input(X, out)
 
 
-class MaxAbsScaler(TransformerMixin, TPUEstimator):
+class MaxAbsScaler(OneToOneFeatureMixin, TransformerMixin, TPUEstimator):
     """Scale each feature by its maximum absolute value (sparse-friendly
     sklearn semantics: no centering, zeros stay zero).  One masked
     reduction over the sharded sample axis."""
@@ -536,7 +536,7 @@ class MaxAbsScaler(TransformerMixin, TPUEstimator):
         return _like_input(X, x * self.scale_)
 
 
-class Normalizer(TransformerMixin, TPUEstimator):
+class Normalizer(OneToOneFeatureMixin, TransformerMixin, TPUEstimator):
     """Scale each ROW to unit norm (l1/l2/max) — stateless, one fused
     elementwise pass; rows of all zeros stay zero (sklearn semantics)."""
 

@@ -398,3 +398,79 @@ class TestContractGapsRound3:
         m = np.asarray(m)
         # balanced: minority rows upweighted by exactly count ratio 3x
         assert m[39] / m[0] == pytest.approx(3.0, rel=1e-4)
+
+
+class TestFeatureNamesAndPcaScore:
+    """Round-5 API slivers: get_feature_names_out across the transformer
+    surface (sklearn OneToOne / ClassNamePrefix mixin contracts) and the
+    probabilistic-PCA log-likelihood (``PCA.score[_samples]``)."""
+
+    def test_one_to_one_names(self, rng):
+        from dask_ml_tpu.impute import SimpleImputer
+        from dask_ml_tpu.preprocessing import (
+            MaxAbsScaler,
+            MinMaxScaler,
+            Normalizer,
+            QuantileTransformer,
+            RobustScaler,
+            StandardScaler,
+        )
+
+        X = rng.normal(size=(30, 3)).astype(np.float64)
+        for est in (StandardScaler(), MinMaxScaler(), MaxAbsScaler(),
+                    RobustScaler(), QuantileTransformer(n_quantiles=5),
+                    Normalizer(), SimpleImputer()):
+            est.fit(X)
+            assert list(est.get_feature_names_out()) == ["x0", "x1", "x2"]
+            assert list(est.get_feature_names_out(["a", "b", "c"])) == [
+                "a", "b", "c"]
+
+    def test_imputer_indicator_names_match_width(self, rng):
+        from dask_ml_tpu.impute import SimpleImputer
+
+        X = rng.normal(size=(30, 3)).astype(np.float64)
+        X[2, 1] = np.nan
+        im = SimpleImputer(add_indicator=True).fit(X)
+        names = im.get_feature_names_out()
+        assert list(names) == ["x0", "x1", "x2", "missingindicator_x1"]
+        assert np.asarray(im.transform(X)).shape[1] == len(names)
+
+    def test_decomposition_names(self, rng):
+        from dask_ml_tpu.decomposition import (
+            PCA,
+            IncrementalPCA,
+            TruncatedSVD,
+        )
+
+        X = rng.normal(size=(40, 4)).astype(np.float64)
+        assert list(
+            PCA(n_components=2).fit(X).get_feature_names_out()
+        ) == ["pca0", "pca1"]
+        assert list(
+            TruncatedSVD(n_components=2).fit(X).get_feature_names_out()
+        ) == ["truncatedsvd0", "truncatedsvd1"]
+        assert list(
+            IncrementalPCA(n_components=2).fit(X).get_feature_names_out()
+        ) == ["incrementalpca0", "incrementalpca1"]
+
+    @pytest.mark.parametrize("whiten", [False, True])
+    def test_pca_score_samples_matches_sklearn(self, rng, whiten):
+        from sklearn.decomposition import PCA as SkPCA
+
+        from dask_ml_tpu.decomposition import PCA
+
+        X = rng.normal(size=(60, 5)).astype(np.float64)
+        ours = PCA(n_components=3, whiten=whiten).fit(X)
+        ref = SkPCA(n_components=3, whiten=whiten).fit(X)
+        np.testing.assert_allclose(
+            np.asarray(ours.get_covariance()), ref.get_covariance(),
+            atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ours.score_samples(X)), ref.score_samples(X),
+            atol=1e-4,
+        )
+        assert ours.score(X) == pytest.approx(ref.score(X), abs=1e-4)
+        # sharded input path slices to real rows
+        s = shard_rows(X.astype(np.float32))
+        assert np.asarray(ours.score_samples(s)).shape == (60,)
