@@ -73,6 +73,23 @@ def _pdim(x, family):
     return x.shape[1] * int(getattr(family, "params_per_feature", 1))
 
 
+def _init_beta(beta0, x, family):
+    """Resolve a solver's initial parameter vector: zeros (cold start)
+    or a caller-supplied warm start (``LogisticRegression(warm_start=
+    True)`` passes the previous fit's coefficients).  Shape-checked: a
+    wrong-length init is a caller bug, not something to run with."""
+    d = _pdim(x, family)
+    dt = _param_dtype(x)
+    if beta0 is None:
+        return jnp.zeros(d, dtype=dt)
+    b = jnp.asarray(beta0, dt).ravel()
+    if b.shape[0] != d:
+        raise ValueError(
+            f"beta0 has {b.shape[0]} parameters; this solve needs {d}"
+        )
+    return b
+
+
 #: Python-level solver dispatch counter (observability for the packed
 #: OvR path: a K-class fit must cost O(1) dispatches, not K).
 DISPATCH_COUNTS = {"solves": 0}
@@ -118,7 +135,7 @@ def _lbfgs_run(x, yv, mask, beta0, lamduh, max_iter, tol, *, family, reg,
 
 def lbfgs(X, y, *, family: type[Family] = Logistic, regularizer=L2,
           lamduh: float = 0.0, max_iter: int = 100, tol: float = 1e-5,
-          return_n_iter: bool = False, line_search: str = "backtrack"):
+          beta0=None, return_n_iter: bool = False, line_search: str = "backtrack"):
     """Full-gradient L-BFGS on the total (smooth) objective.
 
     Reference: ``dask_glm/algorithms.py :: lbfgs`` (scipy driver with
@@ -132,7 +149,7 @@ def lbfgs(X, y, *, family: type[Family] = Logistic, regularizer=L2,
         )
     x, yv, mask = _prep(X, y)
     DISPATCH_COUNTS["solves"] += 1
-    beta0 = jnp.zeros(_pdim(x, family), dtype=_param_dtype(x))
+    beta0 = _init_beta(beta0, x, family)
     beta, n_it = _lbfgs_run(
         x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
         jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
@@ -181,7 +198,7 @@ def _gd_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg,
 def gradient_descent(X, y, *, family: type[Family] = Logistic,
                      regularizer=L2, lamduh: float = 0.0,
                      max_iter: int = 100, tol: float = 1e-7,
-                     return_n_iter: bool = False,
+                     beta0=None, return_n_iter: bool = False,
                      line_search: str = "backtrack"):
     """Armijo-backtracking gradient descent (reference ``gradient_descent``)."""
     reg = get_regularizer(regularizer)
@@ -189,7 +206,7 @@ def gradient_descent(X, y, *, family: type[Family] = Logistic,
         raise ValueError("gradient_descent requires a smooth penalty; use proximal_grad")
     x, yv, mask = _prep(X, y)
     DISPATCH_COUNTS["solves"] += 1
-    beta0 = jnp.zeros(_pdim(x, family), dtype=_param_dtype(x))
+    beta0 = _init_beta(beta0, x, family)
     beta, n_it = _gd_run(
         x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
         jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
@@ -248,13 +265,13 @@ def _pg_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg):
 
 def proximal_grad(X, y, *, family: type[Family] = Logistic, regularizer=L2,
                   lamduh: float = 0.0, max_iter: int = 100, tol: float = 1e-7,
-          return_n_iter: bool = False):
+          beta0=None, return_n_iter: bool = False):
     """Proximal gradient with backtracking on the smooth part (reference
     ``proximal_grad``): z = prox_{tλ}(β − t∇f(β))."""
     reg = get_regularizer(regularizer)
     x, yv, mask = _prep(X, y)
     DISPATCH_COUNTS["solves"] += 1
-    beta0 = jnp.zeros(_pdim(x, family), dtype=_param_dtype(x))
+    beta0 = _init_beta(beta0, x, family)
     beta, n_it = _pg_run(
         x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
         jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
@@ -310,7 +327,7 @@ def _newton_run(x, yv, mask, beta0, lamduh, max_it, tol, *, family, reg,
 
 def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
            lamduh: float = 0.0, max_iter: int = 50, tol: float = 1e-8,
-           return_n_iter: bool = False, line_search: str = "backtrack"):
+           beta0=None, return_n_iter: bool = False, line_search: str = "backtrack"):
     """Damped Newton: distributed Hessian XᵀWX (one psum-reduced gemm),
     replicated (d×d) solve (reference ``newton``)."""
     reg = get_regularizer(regularizer)
@@ -324,7 +341,7 @@ def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
         )
     x, yv, mask = _prep(X, y)
     DISPATCH_COUNTS["solves"] += 1
-    beta0 = jnp.zeros(_pdim(x, family), dtype=_param_dtype(x))
+    beta0 = _init_beta(beta0, x, family)
     beta, n_it = _newton_run(
         x, yv, mask, beta0, jnp.asarray(lamduh, _param_dtype(x)),
         jnp.int32(max_iter), jnp.asarray(tol, _param_dtype(x)),
@@ -342,7 +359,7 @@ def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
     "family", "reg", "mesh_holder", "inner_iter", "line_search",
     "adaptive_rho"))
 def _admm_run(x, yv, mask, lamduh, rho, abstol, reltol, inner_tol, max_it,
-              *, family, reg, mesh_holder, inner_iter,
+              z_init, *, family, reg, mesh_holder, inner_iter,
               line_search="backtrack", adaptive_rho=True):
     mesh = mesh_holder.mesh
     # rows shard over ('dcn', 'data') on a hierarchical multi-slice mesh
@@ -439,9 +456,13 @@ def _admm_run(x, yv, mask, lamduh, rho, abstol, reltol, inner_tol, max_it,
 
     inf = jnp.asarray(jnp.inf, _param_dtype(x))
     zero = jnp.asarray(0.0, _param_dtype(x))
-    beta_l0 = jnp.zeros((n_shards, d), dtype=_param_dtype(x))
+    # warm start: consensus z and every shard's beta begin at z_init
+    # (zeros when cold); duals start at 0 either way — Boyd's warm-start
+    # recipe for re-solves at nearby hyperparameters
+    beta_l0 = jnp.broadcast_to(
+        z_init, (n_shards, d)).astype(_param_dtype(x))
     u_l0 = jnp.zeros((n_shards, d), dtype=_param_dtype(x))
-    z0 = jnp.zeros(d, dtype=_param_dtype(x))
+    z0 = z_init.astype(_param_dtype(x))
     init = (jnp.int32(0), beta_l0, u_l0, z0,
             jnp.asarray(rho, _param_dtype(x)), inf, inf, zero, zero)
     final = lax.while_loop(cond, body, init)
@@ -453,7 +474,7 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
          abstol: float = 1e-4, reltol: float = 1e-2,
          inner_iter: int = 50, inner_tol: float = 1e-6, mesh=None,
          return_n_iter: bool = False, line_search: str = "backtrack",
-         adaptive_rho: bool = True):
+         adaptive_rho: bool = True, beta0=None):
     """Consensus ADMM (Boyd et al. §8): per-shard local subproblems solved by
     the jit-safe L-BFGS inside ``shard_map``, consensus z through the
     regularizer's prox, scaled dual updates.
@@ -480,6 +501,7 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
         jnp.asarray(lamduh, dt), jnp.asarray(rho, dt),
         jnp.asarray(abstol, dt), jnp.asarray(reltol, dt),
         jnp.asarray(inner_tol, dt), jnp.int32(max_iter),
+        _init_beta(beta0, x, family),
         family=family, reg=reg, mesh_holder=MeshHolder(mesh),
         inner_iter=inner_iter, line_search=line_search,
         adaptive_rho=adaptive_rho,
@@ -540,7 +562,7 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
                  tol: float = 1e-5, rho: float = 1.0, abstol: float = 1e-4,
                  reltol: float = 1e-2, inner_iter: int = 50,
                  inner_tol: float = 1e-6, mesh=None,
-                 line_search: str = "backtrack"):
+                 line_search: str = "backtrack", Beta0=None):
     """All K independent solves as ONE vmapped XLA program over the
     leading axis of ``Y`` — the one-vs-rest fit issues a single dispatch
     instead of K sequential ones (the solvers' whole-solve ``while_loop``
@@ -584,6 +606,17 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
         )
     K = Yd.shape[0]
     lam = jnp.asarray(lamduh, dt)
+    # warm start: one initial parameter row per lane (previous fit's
+    # betas_); zeros when cold.  Per-row resolution goes through
+    # _init_beta so the batched path shares its validation exactly.
+    if Beta0 is None:
+        B0 = jnp.zeros((K, _pdim(x, family)), dtype=dt)
+    else:
+        if len(Beta0) != K:
+            raise ValueError(
+                f"Beta0 must have {K} rows (one per lane); got {len(Beta0)}"
+            )
+        B0 = jnp.stack([_init_beta(b, x, family) for b in Beta0])
 
     def _sequential(one_fn, *extra_rows):
         # K whole-solve dispatches (the auto fallback where vmap packing
@@ -602,18 +635,18 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
         mesh = mesh or get_mesh()
         mh = MeshHolder(mesh)
 
-        def one(yv):
+        def one(yv, b0):
             return _admm_run(
                 x, yv, mask, lam, jnp.asarray(rho, dt),
                 jnp.asarray(abstol, dt), jnp.asarray(reltol, dt),
-                jnp.asarray(inner_tol, dt), jnp.int32(max_iter),
+                jnp.asarray(inner_tol, dt), jnp.int32(max_iter), b0,
                 family=family, reg=reg, mesh_holder=mh,
                 inner_iter=inner_iter, line_search=line_search,
             )
 
         if strategy == "sequential":
-            return _sequential(one)
-        return jax.vmap(one)(Yd)
+            return _sequential(one, B0)
+        return jax.vmap(one)(Yd, B0)
     runners = {
         "lbfgs": _lbfgs_run,
         "gradient_descent": _gd_run,
@@ -630,7 +663,6 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
     if solver == "newton" and getattr(family, "params_per_feature", 1) > 1:
         raise ValueError("newton does not support matrix-parameter families")
     run = runners[solver]
-    B0 = jnp.zeros((K, _pdim(x, family)), dtype=dt)
 
     # proximal_grad has its own prox backtracking and takes no knob
     extra_kw = (
@@ -686,6 +718,7 @@ def lambda_sweep(solver: str, X, y, lams, *, family: type[Family] = Logistic,
                 x, yd, mask, lam, jnp.asarray(rho, dt),
                 jnp.asarray(abstol, dt), jnp.asarray(reltol, dt),
                 jnp.asarray(inner_tol, dt), jnp.int32(max_iter),
+                jnp.zeros(_pdim(x, family), dtype=dt),
                 family=family, reg=reg, mesh_holder=mh,
                 inner_iter=inner_iter, line_search=line_search,
             )

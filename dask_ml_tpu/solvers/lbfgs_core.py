@@ -228,7 +228,17 @@ def lbfgs_minimize(
 ):
     """Minimize a traceable scalar function; returns (x, LBFGSState).
 
-    Convergence: ‖g‖_∞ ≤ tol, matching scipy's ``pgtol`` semantics.
+    Convergence: ‖g‖_∞ ≤ tol (scipy's ``pgtol``), OR relative objective
+    decrease ≤ 10·eps(dtype) (scipy's ``factr``-style stagnation exit,
+    active only when ``tol > 0``): in fp32 a sum-scaled objective's
+    gradient often cannot be certified below ~1e-4 even AT the optimum
+    (rounding noise in the gradient evaluation exceeds it — scipy's own
+    L-BFGS-B stops with a larger ‖g‖∞ on the same data), so a solve
+    that has numerically converged must not burn max_iter failing the
+    pgtol test.  ``tol = 0`` disables both CONVERGENCE tests (the
+    line-search-failure exit still fires — a lane that cannot take any
+    step has no further work worth timing), which is how the bench gets
+    its fixed-iteration-count runs.
     ``line_search``: ``backtrack`` (default — the measured-safe choice on
     CPU; REQUIRED under ``vmap``) or ``probe_grid`` (batched grid — the
     bandwidth-optimal candidate for big-n TPU solves; flip per solve via
@@ -281,7 +291,13 @@ def lbfgs_minimize(
         Y = jnp.where(good, st.Y.at[pos].set(y), st.Y)
         rho = jnp.where(good, st.rho.at[pos].set(1.0 / jnp.maximum(sy, 1e-12)), st.rho)
         n_updates = st.n_updates + jnp.where(good, 1, 0)
-        converged = (jnp.max(jnp.abs(g_new)) <= tol) | failed
+        rel_dec = (st.f - f_new) / jnp.maximum(
+            jnp.maximum(jnp.abs(st.f), jnp.abs(f_new)), 1.0
+        )
+        stalled = (tol > 0) & (
+            rel_dec <= 10.0 * jnp.finfo(dtype).eps
+        )
+        converged = (jnp.max(jnp.abs(g_new)) <= tol) | failed | stalled
         return LBFGSState(
             x=x_new, f=f_new, g=g_new, S=S, Y=Y, rho=rho,
             k=st.k + 1, n_updates=n_updates, converged=converged,

@@ -129,20 +129,60 @@ class TestMulticlassLogistic:
         theirs_acc = theirs.score(X, y)
         assert ours_acc >= theirs_acc - 0.03
 
-    def test_inert_params_warn(self, rng):
-        # class_weight is REAL since round 3 (no warning); warm_start is
-        # the one remaining accepted-inert param (reference behavior)
+    def test_no_inert_param_warnings(self, rng):
+        # class_weight is REAL since round 3; warm_start is REAL since
+        # round 5 (seeds the solver with the previous coefficients) —
+        # nothing left to warn about
+        import warnings
+
         from dask_ml_tpu.linear_model import LogisticRegression
 
         X = rng.normal(size=(60, 3)).astype(np.float32)
         y = (X[:, 0] > 0).astype(int)
-        with pytest.warns(UserWarning, match="warm_start"):
-            LogisticRegression(warm_start=True, max_iter=5).fit(X, y)
-        import warnings
-
         with warnings.catch_warnings():
             warnings.simplefilter("error")
+            LogisticRegression(warm_start=True, max_iter=5).fit(X, y)
             LogisticRegression(class_weight="balanced", max_iter=5).fit(X, y)
+
+    def test_warm_start_seeds_previous_solution(self, rng):
+        """A warm refit on the SAME data starts at the previous optimum:
+        the solver converges in (far) fewer iterations and reproduces
+        the cold solution.  Covers binary, packed OvR, and multinomial."""
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        X = rng.normal(size=(200, 6)).astype(np.float32)
+        w = rng.normal(size=6)
+        yb = (X @ w > 0).astype(np.float32)
+        y3 = np.digitize(X @ w, [-0.5, 0.5]).astype(np.float32)
+
+        for y, kw in [
+            (yb, {}),
+            (y3, {}),  # packed OvR
+            (y3, {"multi_class": "multinomial"}),
+        ]:
+            cold = LogisticRegression(
+                solver="lbfgs", max_iter=200, warm_start=True, **kw
+            ).fit(X, y)
+            first_iters = int(np.max(cold.n_iter_))
+            coef_first = np.asarray(cold.coef_).copy()
+            cold.fit(X, y)  # warm refit, same data
+            assert int(np.max(cold.n_iter_)) <= max(first_iters // 2, 2), (
+                kw, cold.n_iter_, first_iters)
+            np.testing.assert_allclose(
+                np.asarray(cold.coef_), coef_first, atol=1e-3)
+
+    def test_warm_start_cold_starts_on_changed_geometry(self, rng):
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        X = rng.normal(size=(100, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        clf = LogisticRegression(
+            solver="lbfgs", max_iter=50, warm_start=True).fit(X, y)
+        # different feature count: silently cold-starts, must not crash
+        X2 = rng.normal(size=(100, 6)).astype(np.float32)
+        y2 = (X2[:, 0] > 0).astype(np.float32)
+        clf.fit(X2, y2)
+        assert clf.coef_.shape == (6,)
 
     def test_single_class_raises(self, rng):
         from dask_ml_tpu.linear_model import LogisticRegression

@@ -27,7 +27,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _CHILD = """
-import os, resource, sys, json
+import os, sys, json
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -36,7 +36,16 @@ from dask_ml_tpu.linear_model import SGDClassifier
 from dask_ml_tpu.io import stream_csv_blocks
 
 def peak_mb():
-    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    # VmHWM, NOT ru_maxrss: a forked child's ru_maxrss includes the
+    # PARENT'S resident set at fork time (the COW window before exec),
+    # so under a fat parent — a pytest session 790 tests deep, ~4 GB —
+    # ru_maxrss reports the parent's peak no matter what this process
+    # does.  VmHWM belongs to the post-exec mm and measures only us.
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmHWM"):
+                return int(line.split()[1]) / 1024.0
+    raise RuntimeError("VmHWM not found")
 
 path = sys.argv[1]
 clf = SGDClassifier(random_state=0)
