@@ -245,13 +245,32 @@ int parse_rows_mt(const char* data, size_t size,
 // ~(window + parsed-window floats + depth ring blocks) regardless of
 // file size — the whole point of the out-of-core ingest path: a 100 GB
 // CSV streams through partial_fit in tens of MB of host memory.
-constexpr size_t kStreamWindowBytes = 32u << 20;
+// DMLT_STREAM_WINDOW_BYTES overrides (floor 16) — the adversarial
+// window-boundary property tests shrink it to a few bytes' scale so
+// tiny files exercise many refill/compact/carry cycles.
+size_t stream_window_bytes() {
+    // Read ONCE per session at open time, on the CALLER's thread (the
+    // worker thread must never call getenv concurrently with Python
+    // setenv — glibc may realloc environ under it).  Sessions open
+    // fresh, so per-open reads still let the tests flip the knob.
+    const char* e = std::getenv("DMLT_STREAM_WINDOW_BYTES");
+    constexpr size_t kDefault = 32u << 20;
+    if (!e || !*e) return kDefault;
+    char* end = nullptr;
+    errno = 0;
+    long long n = std::strtoll(e, &end, 10);
+    if (errno || end == e || *end != '\0' || n <= 0)
+        return kDefault;  // typos ("32M") must not shrink a 100 GB
+                          // ingest to a byte-scale window silently
+    return n >= 16 ? static_cast<size_t>(n) : size_t{16};
+}
 
 struct Stream {
     FILE* f = nullptr;
     std::vector<char> win;  // leftover partial line + freshly read bytes
     size_t win_len = 0;     // valid bytes in win
     size_t consumed = 0;    // first unparsed byte
+    size_t window_bytes = 32u << 20;  // fixed at open (caller thread)
     bool eof = false;
     long cols = 0;
     int64_t block_rows = 0;
@@ -280,10 +299,11 @@ struct Stream {
     // +1 spare byte so the parse can always NUL-terminate its region.
     int refill() {
         if (eof) return 0;
-        if (win.size() < win_len + kStreamWindowBytes + 1)
-            win.resize(win_len + kStreamWindowBytes + 1);
-        size_t got = std::fread(win.data() + win_len, 1, kStreamWindowBytes, f);
-        if (got < kStreamWindowBytes) {
+        const size_t wb = window_bytes;
+        if (win.size() < win_len + wb + 1)
+            win.resize(win_len + wb + 1);
+        size_t got = std::fread(win.data() + win_len, 1, wb, f);
+        if (got < wb) {
             if (std::ferror(f)) return -EIO;
             eof = true;
         }
@@ -459,6 +479,7 @@ void* dmlt_stream_open(const char* path, int has_header, int64_t block_rows,
     s->block_rows = block_rows > 0 ? block_rows : 1;
     s->n_threads = n_threads > 0 ? n_threads : 1;
     s->depth = depth > 0 ? static_cast<size_t>(depth) : 1;
+    s->window_bytes = stream_window_bytes();  // caller thread, once
     size_t skip = has_header ? 1 : 0;
 
     // read until the first data line is complete (its newline in the

@@ -5,6 +5,9 @@ import pytest
 
 from dask_ml_tpu import io as dio
 
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
 
 @pytest.fixture(scope="module")
 def csv_file(tmp_path_factory):
@@ -234,3 +237,103 @@ class TestFastFloatParse:
             else:
                 np.testing.assert_allclose(out, vals, rtol=1e-5,
                                            err_msg=fmt)
+
+
+class TestWindowedStreamProperties:
+    """Adversarial window-boundary coverage for the windowed streaming
+    session (round 5: the session went from whole-file-resident to a
+    moving window; every refill/compact/carry-over cycle is new code).
+    DMLT_STREAM_WINDOW_BYTES shrinks the window to a few tens of bytes
+    so tiny files exercise MANY windows, lines split across refills,
+    blank lines at region starts, and missing trailing newlines."""
+
+    @settings(max_examples=40, deadline=None)
+    @given(
+        seed=st.integers(0, 2**31 - 1),
+        n_rows=st.integers(1, 40),
+        n_cols=st.integers(1, 5),
+        block_rows=st.integers(1, 7),
+        window=st.integers(16, 200),
+        trailing=st.booleans(),
+        blanks=st.booleans(),
+    )
+    def test_stream_matches_whole_file_parse(
+            self, seed, n_rows, n_cols, block_rows, window, trailing,
+            blanks):
+        import os
+        import tempfile
+        rng = np.random.RandomState(seed % (2**31 - 1))
+        rows = rng.normal(size=(n_rows, n_cols)) * 10.0 ** rng.randint(
+            -3, 4, size=(n_rows, n_cols))
+        lines = [",".join(f"{v:.6g}" for v in r) for r in rows]
+        if blanks:
+            # blank lines anywhere (including the very start and between
+            # window boundaries) must be skipped, as the whole-file
+            # parser does
+            out = []
+            for ln in lines:
+                if rng.rand() < 0.3:
+                    out.append("")
+                out.append(ln)
+            if rng.rand() < 0.5:
+                out.append("")
+            lines = out
+        text = "\n".join(lines)
+        if trailing:
+            text += "\n"
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".csv", delete=False) as f:
+            f.write(text)
+            p = f.name
+        saved = os.environ.get("DMLT_STREAM_WINDOW_BYTES")
+        os.environ["DMLT_STREAM_WINDOW_BYTES"] = str(window)
+        try:
+            got = [b.copy() for b in dio.stream_csv_blocks(p, block_rows)]
+        finally:
+            if saved is None:
+                os.environ.pop("DMLT_STREAM_WINDOW_BYTES", None)
+            else:
+                os.environ["DMLT_STREAM_WINDOW_BYTES"] = saved
+        stream = (np.vstack(got) if got
+                  else np.zeros((0, n_cols), np.float32))
+        whole = dio.read_csv(p)
+        os.unlink(p)
+        assert stream.shape == whole.shape, (stream.shape, whole.shape)
+        np.testing.assert_array_equal(stream, whole)
+        assert all(b.shape[0] <= block_rows for b in got)
+
+    @settings(max_examples=15, deadline=None)
+    @given(seed=st.integers(0, 2**31 - 1), window=st.integers(16, 120))
+    def test_malformed_line_prefix_across_windows(self, seed, window):
+        import os
+        import tempfile
+        """The deterministic-prefix error contract must hold at ANY
+        window size: every full block before the first malformed line
+        is delivered, then the error raises."""
+        rng = np.random.RandomState(seed % (2**31 - 1))
+        n = int(rng.randint(4, 30))
+        bad = int(rng.randint(0, n))
+        lines = [f"{i}.0,{i * 2}.0" for i in range(n)]
+        lines[bad] = "not,numeric_at_all"
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".csv", delete=False) as f:
+            f.write("\n".join(lines) + "\n")
+            p = f.name
+        saved = os.environ.get("DMLT_STREAM_WINDOW_BYTES")
+        os.environ["DMLT_STREAM_WINDOW_BYTES"] = str(window)
+        got = []
+        try:
+            with pytest.raises(OSError):
+                for b in dio.stream_csv_blocks(p, 2):
+                    got.append(b.copy())
+        finally:
+            if saved is None:
+                os.environ.pop("DMLT_STREAM_WINDOW_BYTES", None)
+            else:
+                os.environ["DMLT_STREAM_WINDOW_BYTES"] = saved
+            os.unlink(p)
+        assert len(got) == bad // 2  # full blocks strictly before the bad row
+        if got:
+            np.testing.assert_array_equal(
+                np.vstack(got)[:, 0],
+                np.arange(bad // 2 * 2, dtype=np.float32))
