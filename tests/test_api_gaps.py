@@ -157,7 +157,7 @@ class TestMulticlassLogistic:
 
         for y, kw in [
             (yb, {}),
-            (y3, {}),  # packed OvR
+            (y3, {}),  # OvR (sequential on CPU by auto policy)
             (y3, {"multi_class": "multinomial"}),
         ]:
             cold = LogisticRegression(
@@ -170,6 +170,25 @@ class TestMulticlassLogistic:
                 kw, cold.n_iter_, first_iters)
             np.testing.assert_allclose(
                 np.asarray(cold.coef_), coef_first, atol=1e-3)
+
+    def test_warm_start_packed_lanes(self, rng, monkeypatch):
+        """The vmapped packed-OvR path consumes the per-lane Beta0 stack
+        (auto falls back to sequential on CPU, so force packed)."""
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        monkeypatch.setenv("DASK_ML_TPU_PACK", "packed")
+        X = rng.normal(size=(200, 6)).astype(np.float32)
+        w = rng.normal(size=6)
+        y3 = np.digitize(X @ w, [-0.5, 0.5]).astype(np.float32)
+        clf = LogisticRegression(
+            solver="lbfgs", max_iter=200, warm_start=True).fit(X, y3)
+        first = int(np.max(clf.n_iter_))
+        coef_first = np.asarray(clf.coef_).copy()
+        clf.fit(X, y3)
+        assert int(np.max(clf.n_iter_)) <= max(first // 2, 2), (
+            clf.n_iter_, first)
+        np.testing.assert_allclose(
+            np.asarray(clf.coef_), coef_first, atol=1e-3)
 
     def test_warm_start_cold_starts_on_changed_geometry(self, rng):
         from dask_ml_tpu.linear_model import LogisticRegression
