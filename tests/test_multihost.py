@@ -89,6 +89,27 @@ class TestMultihost:
         single = [round(s, 6) for s in search.cv_results_["test_score"]]
         np.testing.assert_allclose(single, parsed[0], atol=1e-4)
 
+    def test_three_process_group(self):
+        """Odd process count (3 × 2 devices): the mesh math, the
+        hierarchical dcn axis (size 3), and the cross-controller
+        agreement must all be nproc-generic, not 2-hardcoded.  All
+        three processes must report identical search scores and
+        Hyperband results."""
+        import re
+
+        outs = []
+        for rc, out in spawn_group(3, 2, timeout_s=900):
+            assert rc == 0, out
+            assert "multihost OK" in out
+            assert "dcn_mesh OK" in out
+            outs.append(out)
+        scores = [re.search(r"search_scores=(\[[^\]]*\])", o).group(1)
+                  for o in outs]
+        assert scores[0] == scores[1] == scores[2]
+        hbs = [re.search(r"hyperband_best=([0-9.]+) n_models=(\d+)",
+                         o).groups() for o in outs]
+        assert hbs[0] == hbs[1] == hbs[2]
+
     def test_graft_entry_dryrun_multihost(self):
         # the driver-facing wrapper end-to-end
         sys.path.insert(0, REPO)
