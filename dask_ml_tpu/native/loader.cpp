@@ -71,19 +71,31 @@ int read_file(const char* path, FileBuf& buf) {
 // Offsets of line starts for every non-empty line.  memchr (SIMD in
 // libc) instead of a byte loop: the index scan is ~5% of parse time on
 // a 60MB file with the fast field parser, and this makes it ~free.
+// THE line-walk idiom, shared by every scanner (whole-file index,
+// streaming-window index, open-time completeness/cols checks) so
+// blank-line and termination semantics can never desynchronize between
+// them: `next_nonblank` skips blank lines; `line_end_next` returns one
+// past this line's '\n', or `end` when the line is unterminated there
+// (a line IS terminated iff the returned j has d[j-1] == '\n').
+inline size_t next_nonblank(const char* d, size_t i, size_t end) {
+    while (i < end && (d[i] == '\n' || d[i] == '\r')) i++;
+    return i;
+}
+
+inline size_t line_end_next(const char* d, size_t i, size_t end) {
+    const char* nl =
+        static_cast<const char*>(std::memchr(d + i, '\n', end - i));
+    return nl ? static_cast<size_t>(nl - d) + 1 : end;
+}
+
 void line_starts(const FileBuf& buf, std::vector<size_t>& starts) {
     const size_t n = buf.size;
     // reserve from an estimated line length to avoid regrowth copies
     starts.reserve(n / 32 + 16);
-    size_t i = 0;
-    while (i < n && (buf.data[i] == '\n' || buf.data[i] == '\r')) i++;
+    size_t i = next_nonblank(buf.data, 0, n);
     while (i < n) {
         starts.push_back(i);
-        const char* nl = static_cast<const char*>(
-            std::memchr(buf.data + i, '\n', n - i));
-        i = nl ? static_cast<size_t>(nl - buf.data) + 1 : n;
-        // swallow blank trailing lines
-        while (i < n && (buf.data[i] == '\n' || buf.data[i] == '\r')) i++;
+        i = next_nonblank(buf.data, line_end_next(buf.data, i, n), n);
     }
 }
 
@@ -345,25 +357,18 @@ struct Stream {
             }
             size_t complete = complete_end();
             if (complete > consumed) {
-                // index the window's complete lines.  Leading blank lines
-                // are skipped BEFORE the first push too: after a compact,
-                // a region can begin exactly at a blank line (the
-                // previous window ended on its preceding newline), and
-                // indexing it as a row would EINVAL legal CSV that the
-                // whole-file path accepts.
+                // index the window's complete lines (shared line-walk:
+                // leading blank lines are skipped BEFORE the first push
+                // too — after a compact, a region can begin exactly at
+                // a blank line, and indexing it as a row would EINVAL
+                // legal CSV that the whole-file path accepts)
                 starts.clear();
-                size_t i = consumed;
-                while (i < complete && (win[i] == '\n' || win[i] == '\r'))
-                    i++;
+                size_t i = next_nonblank(win.data(), consumed, complete);
                 while (i < complete) {
                     starts.push_back(i);
-                    const char* nl = static_cast<const char*>(
-                        std::memchr(win.data() + i, '\n', complete - i));
-                    i = nl ? static_cast<size_t>(nl - win.data()) + 1
-                           : complete;
-                    while (i < complete &&
-                           (win[i] == '\n' || win[i] == '\r'))
-                        i++;
+                    i = next_nonblank(
+                        win.data(),
+                        line_end_next(win.data(), i, complete), complete);
                 }
                 // NUL-terminate the region for the strtof fallback on the
                 // last line; the clobbered byte (the partial tail's first,
@@ -482,18 +487,26 @@ void* dmlt_stream_open(const char* path, int has_header, int64_t block_rows,
     s->window_bytes = stream_window_bytes();  // caller thread, once
     size_t skip = has_header ? 1 : 0;
 
-    // read until the first data line is complete (its newline in the
-    // window, or EOF) so cols can be counted synchronously
-    auto count_newlines = [&](size_t upto) {
-        size_t n = 0, i = 0;
-        while (i < upto) {
-            const char* nl = static_cast<const char*>(
-                std::memchr(s->win.data() + i, '\n', upto - i));
-            if (!nl) break;
-            n++;
-            i = static_cast<size_t>(nl - s->win.data()) + 1;
+    // read until the first DATA line is complete (its newline in the
+    // window, or EOF) so cols can be counted synchronously.  Blank
+    // lines don't count: a file starting with '\n' followed by a line
+    // longer than the window would otherwise satisfy a naive
+    // newline-count check and cols would be read off the TRUNCATED
+    // line (explore-profile Hypothesis find, round 5).
+    auto first_data_complete = [&]() -> bool {
+        const char* d = s->win.data();
+        const size_t n = s->win_len;
+        size_t i = next_nonblank(d, 0, n);
+        size_t complete_lines = 0;  // non-blank lines with a newline
+        while (i < n) {
+            size_t j = line_end_next(d, i, n);
+            if (!(j > i && d[j - 1] == '\n'))
+                return false;  // line still open at the window edge
+            complete_lines++;
+            if (complete_lines > skip) return true;  // header(s) + data
+            i = next_nonblank(d, j, n);
         }
-        return n;
+        return false;
     };
     for (;;) {
         int rc = s->refill();
@@ -502,23 +515,18 @@ void* dmlt_stream_open(const char* path, int has_header, int64_t block_rows,
             delete s;
             return nullptr;
         }
-        if (s->eof || count_newlines(s->win_len) > skip) break;
+        if (s->eof || first_data_complete()) break;
     }
 
-    // line starts of the header (if any) + first data line (leading
-    // blank lines skipped, same as the worker's index loop)
+    // line starts of the header (if any) + first data line (the shared
+    // line-walk, same semantics as every other scanner)
     std::vector<size_t> starts;
-    size_t i = 0;
-    while (i < s->win_len && (s->win[i] == '\n' || s->win[i] == '\r'))
-        i++;
+    size_t i = next_nonblank(s->win.data(), 0, s->win_len);
     while (i < s->win_len && starts.size() <= skip) {
         starts.push_back(i);
-        const char* nl = static_cast<const char*>(
-            std::memchr(s->win.data() + i, '\n', s->win_len - i));
-        i = nl ? static_cast<size_t>(nl - s->win.data()) + 1 : s->win_len;
-        while (i < s->win_len &&
-               (s->win[i] == '\n' || s->win[i] == '\r'))
-            i++;
+        i = next_nonblank(
+            s->win.data(), line_end_next(s->win.data(), i, s->win_len),
+            s->win_len);
     }
     if (starts.size() <= skip) {  // empty or header-only file
         *rows = 0;
