@@ -62,8 +62,14 @@ def _simulate_sha_calls(n, r, R, eta):
         steps += 1
         survivors = sorted(calls)[: max(n_i, 1)]
         if len(survivors) in (0, 1) and steps > 1:
-            for ident in survivors:  # final survivor's remaining budget
-                total += max(0, r_i - calls[ident])
+            # the EXECUTED policy keeps escalating the final survivor's
+            # rung (r_i × eta per round, capped at R) until it holds the
+            # full budget — so the survivor always ends at exactly R
+            # calls, not at the current rung (property-test find at
+            # R=3, eta=2: brackets whose pool shrinks to 1 BEFORE the
+            # rung ladder reaches R under-predicted by the difference)
+            for ident in survivors:
+                total += max(0, R - calls[ident])
             break
         added = 0
         for ident in survivors:

@@ -630,3 +630,62 @@ class TestAdversarialSolvers:
         ref_acc = float(ref.score(sX, sy))
         assert acc >= ref_acc - 0.03, (acc, ref_acc, rho, offset)
         assert acc >= 0.6, (acc, rho, offset)  # sanity: above chance
+
+
+@settings(max_examples=12, deadline=None)
+@given(st.integers(2, 27), st.integers(2, 4), st.integers(0, 2**31 - 1))
+def test_hyperband_executes_its_own_metadata(R, eta, seed):
+    """The crown-jewel contract across the whole (max_iter,
+    aggressiveness) plane, not just the documented examples: the
+    EXECUTED schedule (metadata_) must equal the pre-fit bracket math
+    (metadata) whenever the parameter space is large enough to fill
+    every bracket.  Reference: ``dask_ml/model_selection/_hyperband.py
+    :: metadata`` vs ``metadata_``."""
+    from dask_ml_tpu.model_selection import HyperbandSearchCV
+    from dask_ml_tpu.model_selection.utils_test import LinearFunction
+
+    rng_l = np.random.RandomState(seed % (2**31 - 1))
+    X = rng_l.normal(size=(120, 3)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    hb = HyperbandSearchCV(
+        LinearFunction(),
+        # 200 distinct slopes: no bracket can exhaust the space
+        {"slope": list(rng_l.uniform(0.1, 3.0, size=200))},
+        max_iter=R, aggressiveness=eta, random_state=0,
+    )
+    hb.fit(X, y)
+    assert hb.metadata_["n_models"] == hb.metadata["n_models"]
+    assert (hb.metadata_["partial_fit_calls"]
+            == hb.metadata["partial_fit_calls"])
+    assert hb.metadata_["brackets"] == hb.metadata["brackets"]
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.integers(0, 2**31 - 1), st.integers(1, 4), st.integers(2, 6))
+def test_truncated_svd_streamed_matches_dense(seed, n_blocks, k):
+    """fit_streamed (multi-pass randomized range finder over a sparse
+    block stream) must agree with the dense TSQR fit on singular values
+    and subspace — any block partition, any rank."""
+    import scipy.sparse as sp
+
+    from dask_ml_tpu.decomposition import TruncatedSVD
+
+    rng_l = np.random.RandomState(seed % (2**31 - 1))
+    d = k + rng_l.randint(2, 6)
+    n = n_blocks * rng_l.randint(8, 20)
+    X = rng_l.normal(size=(n, d)).astype(np.float32)
+    X[rng_l.rand(n, d) < 0.5] = 0.0  # sparse-ish
+    bounds = np.linspace(0, n, n_blocks + 1, dtype=int)
+    blocks = lambda: (sp.csr_matrix(X[a:b])  # noqa: E731
+                      for a, b in zip(bounds[:-1], bounds[1:]))
+
+    dense = TruncatedSVD(n_components=k, random_state=0).fit(X)
+    streamed = TruncatedSVD(n_components=k, random_state=0)
+    streamed.fit_streamed(blocks, n_features=d)
+    np.testing.assert_allclose(
+        np.asarray(streamed.singular_values_),
+        np.asarray(dense.singular_values_), rtol=2e-2, atol=1e-3)
+    # subspace agreement (sign/rotation-invariant): V_s V_s^T == V_d V_d^T
+    Vs = np.asarray(streamed.components_, np.float64)
+    Vd = np.asarray(dense.components_, np.float64)
+    np.testing.assert_allclose(Vs.T @ Vs, Vd.T @ Vd, atol=5e-2)
