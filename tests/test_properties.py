@@ -629,7 +629,11 @@ class TestAdversarialSolvers:
         ref = LogisticRegression(solver="lbfgs", max_iter=300).fit(sX, sy)
         ref_acc = float(ref.score(sX, sy))
         assert acc >= ref_acc - 0.03, (acc, ref_acc, rho, offset)
-        assert acc >= 0.6, (acc, rho, offset)  # sanity: above chance
+        # sanity floor only: at offset=1e3 with strong L2, some seeds'
+        # REGULARIZED optimum classifies near 0.6 (explore-profile find:
+        # L-BFGS itself scored 0.60 there) — the oracle comparison above
+        # is the real assertion; this floor only catches catastrophe
+        assert acc >= 0.52, (acc, rho, offset)
 
 
 @settings(max_examples=12, deadline=None)
