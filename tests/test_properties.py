@@ -693,3 +693,79 @@ def test_truncated_svd_streamed_matches_dense(seed, n_blocks, k):
     Vs = np.asarray(streamed.components_, np.float64)
     Vd = np.asarray(dense.components_, np.float64)
     np.testing.assert_allclose(Vs.T @ Vs, Vd.T @ Vd, atol=5e-2)
+
+
+@settings(max_examples=20, deadline=None)
+@given(st.integers(0, 2**31 - 1),
+       st.sampled_from(["str", "int", "mixed_len"]),
+       st.integers(2, 6))
+def test_encoder_roundtrip_any_categories(seed, kind, n_cat):
+    """OneHot/Ordinal fit → transform → inverse_transform is the
+    identity for ANY category alphabet (unicode, negative ints,
+    shared-prefix strings), and categories_ matches sklearn's."""
+    from sklearn.preprocessing import OrdinalEncoder as SkOrd
+
+    from dask_ml_tpu.preprocessing import OneHotEncoder, OrdinalEncoder
+
+    rng_l = np.random.RandomState(seed % (2**31 - 1))
+    if kind == "str":
+        alphabet = np.array(
+            ["α", "beta", "Ω", "zz", "a b", ""][:n_cat], dtype=object)
+    elif kind == "int":
+        alphabet = np.array([-5, -1, 0, 3, 7, 100][:n_cat])
+    else:
+        alphabet = np.array(
+            ["x", "xx", "xxx", "xxxx", "y", "xy"][:n_cat], dtype=object)
+    n = int(rng_l.randint(n_cat, 40))
+    col = alphabet[rng_l.randint(0, n_cat, size=n)]
+    # every category present at least once (fit must see the alphabet)
+    col[:n_cat] = alphabet
+    X = col.reshape(-1, 1)
+
+    for enc in (OneHotEncoder(sparse_output=False)
+                if "sparse_output" in OneHotEncoder().get_params()
+                else OneHotEncoder(), OrdinalEncoder()):
+        enc.fit(X)
+        out = enc.transform(X)
+        try:
+            import scipy.sparse as sp
+
+            if sp.issparse(out):
+                out = out.toarray()
+        except ImportError:
+            pass
+        back = np.asarray(enc.inverse_transform(np.asarray(out)))
+        assert (back.ravel() == col).all(), (kind, type(enc).__name__)
+    ref = SkOrd().fit(X)
+    ours = OrdinalEncoder().fit(X)
+    np.testing.assert_array_equal(
+        np.asarray(ours.categories_[0]), np.asarray(ref.categories_[0]))
+
+
+@settings(max_examples=15, deadline=None)
+@given(st.integers(0, 2**31 - 1), st.integers(1, 12))
+def test_count_vectorizer_matches_sklearn(seed, n_docs):
+    """CountVectorizer parity on random small corpora: same vocabulary,
+    same counts (the reference wraps sklearn's analyzer; so do we —
+    parity must be exact)."""
+    from sklearn.feature_extraction.text import (
+        CountVectorizer as SkCV,
+    )
+
+    from dask_ml_tpu.feature_extraction import CountVectorizer
+
+    rng_l = np.random.RandomState(seed % (2**31 - 1))
+    words = ["apple", "banana", "cat", "dog", "egg", "fish", "goat"]
+    docs = [
+        " ".join(rng_l.choice(words,
+                              size=rng_l.randint(0, 8)).tolist())
+        for _ in range(n_docs)
+    ]
+    if not any(d.strip() for d in docs):
+        docs[0] = "apple"
+    ours = CountVectorizer().fit(docs)
+    ref = SkCV().fit(docs)
+    assert ours.vocabulary_ == ref.vocabulary_
+    a = np.asarray(ours.transform(docs).todense())
+    b = np.asarray(ref.transform(docs).todense())
+    np.testing.assert_array_equal(a, b)
