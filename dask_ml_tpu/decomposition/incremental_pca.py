@@ -61,7 +61,7 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
     def _init_state(self, d, k, dtype):
         self.components_ = jnp.zeros((k, d), dtype=dtype)
         self.singular_values_ = jnp.zeros((k,), dtype=dtype)
-        self.mean_ = jnp.zeros((d,), dtype=dtype)
+        self._mean_sh_ = jnp.zeros((d,), dtype=dtype)
         self.var_ = jnp.zeros((d,), dtype=dtype)
         self.n_samples_seen_ = 0
 
@@ -76,25 +76,43 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
         if not hasattr(self, "components_"):
             self._init_state(d, k, x.dtype)
             self.n_components_ = k
+            # Anchor-shift (the core.sharded._masked_anchor idiom): all
+            # moment/SVD arithmetic runs on x − anchor, at the data's
+            # SPREAD scale instead of its offset scale.  At offset 1e6
+            # the raw-scale update loses ~0.3% of var_ and ~0.1° of
+            # component subspace to f32 mean cancellation (adversarial
+            # property find, round 5); anchored, both drop to the
+            # centered-data floor.  The first row is a valid data value
+            # per feature, so the subtraction is exact for values within
+            # 2× of it (Sterbenz) — exactly the offset-dominated regime.
+            self._anchor_ = x[0]
         if x.shape[0] < self.n_components_:
             raise ValueError(
                 f"batch of {x.shape[0]} rows < n_components={self.n_components_}"
             )
+        if getattr(self, "_anchor_", None) is None:
+            # state restored from a pre-anchor checkpoint: continue at
+            # raw scale (anchor 0) so the shifted state is well-defined
+            self._anchor_ = jnp.zeros((d,), dtype=x.dtype)
+            self._mean_sh_ = jnp.asarray(self.mean_)
         (
             self.components_,
             self.singular_values_,
-            self.mean_,
+            self._mean_sh_,
             self.var_,
             self.n_samples_seen_,
         ) = _update(
             self.components_,
             self.singular_values_,
-            self.mean_,
+            self._mean_sh_,
             self.var_,
             self.n_samples_seen_,
-            x,
+            x - self._anchor_,
             k=self.n_components_,
         )
+        # the reported attribute is the TRUE mean (sklearn parity); one
+        # final add costs only the f32 representation round-off
+        self.mean_ = self._anchor_ + self._mean_sh_
         self.n_samples_seen_ = int(self.n_samples_seen_)
         n = self.n_samples_seen_
         self.explained_variance_ = self.singular_values_ ** 2 / (n - 1)
@@ -129,7 +147,12 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
 
     def transform(self, X):
         x, _ = _masked_or_plain(X)
-        out = (x - self.mean_) @ self.components_.T
+        if getattr(self, "_anchor_", None) is not None:
+            # (x − anchor) is exact in the offset regime; the spread-
+            # scale mean then subtracts without cancellation
+            out = ((x - self._anchor_) - self._mean_sh_) @ self.components_.T
+        else:  # state restored from a pre-anchor checkpoint
+            out = (x - self.mean_) @ self.components_.T
         if self.whiten:
             out = out / jnp.sqrt(self.explained_variance_)
         return _like_input(X, out)
@@ -138,4 +161,8 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
         x, _ = _masked_or_plain(X)
         if self.whiten:
             x = x * jnp.sqrt(self.explained_variance_)
+        if getattr(self, "_anchor_", None) is not None:
+            return _like_input(
+                X, (x @ self.components_ + self._mean_sh_) + self._anchor_
+            )
         return _like_input(X, x @ self.components_ + self.mean_)

@@ -505,6 +505,43 @@ class TestAdversarialNumerics:
             f"Kahan pair lost the sub-ulp mass: {total} vs {expect}"
         )
 
+    @settings(max_examples=8, deadline=None)
+    @given(st.integers(0, 2**31 - 1), st.sampled_from([1e4, 1e6]))
+    def test_incremental_pca_huge_offset(self, seed, offset):
+        # the anchor-shift bug class, fourth member: the Ross rank-update
+        # accumulates mean/var and the SVD correction row from OFFSET-
+        # scale f32 means; at offset 1e6 that cost 0.33% of var_ and
+        # 0.1 deg of component subspace before the anchor fix (the
+        # centered-data floor is ~1e-7 / 3e-5 deg).  Oracle: sklearn's
+        # f64 IncrementalPCA on the SAME quantized f32 inputs, so input
+        # quantization cancels and only computation error is measured.
+        from scipy.linalg import subspace_angles
+        from sklearn.decomposition import IncrementalPCA as SkIPCA
+
+        from dask_ml_tpu.decomposition import IncrementalPCA
+
+        rng = np.random.RandomState(seed)
+        W = rng.normal(size=(4, 6))
+        chunks = [
+            (offset + rng.normal(size=(300, 4)) @ W
+             + 0.1 * rng.normal(size=(300, 6))).astype(np.float32)
+            for _ in range(4)
+        ]
+        ip = IncrementalPCA(n_components=3)
+        sk = SkIPCA(n_components=3)
+        for c in chunks:
+            ip.partial_fit(c)
+            sk.partial_fit(c.astype(np.float64))
+        allx = np.concatenate(chunks).astype(np.float64)
+        np.testing.assert_allclose(
+            np.asarray(ip.var_), allx.var(0), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(ip.explained_variance_), sk.explained_variance_,
+            rtol=1e-4)
+        angle = np.degrees(subspace_angles(
+            np.asarray(ip.components_).T, sk.components_.T)).max()
+        assert angle < 0.01, f"component subspace drifted {angle} deg"
+
     @settings(max_examples=10, deadline=None)
     @given(st.integers(0, 2**31 - 1),
            st.sampled_from([1e4, 1e6, 1e8]))
