@@ -60,8 +60,20 @@ for l in lines:
     if d.get("platform") == "tpu" and d.get("ts", 0) >= start:
         fresh.append(l)
 if fresh:
+    # leading-newline guard: a torn last line (interrupted append) must
+    # not swallow the first fresh record into an unparseable merge
+    lead = ""
+    try:
+        with open(evidence, "rb") as f:
+            f.seek(-1, 2)
+            lead = "" if f.read(1) == b"\n" else "\n"
+    except OSError:
+        pass
     with open(evidence, "a") as f:
-        f.write("\n".join(fresh) + "\n")
+        f.write(lead + "\n".join(fresh) + "\n")
+        f.flush()
+        import os
+        os.fsync(f.fileno())
 print(len(fresh))
 PY
 }
