@@ -12,8 +12,7 @@ CPU (with a smaller workload) rather than crash; each workload fails
 soft; the JSON line is emitted no matter what.
 
 Both workloads run their ENTIRE iteration loop as one XLA program
-(lax.while_loop fusion); on TPU the Lloyd round additionally uses the
-fused Pallas assign+reduce kernel (ops.lloyd) when enabled.
+(lax.while_loop fusion).
 """
 
 from __future__ import annotations
@@ -155,7 +154,8 @@ def _merge_and_finalize():
         if "_extra" in rec:
             # keep carried extras clearly separated from this run's own
             # measurements — a carried pallas_parity_ok must not read as
-            # having been verified on this run's platform
+            # having been verified on this run's platform (historic
+            # example: the deleted Pallas kernel's parity flag)
             for k, v in rec["_extra"].items():
                 if k not in extra:
                     extra.setdefault("carried_extra", {}).setdefault(k, v)
@@ -537,7 +537,7 @@ def main():
         extra[key] = value
         _persist({"_extra": {key: value}, "platform": platform})
 
-    def _time_lloyd(s, centers, n, d, k, iters, use_pallas, mh,
+    def _time_lloyd(s, centers, n, d, k, iters,
                     mode="highest"):
         from dask_ml_tpu.cluster.k_means import _lloyd_loop
 
@@ -555,8 +555,7 @@ def main():
         def run(n_it):
             out = _lloyd_loop(
                 s.data, s.mask, centers, jnp.float32(0.0), jnp.int32(n_it),
-                mesh_holder=mh, use_pallas=use_pallas, mode=mode,
-                scatter=scatter,
+                mode=mode, scatter=scatter,
             )
             float(out[1])  # result fetch = the one reliable sync
             return int(out[2])  # rounds ACTUALLY executed (the loop may
@@ -581,8 +580,7 @@ def main():
         gbytes = n * d * 4 / 1e9
         return {
             "workload": (
-                f"kmeans_lloyd_{n}x{d}_k{k}"
-                + ("_pallas" if use_pallas else "_xla")
+                f"kmeans_lloyd_{n}x{d}_k{k}_xla"
                 + ("" if mode == "highest" else f"_{mode}")
             ),
             "wall_s": round(times[hi], 3),
@@ -602,79 +600,31 @@ def main():
     try:
         if not _want("lloyd"):
             raise _SkipSection
-        from dask_ml_tpu.core import shard_rows, get_mesh
-        from dask_ml_tpu.core.mesh import MeshHolder
+        from dask_ml_tpu.core import shard_rows
 
         n, d, k = (2_000_000, 50, 8) if on_tpu else (200_000, 50, 8)
         X = rng.normal(size=(n, d)).astype(np.float32)
         s = shard_rows(X)
         centers = s.data[:k]
         iters = 40
-        mh = MeshHolder(get_mesh())
 
-        xla_stats = _time_lloyd(s, centers, n, d, k, iters, False, mh)
+        xla_stats = _time_lloyd(s, centers, n, d, k, iters)
         _record(xla_stats)
         best = xla_stats
-
-        if on_tpu:
-            # The Pallas kernel is opt-in (cluster.k_means._pallas_ok):
-            # with slope-timed measurement the XLA lowering wins on v5e.
-            # Bench still verifies the kernel's parity on the RUNNING chip
-            # and records the honest Pallas-vs-XLA delta
-            try:
-                from dask_ml_tpu.ops import lloyd_assign_reduce
-
-                ps, pc, pi = lloyd_assign_reduce(
-                    s.data[:8192], s.mask[:8192], centers
-                )
-                # reference via plain XLA ops on the same slice
-                import jax as _jax
-
-                from dask_ml_tpu.metrics.pairwise import _sq_euclidean_hi
-
-                d2 = _sq_euclidean_hi(s.data[:8192], centers)
-                lbl = jnp.argmin(d2, 1)
-                oh = _jax.nn.one_hot(lbl, k) * s.mask[:8192, None]
-                # float64 HOST reference for the sums so the gate is not
-                # comparing one device gemm's rounding against another's
-                es = (
-                    np.asarray(oh, np.float64).T
-                    @ np.asarray(s.data[:8192], np.float64)
-                )
-                # assignments (counts) must match EXACTLY; sums only to a
-                # scale-aware tolerance — near-zero entries of onehot.T @ x
-                # are catastrophic cancellations where fp32 accumulation
-                # ORDER legitimately differs from fp64
-                ok = bool(
-                    np.array_equal(np.asarray(pc), np.asarray(oh.sum(0)))
-                    and np.max(np.abs(np.asarray(ps, np.float64) - es))
-                    <= 1e-3 * max(np.max(np.abs(es)), 1.0)
-                )
-                _record_extra("pallas_parity_ok", bool(ok))
-                if ok:
-                    pallas_stats = _time_lloyd(s, centers, n, d, k, iters, True, mh)
-                    _record(pallas_stats)
-                    _record_extra("pallas_vs_xla_speedup", round(
-                        xla_stats["per_iter_ms"] / pallas_stats["per_iter_ms"], 3
-                    ))
-                    if pallas_stats["rows_per_s"] > best["rows_per_s"]:
-                        best = pallas_stats
-            except Exception:
-                extra["pallas_error"] = traceback.format_exc(limit=3)
+        # (The opt-in Pallas kernel this section used to parity-check and
+        # A/B was deleted after its chip adjudication: XLA won 0.089-
+        # 0.176x at this shape and 0.198x at k=64 — docs/design.md
+        # "Pallas negative result".)
 
         result["value"] = best["rows_per_s"]
         result["unit"] = f"rows*iters/s ({n}x{d}, k={k}, fp32)"
         result["vs_baseline"] = 1.0
 
-        # --- k=64 kernel adjudication (r3 verdict #6): the Pallas fused
-        # kernel's win condition is large k (no MXU lane padding) at the
-        # 6-pass "fast" precision; measure all four variants so the
-        # keep-or-delete decision and the fast-mode default each cite a
-        # chip number.  Shapes sized so X ≈ 256MB on chip.  DEEP-budget
-        # only on TPU (like the 11M admm rows): four variants' compiles
-        # would eat most of the driver's default 480 s window and starve
-        # the still-unmeasured admm/tsqr/streamed sections; the
-        # auto-trigger/manual runs use 2400 s and get it.
+        # --- k=64 fast-mode adjudication: at large k the per-round gemms
+        # are MXU-bound and the 6-pass bf16-split "fast" precision can
+        # beat 12-pass HIGHEST (chip-measured 1.362x, r5).  DEEP-budget
+        # only on TPU: the variants' compiles would starve the driver's
+        # default 480 s window; the auto-trigger/manual runs get it.
         if on_tpu and _BUDGET_S < 900:
             _record_extra("lloyd_k64_skipped",
                           f"deep-budget only (budget={_BUDGET_S}s < 900)")
@@ -684,29 +634,13 @@ def main():
         s64 = shard_rows(X64)
         c64 = s64.data[:k64]
         it64 = 20
-        xla_hi64 = _time_lloyd(s64, c64, n64, d64, k64, it64, False, mh)
+        xla_hi64 = _time_lloyd(s64, c64, n64, d64, k64, it64)
         _record(xla_hi64)
-        xla_fast64 = _time_lloyd(s64, c64, n64, d64, k64, it64, False, mh,
+        xla_fast64 = _time_lloyd(s64, c64, n64, d64, k64, it64,
                                  mode="fast")
         _record(xla_fast64)
         _record_extra("lloyd_k64_xla_fast_vs_highest", round(
             xla_hi64["per_iter_ms"] / xla_fast64["per_iter_ms"], 3))
-        if on_tpu:
-            try:
-                pal_hi64 = _time_lloyd(s64, c64, n64, d64, k64, it64,
-                                       True, mh)
-                _record(pal_hi64)
-                pal_fast64 = _time_lloyd(s64, c64, n64, d64, k64, it64,
-                                         True, mh, mode="fast")
-                _record(pal_fast64)
-                best_xla = min(xla_hi64["per_iter_ms"],
-                               xla_fast64["per_iter_ms"])
-                _record_extra("lloyd_k64_pallas_fast_vs_best_xla", round(
-                    best_xla / pal_fast64["per_iter_ms"], 3))
-                _record_extra("lloyd_k64_pallas_parity_vs_xla_hi", round(
-                    xla_hi64["per_iter_ms"] / pal_hi64["per_iter_ms"], 3))
-            except Exception:
-                extra["pallas_k64_error"] = traceback.format_exc(limit=3)
     except _SkipSection:
         pass
     except Exception:
@@ -907,11 +841,16 @@ def main():
             mv = jax.device_put(mv[:nv], sh1)
 
         @jax.jit
-        def vg_run(n_evals, b0):
-            # fori_loop with a TRACED bound: one compile serves both
-            # iteration counts (scan would recompile per static length)
+        def vg_run(Xa, ya, ma, n_evals, b0):
+            # data threads through AS ARGUMENTS — a closure-captured
+            # device array is a compile-time constant, and serializing
+            # 112 MB of constants into the remote axon compile is the
+            # same pathology that hung the tsqr chain for its full
+            # watchdog (fixed there the same way).  fori_loop with a
+            # TRACED bound: one compile serves both iteration counts
+            # (scan would recompile per static length)
             vg = jax.value_and_grad(
-                lambda b: Logistic.loss(b, Xv, yv, mv)
+                lambda b: Logistic.loss(b, Xa, ya, ma)
             )
 
             def one(_, carry):
@@ -925,7 +864,8 @@ def main():
 
         b0 = jnp.zeros((d2,), jnp.float32)
         per_eval = _two_point_slope(
-            lambda n_evals: float(vg_run(jnp.int32(n_evals), b0)[1]), 2, 20
+            lambda n_evals: float(
+                vg_run(Xv, yv, mv, jnp.int32(n_evals), b0)[1]), 2, 20
         )
         ev_gbytes = 2 * nv * d2 * 4 / 1e9
         ev_flops = 4.0 * nv * d2
@@ -958,11 +898,20 @@ def main():
 
             nQ, dQ = (4_000_000, 64) if on_tpu else (200_000, 32)
             mhQ = _MeshHolder(_gm())
-            Xq = jax.random.normal(
-                jax.random.PRNGKey(1), (nQ, dQ), jnp.float32)
+            # generate ON device inside jit and thread Xq through the
+            # chain AS AN ARGUMENT: a closure-captured device array is a
+            # compile-time CONSTANT, and serializing a 1 GB constant into
+            # the remote axon compile hung the whole section for its full
+            # 1500 s watchdog twice this round (measured: gen 9 s, tsqr
+            # compile 15 s, chain-compile-with-constant >150 s and never
+            # seen finishing)
+            Xq = jax.jit(
+                lambda key: jax.random.normal(key, (nQ, dQ), jnp.float32)
+            )(jax.random.PRNGKey(1))
+            Xq.block_until_ready()
 
             @jax.jit
-            def tsqr_chain(n_it):
+            def tsqr_chain(x0, n_it):
                 def one(i, x):
                     q, r = _tsqr_impl(x, mesh_holder=mhQ)
                     # serialize on BOTH outputs (depending only on r would
@@ -974,11 +923,11 @@ def main():
                     return jax.lax.dynamic_update_slice(
                         x, x[:1, :1] + eps, (0, 0))
 
-                x = jax.lax.fori_loop(0, n_it, one, Xq)
+                x = jax.lax.fori_loop(0, n_it, one, x0)
                 return x[0, 0]
 
             per_qr = _two_point_slope(
-                lambda n_it: float(tsqr_chain(jnp.int32(n_it))), 1, 5)
+                lambda n_it: float(tsqr_chain(Xq, jnp.int32(n_it))), 1, 5)
             # traffic: read X + write Q per factorization (R is d x d,
             # negligible); flops: ~2nd^2 local QR + 2nd^2 Q correction
             q_gbytes = 2 * nQ * dQ * 4 / 1e9
@@ -1009,19 +958,23 @@ def main():
             nbins = 256
             vals = jnp.asarray(rng.normal(size=(nS,)).astype(np.float32))
 
+            # every timed jit takes the values array AS AN ARGUMENT —
+            # a closure-captured device array is a compile-time constant
+            # serialized into the remote axon compile (the tsqr-chain
+            # hang, fixed the same way)
             def make_hist_segsum(nb, scale):
                 # shared body for every segment-sum bin count: the
-                # anti-hoist perturbation (vals + acc[0]*1e-20) forces a
+                # anti-hoist perturbation (va + acc[0]*1e-20) forces a
                 # fresh bucketing per round so XLA cannot lift the
                 # scatter out of the loop
                 @jax.jit
-                def run(n_it):
+                def run(va, n_it):
                     def one(i, acc):
                         ids = jnp.clip(
-                            ((vals + acc[0] * 1e-20) * scale).astype(
+                            ((va + acc[0] * 1e-20) * scale).astype(
                                 jnp.int32) + nb // 2, 0, nb - 1)
                         hist = jax.ops.segment_sum(
-                            jnp.ones_like(vals), ids, num_segments=nb)
+                            jnp.ones_like(va), ids, num_segments=nb)
                         return acc + hist
                     return jax.lax.fori_loop(
                         0, n_it, one, jnp.zeros((nb,), jnp.float32))
@@ -1030,10 +983,10 @@ def main():
             hist_scatter = make_hist_segsum(nbins, 42.0)
 
             @jax.jit
-            def hist_onehot(n_it):
+            def hist_onehot(va, n_it):
                 def one(i, acc):
                     ids = jnp.clip(
-                        ((vals + acc[0] * 1e-20) * 42.0).astype(jnp.int32)
+                        ((va + acc[0] * 1e-20) * 42.0).astype(jnp.int32)
                         + nbins // 2, 0, nbins - 1)
                     oh = jax.nn.one_hot(ids, nbins, dtype=jnp.float32)
                     return acc + oh.sum(axis=0)
@@ -1041,12 +994,12 @@ def main():
                     0, n_it, one, jnp.zeros((nbins,), jnp.float32))
 
             @jax.jit
-            def mode_scatter(n_it):
+            def mode_scatter(va, n_it):
                 k_ids = 1024
 
                 def one(i, acc):
                     ids = jnp.clip(
-                        ((vals + acc[0] * 1e-20) * 100.0).astype(jnp.int32)
+                        ((va + acc[0] * 1e-20) * 100.0).astype(jnp.int32)
                         + k_ids // 2, 0, k_ids - 1)
                     return acc.at[ids].add(1.0)
                 return jax.lax.fori_loop(
@@ -1069,7 +1022,8 @@ def main():
                 # jnp.int32 inside the lambda: consistent aval for the
                 # warmup and timed calls → one jit executable
                 per = _two_point_slope(
-                    lambda n_i, f=fn: float(f(jnp.int32(n_i))[0]), 2, 20
+                    lambda n_i, f=fn: float(
+                        f(vals, jnp.int32(n_i))[0]), 2, 20
                 )
                 per_by_name[name] = per
                 _record({
@@ -1103,6 +1057,17 @@ def main():
                 block_rows, dS, n_blocks = 1 << 14, 16, 8
             clf = SGDClassifier(random_state=0)
             warm, t_steady, n_done = 2, None, 0
+            # deadline INSIDE the block loop: on a slow tunnel the
+            # 70-block sweep may not finish inside the watchdog — land
+            # the honest blocks that DID stream (total_gb/exceeds_hbm16
+            # recorded from n_done, not the configured 70) instead of
+            # timing out with nothing.  SECTION-relative allowance capped
+            # by the absolute 0.92 entry-gate mark: anchoring to
+            # _START_TS alone would make a full run that reaches here
+            # late cut the sweep immediately even on hardware that would
+            # finish all 70 blocks in seconds.
+            sec_deadline = min(_START_TS + _BUDGET_S * 0.92,
+                               time.time() + _BUDGET_S * 0.45)
             for i, (Xb, yb) in enumerate(
                 stream_classification_blocks(n_blocks, block_rows, dS)
             ):
@@ -1115,12 +1080,25 @@ def main():
                     # so blocks can't pile up live on device
                     float(clf._loss_)
                 n_done += 1
+                if (n_done > warm + 1
+                        and time.time() > sec_deadline):
+                    float(clf._loss_)  # sync before declaring the cut
+                    break
             final_loss = float(clf._loss_)  # closing sync
             dt = time.perf_counter() - t_steady
             srows = (n_done - warm) * block_rows
             total_gb = n_done * block_rows * dS * 4 / 1e9
+            # a deadline-truncated sweep gets its own workload name so
+            # _compact_partial can never shadow a COMPLETE 70-block chip
+            # record with a fresher truncated one; the suffix is FIXED
+            # (not per-count) so successive truncated runs supersede each
+            # other in the compaction instead of accumulating one record
+            # per distinct cut point forever
+            _cut = "_cut" if n_done < n_blocks else ""
             _record({
-                "workload": f"streamed_sgd_{n_blocks}x{block_rows}x{dS}",
+                "workload":
+                    f"streamed_sgd_{n_blocks}x{block_rows}x{dS}{_cut}",
+                "blocks_done": n_done,
                 "total_gb": round(total_gb, 2),
                 "exceeds_hbm16": bool(total_gb > 16.0),
                 "steady_ms_per_block": round(
@@ -1144,6 +1122,13 @@ def main():
 
             from dask_ml_tpu.io import read_binary
 
+            # remaining-budget gate: after a deadline-cut sweep the
+            # watchdog may be <40 s away, and entering a 90 s loader loop
+            # there guarantees a watchdog exit mid-fetch (the wedge
+            # mechanism) — skip the segment instead; its record carries
+            # forward from the last complete run
+            if time.time() - _START_TS > _BUDGET_S * 0.92 - 120.0:
+                raise _SkipSection
             blk_rows, dL = (1 << 18, 64) if on_tpu else (1 << 14, 16)
             n_cycle, max_lblocks, budget_s = 4, 24, 90.0
             arrL = rng.rand(n_cycle * blk_rows, dL).astype(np.float32)
@@ -1191,6 +1176,8 @@ def main():
                     os.unlink(bin_path)
                 except OSError:
                     pass
+    except _SkipSection:
+        pass
     except Exception:
         extra["streamed_error"] = traceback.format_exc(limit=3)
 

@@ -58,8 +58,10 @@ def _kmeans_mode() -> str:
       bf16-split gemm (both operands split: the one-hot side carries the
       sample-weight mask).  6 MXU passes per round instead of 12; on
       MXU-bound shapes (k ≥ ~32) this can halve round time at
-      k-means-irrelevant precision cost.  The bench adjudicates both;
-      see ops/lloyd.py for the traffic model.
+      k-means-irrelevant precision cost.  Chip-adjudicated: 1.36–1.44×
+      faster at 1M×64 k=64 in 3 of 4 sessions (docs/design.md, round-5
+      chip table); the default stays ``highest`` as a deliberate
+      precision-contract exception.
     """
     import os
 
@@ -115,73 +117,20 @@ def _lloyd_step(x, mask, centers, mode="highest", scatter="segsum"):
     return new_centers, inertia, shift
 
 
-def _lloyd_step_pallas(x, mask, centers, mesh, mode="highest"):
-    """Lloyd round via the fused Pallas kernel (ops.lloyd): X streams
-    through VMEM once; the three tiny reductions psum over the mesh."""
-    from jax import lax
-    from jax.sharding import PartitionSpec as P
-
-    from ..core.compat import shard_map_unchecked
-    from ..core.mesh import data_axes
-    from ..ops import lloyd_assign_reduce
-
-    kmode = "fast" if mode == "fast" else "parity"
-    row_ax = data_axes(mesh)
-
-    def local(xb, mb, c):
-        sums, counts, inertia = lloyd_assign_reduce(xb, mb, c, mode=kmode)
-        sums = lax.psum(sums, row_ax)
-        counts = lax.psum(counts, row_ax)
-        inertia = lax.psum(inertia, row_ax)
-        safe = safe_denominator(counts)[:, None]
-        new_centers = jnp.where(counts[:, None] > 0, sums / safe, c)
-        shift = jnp.sum((new_centers - c) ** 2)
-        return new_centers, inertia, shift
-
-    return shard_map_unchecked(
-        local, mesh,
-        in_specs=(P(row_ax, None), P(row_ax), P()),
-        out_specs=(P(), P(), P()),
-    )(x, mask, centers)
+# A fused Pallas Lloyd kernel (ops/lloyd.py) lived here through rounds
+# 2-5 and was DELETED after its win-or-delete chip adjudication: on a
+# TPU v5e the XLA lowering of ``_lloyd_step`` beat every kernel variant
+# — 0.089-0.176x at 2Mx50 k=8 and 0.198x (fast) at 1Mx64 k=64, where
+# lane padding vanishes and the kernel was predicted to win.  XLA's
+# fusion already keeps the round at ~2 HBM passes, so the kernel had no
+# traffic to remove and its Mosaic gemms lost to XLA's MXU scheduling.
+# Full numbers: docs/design.md "Pallas negative result"; resurrection is
+# one git revert away.
 
 
-def _pallas_ok(x, centers) -> bool:
-    """Pallas path gate: opt-in (``DASK_ML_TPU_PALLAS=1``), TPU backend,
-    kernel-friendly shapes.
-
-    The Mosaic lowering is verified against a float64 numpy reference by a
-    hardware parity test (tests/test_ops.py::TestLloydKernel::
-    test_pallas_parity_on_tpu, DASK_ML_TPU_TEST_TPU=1 on a real chip —
-    passed on TPU v5e 2026-07-30).  It is NOT the default: with properly
-    synchronized timing (result-fetch sync + iteration-count slope, see
-    bench.py) the fused XLA lowering of ``_lloyd_step`` runs one 2M×50
-    k=8 round in ~1.4 ms on a v5e while this kernel takes ~5.5 ms — the
-    two fp32 Precision.HIGHEST gemms padded to the 128-lane MXU dominate
-    the kernel's runtime, and XLA's fusion already keeps the round at
-    ~2 HBM passes.  The kernel remains available for experimentation on
-    shapes where a single-pass streaming layout could win (d near 128,
-    large k).
-    """
-    import os
-
-    if not os.environ.get("DASK_ML_TPU_PALLAS"):
-        return False
-    if jax.default_backend() != "tpu":
-        return False
-    # VMEM budget for the 2048-row tile: x-tile (T·d·4B) plus the
-    # cross/d2/onehot intermediates (3·T·k·4B) must stay well under the
-    # ~16 MB/core VMEM with double buffering — d≤128, k≤64 keeps the
-    # working set ≤ ~2.5 MB
-    return centers.shape[0] <= 64 and x.shape[1] <= 128
-
-
-from ..core.mesh import MeshHolder  # noqa: E402
-
-
-@_fpartial(jax.jit,
-           static_argnames=("mesh_holder", "use_pallas", "mode", "scatter"))
-def _lloyd_loop(x, mask, centers, tol, max_iter, *, mesh_holder=None,
-                use_pallas=False, mode="highest", scatter="segsum"):
+@_fpartial(jax.jit, static_argnames=("mode", "scatter"))
+def _lloyd_loop(x, mask, centers, tol, max_iter, *,
+                mode="highest", scatter="segsum"):
     """The ENTIRE Lloyd iteration as one XLA program.
 
     The reference re-enters the scheduler every round (SURVEY.md §3.2); a
@@ -189,13 +138,10 @@ def _lloyd_loop(x, mask, centers, tol, max_iter, *, mesh_holder=None,
     (the ``shift <= tol`` check) per round.  Fusing the loop into
     ``lax.while_loop`` keeps convergence control on device: one dispatch
     per fit, no host round-trips.  ``tol``/``max_iter`` are device scalars
-    so different settings don't recompile.  With ``use_pallas`` the round
-    body is the fused ops.lloyd kernel instead of the XLA lowering.
+    so different settings don't recompile.
     """
 
     def step(x_, m_, c_):
-        if use_pallas:
-            return _lloyd_step_pallas(x_, m_, c_, mesh_holder.mesh, mode)
         return _lloyd_step(x_, m_, c_, mode, scatter)
 
     def cond(state):
@@ -438,9 +384,7 @@ class KMeans(TransformerMixin, TPUEstimator):
         # tol from UNWEIGHTED variances: sklearn's _tolerance ignores
         # sample_weight, so weighting must not move the stopping threshold
         tol = self.tol * jnp.mean(masked_var(x, valid_mask))  # on device
-        use_pallas = _pallas_ok(x, centers)
         with _timer("Lloyd loop", logger, logging.DEBUG):
-            from ..core.mesh import get_mesh
             from ..ops.scatter import scatter_strategy
 
             # policy knobs resolve OUTSIDE the jit so they participate in
@@ -448,8 +392,6 @@ class KMeans(TransformerMixin, TPUEstimator):
             # the first call's env values in for the process lifetime
             centers, _, n_iter_dev = _lloyd_loop(
                 x, mask, centers, tol.astype(x.dtype), jnp.int32(self.max_iter),
-                mesh_holder=MeshHolder(get_mesh()) if use_pallas else None,
-                use_pallas=use_pallas,
                 mode=_kmeans_mode(),
                 scatter=scatter_strategy(self.n_clusters),
             )

@@ -1,12 +1,14 @@
-"""Pallas TPU kernels for the framework's hot ops.
+"""Device-op strategies for the framework's hot ops.
 
 The reference has no native kernels (its L0 is NumPy/BLAS via dependencies —
-SURVEY.md §2); here the analogous fast layer is XLA, and where XLA's fusion
-falls short we drop to Pallas.  Kernels ship with an ``interpret`` path so
-the CPU-mesh test suite exercises them without TPU hardware.
+SURVEY.md §2); here the analogous fast layer is XLA itself, with measured
+per-platform strategy knobs where more than one lowering is viable (the
+scatter/one-hot policy below).  A fused Pallas Lloyd kernel lived here
+through rounds 2-5 and was deleted after losing its win-or-delete chip
+adjudication to XLA's own lowering on every shape — the full numbers and
+the reasoning live in docs/design.md ("Pallas negative result").
 """
 
-from .lloyd import lloyd_assign_reduce  # noqa: F401
 from .scatter import bucket_sum, scatter_strategy  # noqa: F401
 
-__all__ = ["lloyd_assign_reduce", "bucket_sum", "scatter_strategy"]
+__all__ = ["bucket_sum", "scatter_strategy"]

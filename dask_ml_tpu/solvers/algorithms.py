@@ -135,12 +135,16 @@ def _lbfgs_run(x, yv, mask, beta0, lamduh, max_iter, tol, *, family, reg,
 
 def lbfgs(X, y, *, family: type[Family] = Logistic, regularizer=L2,
           lamduh: float = 0.0, max_iter: int = 100, tol: float = 1e-5,
-          beta0=None, return_n_iter: bool = False, line_search: str = "backtrack"):
+          beta0=None, return_n_iter: bool = False, line_search: str = "auto"):
     """Full-gradient L-BFGS on the total (smooth) objective.
 
     Reference: ``dask_glm/algorithms.py :: lbfgs`` (scipy driver with
     distributed gradient); here the whole optimizer is one XLA program.
+
+    ``line_search="auto"`` resolves to the measured per-platform winner
+    (probe_grid on TPU, backtrack on CPU — :func:`line_search_strategy`).
     """
+    line_search = line_search_strategy(line_search)
     reg = get_regularizer(regularizer)
     if lamduh and not reg.smooth:
         raise ValueError(
@@ -201,6 +205,7 @@ def gradient_descent(X, y, *, family: type[Family] = Logistic,
                      beta0=None, return_n_iter: bool = False,
                      line_search: str = "backtrack"):
     """Armijo-backtracking gradient descent (reference ``gradient_descent``)."""
+    line_search = line_search_strategy(line_search)
     reg = get_regularizer(regularizer)
     if lamduh and not reg.smooth:
         raise ValueError("gradient_descent requires a smooth penalty; use proximal_grad")
@@ -330,6 +335,7 @@ def newton(X, y, *, family: type[Family] = Logistic, regularizer=L2,
            beta0=None, return_n_iter: bool = False, line_search: str = "backtrack"):
     """Damped Newton: distributed Hessian XᵀWX (one psum-reduced gemm),
     replicated (d×d) solve (reference ``newton``)."""
+    line_search = line_search_strategy(line_search)
     reg = get_regularizer(regularizer)
     if lamduh and not reg.smooth:
         raise ValueError("newton requires a smooth penalty")
@@ -519,7 +525,14 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
     robustness gap: with a fixed rho 3 orders of magnitude off, the solve
     stalled below 85% train accuracy at max_iter=150 on separable data
     (tests/test_properties.py :: TestAdversarialSolvers).
+
+    ``line_search`` defaults to ``backtrack`` (not ``auto``): the inner
+    L-BFGS runs inside ``shard_map`` where probe_grid is legal but
+    unmeasured, and the chip-adjudicated ADMM numbers (478 ms/outer
+    fp32, 264 ms bf16 at 11M×28) were captured with backtrack — pass
+    ``auto``/``probe_grid`` explicitly to opt in.
     """
+    line_search = line_search_strategy(line_search)
     reg = get_regularizer(regularizer)
     mesh = mesh or get_mesh()
     x, yv, mask = _prep(X, y)
@@ -551,19 +564,53 @@ def pack_strategy() -> str:
     - ``sequential``: K whole-solve dispatches, one per class — each
       class stops at ITS OWN convergence instead of the pack's slowest
       lane.
-    - ``auto`` (default): the measured per-platform winner.  On CPU,
-      vmap serializes lanes and the pack runs every lane to the slowest
-      lane's iteration count: measured ``packed_speedup 0.684`` (a 1.5×
-      LOSS, BENCH_r03 ``packed_ovr_lbfgs``) — so auto falls back to
-      sequential there.  On TPU the MXU batches the lanes; auto stays
-      packed, with the bench's packed section owning the number.
+    - ``auto`` (default): the measured per-platform winner — currently
+      **sequential on BOTH platforms**.  On CPU, vmap serializes lanes
+      and the pack runs every lane to the slowest lane's iteration
+      count: 0.684× (BENCH_r03).  On TPU, three chip sessions (r5,
+      1M×28 K=4) measured 0.738× (undecided), 0.82× and 0.78× (both
+      decisively sequential under the dispersion gate) — OvR lanes
+      solve DIFFERENT objectives, so the pack wastes the fast lanes'
+      iterations and lockstep line search, and the batched gemms do not
+      buy that back at K=4.  Contrast :func:`grid_pack_strategy`: the
+      C-sweep packs K solves of the SAME data, one X read serves every
+      lane, and it won 3.4–5.3× across the same three chip sessions —
+      the two knobs measure differently because the physics differ.
     """
     from ..utils import env_choice
 
     v = env_choice("DASK_ML_TPU_PACK", ("auto", "packed", "sequential"))
     if v != "auto":
         return v
-    return "packed" if jax.default_backend() == "tpu" else "sequential"
+    # measured loser on both platforms (see docstring); the vmapped
+    # machinery stays one env flip away for large-K experimentation
+    return "sequential"
+
+
+def line_search_strategy(requested: str = "auto") -> str:
+    """Resolve a line-search choice, ``DASK_ML_TPU_LINE_SEARCH`` =
+    ``auto`` | ``backtrack`` | ``probe_grid``.
+
+    ``auto`` (the :func:`lbfgs` default) picks the measured per-platform
+    winner: ``probe_grid`` on TPU (chip-measured 1.383× over backtrack
+    on the 1M×28 L-BFGS solve, BENCH r5 ``lbfgs_line_search`` —
+    batching every candidate step into ONE objective pass is
+    bandwidth-optimal when each pass streams the whole dataset from
+    HBM), ``backtrack`` on CPU (probe_grid measured 0.585×, r4: the
+    grid's extra objective evaluations are pure cost when compute-bound).
+    An explicit ``requested`` value wins over the env knob; the env knob
+    wins over ``auto``.  Resolution must happen OUTSIDE jit (same
+    trace-time-staleness rule as ``ops.scatter.scatter_strategy``).
+    """
+    from ..utils import env_choice
+
+    if requested != "auto":
+        return requested
+    v = env_choice("DASK_ML_TPU_LINE_SEARCH",
+                   ("auto", "backtrack", "probe_grid"))
+    if v != "auto":
+        return v
+    return "probe_grid" if jax.default_backend() == "tpu" else "backtrack"
 
 
 def grid_pack_strategy() -> str:
@@ -591,7 +638,7 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
                  tol: float = 1e-5, rho: float = 1.0, abstol: float = 1e-4,
                  reltol: float = 1e-2, inner_iter: int = 50,
                  inner_tol: float = 1e-6, mesh=None,
-                 line_search: str = "backtrack", Beta0=None):
+                 line_search: str = "auto", Beta0=None):
     """All K independent solves as ONE vmapped XLA program over the
     leading axis of ``Y`` — the one-vs-rest fit issues a single dispatch
     instead of K sequential ones (the solvers' whole-solve ``while_loop``
@@ -616,15 +663,27 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
     """
     reg = get_regularizer(regularizer)
     strategy = pack_strategy()
-    if line_search != "backtrack" and strategy == "packed":
+    if strategy == "packed":
         # a lax.cond grid under vmap executes BOTH branches in every
         # lane, so probe_grid would pay the full grid per lane per
         # iteration — lockstep backtracking is strictly better here.
         # (sequential solves have no lanes; they keep the request)
-        logger.info(
-            "packed_solve forces line_search='backtrack' (requested %r): "
-            "vmapped lanes run grids in both cond branches", line_search,
-        )
+        if line_search not in ("backtrack", "auto"):
+            logger.info(
+                "packed_solve forces line_search='backtrack' "
+                "(requested %r): vmapped lanes run grids in both cond "
+                "branches", line_search,
+            )
+        line_search = "backtrack"
+    elif solver == "lbfgs":
+        # only the lbfgs workload is chip-adjudicated for probe_grid;
+        # auto resolves to the measured per-platform winner
+        line_search = line_search_strategy(line_search)
+    elif line_search == "auto":
+        # admm/gd/newton keep their own measured-safe default — a
+        # packed_solve default must not silently opt them into the
+        # unadjudicated configuration (their direct entry points treat
+        # an EXPLICIT auto as opt-in; this 'auto' is just our default)
         line_search = "backtrack"
     x, _, mask = _prep(X, Y[0])
     dt = _param_dtype(x)

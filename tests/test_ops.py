@@ -1,112 +1,17 @@
-"""Pallas kernel parity tests (interpret mode on the CPU mesh)."""
+"""Device-op policy tests: k-means precision modes and the scatter
+strategy (segment_sum vs one-hot gemm).
+
+The Pallas Lloyd kernel these tests originally covered was deleted after
+its win-or-delete chip adjudication (XLA won every variant — see
+docs/design.md "Pallas negative result" and cluster/k_means.py).
+"""
 
 import numpy as np
 import pytest
 import jax.numpy as jnp
 
-import jax
 
-from dask_ml_tpu.ops import lloyd_assign_reduce
-
-
-def _reference(x, mask, centers):
-    d2 = (
-        np.sum(x * x, axis=1)[:, None]
-        + np.sum(centers * centers, axis=1)[None, :]
-        - 2 * x @ centers.T
-    )
-    labels = np.argmin(d2, axis=1)
-    min_d2 = np.maximum(d2[np.arange(len(x)), labels], 0.0)
-    k = centers.shape[0]
-    onehot = (labels[:, None] == np.arange(k)[None, :]).astype(np.float32) * mask[:, None]
-    return onehot.T @ x, onehot.sum(axis=0), float((min_d2 * mask).sum())
-
-
-class TestLloydKernel:
-    def test_matches_xla_reference(self, rng):
-        n, d, k = 300, 7, 5
-        x = rng.normal(size=(n, d)).astype(np.float32)
-        mask = np.ones(n, dtype=np.float32)
-        mask[-13:] = 0.0  # padding rows must contribute nothing
-        centers = x[:k].copy()
-        sums, counts, inertia = lloyd_assign_reduce(
-            jnp.asarray(x), jnp.asarray(mask), jnp.asarray(centers), interpret=True
-        )
-        esums, ecounts, einertia = _reference(x, mask, centers)
-        np.testing.assert_allclose(np.asarray(sums), esums, rtol=1e-4, atol=1e-4)
-        np.testing.assert_allclose(np.asarray(counts), ecounts)
-        np.testing.assert_allclose(float(inertia), einertia, rtol=1e-4)
-
-    def test_multi_tile_accumulation(self, rng):
-        # more rows than one tile: grid accumulation across steps
-        import dask_ml_tpu.ops.lloyd as L
-
-        orig = L._TILE
-        L._TILE = 128
-        try:
-            n, d, k = 1000, 4, 3
-            x = rng.normal(size=(n, d)).astype(np.float32)
-            mask = np.ones(n, dtype=np.float32)
-            centers = x[:k].copy()
-            sums, counts, inertia = lloyd_assign_reduce(
-                jnp.asarray(x), jnp.asarray(mask), jnp.asarray(centers),
-                interpret=True,
-            )
-            esums, ecounts, einertia = _reference(x, mask, centers)
-            np.testing.assert_allclose(np.asarray(sums), esums, rtol=1e-4, atol=1e-3)
-            np.testing.assert_allclose(np.asarray(counts), ecounts)
-            np.testing.assert_allclose(float(inertia), einertia, rtol=1e-4)
-        finally:
-            L._TILE = orig
-
-    def test_fast_mode_matches_reference(self, rng):
-        # "fast" (bf16-split gemms) must stay within k-means-irrelevant
-        # error of the float64 reference: label-flip-free data here, so
-        # sums/inertia agree to ~1e-4 relative
-        n, d, k = 600, 9, 48
-        x = rng.normal(size=(n, d)).astype(np.float32)
-        mask = np.ones(n, dtype=np.float32)
-        mask[-17:] = 0.0
-        centers = (x[:k] + 3.0 * rng.normal(size=(k, d))).astype(np.float32)
-        sums, counts, inertia = lloyd_assign_reduce(
-            jnp.asarray(x), jnp.asarray(mask), jnp.asarray(centers),
-            interpret=True, mode="fast",
-        )
-        esums, ecounts, einertia = _reference(x, mask, centers)
-        np.testing.assert_allclose(np.asarray(sums), esums,
-                                   rtol=2e-4, atol=2e-3)
-        np.testing.assert_allclose(np.asarray(counts), ecounts)
-        np.testing.assert_allclose(float(inertia), einertia, rtol=2e-4)
-
-    def test_fast_mode_fractional_weights(self, rng):
-        # the mask carries SAMPLE WEIGHTS (utils.reweight_rows), which
-        # are not bf16-exact — a bare bf16 cast of the one-hot operand
-        # would bias sums vs the fp32 counts denominator (r4 review
-        # finding); the 3-pass split must keep weighted sums accurate
-        n, d, k = 500, 6, 24
-        x = rng.normal(size=(n, d)).astype(np.float32)
-        mask = rng.uniform(0.1, 3.0, size=n).astype(np.float32)
-        mask[-11:] = 0.0
-        centers = (x[:k] + 2.0 * rng.normal(size=(k, d))).astype(np.float32)
-        sums, counts, inertia = lloyd_assign_reduce(
-            jnp.asarray(x), jnp.asarray(mask), jnp.asarray(centers),
-            interpret=True, mode="fast",
-        )
-        esums, ecounts, einertia = _reference(x, mask, centers)
-        np.testing.assert_allclose(np.asarray(sums), esums,
-                                   rtol=2e-4, atol=2e-3)
-        np.testing.assert_allclose(np.asarray(counts), ecounts,
-                                   rtol=1e-6)
-        np.testing.assert_allclose(float(inertia), einertia, rtol=2e-4)
-
-    def test_bad_mode_rejected(self, rng):
-        x = rng.normal(size=(8, 4)).astype(np.float32)
-        with pytest.raises(ValueError, match="mode"):
-            lloyd_assign_reduce(
-                jnp.asarray(x), jnp.ones(8, dtype=np.float32),
-                jnp.asarray(x[:2]), interpret=True, mode="banana",
-            )
-
+class TestKMeansPrecision:
     def test_kmeans_fast_env_matches_highest(self, rng, monkeypatch, mesh):
         # end-to-end: DASK_ML_TPU_KMEANS_PRECISION=fast must converge to
         # the same clustering as highest on well-separated blobs
@@ -130,24 +35,6 @@ class TestLloydKernel:
             np.sort(np.asarray(km_hi.cluster_centers_), axis=0),
             rtol=1e-3, atol=1e-3)
         assert km_fast.inertia_ == pytest.approx(km_hi.inertia_, rel=1e-3)
-
-    def test_pallas_parity_on_tpu(self, rng):
-        # Hardware (Mosaic-lowered) parity check — the gate that lets
-        # DASK_ML_TPU_PALLAS=1 be safely enabled (cluster.k_means._pallas_ok).
-        if jax.default_backend() != "tpu":
-            pytest.skip("requires a real TPU backend")
-        n, d, k = 4096, 16, 8
-        x = rng.normal(size=(n, d)).astype(np.float32)
-        mask = np.ones(n, dtype=np.float32)
-        mask[-100:] = 0.0
-        centers = x[:k].copy()
-        sums, counts, inertia = lloyd_assign_reduce(
-            jnp.asarray(x), jnp.asarray(mask), jnp.asarray(centers)
-        )
-        esums, ecounts, einertia = _reference(x, mask, centers)
-        np.testing.assert_allclose(np.asarray(sums), esums, rtol=1e-3, atol=1e-2)
-        np.testing.assert_allclose(np.asarray(counts), ecounts)
-        np.testing.assert_allclose(float(inertia), einertia, rtol=1e-3)
 
 
 class TestScatterPolicy:
