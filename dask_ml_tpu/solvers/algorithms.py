@@ -46,12 +46,30 @@ logger = logging.getLogger(__name__)
 
 def _prep(X, y):
     """Normalize inputs to (x, y, mask) padded device arrays."""
-    Xs = X if isinstance(X, ShardedRows) else shard_rows(np.asarray(X, dtype=np.float32))
+    # shard_rows dispatches on input type; device arrays stay on device
+    # (forcing np.asarray here would round-trip them through the host).
+    # Floating device dtypes pass through (bf16 designs are supported);
+    # anything else promotes to f32.
+    if isinstance(X, ShardedRows):
+        Xs = X
+    elif isinstance(X, jax.Array):
+        Xs = shard_rows(
+            X if jnp.issubdtype(X.dtype, jnp.floating)
+            else X.astype(jnp.float32))
+    else:
+        Xs = shard_rows(np.asarray(X, dtype=np.float32))
     x, mask = Xs.data, Xs.mask
     if isinstance(y, ShardedRows):
         yv = y.data
     else:
-        yv = jnp.asarray(np.asarray(y))
+        # a DEVICE-resident y must stay on device: `np.asarray(y)` on a
+        # jax array is a device->host fetch, and the old unconditional
+        # jnp.asarray(np.asarray(y)) round-tripped every device target
+        # through the host — per SOLVER CALL.  Found on the axon relay
+        # where the round trip is ~2x 200 ms for a 1M-row target (the
+        # sequential OvR arm measured 4x slower than its true compute);
+        # on local hardware it is still a PCIe bounce per call.
+        yv = y if isinstance(y, jax.Array) else jnp.asarray(np.asarray(y))
         if yv.shape[0] != x.shape[0]:
             yv = jnp.pad(yv, (0, x.shape[0] - yv.shape[0]))
     # mixed precision: X may stay half (bf16 halves its HBM traffic, the
@@ -562,7 +580,7 @@ def admm(X, y, *, family: type[Family] = Logistic, regularizer=L2,
 # ------------------------------------------------------- packed (vmap) --
 
 
-def pack_strategy() -> str:
+def pack_strategy(n_lanes: int | None = None) -> str:
     """How one-vs-rest multi-class solves execute,
     ``DASK_ML_TPU_PACK`` = ``packed`` | ``sequential`` | ``auto``:
 
@@ -570,27 +588,31 @@ def pack_strategy() -> str:
     - ``sequential``: K whole-solve dispatches, one per class — each
       class stops at ITS OWN convergence instead of the pack's slowest
       lane.
-    - ``auto`` (default): the measured per-platform winner — currently
-      **sequential on BOTH platforms**.  On CPU, vmap serializes lanes
-      and the pack runs every lane to the slowest lane's iteration
-      count: 0.684× (BENCH_r03).  On TPU, three chip sessions (r5,
-      1M×28 K=4) measured 0.738× (undecided), 0.82× and 0.78× (both
-      decisively sequential under the dispersion gate) — OvR lanes
-      solve DIFFERENT objectives, so the pack wastes the fast lanes'
-      iterations and lockstep line search, and the batched gemms do not
-      buy that back at K=4.  Contrast :func:`grid_pack_strategy`: the
-      C-sweep packs K solves of the SAME data, one X read serves every
-      lane, and it won 3.4–5.3× across the same three chip sessions —
-      the two knobs measure differently because the physics differ.
+    - ``auto`` (default): the measured per-platform winner — **packed
+      on TPU at every measured K, sequential on CPU**.  Final clean
+      chip numbers (fixed-work instrument, device-resident operands,
+      all-outputs terminal dependency): **1.60× (K=4), 2.49× (K=8),
+      4.02× (K=16), 7.55× (K=64)** — the packed gemm reads X once for
+      all K lanes (the dominant HBM traffic, amortized K ways) and the
+      MXU batches K ≤ 128 lanes at near-constant cost.  Three earlier
+      contradictory adjudications were instrument errors, each worth
+      knowing (docs/design.md "invalid-instrument postmortem"):
+      coin-flip targets let the line-search-failure exit give the arms
+      different WORK; iteration-count fetches inside the timed region
+      gave the arms different SYNC; and a ``_prep``/``shard_rows``
+      device→host→device round trip on device-resident operands — a
+      real product bug found BY the instrument chase, since fixed —
+      taxed the arms differently per input type.  On CPU the fixed-work
+      pack loses (vmap serializes lanes; 0.84× at K=4) — sequential
+      stays the CPU winner.  ``n_lanes`` is accepted for future
+      K-dependent policies; the current winner does not depend on it.
     """
     from ..utils import env_choice
 
     v = env_choice("DASK_ML_TPU_PACK", ("auto", "packed", "sequential"))
     if v != "auto":
         return v
-    # measured loser on both platforms (see docstring); the vmapped
-    # machinery stays one env flip away for large-K experimentation
-    return "sequential"
+    return "packed" if jax.default_backend() == "tpu" else "sequential"
 
 
 def line_search_strategy(requested: str = "auto") -> str:
@@ -668,7 +690,7 @@ def packed_solve(solver: str, X, Y, *, family: type[Family] = Logistic,
       carries its own executed-iteration count.
     """
     reg = get_regularizer(regularizer)
-    strategy = pack_strategy()
+    strategy = pack_strategy(len(Y))
     if strategy == "packed":
         # a lax.cond grid under vmap executes BOTH branches in every
         # lane, so probe_grid would pay the full grid per lane per
