@@ -122,6 +122,22 @@ def shard_rows(
     return ShardedRows(data=data, mask=mask, n_samples=n)
 
 
+def as_sharded(x):
+    """Wrap a RAW device array (1-D targets or 2-D designs alike) into
+    :class:`ShardedRows` (device-side pad+mask, no host round trip);
+    everything else — ShardedRows, numpy, pandas, lists, None — passes
+    through unchanged.  Entry points that dispatch on ShardedRows
+    (estimator ``fit``/``score``, the CV search) apply this so raw
+    ``jax.Array`` inputs ride the no-fetch device paths (class
+    discovery, device scoring, device fold slicing) instead of falling
+    back to an O(n) ``np.asarray`` fetch; paths that already route
+    through :func:`shard_rows`/solver ``_prep`` get the same treatment
+    from those functions' own device branches."""
+    if isinstance(x, jax.Array):
+        return shard_rows(x)
+    return x
+
+
 def unshard(x) -> np.ndarray:
     """Bring a (possibly sharded) array back to host memory."""
     if isinstance(x, ShardedRows):

@@ -191,8 +191,10 @@ class LogisticRegression(ClassifierMixin, _GLM):
         Returns (betas (K, p), classes (2,)).
         """
         from ..core.sharded import ShardedRows as _SR
+        from ..core.sharded import as_sharded
         from ..solvers import lambda_sweep
 
+        y = as_sharded(y)
         if isinstance(y, _SR):
             yd = jnp.where(y.mask > 0, y.data, y.data[0])
             classes = np.asarray(jnp.unique(yd))
@@ -234,7 +236,10 @@ class LogisticRegression(ClassifierMixin, _GLM):
                 f"{self.multi_class!r}"
             )
         from ..core.sharded import ShardedRows as _SR
+        from ..core.sharded import as_sharded
 
+        # raw device label vectors ride the ShardedRows no-fetch paths
+        y = as_sharded(y)
         if isinstance(y, _SR):
             # device-side class discovery: only the unique label VALUES
             # cross to host (a handful of scalars), never the n-row label
@@ -477,10 +482,11 @@ class LogisticRegression(ClassifierMixin, _GLM):
         device-resident CV search relies on, and the only legal one for
         multi-host global arrays)."""
         from ..core.sharded import ShardedRows as _SR
-        from ..core.sharded import unshard
+        from ..core.sharded import as_sharded, unshard
 
         from ..utils import classes_f32_exact, masked_device_accuracy
 
+        X, y = as_sharded(X), as_sharded(y)
         if sample_weight is not None:
             if isinstance(y, _SR):
                 # device labels stay on device: accuracy_score consumes

@@ -357,6 +357,18 @@ class _BaseSearchCV(TPUEstimator):
         return toks
 
     def fit(self, X, y=None, **fit_params):
+        from ..core.sharded import as_sharded
+        from ..utils import check_consistent_length
+
+        # raw device arrays ride the ShardedRows device path (wrapping
+        # is a device-side reshard; np.asarray on them would be an O(n)
+        # device->host fetch).  Length consistency must be checked HERE:
+        # past the wrap, the device split slices y by X-derived indices
+        # and jnp.take would silently clamp a shorter y instead of
+        # raising the sklearn error
+        if y is not None:
+            check_consistent_length(X, y)
+        X, y = as_sharded(X), as_sharded(y)
         device_path = isinstance(X, ShardedRows) and self._device_capable()
         if device_path:
             # sharded input stays ON DEVICE through the whole search
