@@ -944,7 +944,9 @@ def main():
     try:
         if _want("tsqr") and time.time() - _START_TS < _BUDGET_S * 0.80:
             from dask_ml_tpu.core.mesh import get_mesh as _gm
-            from dask_ml_tpu.linalg.tsqr import _MeshHolder, _tsqr_impl
+            from dask_ml_tpu.linalg.tsqr import (
+                _MeshHolder, _tsqr_impl, tsqr_strategy,
+            )
 
             nQ, dQ = (4_000_000, 64) if on_tpu else (200_000, 32)
             mhQ = _MeshHolder(_gm())
@@ -960,36 +962,70 @@ def main():
             )(jax.random.PRNGKey(1))
             Xq.block_until_ready()
 
-            @jax.jit
-            def tsqr_chain(x0, n_it):
-                def one(i, x):
-                    q, r = _tsqr_impl(x, mesh_holder=mhQ)
-                    # serialize on BOTH outputs (depending only on r would
-                    # let XLA dead-code-eliminate the Q-correction gemm),
-                    # via a single-element update — a whole-array x*scale
-                    # would add a read+write pass of the same order as the
-                    # TSQR's own traffic and bias the slope
-                    eps = (jnp.abs(r[0, 0]) + jnp.abs(q[0, 0])) * 1e-30
-                    return jax.lax.dynamic_update_slice(
-                        x, x[:1, :1] + eps, (0, 0))
+            def _mk_chain(strategy):
+                @jax.jit
+                def tsqr_chain(x0, n_it):
+                    def one(i, x):
+                        q, r = _tsqr_impl(
+                            x, mesh_holder=mhQ, strategy=strategy)
+                        # serialize on BOTH outputs (depending only on r
+                        # would let XLA dead-code-eliminate the
+                        # Q-correction gemm), via a single-element update
+                        # — a whole-array x*scale would add a read+write
+                        # pass of the same order as the TSQR's own
+                        # traffic and bias the slope
+                        eps = (jnp.abs(r[0, 0]) + jnp.abs(q[0, 0])) * 1e-30
+                        return jax.lax.dynamic_update_slice(
+                            x, x[:1, :1] + eps, (0, 0))
 
-                x = jax.lax.fori_loop(0, n_it, one, x0)
-                return x[0, 0]
+                    x = jax.lax.fori_loop(0, n_it, one, x0)
+                    return x[0, 0]
 
-            per_qr = _two_point_slope(
-                lambda n_it: float(tsqr_chain(Xq, jnp.int32(n_it))), 1, 5)
-            # traffic: read X + write Q per factorization (R is d x d,
-            # negligible); flops: ~2nd^2 local QR + 2nd^2 Q correction
-            q_gbytes = 2 * nQ * dQ * 4 / 1e9
-            q_flops = 4.0 * nQ * dQ * dQ
+                return lambda n_it: float(tsqr_chain(Xq, jnp.int32(n_it)))
+
+            chains = {s: _mk_chain(s) for s in ("householder", "cholqr2")}
+            auto_strategy = tsqr_strategy()
+            per_qr = _two_point_slope(chains[auto_strategy], 1, 5)
+            # per-strategy cost model (R is d x d, negligible either way):
+            # householder — read X + write Q (the local QR works in
+            # place), ~2nd^2 local QR + 2nd^2 Q-correction flops;
+            # cholqr2 — six n x d passes (Gram read, whiten read+write,
+            # re-Gram read, repair whiten read+write) and four n x d x d
+            # gemms
+            if auto_strategy == "cholqr2":
+                q_gbytes = 6 * nQ * dQ * 4 / 1e9
+                q_flops = 8.0 * nQ * dQ * dQ
+            else:
+                q_gbytes = 2 * nQ * dQ * 4 / 1e9
+                q_flops = 4.0 * nQ * dQ * dQ
             _record({
                 "workload": f"tsqr_{nQ}x{dQ}",
+                "strategy": auto_strategy,
                 "per_qr_ms": round(per_qr * 1e3, 3),
                 "rows_per_s": round(nQ / per_qr, 1),
                 "achieved_gb_s": round(q_gbytes / per_qr, 2),
                 "bw_frac": round(q_gbytes / per_qr / peak_gb_s, 4),
                 "achieved_tflops": round(q_flops / per_qr / 1e12, 3),
                 "mfu": round(q_flops / per_qr / 1e12 / peak_tflops, 4),
+            })
+
+            # strategy A/B: Householder local QR (a) vs CholeskyQR2 (b) —
+            # the DASK_ML_TPU_TSQR policy's evidence (linalg/tsqr.py).
+            # Same interleaved-slope discipline as every policy A/B.
+            sa, sb, decision = _slope_ab(
+                chains["householder"], chains["cholqr2"], 1, 5)
+            measured = {"a": "householder", "b": "cholqr2",
+                        "undecided": "undecided"}[decision]
+            _record({
+                "workload": f"tsqr_strategy_ab_{nQ}x{dQ}",
+                "householder": sa, "cholqr2": sb,
+                "cholqr2_speedup": round(
+                    sa["median_s"] / max(sb["median_s"], 1e-9), 3),
+                "decision": measured,
+                "auto_policy": auto_strategy,
+                "auto_matches_measurement": (
+                    None if measured == "undecided"
+                    else bool(auto_strategy == measured)),
             })
     except Exception:
         extra["tsqr_error"] = traceback.format_exc(limit=3)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from ..base import TPUEstimator
 from ..core.prng import as_key
+from ..linalg.tsqr import tsqr_strategy as _tsqr_strategy
 from ..core.sharded import ShardedRows
 from ..metrics.pairwise import PAIRWISE_KERNEL_FUNCTIONS
 from ..preprocessing.data import _ingest_float
@@ -59,12 +60,14 @@ def _normalized_affinity(W, mask):
     return dinv[:, None] * W * dinv[None, :]
 
 
-@partial(jax.jit, static_argnames=("mesh_holder", "iters"))
-def _subspace_chunk(C, V, *, mesh_holder, iters):
+@partial(jax.jit, static_argnames=("mesh_holder", "iters", "qr_strategy"))
+def _subspace_chunk(C, V, *, mesh_holder, iters, qr_strategy="householder"):
     from ..linalg.tsqr import _tsqr_impl
 
     def body(_, v):
-        return _tsqr_impl(C @ v + v, mesh_holder=mesh_holder)[0]  # (C+I)v
+        return _tsqr_impl(
+            C @ v + v, mesh_holder=mesh_holder, strategy=qr_strategy
+        )[0]  # (C+I)v
 
     return jax.lax.fori_loop(0, iters, body, V)
 
@@ -314,7 +317,10 @@ class SpectralClustering(TPUEstimator):
         tol = max(float(self.eigen_tol or 0.0), 1e-6)
         prev = None
         for chunk in range(10):  # ≤ 10 * n_power_iters iterations
-            V = _subspace_chunk(C, V, mesh_holder=mh, iters=int(n_power_iters))
+            V = _subspace_chunk(
+                C, V, mesh_holder=mh, iters=int(n_power_iters),
+                qr_strategy=_tsqr_strategy(),
+            )
             lam_now = np.asarray(_ritz_values(C, V))[-k:]
             if prev is not None and np.max(np.abs(lam_now - prev)) < tol:
                 break
