@@ -427,3 +427,13 @@ class KMeans(TransformerMixin, TPUEstimator):
             X = reweight_rows(X, sample_weight=sample_weight)
         _, inertia = _assign(X.data, X.mask, self.cluster_centers_)
         return -float(inertia)
+
+    def get_feature_names_out(self, input_features=None):
+        """sklearn contract for cluster-transformers: ``transform``
+        outputs one distance column per center, named
+        ``<classname_lower><i>``."""
+        import numpy as np
+
+        k = self.cluster_centers_.shape[0]
+        prefix = type(self).__name__.lower()
+        return np.asarray([f"{prefix}{i}" for i in range(k)], dtype=object)

@@ -166,3 +166,32 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
                 X, (x @ self.components_ + self._mean_sh_) + self._anchor_
             )
         return _like_input(X, x @ self.components_ + self.mean_)
+
+    def get_covariance(self):
+        from .pca import PCA
+
+        return PCA.get_covariance(self)
+
+    get_covariance.__doc__ = (
+        "Probabilistic-PCA model covariance — same fitted-attribute "
+        "formula as :meth:`PCA.get_covariance` (sklearn "
+        "``IncrementalPCA`` inherits it from the same base).  Note a "
+        "deliberate deviation: this class's ``noise_variance_`` is the "
+        "PCA-consistent residual estimator (total running variance "
+        "minus retained, over the discarded dimensions), which tracks "
+        "full-PCA ground truth; sklearn's IncrementalPCA reports the "
+        "mean of the LAST rank-update's discarded spectrum, which "
+        "under-estimates it (measured 0.186 vs true 1.019 on the "
+        "test fixture) — so covariance/precision here agree with "
+        "``PCA`` on the same data, not with sklearn's IPCA quirk."
+    )
+
+    def get_precision(self):
+        from .pca import PCA
+
+        return PCA.get_precision(self)
+
+    get_precision.__doc__ = (
+        "Inverse model covariance via the matrix-inversion lemma — "
+        "shares :meth:`PCA.get_precision`."
+    )

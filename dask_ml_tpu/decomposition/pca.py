@@ -163,6 +163,31 @@ class PCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
         d = c.shape[1]
         return cov + self.noise_variance_ * jnp.eye(d, dtype=cov.dtype)
 
+    def get_precision(self):
+        """Inverse of :meth:`get_covariance` via the matrix-inversion
+        lemma (sklearn ``PCA.get_precision``): O(d·k²) instead of a
+        d×d inverse when k < d, exact fallback otherwise."""
+        d = self.components_.shape[1]
+        ev = self.explained_variance_
+        nv = self.noise_variance_
+        if float(nv) == 0.0 or self.n_components_ >= d:
+            cov = self.get_covariance()
+            jitter = 1e-12 * jnp.trace(cov) / d
+            return jnp.linalg.inv(cov + jitter * jnp.eye(d, dtype=cov.dtype))
+        c = self.components_
+        if self.whiten:
+            c = c * jnp.sqrt(ev)[:, None]
+        diff = jnp.maximum(ev - nv, 0.0)
+        # a component whose variance is entirely noise (diff == 0) adds
+        # nothing to the model covariance, so it must add nothing to the
+        # precision: zero its row (exact) instead of letting 1/diff blow
+        # up — the masked diagonal lane then decouples in the inverse
+        c = c * (diff > 0)[:, None]
+        inner = jnp.diag(1.0 / jnp.where(diff > 0, diff, 1.0)) + (c @ c.T) / nv
+        middle = jnp.linalg.inv(inner)
+        eye = jnp.eye(d, dtype=c.dtype)
+        return (eye - (c.T @ middle @ c) / nv) / nv
+
     def score_samples(self, X):
         """Per-sample average log-likelihood under the probabilistic PCA
         model (sklearn ``PCA.score_samples``; Tipping & Bishop 1999).

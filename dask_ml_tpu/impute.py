@@ -116,3 +116,33 @@ class SimpleImputer(OneToOneFeatureMixin, TransformerMixin, TPUEstimator):
             ind = missing[:, jnp.asarray(self.indicator_features_)].astype(x.dtype)
             out = jnp.concatenate([out, ind], axis=1)
         return _like_input(X, out)
+
+    def inverse_transform(self, X):
+        """Restore ``missing_values`` at imputed positions using the
+        indicator columns (sklearn contract: requires
+        ``add_indicator=True`` so the transform is invertible; the
+        indicator block is consumed and dropped)."""
+        if not self.add_indicator:
+            raise ValueError(
+                "inverse_transform needs add_indicator=True: without the "
+                "indicator columns the imputed positions are unrecoverable"
+            )
+        x, _ = _masked_or_plain(X)
+        d = self.statistics_.shape[0]
+        vals, ind = x[:, :d], x[:, d:]
+        feats = np.asarray(
+            getattr(self, "indicator_features_", np.arange(0)), dtype=int
+        )
+        missing = jnp.zeros(vals.shape, dtype=bool)
+        if feats.size:
+            missing = missing.at[:, jnp.asarray(feats)].set(ind > 0.5)
+        fill = jnp.asarray(
+            np.nan if (isinstance(self.missing_values, float)
+                       and np.isnan(self.missing_values))
+            else self.missing_values, dtype=vals.dtype
+        )
+        out = jnp.where(missing, fill, vals)
+        if isinstance(X, ShardedRows):
+            # column count changed: rebuild rather than _like_input
+            return ShardedRows(data=out, mask=X.mask, n_samples=X.n_samples)
+        return out

@@ -313,3 +313,15 @@ class OrdinalEncoder(TransformerMixin, TPUEstimator):
         codes = np.asarray(unshard(X) if isinstance(X, ShardedRows) else X)
         cols = [np.asarray(self.categories_[j])[codes[:, j]] for j in range(codes.shape[1])]
         return np.stack(cols, axis=1)
+
+    def get_feature_names_out(self, input_features=None):
+        """One-to-one transform: output names are the input names
+        (sklearn ``OrdinalEncoder`` contract; frame fits use the fitted
+        columns)."""
+        if getattr(self, "_frame_input_", False):
+            return np.asarray(list(self.columns_), dtype=object)
+        if input_features is not None:
+            return np.asarray(list(input_features), dtype=object)
+        return np.asarray(
+            [f"x{j}" for j in range(self.n_features_in_)], dtype=object
+        )

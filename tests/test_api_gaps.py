@@ -533,3 +533,117 @@ class TestFeatureNamesAndPcaScore:
         # sharded input path slices to real rows
         s = shard_rows(X.astype(np.float32))
         assert np.asarray(ours.score_samples(s)).shape == (60,)
+
+
+class TestRound5Slivers:
+    """Continuation-session sliver sweep: methods a migrating sklearn
+    user would reach for that the surface audit found missing."""
+
+    @pytest.mark.parametrize("whiten", [False, True])
+    @pytest.mark.parametrize("k", [3, 5])
+    def test_pca_get_precision_parity(self, rng, whiten, k):
+        from sklearn.decomposition import PCA as SkPCA
+
+        from dask_ml_tpu.decomposition import PCA
+
+        X = (rng.normal(size=(80, 5)) * np.linspace(2, 0.3, 5)).astype(
+            np.float64
+        )
+        ours = PCA(n_components=k, whiten=whiten).fit(X)
+        ref = SkPCA(n_components=k, whiten=whiten, svd_solver="full").fit(X)
+        scale = np.abs(ref.get_precision()).max()
+        np.testing.assert_allclose(
+            np.asarray(ours.get_precision()) / scale,
+            ref.get_precision() / scale, atol=2e-5,
+        )
+
+    def test_incremental_pca_covariance_tracks_full_pca(self, rng):
+        # deliberate deviation from sklearn's IPCA (docstring): our
+        # noise_variance_ is the PCA-consistent residual estimator, so
+        # the model covariance/precision must track FULL PCA on the
+        # same data — sklearn's IPCA tail-spectrum estimate does not
+        from sklearn.decomposition import PCA as SkPCA
+
+        from dask_ml_tpu.decomposition import IncrementalPCA
+
+        X = (rng.normal(size=(300, 8)) * np.linspace(3, 0.1, 8)).astype(
+            np.float32
+        )
+        io = IncrementalPCA(n_components=4, batch_size=60).fit(X)
+        ref = SkPCA(n_components=4, svd_solver="full").fit(
+            X.astype(np.float64)
+        )
+        # streamed fit: loose sanity on the covariance (incremental
+        # components/noise carry estimation error of their own; the
+        # precision INVERSE amplifies it, so exactness is asserted via
+        # the transplanted-attributes check below instead)
+        got = np.asarray(io.get_covariance())
+        want = ref.get_covariance()
+        scale = np.abs(want).max()
+        np.testing.assert_allclose(got / scale, want / scale, atol=5e-2)
+        # formula exactness: with identical fitted attributes the two
+        # classes must produce identical covariance/precision
+        io.components_ = np.asarray(ref.components_, np.float64)
+        io.explained_variance_ = np.asarray(
+            ref.explained_variance_, np.float64
+        )
+        io.noise_variance_ = float(ref.noise_variance_)
+        io.n_components_ = 4
+        for m in ("get_covariance", "get_precision"):
+            got, want = np.asarray(getattr(io, m)()), getattr(ref, m)()
+            scale = np.abs(want).max()
+            # f32 device math: formula-identical up to roundoff
+            np.testing.assert_allclose(
+                got / scale, want / scale, atol=1e-5
+            )
+
+    def test_kmeans_get_feature_names_out(self, rng):
+        from dask_ml_tpu.cluster import KMeans
+
+        X = rng.normal(size=(100, 4)).astype(np.float32)
+        km = KMeans(n_clusters=3, random_state=0).fit(shard_rows(X))
+        assert list(km.get_feature_names_out()) == [
+            "kmeans0", "kmeans1", "kmeans2",
+        ]
+        # names describe transform's output width
+        assert np.asarray(km.transform(shard_rows(X))).shape[1] == 3
+
+    def test_ordinal_encoder_get_feature_names_out(self):
+        import pandas as pd
+
+        from dask_ml_tpu.preprocessing import OrdinalEncoder
+
+        Xc = np.array([["a", "x"], ["b", "y"], ["a", "y"]], dtype=object)
+        oe = OrdinalEncoder().fit(Xc)
+        assert list(oe.get_feature_names_out()) == ["x0", "x1"]
+        assert list(oe.get_feature_names_out(["u", "v"])) == ["u", "v"]
+        df = pd.DataFrame({"c1": ["a", "b"], "c2": [1.0, 2.0]})
+        oe2 = OrdinalEncoder().fit(df)
+        assert list(oe2.get_feature_names_out()) == ["c1", "c2"]
+
+    def test_simple_imputer_inverse_transform(self, rng):
+        from sklearn.impute import SimpleImputer as SkImputer
+
+        from dask_ml_tpu.impute import SimpleImputer
+
+        X = rng.normal(size=(50, 4)).astype(np.float64)
+        X[rng.rand(*X.shape) < 0.25] = np.nan
+        ours = SimpleImputer(strategy="mean", add_indicator=True).fit(X)
+        ref = SkImputer(strategy="mean", add_indicator=True).fit(X)
+        t = np.asarray(ours.transform(X))
+        inv, inv_ref = (
+            np.asarray(ours.inverse_transform(t)),
+            ref.inverse_transform(ref.transform(X)),
+        )
+        np.testing.assert_array_equal(np.isnan(inv), np.isnan(inv_ref))
+        np.testing.assert_allclose(
+            np.nan_to_num(inv), np.nan_to_num(inv_ref), atol=1e-6
+        )
+        # sharded roundtrip preserves the container
+        s = shard_rows(X.astype(np.float32))
+        ts = ours.transform(s)
+        invs = ours.inverse_transform(ts)
+        assert isinstance(invs, ShardedRows)
+        assert invs.n_samples == 50
+        with pytest.raises(ValueError, match="add_indicator"):
+            SimpleImputer().fit(X).inverse_transform(t[:, :4])
