@@ -41,6 +41,7 @@ from . import compose  # noqa: F401
 from . import wrappers  # noqa: F401
 from . import _partial  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import resilience  # noqa: F401
 from . import diagnostics  # noqa: F401
 from . import model_selection  # noqa: F401
 
@@ -61,6 +62,7 @@ __all__ = [
     "naive_bayes",
     "ensemble",
     "checkpoint",
+    "resilience",
     "compose",
     "diagnostics",
     "wrappers",

@@ -97,6 +97,12 @@ def _atomic_pickle(obj, path: str):
     try:
         with os.fdopen(fd, "wb") as f:
             pickle.dump(obj, f)
+        # fault-injection point BETWEEN the tmp write and the atomic
+        # rename: exactly the crash-mid-write window the tmp+rename
+        # protocol protects against (the previous snapshot must survive)
+        from .resilience.testing import maybe_fault
+
+        maybe_fault("checkpoint-write")
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):

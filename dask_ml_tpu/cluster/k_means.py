@@ -139,6 +139,10 @@ def _lloyd_loop(x, mask, centers, tol, max_iter, *,
     ``lax.while_loop`` keeps convergence control on device: one dispatch
     per fit, no host round-trips.  ``tol``/``max_iter`` are device scalars
     so different settings don't recompile.
+
+    Returns ``(centers, inertia, n_iter, shift)`` — the final center
+    shift rides along so a SEGMENTED run (``FitCheckpoint`` chunking)
+    can detect convergence that lands exactly on a segment boundary.
     """
 
     def step(x_, m_, c_):
@@ -160,7 +164,7 @@ def _lloyd_loop(x, mask, centers, tol, max_iter, *,
         jnp.asarray(jnp.inf, x.dtype),
     )
     i, centers, inertia, shift = jax.lax.while_loop(cond, body, init)
-    return centers, inertia, i
+    return centers, inertia, i, shift
 
 
 @jax.jit
@@ -281,12 +285,22 @@ def init_scalable(X: ShardedRows, n_clusters: int, key, oversampling_factor=2,
 class KMeans(TransformerMixin, TPUEstimator):
     """Parameters mirror the reference (``n_clusters``, ``init='k-means||'``,
     ``oversampling_factor``, ``max_iter``, ``tol``, ``init_max_iter``,
-    ``random_state``, ``n_jobs`` accepted-inert)."""
+    ``random_state``, ``n_jobs`` accepted-inert).
+
+    ``fit_checkpoint`` (a :class:`~dask_ml_tpu.resilience.FitCheckpoint`)
+    makes the fit preemption-safe: the fused Lloyd ``while_loop`` runs as
+    SEGMENTS of ``every_n_iters`` iterations (same compiled step program,
+    one extra dispatch + scalar sync per boundary), snapshotting the
+    centers atomically at each boundary so a killed fit resumes from the
+    last snapshot with the identical trajectory.  Preemption (SIGTERM via
+    :class:`~dask_ml_tpu.resilience.PreemptionWatcher`) is honored at the
+    same boundaries.
+    """
 
     def __init__(self, n_clusters=8, init="k-means||", oversampling_factor=2,
                  max_iter=300, tol=1e-4, precompute_distances="auto",
                  random_state=None, copy_x=True, n_jobs=1, algorithm="full",
-                 init_max_iter=None):
+                 init_max_iter=None, fit_checkpoint=None):
         self.n_clusters = n_clusters
         self.init = init
         self.oversampling_factor = oversampling_factor
@@ -298,6 +312,7 @@ class KMeans(TransformerMixin, TPUEstimator):
         self.n_jobs = n_jobs
         self.algorithm = algorithm
         self.init_max_iter = init_max_iter
+        self.fit_checkpoint = fit_checkpoint
 
     def _init_centers(self, X: ShardedRows, key):
         init = self.init
@@ -374,7 +389,17 @@ class KMeans(TransformerMixin, TPUEstimator):
 
             X = reweight_rows(X, sample_weight=sample_weight)
         key = as_key(self.random_state)
-        centers = self._init_centers(X, key)
+        ckpt = self.fit_checkpoint
+        it0 = 0
+        snap = ckpt.load_if_matches(self) if ckpt is not None else None
+        if snap is not None:
+            # resume mid-fit: the snapshot's centers REPLACE the (seed-
+            # deterministic) init, and the Lloyd budget continues from the
+            # recorded iteration count
+            it0, state = snap
+            centers = jnp.asarray(state["centers"], dtype=X.data.dtype)
+        else:
+            centers = self._init_centers(X, key)
 
         x, mask = X.data, X.mask
         # sklearn-style tol scaling: mean of per-feature variances, masked so
@@ -384,18 +409,51 @@ class KMeans(TransformerMixin, TPUEstimator):
         # tol from UNWEIGHTED variances: sklearn's _tolerance ignores
         # sample_weight, so weighting must not move the stopping threshold
         tol = self.tol * jnp.mean(masked_var(x, valid_mask))  # on device
+        from ..resilience.preemption import active_watcher, check_preemption
+
         with _timer("Lloyd loop", logger, logging.DEBUG):
             from ..ops.scatter import scatter_strategy
 
             # policy knobs resolve OUTSIDE the jit so they participate in
             # the jit cache key (static args); resolving inside would bake
             # the first call's env values in for the process lifetime
-            centers, _, n_iter_dev = _lloyd_loop(
-                x, mask, centers, tol.astype(x.dtype), jnp.int32(self.max_iter),
-                mode=_kmeans_mode(),
-                scatter=scatter_strategy(self.n_clusters),
-            )
-            n_iter = int(n_iter_dev)
+            mode = _kmeans_mode()
+            scatter = scatter_strategy(self.n_clusters)
+            if ckpt is None and active_watcher() is None:
+                # the uninstrumented fast path: ONE fused dispatch
+                centers, _, n_iter_dev, _ = _lloyd_loop(
+                    x, mask, centers, tol.astype(x.dtype),
+                    jnp.int32(self.max_iter), mode=mode, scatter=scatter,
+                )
+                n_iter = int(n_iter_dev)
+            else:
+                # segmented: the SAME compiled step program in chunks of
+                # the checkpoint cadence, one host boundary per chunk
+                # (snapshot + preemption check + fault-injection point)
+                from ..resilience.testing import maybe_fault
+
+                chunk = (ckpt.chunk_iters(32) if ckpt is not None
+                         else min(32, int(self.max_iter)))
+                n_iter = it0
+                while n_iter < self.max_iter:
+                    maybe_fault("step")
+                    seg = min(chunk, self.max_iter - n_iter)
+                    centers, _, seg_n_dev, shift = _lloyd_loop(
+                        x, mask, centers, tol.astype(x.dtype),
+                        jnp.int32(seg), mode=mode, scatter=scatter,
+                    )
+                    seg_n = int(seg_n_dev)
+                    n_iter += seg_n
+                    if ckpt is not None and ckpt.due(n_iter):
+                        ckpt.save(self, {"centers": centers}, n_iter)
+                    check_preemption(ckpt, self, {"centers": centers}, n_iter)
+                    # converged: the segment stopped early, or the final
+                    # shift cleared tol exactly at the boundary (the fused
+                    # loop's cond — boundaries must not add iterations)
+                    if seg_n < seg or float(shift) <= float(tol):
+                        break
+                if ckpt is not None:
+                    ckpt.complete()
         labels, inertia = _assign(x, mask, centers)
 
         self.cluster_centers_ = centers

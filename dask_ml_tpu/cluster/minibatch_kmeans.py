@@ -168,7 +168,7 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
     def __init__(self, n_clusters=8, init="k-means++", max_iter=100,
                  batch_size=1024, tol=0.0, max_no_improvement=10,
                  random_state=None, reassignment_ratio=0.01,
-                 oversampling_factor=2):
+                 oversampling_factor=2, fit_checkpoint=None):
         self.n_clusters = n_clusters
         self.init = init
         self.max_iter = max_iter
@@ -178,6 +178,7 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
         self.random_state = random_state
         self.reassignment_ratio = reassignment_ratio
         self.oversampling_factor = oversampling_factor
+        self.fit_checkpoint = fit_checkpoint
 
     # -- init --------------------------------------------------------------
     def _init_from_block(self, X: ShardedRows, key):
@@ -249,6 +250,9 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
         ragged chunk sizes compiles a handful of programs, not one per
         distinct length.  ``sample_weight`` folds into the mask (sklearn
         semantics: weighted center means, weighted 1/n_c decay)."""
+        from ..resilience.testing import maybe_fault
+
+        maybe_fault("step")
         if not isinstance(X, ShardedRows):
             from ..linear_model._sgd import _bucket_pad
 
@@ -285,16 +289,40 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
         for attr in ("cluster_centers_", "_counts"):
             if hasattr(self, attr):
                 delattr(self, attr)
+
+        from ..resilience.preemption import check_preemption
+        from ..resilience.testing import maybe_fault
+
+        ckpt = self.fit_checkpoint
+        best = np.inf
+        bad = 0
+        epoch0 = 0
+        snap = ckpt.load_if_matches(self) if ckpt is not None else None
+        if snap is not None:
+            # resume: install the snapshot BEFORE _ensure_state so the
+            # (discarded-anyway) k-means++ init is skipped entirely
+            epoch0, state = snap
+            self.cluster_centers_ = jnp.asarray(state["centers"],
+                                                dtype=X.data.dtype)
+            self._counts = jnp.asarray(state["counts"], dtype=jnp.float32)
+            best, bad = float(state["best"]), int(state["bad"])
+            self.n_features_in_ = X.data.shape[1]
         self._ensure_state(X)
         n = X.data.shape[0]
         bs = int(min(self.batch_size, n))
         n_batches = max(n // bs, 1)
         key = as_key(self.random_state)
-
-        best = np.inf
-        bad = 0
+        # the per-epoch key schedule is a pure function of the epoch index:
+        # fast-forward the splits for already-completed epochs so a resumed
+        # fit draws the SAME reassignment/offset keys the killed fit would
+        for e in range(epoch0):
+            if e > 0 and self.reassignment_ratio:
+                key, _ = jax.random.split(key)
+            key, _ = jax.random.split(key)
         centers, counts = self.cluster_centers_, self._counts
-        for epoch in range(self.max_iter):
+        epoch = max(epoch0 - 1, 0)
+        for epoch in range(epoch0, self.max_iter):
+            maybe_fault("step")
             if epoch > 0 and self.reassignment_ratio:
                 # BEFORE the epoch (sklearn reassigns before the batch
                 # update): a reseeded center is always refined by the
@@ -312,14 +340,24 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
                 batch_size=bs, n_batches=n_batches,
             )
             cur = float(mean_inertia)  # one scalar sync per epoch
+            stop = False
             if self.max_no_improvement is not None:
                 if cur > best - self.tol * max(abs(best), 1.0):
                     bad += 1
                     if bad >= self.max_no_improvement:
-                        break
+                        stop = True
                 else:
                     bad = 0
             best = min(best, cur)
+            state = {"centers": centers, "counts": counts,
+                     "best": best, "bad": bad}
+            if ckpt is not None and not stop and ckpt.due(epoch + 1):
+                ckpt.save(self, state, epoch + 1)
+            check_preemption(ckpt, self, state, epoch + 1)
+            if stop:
+                break
+        if ckpt is not None:
+            ckpt.complete()
         self.cluster_centers_, self._counts = centers, counts
         self.n_iter_ = epoch + 1
         self.n_steps_ = (epoch + 1) * n_batches

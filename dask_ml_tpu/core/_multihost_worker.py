@@ -21,10 +21,20 @@ import sys
 
 def main(pid: int, nproc: int, port: str, local_devices: int = 4) -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={local_devices}"
+    ).strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", local_devices)
+    try:
+        jax.config.update("jax_num_cpu_devices", local_devices)
+    except AttributeError:
+        # older jax (< 0.4.38) has no jax_num_cpu_devices option; the
+        # XLA_FLAGS host-platform count set above covers it (backends
+        # haven't been created yet at this point in the worker)
+        pass
 
     from dask_ml_tpu.core import distributed as dist
 
@@ -87,6 +97,66 @@ def main(pid: int, nproc: int, port: str, local_devices: int = 4) -> None:
     # hierarchical mesh builds too (explicit DCN axis)
     hmesh = dist.global_mesh(hierarchical=True)
     assert hmesh.axis_names == (dist.DCN_AXIS, "data", "model")
+
+    # -- flagship 6 (this round): cross-process PREEMPTION drill.  The
+    # multi-controller contract (resilience/preemption.py): a watcher is
+    # installed on EVERY process (the boundary flag check is itself a
+    # tiny collective — a process without a watcher would skip it and
+    # desynchronize the fleet), the signal lands on ONE process only
+    # (process 0, via the programmatic trigger — a real SIGTERM hits one
+    # host first the same way), and every process must stop at the SAME
+    # iteration boundary with a final snapshot, then resume to
+    # completion from it.
+    import tempfile
+
+    from dask_ml_tpu.linear_model import SGDRegressor
+    from dask_ml_tpu.resilience import (
+        FitCheckpoint,
+        PreemptionWatcher,
+        TrainingPreempted,
+        fault_plan,
+    )
+
+    set_mesh(mesh)
+    ckpt_path = os.path.join(
+        tempfile.gettempdir(), f"dmlt_preempt_{port}_{pid}.pkl"
+    )
+    if os.path.exists(ckpt_path):
+        os.unlink(ckpt_path)
+
+    def make_sgd():
+        # tol=None: a fixed 10-epoch schedule, so the stopping boundary
+        # is deterministic and identical on every process
+        return SGDRegressor(
+            random_state=0, tol=None, max_iter=10, eta0=0.01,
+            learning_rate="constant",
+            fit_checkpoint=FitCheckpoint(ckpt_path, every_n_iters=2),
+        )
+
+    with PreemptionWatcher() as w:
+        stopped_at = None
+        try:
+            if pid == 0:
+                with fault_plan() as plan:
+                    plan.on_call("step", w.trigger, at_call=2)
+                    make_sgd().fit(Xs, ys)
+            else:
+                make_sgd().fit(Xs, ys)
+        except TrainingPreempted as e:
+            stopped_at = e.iteration
+            assert e.checkpoint_path == ckpt_path, e.checkpoint_path
+    # the flag collective must stop EVERY process (only pid 0 saw the
+    # "signal"), and at the same boundary: the end of epoch 2
+    assert stopped_at == 2, (
+        f"proc {pid}: expected a fleet-wide stop at epoch 2, "
+        f"got {stopped_at}"
+    )
+    assert os.path.exists(ckpt_path), "no final snapshot at preemption"
+    sgd = make_sgd().fit(Xs, ys)  # restarted process: resume and finish
+    assert sgd.n_iter_ == 10 and np.all(np.isfinite(sgd.coef_))
+    assert not os.path.exists(ckpt_path)  # completed fit clears it
+    print(f"[proc {pid}] preemption drill OK: stopped_at={stopped_at} "
+          f"resumed_iters={sgd.n_iter_}", flush=True)
 
     # -- flagship 3 (round 3): CROSS-HOST packed adaptive search.  A 2-D
     # global mesh puts the cohort's stacked MODEL_AXIS across the process

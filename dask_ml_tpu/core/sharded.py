@@ -91,6 +91,12 @@ def shard_rows(
     """
     if isinstance(x, ShardedRows):
         return x
+    # collective-layer fault-injection point (resilience.testing): the
+    # in-process stand-in for an ICI/DCN transport fault at the sharding
+    # boundary; a no-op unless a FaultPlan is active
+    from ..resilience.testing import maybe_fault
+
+    maybe_fault("collective")
     mesh = mesh or get_mesh()
     n_shards = data_axes_size(mesh)
     if isinstance(x, jax.Array):
@@ -140,6 +146,9 @@ def as_sharded(x):
 
 def unshard(x) -> np.ndarray:
     """Bring a (possibly sharded) array back to host memory."""
+    from ..resilience.testing import maybe_fault
+
+    maybe_fault("collective")
     if isinstance(x, ShardedRows):
         x = x.unpad()
     return np.asarray(jax.device_get(x))

@@ -52,11 +52,24 @@ def _update(components, singular_values, mean, var, n_seen, batch, *, k):
 
 
 class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
-    def __init__(self, n_components=None, whiten=False, copy=True, batch_size=None):
+    #: the loop state a FitCheckpoint snapshot carries (everything
+    #: partial_fit reads; the derived attrs are recomputed by the next
+    #: update, but snapshotting them keeps a resumed-but-never-stepped
+    #: model usable for transform as well)
+    _FIT_STATE_ATTRS = (
+        "components_", "singular_values_", "_mean_sh_", "var_",
+        "n_samples_seen_", "_anchor_", "n_components_", "n_features_in_",
+        "mean_", "explained_variance_", "explained_variance_ratio_",
+        "noise_variance_",
+    )
+
+    def __init__(self, n_components=None, whiten=False, copy=True,
+                 batch_size=None, fit_checkpoint=None):
         self.n_components = n_components
         self.whiten = whiten
         self.copy = copy
         self.batch_size = batch_size
+        self.fit_checkpoint = fit_checkpoint
 
     def _init_state(self, d, k, dtype):
         self.components_ = jnp.zeros((k, d), dtype=dtype)
@@ -66,6 +79,9 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
         self.n_samples_seen_ = 0
 
     def partial_fit(self, X, y=None, check_input=True):
+        from ..resilience.testing import maybe_fault
+
+        maybe_fault("step")
         if check_input:
             X = check_array(X)
         x = jnp.asarray(unshard(X) if isinstance(X, ShardedRows) else X)
@@ -129,8 +145,21 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
 
     def fit(self, X, y=None):
         """Stream X through partial_fit in row batches (reference walks dask
-        blocks in sequence)."""
-        if hasattr(self, "components_"):
+        blocks in sequence).  With a ``fit_checkpoint``, the batch walk
+        snapshots the rank-update state at the checkpoint cadence and a
+        killed fit resumes at the first unprocessed batch — the update is
+        deterministic, so the resumed sweep matches an uninterrupted one.
+        """
+        from ..resilience.preemption import check_preemption
+
+        ckpt = self.fit_checkpoint
+        done_batches = 0
+        snap = ckpt.load_if_matches(self) if ckpt is not None else None
+        if snap is not None:
+            done_batches, state = snap
+            for attr, val in state.items():
+                setattr(self, attr, val)
+        elif hasattr(self, "components_"):
             del self.components_  # refit from scratch, sklearn semantics
         x = unshard(X) if isinstance(X, ShardedRows) else np.asarray(X)
         n, d = x.shape
@@ -138,12 +167,25 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
         # resolved rank: explicit, else inferred from the first batch as
         # partial_fit will (sklearn drops tails < rank via gen_batches)
         k = self.n_components or min(batch, n, d)
+        i = 0
         for start in range(0, n, batch):
             stop = min(start + batch, n)
             if stop - start < k:
                 break
+            i += 1
+            if i <= done_batches:
+                continue  # already folded into the resumed state
             self.partial_fit(x[start:stop], check_input=False)
+            if ckpt is not None and ckpt.due(i):
+                ckpt.save(self, self._fit_state(), i)
+            check_preemption(ckpt, self, self._fit_state(), i)
+        if ckpt is not None:
+            ckpt.complete()
         return self
+
+    def _fit_state(self) -> dict:
+        return {a: getattr(self, a) for a in self._FIT_STATE_ATTRS
+                if hasattr(self, a)}
 
     def transform(self, X):
         x, _ = _masked_or_plain(X)

@@ -172,6 +172,13 @@ class PCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
         nv = self.noise_variance_
         if float(nv) == 0.0 or self.n_components_ >= d:
             cov = self.get_covariance()
+            prec = jnp.linalg.inv(cov)
+            if bool(jnp.all(jnp.isfinite(prec))):
+                return prec  # plain inverse is well-posed: report it exactly
+            # singular / near-singular covariance only: regularize with a
+            # trace-scaled jitter so callers get a finite precision instead
+            # of inf/nan (sklearn raises LinAlgError here; a loud-but-
+            # finite answer serves score_samples better)
             jitter = 1e-12 * jnp.trace(cov) / d
             return jnp.linalg.inv(cov + jitter * jnp.eye(d, dtype=cov.dtype))
         c = self.components_

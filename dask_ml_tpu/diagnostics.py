@@ -19,7 +19,21 @@ import jax
 
 from .utils import _timer  # noqa: F401  (re-export: phase logging)
 
-__all__ = ["trace", "benchmark_step", "benchmark_slope", "_timer"]
+# fault observability (re-export): the process-global retry/fault
+# counters live in resilience.retry; surfacing them here keeps one
+# diagnostics namespace for "what happened during that fit" — timings,
+# traces, AND absorbed/propagated faults (resilience faults must be
+# observable, never silent)
+from .resilience.retry import (  # noqa: F401
+    FaultStats,
+    fault_stats,
+    reset_fault_stats,
+)
+
+__all__ = [
+    "trace", "benchmark_step", "benchmark_slope", "_timer",
+    "FaultStats", "fault_stats", "reset_fault_stats",
+]
 
 
 @contextlib.contextmanager

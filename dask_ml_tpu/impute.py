@@ -129,10 +129,17 @@ class SimpleImputer(OneToOneFeatureMixin, TransformerMixin, TPUEstimator):
             )
         x, _ = _masked_or_plain(X)
         d = self.statistics_.shape[0]
-        vals, ind = x[:, :d], x[:, d:]
         feats = np.asarray(
             getattr(self, "indicator_features_", np.arange(0)), dtype=int
         )
+        expected = d + feats.size
+        if x.shape[1] != expected:
+            raise ValueError(
+                f"X has {x.shape[1]} columns; inverse_transform expects "
+                f"{expected} ({d} imputed features + {feats.size} "
+                f"indicator columns, in transform's output layout)"
+            )
+        vals, ind = x[:, :d], x[:, d:]
         missing = jnp.zeros(vals.shape, dtype=bool)
         if feats.size:
             missing = missing.at[:, jnp.asarray(feats)].set(ind > 0.5)

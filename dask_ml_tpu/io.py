@@ -104,19 +104,36 @@ def csv_dims(path: str, *, has_header: bool = False) -> tuple[int, int]:
 
 
 def read_csv(path: str, *, has_header: bool = False,
-             n_threads: int | None = None) -> np.ndarray:
+             n_threads: int | None = None, retries: int = 0,
+             retry_backoff: float = 0.1) -> np.ndarray:
     """Parse a numeric CSV into a float32 (rows, cols) array, one parser
-    thread per row range."""
-    lib = _load()
-    rows, cols = csv_dims(path, has_header=has_header)
-    out = np.empty((rows, cols), dtype=np.float32)
-    n_threads = n_threads or min(32, os.cpu_count() or 1)
-    rc = lib.dmlt_csv_read_f32(
-        path.encode(), int(has_header), 0, rows, cols,
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), int(n_threads),
-    )
-    _check(rc, path)
-    return out
+    thread per row range.
+
+    ``retries`` re-attempts the whole parse on a transient fault
+    (flaky network filesystem, contended mount) with exponential backoff
+    via :func:`dask_ml_tpu.resilience.retry` — absorbed faults and
+    propagated failures are both counted in the global
+    :func:`~dask_ml_tpu.diagnostics.fault_stats` under the ``"ingest"``
+    tag, so recovery is observable, never silent.
+    """
+    from .resilience.retry import retry as _retry
+    from .resilience.testing import maybe_fault
+
+    def _parse():
+        maybe_fault("ingest")
+        lib = _load()
+        rows, cols = csv_dims(path, has_header=has_header)
+        out = np.empty((rows, cols), dtype=np.float32)
+        nt = n_threads or min(32, os.cpu_count() or 1)
+        rc = lib.dmlt_csv_read_f32(
+            path.encode(), int(has_header), 0, rows, cols,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), int(nt),
+        )
+        _check(rc, path)
+        return out
+
+    return _retry(_parse, retries=int(retries), backoff=retry_backoff,
+                  tag="ingest")
 
 
 def read_binary(path: str, shape: tuple[int, ...], *,
@@ -133,7 +150,8 @@ def read_binary(path: str, shape: tuple[int, ...], *,
 
 
 def stream_csv_blocks(path: str, block_rows: int, *, has_header: bool = False,
-                      n_threads: int | None = None, prefetch: int = 2):
+                      n_threads: int | None = None, prefetch: int = 2,
+                      retries: int = 0, retry_backoff: float = 0.1):
     """Yield float32 row blocks of (at most) ``block_rows`` — the
     out-of-core ingest feeding ``wrappers.Incremental`` (the reference's
     sequential block streaming, SURVEY.md §2.2).
@@ -144,9 +162,17 @@ def stream_csv_blocks(path: str, block_rows: int, *, has_header: bool = False,
     including the jax runtime, and a 12 GB stream asserts < 1.5 GB —
     tests/test_streaming_rss.py) while a background C++ worker parses
     ``prefetch`` blocks ahead of the consumer, so parsing overlaps the
-    device compute consuming the blocks."""
+    device compute consuming the blocks.
+
+    ``retries`` re-attempts each BLOCK fetch on a transient fault with
+    exponential backoff (:func:`dask_ml_tpu.resilience.retry`, tag
+    ``"ingest"``) — the native session keeps the stream position, so a
+    failed attempt never skips rows."""
     if block_rows < 1:
         raise ValueError(f"block_rows must be >= 1, got {block_rows}")
+    from .resilience.retry import retry as _retry
+    from .resilience.testing import maybe_fault
+
     lib = _load()
     n_threads = n_threads or min(8, os.cpu_count() or 1)
     rows = ctypes.c_int64()
@@ -162,7 +188,9 @@ def stream_csv_blocks(path: str, block_rows: int, *, has_header: bool = False,
     try:
         c = cols.value
         got = ctypes.c_int64()
-        while True:
+
+        def _next_block():
+            maybe_fault("ingest")
             # fresh buffer per block: the native memcpy fills it and the
             # trimmed view is yielded as-is — no second Python-side copy
             buf = np.empty((block_rows, max(c, 1)), dtype=np.float32)
@@ -171,6 +199,11 @@ def stream_csv_blocks(path: str, block_rows: int, *, has_header: bool = False,
                 ctypes.byref(got),
             )
             _check(rc, path)
+            return buf
+
+        while True:
+            buf = _retry(_next_block, retries=int(retries),
+                         backoff=retry_backoff, tag="ingest")
             if got.value == 0:
                 break
             yield buf[: got.value]
@@ -194,8 +227,13 @@ def stream_text_lines(path: str, block_lines: int = 10_000):
         yield block
 
 
-def read_csv_sharded(path: str, *, has_header: bool = False, mesh=None):
+def read_csv_sharded(path: str, *, has_header: bool = False, mesh=None,
+                     retries: int = 0, retry_backoff: float = 0.1):
     """Parse a CSV and place it row-sharded over the mesh (ShardedRows)."""
     from .core.sharded import shard_rows
 
-    return shard_rows(read_csv(path, has_header=has_header), mesh)
+    return shard_rows(
+        read_csv(path, has_header=has_header, retries=retries,
+                 retry_backoff=retry_backoff),
+        mesh,
+    )

@@ -410,8 +410,32 @@ def _run_epochs(est, xb, yb, mask, n_real=None) -> int:
         if float(jnp.sum(val_mask)) == 0.0:  # degenerate tiny input
             early, train_mask, val_mask = False, mask, None
     stop = EpochStopper(est.tol, getattr(est, "n_iter_no_change", 5))
+
+    from ..resilience.preemption import active_watcher, check_preemption
+    from ..resilience.testing import maybe_fault
+
+    ckpt = getattr(est, "fit_checkpoint", None)
+    epoch0 = 0
+    snap = ckpt.load_if_matches(est) if ckpt is not None else None
+    if snap is not None:
+        # resume mid-fit: the snapshot replaces the fresh state installed
+        # by _ensure_state, and the stopping rule + adaptive-eta scale
+        # continue exactly where the killed fit left off (the validation
+        # mask above is a pure function of random_state, so the resumed
+        # trajectory is identical to the uninterrupted one)
+        epoch0, st = snap
+        est._state = jax.tree.map(jnp.asarray, st["state"])
+        stop.best, stop.bad = float(st["best"]), int(st["bad"])
+        hyper = {**hyper, "eta_scale": jnp.float32(st["eta_scale"])}
+
+    def _snapshot_state():
+        return {"state": est._state, "best": stop.best, "bad": stop.bad,
+                "eta_scale": float(hyper["eta_scale"])}
+
     views = _minibatch_views(est, xb, yb, train_mask, n_real)
-    for epoch in range(est.max_iter):
+    n_iter = est.max_iter
+    for epoch in range(epoch0, est.max_iter):
+        maybe_fault("step")
         if views is not None:
             xs, ys, ms = views
             est._state, loss = _jitted_epoch(
@@ -421,6 +445,7 @@ def _run_epochs(est, xb, yb, mask, n_real=None) -> int:
             )
         else:
             loss = est._step_block(xb, yb, train_mask, hyper)
+        done = False
         if stop.active:
             monitor = (
                 _eval_loss(est._state, xb, yb, val_mask, hyper,
@@ -429,17 +454,30 @@ def _run_epochs(est, xb, yb, mask, n_real=None) -> int:
             )
             if stop.update(float(monitor)):
                 if not adaptive:
-                    return epoch + 1
-                # sklearn's adaptive rule: divide eta by 5 and keep
-                # going; stop once eta underflows 1e-6.  The stopper's
-                # best loss persists across reductions — only the
-                # patience counter resets
-                new_scale = hyper["eta_scale"] / 5.0
-                if float(new_scale) * float(hyper["eta0"]) < 1e-6:
-                    return epoch + 1
-                hyper = {**hyper, "eta_scale": new_scale}
-                stop.reset_patience()
-    return est.max_iter
+                    n_iter, done = epoch + 1, True
+                else:
+                    # sklearn's adaptive rule: divide eta by 5 and keep
+                    # going; stop once eta underflows 1e-6.  The stopper's
+                    # best loss persists across reductions — only the
+                    # patience counter resets
+                    new_scale = hyper["eta_scale"] / 5.0
+                    if float(new_scale) * float(hyper["eta0"]) < 1e-6:
+                        n_iter, done = epoch + 1, True
+                    else:
+                        hyper = {**hyper, "eta_scale": new_scale}
+                        stop.reset_patience()
+        # boundary instrumentation only when someone is listening: the
+        # snapshot dict costs a device->host sync (float(eta_scale)), and
+        # the uninstrumented fit keeps its one-dispatch-per-epoch shape
+        if ckpt is not None or active_watcher() is not None:
+            if ckpt is not None and not done and ckpt.due(epoch + 1):
+                ckpt.save(est, _snapshot_state(), epoch + 1)
+            check_preemption(ckpt, est, _snapshot_state(), epoch + 1)
+        if done:
+            break
+    if ckpt is not None:
+        ckpt.complete()
+    return n_iter
 
 
 class _BaseSGD(TPUEstimator):
@@ -568,11 +606,12 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
                  learning_rate="optimal", eta0=0.01, power_t=0.25,
                  n_iter_no_change=5, random_state=None, warm_start=False,
                  class_weight=None, batch_size=None, early_stopping=False,
-                 validation_fraction=0.1):
+                 validation_fraction=0.1, fit_checkpoint=None):
         self.class_weight = class_weight
         self.batch_size = batch_size
         self.early_stopping = early_stopping
         self.validation_fraction = validation_fraction
+        self.fit_checkpoint = fit_checkpoint
         self.loss = loss
         self.penalty = penalty
         self.alpha = alpha
@@ -691,6 +730,9 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
 
     def partial_fit(self, X, y, classes=None, sample_weight=None, **kwargs):
         self._validate()
+        from ..resilience.testing import maybe_fault
+
+        maybe_fault("step")
         if not hasattr(self, "classes_"):
             if classes is None:
                 raise ValueError(
@@ -838,10 +880,11 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
                  learning_rate="invscaling", eta0=0.01, power_t=0.25,
                  epsilon=0.1, n_iter_no_change=5, random_state=None,
                  warm_start=False, batch_size=None, early_stopping=False,
-                 validation_fraction=0.1):
+                 validation_fraction=0.1, fit_checkpoint=None):
         self.batch_size = batch_size
         self.early_stopping = early_stopping
         self.validation_fraction = validation_fraction
+        self.fit_checkpoint = fit_checkpoint
         self.loss = loss
         self.penalty = penalty
         self.alpha = alpha
@@ -894,6 +937,9 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
 
     def partial_fit(self, X, y, sample_weight=None, **kwargs):
         self._validate()
+        from ..resilience.testing import maybe_fault
+
+        maybe_fault("step")
         xb, yb, mask = self._prep_block(X, self._targets(y, X))
         mask = self._weighted_mask(X, mask, sample_weight)
         self._ensure_state(xb.shape[1])

@@ -43,7 +43,7 @@ class _GLM(TPUEstimator):
                  fit_intercept=True, intercept_scaling=1.0, class_weight=None,
                  random_state=None, solver="admm", max_iter=100,
                  multi_class="ovr", verbose=0, warm_start=False, n_jobs=1,
-                 solver_kwargs=None):
+                 solver_kwargs=None, fit_checkpoint=None):
         self.penalty = penalty
         self.dual = dual
         self.tol = tol
@@ -59,6 +59,7 @@ class _GLM(TPUEstimator):
         self.warm_start = warm_start
         self.n_jobs = n_jobs
         self.solver_kwargs = solver_kwargs
+        self.fit_checkpoint = fit_checkpoint
 
     def _solver_call_kwargs(self):
         """Solver kwargs shared by the single and packed dispatch paths —
@@ -81,10 +82,60 @@ class _GLM(TPUEstimator):
 
     def _solve(self, X: ShardedRows, y, family=None, beta0=None):
         kwargs = self._solver_call_kwargs()  # validates self.solver
+        if getattr(self, "fit_checkpoint", None) is not None:
+            return self._solve_chunked(
+                X, y, family or self.family, beta0, kwargs,
+                self.fit_checkpoint,
+            )
         return _SOLVERS[self.solver](
             X, y, return_n_iter=True, family=family or self.family,
             beta0=beta0, **kwargs
         )
+
+    def _solve_chunked(self, X, y, family, beta0, kwargs, ckpt):
+        """Preemption-safe solve: the fused device solver runs in SEGMENTS
+        of the checkpoint cadence, warm-started from the previous
+        segment's beta, with an atomic snapshot at every boundary.
+
+        Restarting a solver segment resets its internal machinery (LBFGS
+        curvature history, ADMM duals/rho, line-search step sizes), so the
+        CHUNKED trajectory differs from the single-dispatch solve — but it
+        is deterministic: a fit killed at any boundary and resumed from
+        its snapshot replays the identical remaining segments, and the
+        converged optimum is the same within ``tol``.  Pick a cadence of
+        tens of iterations so the restart overhead amortizes (see
+        :class:`~dask_ml_tpu.resilience.FitCheckpoint`).  The packed
+        one-vs-rest plane ignores the checkpoint (one vmapped program for
+        ALL classes — there is no per-class boundary to snapshot).
+        """
+        from ..resilience.preemption import check_preemption
+        from ..resilience.testing import maybe_fault
+
+        max_iter = int(kwargs.get("max_iter", 100))
+        chunk = ckpt.chunk_iters(max(1, min(20, max_iter)))
+        it = 0
+        snap = ckpt.load_if_matches(self)
+        if snap is not None:
+            it, state = snap
+            beta0 = np.asarray(state["beta"])
+        solver = _SOLVERS[self.solver]
+        beta = beta0
+        while it < max_iter:
+            maybe_fault("step")
+            seg = min(chunk, max_iter - it)
+            kw = dict(kwargs, max_iter=seg)
+            beta, n_it = solver(
+                X, y, return_n_iter=True, family=family, beta0=beta, **kw
+            )
+            n = int(n_it)
+            it += n
+            if ckpt.due(it):
+                ckpt.save(self, {"beta": beta}, it)
+            check_preemption(ckpt, self, {"beta": beta}, it)
+            if n < seg:
+                break  # the segment's own tol stop fired: converged
+        ckpt.complete()
+        return beta, it
 
     @staticmethod
     def _warm_ok(prev, shape, *, was_multinomial=False,

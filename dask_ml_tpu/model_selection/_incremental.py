@@ -416,39 +416,45 @@ class BaseIncrementalSearchCV(TPUEstimator):
             The reference's resilience comes from the scheduler: a task
             lost to a dead worker is resubmitted and lineage recomputes
             its inputs (SURVEY.md §5 failure detection).  Here the unit
-            retries once from a deep-copied round-start state — exact-state
-            recovery (sklearn partial_fit mutates in place, so re-running
-            without the snapshot would double-apply blocks).  A second
-            failure propagates: persistent faults must surface, not spin.
+            rides the shared :func:`dask_ml_tpu.resilience.retry`
+            primitive (tag ``"search-unit"`` in the global fault stats)
+            with an ``on_error`` hook that restores the deep-copied
+            round-start state — exact-state recovery (sklearn partial_fit
+            mutates in place, so re-running without the snapshot would
+            double-apply blocks).  One retry, no backoff (the fault is a
+            dead unit, not a contended resource); a second failure
+            propagates: persistent faults must surface, not spin.
 
-            On a multi-process group there is NO retry: an exception seen
-            by one process only would make that process re-issue the
-            unit's device programs while its peers move on — the fleet's
-            collective streams diverge and deadlock.  State is rolled back
-            and the fault propagates so every process stops loudly.
+            On a multi-process group there is NO retry (``retries=0``):
+            an exception seen by one process only would make that process
+            re-issue the unit's device programs while its peers move on —
+            the fleet's collective streams diverge and deadlock.  State is
+            rolled back and the fault propagates so every process stops
+            loudly.
             """
             import copy
+
+            from ..resilience.retry import retry as _retry
 
             snapshot = {i: copy.deepcopy(models[i]) for i in unit_ids}
             # a cohort can fail after appending SOME members' history
             # records — roll info back too, or the policy sees phantom
             # rounds for the members that finished before the fault
             info_snapshot = {i: len(info[i]) for i in unit_ids}
-            try:
-                return fn(first_arg, n_calls)
-            except Exception:
+
+            def rollback(exc, attempt):
                 with self._fit_failures_lock:
                     self._fit_failures += len(unit_ids)
                 for i in unit_ids:
                     models[i] = snapshot[i]
                     del info[i][info_snapshot[i]:]
-                if lockstep:
-                    raise
-                logger.warning(
-                    "training unit %s failed; retrying once from "
-                    "round-start state", unit_ids, exc_info=True,
-                )
-                return fn(first_arg, n_calls)
+
+            return _retry(
+                fn, first_arg, n_calls,
+                retries=0 if lockstep else 1,
+                backoff=0.0, jitter=0.0,
+                tag="search-unit", on_error=rollback,
+            )
 
         async def run_round(instructions):
             """Fan this round's training units over the shared thread pool

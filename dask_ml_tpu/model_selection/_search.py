@@ -271,6 +271,20 @@ def _resolve_n_jobs(n_jobs) -> int:
     return int(n_jobs)
 
 
+def _uses_device_estimator(est) -> bool:
+    """Does fitting ``est`` dispatch device programs — a TPUEstimator
+    anywhere in it, including pipeline steps?"""
+    if isinstance(est, TPUEstimator):
+        return True
+    steps = getattr(est, "steps", None)
+    if steps is not None:
+        return any(
+            _uses_device_estimator(step) for _, step in steps
+            if step is not None and step != "passthrough"
+        )
+    return False
+
+
 class _BaseSearchCV(TPUEstimator):
     def __init__(self, estimator, scoring=None, cv=None, refit=True,
                  error_score="raise", return_train_score=False,
@@ -542,6 +556,25 @@ class _BaseSearchCV(TPUEstimator):
                   for ci in range(n_cand)]
         )
         n_workers = min(_resolve_n_jobs(self.n_jobs), max(len(tasks), 1))
+        if n_workers > 1 and (
+            _uses_device_estimator(self.estimator)
+            # a grid may SUBSTITUTE a device estimator via set_params
+            # (e.g. {'clf': [LogisticRegression()]}): scan candidate
+            # param values too, or the guard below is bypassed
+            or any(
+                _uses_device_estimator(v)
+                for params in candidates for v in params.values()
+            )
+        ):
+            # collective-safety: a library estimator's fit dispatches
+            # multi-device programs (sharded solves, psum reductions) on
+            # the one shared mesh, and two threads submitting such
+            # programs concurrently can interleave enqueue order across
+            # devices and deadlock the runtime — the intra-process
+            # analogue of the multi-controller boundary contract
+            # (resilience.preemption).  A device fit already occupies
+            # every device, so threads buy no speedup here: serialize.
+            n_workers = 1
         if n_workers <= 1:
             for ci, fi in tasks:
                 run_task(ci, fi)

@@ -317,10 +317,25 @@ class OrdinalEncoder(TransformerMixin, TPUEstimator):
     def get_feature_names_out(self, input_features=None):
         """One-to-one transform: output names are the input names
         (sklearn ``OrdinalEncoder`` contract; frame fits use the fitted
-        columns)."""
+        columns).  ``input_features``, when given, is VALIDATED against
+        the fitted surface — a frame fit requires the fitted column names
+        verbatim, an array fit the fitted feature count — matching
+        sklearn's ``_check_feature_names_in`` instead of silently
+        echoing a mismatched list back."""
         if getattr(self, "_frame_input_", False):
-            return np.asarray(list(self.columns_), dtype=object)
+            cols = list(self.columns_)
+            if input_features is not None and list(input_features) != cols:
+                raise ValueError(
+                    f"input_features {list(input_features)!r} do not match "
+                    f"the columns seen at fit {cols!r}"
+                )
+            return np.asarray(cols, dtype=object)
         if input_features is not None:
+            if len(input_features) != self.n_features_in_:
+                raise ValueError(
+                    f"input_features has {len(input_features)} names; the "
+                    f"encoder was fit on {self.n_features_in_} features"
+                )
             return np.asarray(list(input_features), dtype=object)
         return np.asarray(
             [f"x{j}" for j in range(self.n_features_in_)], dtype=object

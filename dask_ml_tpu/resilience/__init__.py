@@ -1,0 +1,72 @@
+"""Fault-tolerant training runtime.
+
+The reference inherits resilience from dask.distributed (lineage
+recompute, worker-death resubmission — SURVEY.md §5); the TPU-native
+runtime replaced that scheduler with SPMD collectives, so the resilience
+story lives here as first-class layers:
+
+* :mod:`.retry` — transient-fault primitives: :func:`retry` with
+  exponential backoff + jitter, :class:`Deadline`, and observable
+  :class:`FaultStats` (surfaced via ``dask_ml_tpu.diagnostics``).
+* :mod:`.fit_checkpoint` — :class:`FitCheckpoint`, the in-fit snapshot
+  policy iterative estimators accept as a constructor param; restart-
+  from-snapshot for every long fit, across mesh shapes.
+* :mod:`.preemption` — SIGTERM/SIGINT → flag → collective-safe stop at
+  the next iteration boundary with a final snapshot
+  (:class:`PreemptionWatcher`, :class:`TrainingPreempted`).
+* :mod:`.testing` — the pluggable fault-injection harness
+  (:class:`FaultPlan`, :func:`maybe_fault`) wired through ingest, step,
+  checkpoint-write, and collective layers.
+
+NOTE on import order: the injection sites inside ``checkpoint`` and
+``core.sharded`` import :mod:`.testing` lazily (function level) — an
+eager import there would close a cycle back through
+``fit_checkpoint`` → ``checkpoint`` → ``core.sharded``.
+"""
+
+from .fit_checkpoint import FitCheckpoint, fit_fingerprint
+from .preemption import (
+    PreemptionWatcher,
+    TrainingPreempted,
+    active_watcher,
+    check_preemption,
+    preemption_requested,
+)
+from .testing import (
+    FaultInjected,
+    FaultPlan,
+    active_plan,
+    fault_plan,
+    maybe_fault,
+)
+
+# last, so the package attribute `retry` is the FUNCTION, not the module
+from .retry import (  # noqa: E402
+    Deadline,
+    DeadlineExceeded,
+    FaultStats,
+    fault_stats,
+    reset_fault_stats,
+    retry,
+)
+
+__all__ = [
+    "Deadline",
+    "DeadlineExceeded",
+    "FaultInjected",
+    "FaultPlan",
+    "FaultStats",
+    "FitCheckpoint",
+    "PreemptionWatcher",
+    "TrainingPreempted",
+    "active_plan",
+    "active_watcher",
+    "check_preemption",
+    "fault_plan",
+    "fault_stats",
+    "fit_fingerprint",
+    "maybe_fault",
+    "preemption_requested",
+    "reset_fault_stats",
+    "retry",
+]

@@ -1,0 +1,194 @@
+"""In-fit checkpointing: preemption-safe snapshots of iterative fits.
+
+``checkpoint.SearchCheckpoint`` gave the adaptive searches round-granular
+restart; this module extends the same story to EVERY long iterative fit —
+KMeans Lloyd loops, SGD epochs, GLM solver segments, IncrementalPCA
+sweeps.  A :class:`FitCheckpoint` is passed as an estimator constructor
+parameter (``KMeans(..., fit_checkpoint=FitCheckpoint(path,
+every_n_iters=20))``); the estimator snapshots its loop state atomically
+at round boundaries and a subsequent ``fit`` with the same configuration
+resumes from the last snapshot instead of starting over.
+
+Snapshots ride the ``checkpoint`` module's host-conversion machinery
+(``_to_host`` / ``_from_host`` / ``_atomic_pickle``): device arrays pull
+to host numpy, ``ShardedRows`` become re-shard markers, and namedtuple
+solver-state pytrees rebuild as their original types — so a snapshot
+written on one mesh shape restores onto another (the ``_ShardedMarker``
+re-shard path), and a crash mid-write can never corrupt the previous
+snapshot (tmp + atomic rename).
+
+A ``fingerprint`` of the estimator's configuration is stored with every
+snapshot and checked on load: resuming a DIFFERENTLY-configured fit from a
+stale snapshot would silently train the wrong model, so a mismatch is
+ignored (the foreign snapshot is left on disk) and the fit starts fresh.
+Data identity is deliberately NOT fingerprinted — resuming against
+different data is the caller's contract, exactly as for
+``SearchCheckpoint``.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import time
+
+from ..checkpoint import _atomic_pickle, _from_host, _param_repr, _to_host
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["FitCheckpoint", "fit_fingerprint"]
+
+_FORMAT_VERSION = 1
+
+#: constructor params that never shape the trajectory being resumed
+_FINGERPRINT_EXCLUDE = ("fit_checkpoint", "checkpoint", "verbose")
+
+
+def fit_fingerprint(estimator) -> str:
+    """Stable identity of an estimator's fit-relevant configuration
+    (class + every constructor param except the checkpoint/verbosity
+    plumbing).  Mirrors ``checkpoint.search_fingerprint``."""
+    import hashlib
+
+    payload = repr((
+        type(estimator).__qualname__,
+        sorted(
+            (k, _param_repr(v))
+            for k, v in estimator.get_params(deep=False).items()
+            if k not in _FINGERPRINT_EXCLUDE
+        ),
+    ))
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+class FitCheckpoint:
+    """Mid-fit snapshot policy + store for ONE estimator's fit loop.
+
+    Args:
+      path: snapshot file (one pickle, overwritten atomically).
+      every_n_iters: snapshot cadence in loop iterations.  For fused
+        device loops (KMeans Lloyd, GLM solvers) this is also the CHUNK
+        size: the single ``lax.while_loop`` dispatch becomes segments of
+        this many iterations with a host boundary between them — the
+        trajectory is unchanged (same compiled step program), but each
+        boundary costs one dispatch + one scalar sync, so pick a cadence
+        that amortizes it (tens of iterations, not 1).
+      every_s: wall-clock cadence; snapshots happen at the first loop
+        boundary after this many seconds since the last save.  May be
+        combined with ``every_n_iters`` (whichever fires first).
+      keep_on_complete: keep the final snapshot when the fit finishes
+        (default removes it so a later re-fit starts fresh).
+
+    With neither cadence given, ``every_n_iters`` defaults to 1 (snapshot
+    every boundary — the maximally safe, maximally chatty schedule).
+    """
+
+    def __init__(self, path: str, every_n_iters: int | None = None,
+                 every_s: float | None = None,
+                 keep_on_complete: bool = False):
+        if every_n_iters is not None and int(every_n_iters) < 1:
+            raise ValueError(
+                f"every_n_iters must be >= 1, got {every_n_iters}"
+            )
+        if every_s is not None and not float(every_s) > 0:
+            raise ValueError(f"every_s must be > 0, got {every_s}")
+        if every_n_iters is None and every_s is None:
+            every_n_iters = 1
+        self.path = str(path)
+        self.every_n_iters = None if every_n_iters is None else int(every_n_iters)
+        self.every_s = None if every_s is None else float(every_s)
+        self.keep_on_complete = bool(keep_on_complete)
+        # anchor the wall-clock cadence NOW: the first every_s snapshot
+        # lands ~every_s after construction, not at the first boundary
+        self._last_save_t: float | None = time.monotonic()
+        self._last_save_iter: int | None = None
+
+    # -- policy --------------------------------------------------------
+    def chunk_iters(self, default: int) -> int:
+        """Iteration chunk size for fused-loop estimators (``default``
+        when the cadence is purely time-based)."""
+        return self.every_n_iters if self.every_n_iters else int(default)
+
+    def due(self, iteration: int) -> bool:
+        """Should a boundary at ``iteration`` (1-based count of completed
+        iterations) snapshot?"""
+        if self.every_n_iters and iteration % self.every_n_iters == 0:
+            return True
+        if self.every_s is not None:
+            now = time.monotonic()
+            anchor = self._last_save_t
+            if anchor is None or now - anchor >= self.every_s:
+                return True
+        return False
+
+    # -- store ---------------------------------------------------------
+    def exists(self) -> bool:
+        import os
+
+        return os.path.exists(self.path)
+
+    def save(self, estimator, state: dict, iteration: int) -> None:
+        """Atomically snapshot ``state`` (a pytree of loop variables —
+        device arrays, ShardedRows, namedtuples all fine) at a completed
+        ``iteration`` count."""
+        _atomic_pickle(
+            {
+                "format": _FORMAT_VERSION,
+                "fingerprint": fit_fingerprint(estimator),
+                "iteration": int(iteration),
+                "state": _to_host(state),
+            },
+            self.path,
+        )
+        self._last_save_t = time.monotonic()
+        self._last_save_iter = int(iteration)
+
+    def load_if_matches(self, estimator):
+        """``(iteration, state)`` from the snapshot, or ``None`` if absent
+        or written by a differently-configured fit (the foreign snapshot
+        is left on disk — see module docstring)."""
+        if not self.exists():
+            return None
+        with open(self.path, "rb") as f:
+            snap = pickle.load(f)
+        if snap.get("format", 0) > _FORMAT_VERSION:  # pragma: no cover
+            raise ValueError(
+                f"fit checkpoint format {snap['format']} is newer than "
+                f"{_FORMAT_VERSION}"
+            )
+        if snap.get("fingerprint") != fit_fingerprint(estimator):
+            logger.warning(
+                "fit checkpoint %s belongs to a differently-configured "
+                "fit; ignoring it and starting fresh", self.path,
+            )
+            return None
+        logger.info(
+            "resuming fit from %s at iteration %d", self.path,
+            snap["iteration"],
+        )
+        # re-anchor the wall-clock cadence at the resume point; the
+        # on-disk snapshot IS the save at this iteration count
+        self._last_save_t = time.monotonic()
+        self._last_save_iter = int(snap["iteration"])
+        return snap["iteration"], _from_host(snap["state"])
+
+    def complete(self) -> None:
+        """Remove the snapshot of a finished fit (kept with
+        ``keep_on_complete=True``)."""
+        import os
+
+        if self.keep_on_complete:
+            return
+        if self.exists():
+            os.unlink(self.path)
+        # the store is empty again: a later preemption at the same
+        # iteration count must write a fresh snapshot, not skip it
+        self._last_save_iter = None
+
+    def __repr__(self):
+        cad = []
+        if self.every_n_iters:
+            cad.append(f"every_n_iters={self.every_n_iters}")
+        if self.every_s is not None:
+            cad.append(f"every_s={self.every_s:g}")
+        return f"FitCheckpoint({self.path!r}, {', '.join(cad)})"

@@ -1,0 +1,186 @@
+"""Preemption handling: SIGTERM/SIGINT → clean checkpoint-and-stop.
+
+TPU pods are preemptible: maintenance events and spot reclamation deliver
+SIGTERM with a grace window.  The reference outlives worker death through
+the scheduler (lineage recompute); the SPMD-runtime analogue is a watcher
+that flips a flag in the signal handler and lets every fit loop check it
+at round/iteration boundaries — the only safe place to stop a collective
+program — write a final :class:`..fit_checkpoint.FitCheckpoint` snapshot,
+and raise :class:`TrainingPreempted` so the caller exits cleanly and a
+restarted process resumes from the snapshot.
+
+Multi-controller contract: on a multi-process fleet EVERY process must
+observe the SAME stopping boundary — one process exiting its loop while
+its peers dispatch the next collective deadlocks the fleet.  So the
+boundary check is itself a tiny collective: each process contributes its
+local flag and the fleet stops iff ANY process saw the signal (a psum of
+the flag, via ``multihost_utils.process_allgather``).  The collective only
+runs while a watcher is installed — uninstrumented fits pay a single
+``is None`` check.  Exercised cross-process by
+``core/_multihost_worker.py`` (flagship 6).
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "PreemptionWatcher",
+    "TrainingPreempted",
+    "active_watcher",
+    "check_preemption",
+    "preemption_requested",
+]
+
+
+class TrainingPreempted(RuntimeError):
+    """A fit stopped at a round boundary because preemption was requested.
+
+    ``iteration`` is the completed-iteration count at the stop;
+    ``checkpoint_path`` names the final snapshot (None when the fit had no
+    :class:`FitCheckpoint` configured — state is lost, but the stop is
+    still clean and collective-safe).
+    """
+
+    def __init__(self, iteration: int, checkpoint_path: str | None = None):
+        self.iteration = int(iteration)
+        self.checkpoint_path = checkpoint_path
+        where = f"; snapshot at {checkpoint_path}" if checkpoint_path else ""
+        super().__init__(
+            f"training preempted at iteration {iteration}{where}"
+        )
+
+
+_WATCHER: "PreemptionWatcher | None" = None
+_WATCHER_LOCK = threading.Lock()
+
+
+class PreemptionWatcher:
+    """Installable SIGTERM/SIGINT watcher.
+
+    The handler only sets a flag (handlers must be async-signal-safe and
+    must not raise into arbitrary frames mid-collective); fit loops poll
+    the flag at boundaries via :func:`check_preemption`.  A SECOND signal
+    of the same kind restores the original handler and re-delivers —
+    an operator pressing Ctrl-C twice still gets an immediate
+    KeyboardInterrupt.
+
+    Usable as a context manager::
+
+        with PreemptionWatcher():
+            est.fit(X)   # SIGTERM → snapshot + TrainingPreempted
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = tuple(signals)
+        self._requested = threading.Event()
+        self._prev: dict = {}
+        self._installed = False
+
+    # -- lifecycle -----------------------------------------------------
+    def install(self) -> "PreemptionWatcher":
+        global _WATCHER
+        with _WATCHER_LOCK:
+            if _WATCHER is not None and _WATCHER is not self:
+                raise RuntimeError(
+                    "another PreemptionWatcher is already installed"
+                )
+            for s in self.signals:
+                self._prev[s] = signal.signal(s, self._handler)
+            self._installed = True
+            _WATCHER = self
+        return self
+
+    def uninstall(self) -> None:
+        global _WATCHER
+        with _WATCHER_LOCK:
+            for s, prev in self._prev.items():
+                signal.signal(s, prev)
+            self._prev.clear()
+            self._installed = False
+            if _WATCHER is self:
+                _WATCHER = None
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- signal path ---------------------------------------------------
+    def _handler(self, signum, frame):
+        if self._requested.is_set():
+            # second signal: the operator insists — restore the original
+            # disposition and re-deliver immediately
+            prev = self._prev.get(signum, signal.SIG_DFL)
+            signal.signal(signum, prev)
+            signal.raise_signal(signum)
+            return
+        self._requested.set()
+        logger.warning(
+            "received signal %d: will checkpoint and stop at the next "
+            "iteration boundary", signum,
+        )
+
+    def trigger(self) -> None:
+        """Set the flag programmatically (tests; cloud preemption notices
+        that arrive over HTTP instead of a signal)."""
+        self._requested.set()
+
+    @property
+    def requested(self) -> bool:
+        return self._requested.is_set()
+
+
+def active_watcher() -> PreemptionWatcher | None:
+    return _WATCHER
+
+
+def preemption_requested(sync: bool = True) -> bool:
+    """Has any process of the group requested preemption?
+
+    Fast path: no watcher installed → False with zero device traffic.
+    Single process: the local flag.  Multi-process with ``sync=True``:
+    the tiny flag collective described in the module docstring, so every
+    process returns the SAME answer at the same boundary.
+    """
+    w = _WATCHER
+    if w is None:
+        return False
+    local = w.requested
+    try:
+        import jax
+
+        multiproc = jax.process_count() > 1
+    except Exception:  # pragma: no cover - jax always importable in-repo
+        multiproc = False
+    if not (multiproc and sync):
+        return local
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray([1.0 if local else 0.0], np.float32)
+    )
+    return bool(np.sum(flags) > 0)
+
+
+def check_preemption(ckpt, estimator, state: dict, iteration: int) -> None:
+    """Round-boundary check used by every instrumented fit loop: when the
+    fleet agrees preemption was requested, write a final snapshot (if a
+    :class:`FitCheckpoint` is configured) and stop loudly."""
+    if not preemption_requested():
+        return
+    path = None
+    if ckpt is not None:
+        # the caller's due() branch may have just snapshotted this very
+        # boundary — don't host-pull and rewrite identical state
+        if getattr(ckpt, "_last_save_iter", None) != int(iteration):
+            ckpt.save(estimator, state, iteration)
+        path = ckpt.path
+    raise TrainingPreempted(iteration, path)

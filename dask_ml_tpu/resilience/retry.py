@@ -1,0 +1,230 @@
+"""Transient-fault primitives: retry with exponential backoff, deadlines,
+and observable fault accounting.
+
+The reference inherits its entire transient-fault story from
+dask.distributed — a task lost to a dead worker is resubmitted by the
+scheduler and lineage recomputes its inputs (SURVEY.md §5).  The TPU-native
+runtime replaced that scheduler with SPMD collectives, so the retry layer
+must live in-repo as first-class primitives instead of being re-implemented
+inline per subsystem:
+
+* :func:`retry` — call a function with exponential backoff + jitter,
+  a narrowable ``retryable`` exception filter, an optional ``deadline``,
+  and an ``on_error`` hook for callers whose units need state rollback
+  between attempts (the adaptive-search ``run_unit`` uses it).
+* :class:`Deadline` — a wall-clock budget that both caps backoff sleeps
+  and converts "still failing at T" into a loud :class:`DeadlineExceeded`.
+* :class:`FaultStats` — thread-safe counters (faults seen, retries
+  scheduled, failures propagated) keyed by tag, surfaced through
+  ``dask_ml_tpu.diagnostics`` so recovery is observable, never silent.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from collections import Counter
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "Deadline",
+    "DeadlineExceeded",
+    "FaultStats",
+    "fault_stats",
+    "reset_fault_stats",
+    "retry",
+]
+
+
+class DeadlineExceeded(TimeoutError):
+    """A :class:`Deadline` expired before the wrapped work finished."""
+
+
+class Deadline:
+    """Wall-clock budget for a unit of work.
+
+    ``Deadline(30).check()`` raises :class:`DeadlineExceeded` once 30
+    seconds have elapsed since construction; :func:`retry` also compares
+    its backoff sleeps against ``remaining()`` so a retry loop can never
+    sleep through its own budget.
+    """
+
+    def __init__(self, seconds: float):
+        if not seconds > 0:
+            raise ValueError(f"deadline must be positive, got {seconds!r}")
+        self.seconds = float(seconds)
+        self._t0 = time.monotonic()
+
+    def remaining(self) -> float:
+        return self.seconds - (time.monotonic() - self._t0)
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self, what: str = "work") -> None:
+        if self.expired():
+            raise DeadlineExceeded(
+                f"{what} exceeded its {self.seconds:g}s deadline"
+            )
+
+    def __repr__(self):
+        return f"Deadline({self.seconds:g}s, {self.remaining():.3g}s left)"
+
+
+class FaultStats:
+    """Thread-safe fault accounting, keyed by caller-chosen tags.
+
+    Three monotone counters per tag:
+
+    * ``faults`` — every retryable exception observed (absorbed or not);
+    * ``retries`` — re-attempts actually scheduled;
+    * ``failures`` — faults that propagated (budget exhausted or
+      non-retryable), i.e. the loud ones.
+
+    ``faults == retries + failures`` holds per tag for :func:`retry`
+    traffic, which is the invariant tests assert against.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.faults: Counter = Counter()
+        self.retries: Counter = Counter()
+        self.failures: Counter = Counter()
+
+    def record_fault(self, tag: str) -> None:
+        with self._lock:
+            self.faults[tag] += 1
+
+    def record_retry(self, tag: str) -> None:
+        with self._lock:
+            self.retries[tag] += 1
+
+    def record_failure(self, tag: str) -> None:
+        with self._lock:
+            self.failures[tag] += 1
+
+    def snapshot(self) -> dict:
+        """Plain-dict copy (stable for logging / assertions)."""
+        with self._lock:
+            return {
+                "faults": dict(self.faults),
+                "retries": dict(self.retries),
+                "failures": dict(self.failures),
+            }
+
+    def total(self, kind: str = "faults") -> int:
+        with self._lock:
+            return sum(getattr(self, kind).values())
+
+    def reset(self) -> None:
+        with self._lock:
+            self.faults.clear()
+            self.retries.clear()
+            self.failures.clear()
+
+    def __repr__(self):
+        s = self.snapshot()
+        return (f"FaultStats(faults={s['faults']}, retries={s['retries']}, "
+                f"failures={s['failures']})")
+
+
+# The process-global stats object: every in-repo retry site records here
+# (callers may pass their own FaultStats to keep private books instead).
+_GLOBAL_STATS = FaultStats()
+
+
+def fault_stats() -> FaultStats:
+    """The process-global :class:`FaultStats` (re-exported by
+    ``dask_ml_tpu.diagnostics``)."""
+    return _GLOBAL_STATS
+
+
+def reset_fault_stats() -> None:
+    _GLOBAL_STATS.reset()
+
+
+def retry(fn, *args, retries: int = 3, backoff: float = 0.1,
+          factor: float = 2.0, max_backoff: float = 30.0,
+          jitter: float = 0.1, retryable=(Exception,), deadline=None,
+          stats: FaultStats | None = None, tag: str = "retry",
+          on_error=None, sleep=time.sleep, **kwargs):
+    """Call ``fn(*args, **kwargs)``, retrying transient faults.
+
+    Backoff before attempt ``k`` (0-based) is
+    ``min(backoff * factor**k, max_backoff) * (1 + jitter * U[0,1))`` —
+    exponential with multiplicative jitter so a fleet of callers hitting
+    the same flaky dependency doesn't resynchronize into a thundering
+    herd.
+
+    Args:
+      retries: maximum number of RE-attempts (0 = single attempt; the
+        fault is still recorded before propagating).
+      retryable: exception class/tuple that qualifies for retry; anything
+        else propagates immediately (and is NOT counted — it is a bug,
+        not a fault).
+      deadline: optional :class:`Deadline` (or seconds) bounding the whole
+        loop: an expired deadline stops retrying even with budget left,
+        and a backoff that would outlive the deadline propagates the
+        fault immediately instead of sleeping into a dead budget.
+      stats: a :class:`FaultStats` to record into (defaults to the global
+        one via :func:`fault_stats`); pass ``tag`` to separate books.
+      on_error: ``on_error(exc, attempt)`` called on every caught
+        retryable fault BEFORE the retry decision — the hook for callers
+        that must roll state back between attempts (exact-state recovery;
+        see ``model_selection._incremental.run_unit``).
+      sleep: injection point for tests (defaults to ``time.sleep``).
+
+    Returns ``fn``'s result; raises the last fault when the budget is
+    exhausted, the deadline expires, or the fault is persistent.
+    """
+    if retries < 0:
+        raise ValueError(f"retries must be >= 0, got {retries}")
+    if isinstance(deadline, (int, float)):
+        deadline = Deadline(deadline)
+    if stats is None:
+        stats = _GLOBAL_STATS
+    attempt = 0
+    while True:
+        if deadline is not None:
+            deadline.check(tag)
+        try:
+            return fn(*args, **kwargs)
+        except DeadlineExceeded:
+            # a deadline blown INSIDE fn is a budget exhaustion, not a
+            # transient fault — never absorbed, even with Exception in
+            # retryable.  Still counted as a fault so the books keep
+            # faults == retries + failures.
+            stats.record_fault(tag)
+            stats.record_failure(tag)
+            raise
+        except retryable as exc:
+            stats.record_fault(tag)
+            if on_error is not None:
+                on_error(exc, attempt)
+            out_of_budget = attempt >= retries or (
+                deadline is not None and deadline.expired()
+            )
+            if out_of_budget:
+                stats.record_failure(tag)
+                raise
+            delay = min(backoff * (factor ** attempt), max_backoff)
+            delay *= 1.0 + jitter * random.random()
+            if deadline is not None and delay >= deadline.remaining():
+                # the deadline dies before the retry could run: this fault
+                # is terminal — propagate NOW instead of sleeping into a
+                # dead budget (and keep the books exact: every fault is
+                # either a retry or a failure, never both, never neither)
+                stats.record_failure(tag)
+                raise
+            stats.record_retry(tag)
+            logger.warning(
+                "%s: attempt %d/%d failed (%s: %s); retrying in %.3gs",
+                tag, attempt + 1, retries + 1, type(exc).__name__, exc,
+                delay,
+            )
+            if delay > 0:
+                sleep(delay)
+            attempt += 1

@@ -1,0 +1,184 @@
+"""Pluggable fault-injection harness.
+
+The reference's resilience tests kill distributed workers mid-search
+(SURVEY.md §5); the in-process analogue used ad-hoc class-level call
+counters on fake estimators (the old ``tests/test_fault_injection.py``
+pattern).  This module replaces that with a declarative registry: a
+:class:`FaultPlan` schedules faults (and side-effect probes) against named
+**injection points** wired through the runtime —
+
+* ``"ingest"`` — the io layer (``io.read_csv`` / ``stream_csv_blocks``),
+  fired inside the retried unit so :func:`..retry.retry` semantics are
+  exercised end-to-end;
+* ``"step"`` — iterative fit loops, fired once per round/epoch/chunk
+  boundary (KMeans Lloyd chunks, SGD epochs, GLM solver segments,
+  IncrementalPCA batches);
+* ``"checkpoint-write"`` — inside ``checkpoint._atomic_pickle`` AFTER the
+  tmp file is written but BEFORE the atomic rename, i.e. exactly the
+  crash-mid-write window the atomicity contract protects against;
+* ``"collective"`` — the sharding boundary (``core.sharded.shard_rows`` /
+  ``unshard``), the in-process stand-in for an ICI/DCN transport fault.
+
+Hot paths pay one global ``is None`` check when no plan is active.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from collections import Counter
+from dataclasses import dataclass, field
+
+__all__ = [
+    "FaultInjected",
+    "FaultPlan",
+    "active_plan",
+    "fault_plan",
+    "maybe_fault",
+]
+
+#: The canonical injection points wired through the runtime (plans may
+#: use additional caller-private point names freely).
+INJECTION_POINTS = ("ingest", "step", "checkpoint-write", "collective")
+
+
+class FaultInjected(RuntimeError):
+    """The default exception raised at a scheduled injection."""
+
+
+@dataclass
+class _Rule:
+    point: str
+    at_calls: frozenset | None  # 1-based call numbers; None = every call
+    times: int | None           # max firings; None = unlimited
+    exc_factory: object
+    fired: int = 0
+
+    def should_fire(self, call_no: int) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        return self.at_calls is None or call_no in self.at_calls
+
+
+@dataclass
+class _Probe:
+    point: str
+    at_calls: frozenset | None
+    fn: object
+    fired: int = 0
+
+
+@dataclass
+class FaultPlan:
+    """A declarative schedule of faults keyed by injection point.
+
+    ``calls`` counts every arrival at each point (fault or not) and
+    ``fired`` every injection actually raised — the observability the old
+    class-level counters provided, now in one place for any estimator.
+    """
+
+    _rules: list = field(default_factory=list)
+    _probes: list = field(default_factory=list)
+    calls: Counter = field(default_factory=Counter)
+    fired: Counter = field(default_factory=Counter)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def inject(self, point: str, *, at_call=None, times: int | None = 1,
+               exc=FaultInjected):
+        """Schedule a fault at ``point``.
+
+        Args:
+          at_call: 1-based call number(s) at which to fire (int or
+            iterable); ``None`` fires on EVERY call — combined with
+            ``times=None`` that is a persistent fault.
+          times: maximum number of firings (``None`` = unlimited).
+          exc: exception instance, class, or zero-arg factory.
+        """
+        if at_call is not None and not hasattr(at_call, "__iter__"):
+            at_call = (at_call,)
+        self._rules.append(_Rule(
+            point=point,
+            at_calls=None if at_call is None else frozenset(int(c) for c in at_call),
+            times=times,
+            exc_factory=exc,
+        ))
+        return self
+
+    def persistent(self, point: str, exc=FaultInjected):
+        """Every call at ``point`` faults — the persistent-fault schedule."""
+        return self.inject(point, at_call=None, times=None, exc=exc)
+
+    def on_call(self, point: str, fn, *, at_call=None):
+        """Run ``fn()`` (a side effect, e.g. triggering the preemption
+        watcher) when ``point`` is reached — without raising."""
+        if at_call is not None and not hasattr(at_call, "__iter__"):
+            at_call = (at_call,)
+        self._probes.append(_Probe(
+            point=point,
+            at_calls=None if at_call is None else frozenset(int(c) for c in at_call),
+            fn=fn,
+        ))
+        return self
+
+    def fire(self, point: str) -> None:
+        """Called by an injection site: count the arrival, run probes,
+        raise if a rule is scheduled for this call."""
+        with self._lock:
+            self.calls[point] += 1
+            n = self.calls[point]
+            to_run = [
+                p for p in self._probes
+                if p.point == point and (p.at_calls is None or n in p.at_calls)
+            ]
+            for p in to_run:
+                # count selections under the lock (concurrent sites would
+                # lose updates); fn itself runs outside so a probe may
+                # re-enter maybe_fault without deadlocking
+                p.fired += 1
+            to_raise = None
+            for r in self._rules:
+                if r.point == point and r.should_fire(n):
+                    r.fired += 1
+                    self.fired[point] += 1
+                    to_raise = r.exc_factory
+                    break
+        for p in to_run:
+            p.fn()
+        if to_raise is not None:
+            if isinstance(to_raise, BaseException):
+                raise to_raise
+            exc = to_raise() if callable(to_raise) else to_raise
+            if isinstance(exc, FaultInjected) and not exc.args:
+                exc = FaultInjected(f"injected fault at {point!r}")
+            raise exc
+
+
+_ACTIVE: FaultPlan | None = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active_plan() -> FaultPlan | None:
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def fault_plan(plan: FaultPlan | None = None):
+    """Install ``plan`` (or a fresh one) as the process-active fault plan
+    for the duration of the block; yields it."""
+    global _ACTIVE
+    plan = plan if plan is not None else FaultPlan()
+    with _ACTIVE_LOCK:
+        prev, _ACTIVE = _ACTIVE, plan
+    try:
+        yield plan
+    finally:
+        with _ACTIVE_LOCK:
+            _ACTIVE = prev
+
+
+def maybe_fault(point: str) -> None:
+    """Injection-site entry: a no-op (one global load + None check) unless
+    a plan is active."""
+    plan = _ACTIVE
+    if plan is not None:
+        plan.fire(point)

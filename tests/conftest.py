@@ -38,7 +38,13 @@ import jax  # noqa: E402
 
 if not _USE_TPU:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", _N_DEV)
+    try:
+        jax.config.update("jax_num_cpu_devices", _N_DEV)
+    except AttributeError:
+        # older jax (< 0.4.38) has no jax_num_cpu_devices option; the
+        # XLA_FLAGS host-platform count set above covers it as long as
+        # jax hasn't created its backends yet
+        pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -59,6 +65,13 @@ def require_devices_divisible(k: int) -> int:
     if n % k:
         pytest.skip(f"needs a device count divisible by {k} (have {n})")
     return n
+
+
+def spec_axis(entry):
+    """Unwrap one PartitionSpec entry to its axis name: jax versions
+    differ on whether a propagated entry is the name or a 1-tuple of it
+    (jax < 0.4.38 tuple-wraps)."""
+    return entry[0] if isinstance(entry, tuple) else entry
 
 
 @pytest.fixture(scope="session")

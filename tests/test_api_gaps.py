@@ -647,3 +647,59 @@ class TestRound5Slivers:
         assert invs.n_samples == 50
         with pytest.raises(ValueError, match="add_indicator"):
             SimpleImputer().fit(X).inverse_transform(t[:, :4])
+
+
+class TestRound5AdviceFixes:
+    """ISSUE 1 satellites: validation/regularization fixes flagged by the
+    round-5 advice review."""
+
+    def test_imputer_inverse_transform_rejects_wrong_width(self, rng):
+        from dask_ml_tpu.impute import SimpleImputer
+
+        X = rng.normal(size=(30, 4)).astype(np.float64)
+        X[rng.rand(*X.shape) < 0.3] = np.nan
+        imp = SimpleImputer(strategy="mean", add_indicator=True).fit(X)
+        t = np.asarray(imp.transform(X))
+        # truncated input used to be SILENTLY split at d columns; now the
+        # width must be exactly d + len(indicator_features_)
+        with pytest.raises(ValueError, match="columns"):
+            imp.inverse_transform(t[:, :-1])
+        with pytest.raises(ValueError, match="columns"):
+            imp.inverse_transform(np.hstack([t, t[:, :1]]))
+        # the exact width still round-trips
+        assert np.asarray(imp.inverse_transform(t)).shape == X.shape
+
+    def test_ordinal_encoder_feature_names_validates_input(self):
+        import pandas as pd
+
+        from dask_ml_tpu.preprocessing import OrdinalEncoder
+
+        Xc = np.array([["a", "x"], ["b", "y"], ["a", "y"]], dtype=object)
+        oe = OrdinalEncoder().fit(Xc)
+        with pytest.raises(ValueError, match="2 features"):
+            oe.get_feature_names_out(["only_one"])
+        df = pd.DataFrame({"c1": ["a", "b"], "c2": [1.0, 2.0]})
+        oe2 = OrdinalEncoder().fit(df)
+        # frame fit: the fitted column names verbatim, or an error
+        assert list(oe2.get_feature_names_out(["c1", "c2"])) == ["c1", "c2"]
+        with pytest.raises(ValueError, match="columns seen at fit"):
+            oe2.get_feature_names_out(["c2", "c1"])
+
+    def test_pca_get_precision_unjittered_when_well_posed(self, rng):
+        """The full-rank branch must report the PLAIN inverse when it is
+        finite — the 1e-12·trace jitter only rescues a singular
+        covariance (it used to be applied unconditionally)."""
+        from sklearn.decomposition import PCA as SkPCA
+
+        from dask_ml_tpu.decomposition import PCA
+
+        X = (rng.normal(size=(60, 4)) * np.linspace(2, 0.5, 4)).astype(
+            np.float64
+        )
+        ours = PCA(n_components=4).fit(X)  # k == d: full-rank branch
+        ref = SkPCA(n_components=4, svd_solver="full").fit(X)
+        scale = np.abs(ref.get_precision()).max()
+        np.testing.assert_allclose(
+            np.asarray(ours.get_precision()) / scale,
+            ref.get_precision() / scale, atol=1e-6,
+        )

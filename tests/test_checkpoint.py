@@ -279,8 +279,19 @@ from dask_ml_tpu.base import TPUEstimator
 
 _NTState = collections.namedtuple("_NTState", ["w", "n"])
 
+#: module-level (pickle-able) namedtuple solver state + carrier estimator
+#: for the mesh-shape-change roundtrip below
+_SolverNTState = collections.namedtuple("_SolverNTState", ["w", "step"])
+
 
 class _WithState(TPUEstimator):
+    def __init__(self):
+        pass
+
+
+class _SolverEst(TPUEstimator):
+    _checkpoint_private_attrs = ("_solver_state",)
+
     def __init__(self):
         pass
 
@@ -459,3 +470,74 @@ class TestCrashMatrix:
         res = self._crash_at(X, y, str(tmp_path / "hb_cc"), [1, 1])
         assert res.best_params_ == ref.best_params_
         assert res.best_score_ == ref.best_score_
+
+
+class TestSearchCheckpointEdgeCases:
+    """ISSUE 1 satellite: the SearchCheckpoint corners the crash matrix
+    above doesn't isolate — the atomic-write window itself, foreign-
+    snapshot preservation, and namedtuple state across a MESH change."""
+
+    def test_crash_mid_atomic_write_keeps_previous_snapshot(self, tmp_path):
+        """The checkpoint-write injection point fires BETWEEN the tmp
+        write and the atomic rename: the previous snapshot must survive
+        byte-identical and the tmp file must not leak."""
+        from dask_ml_tpu.resilience import FaultInjected, fault_plan
+
+        path = tmp_path / "s.pkl"
+        ck = SearchCheckpoint(str(path), fingerprint="fp")
+        ck.save({"m": 1}, {"i": [1]}, {"round": 1}, elapsed=1.0)
+        first = path.read_bytes()
+
+        with fault_plan() as plan:
+            plan.inject("checkpoint-write", at_call=1)
+            with pytest.raises(FaultInjected):
+                ck.save({"m": 2}, {"i": [1, 2]}, {"round": 2}, elapsed=2.0)
+
+        assert path.read_bytes() == first
+        _, _, policy, elapsed = ck.load_if_matches()
+        assert policy == {"round": 1} and elapsed == 1.0
+        assert [p.name for p in tmp_path.iterdir()] == ["s.pkl"]
+
+    def test_fingerprint_mismatch_keeps_foreign_snapshot_file(self, tmp_path):
+        """A mismatched fingerprint starts fresh but must NOT consume or
+        delete the foreign snapshot — it belongs to another search."""
+        path = tmp_path / "s.pkl"
+        SearchCheckpoint(str(path), fingerprint="theirs").save(
+            {"m": 1}, {}, {"round": 3}
+        )
+        raw = path.read_bytes()
+
+        ours = SearchCheckpoint(str(path), fingerprint="ours")
+        assert ours.load_if_matches() is None
+        assert path.read_bytes() == raw  # untouched on disk
+
+    def test_namedtuple_state_resharded_across_mesh_change(self, tmp_path,
+                                                           rng):
+        """An estimator checkpoint holding a namedtuple solver-state attr
+        with a ShardedRows leaf must round-trip onto a DIFFERENT mesh
+        shape: the namedtuple rebuilds field-wise and the _ShardedMarker
+        re-shards onto whatever mesh is active at load time."""
+        import jax
+
+        from dask_ml_tpu.core.mesh import device_mesh, use_mesh
+
+        State = _SolverNTState
+
+        n_dev = len(jax.devices())
+        if n_dev < 2 or n_dev % 2:
+            pytest.skip("needs an even device count >= 2 to halve the mesh")
+
+        arr = rng.normal(size=(48, 4)).astype(np.float32)
+        est = _SolverEst()
+        est._solver_state = State(w=shard_rows(arr), step=5)
+        est.coef_ = np.ones(4, np.float32)
+        save_estimator(est, str(tmp_path / "solver"))
+
+        half = device_mesh(n_dev // 2)
+        with use_mesh(half):
+            loaded = load_estimator(str(tmp_path / "solver"))
+            st = loaded._solver_state
+            assert isinstance(st, State) and st.step == 5
+            # re-sharded over the SMALLER mesh, values intact
+            assert len(st.w.data.sharding.device_set) == n_dev // 2
+            np.testing.assert_allclose(unshard(st.w), arr, rtol=1e-6)

@@ -276,10 +276,19 @@ class TestReviewRegressions:
         assert np.isfinite(km.inertia_)
 
     def test_kmeanspp_respects_random_state(self, blobs):
+        # assert on the INIT centers themselves: even one Lloyd round can
+        # snap two different seeds' inits onto the identical partition
+        # means on well-separated blobs, which is convergence working,
+        # not the seed being ignored
         X, _ = blobs
-        c1 = dc.KMeans(n_clusters=4, init="k-means++", random_state=1, max_iter=0 or 1).fit(X)
-        c2 = dc.KMeans(n_clusters=4, init="k-means++", random_state=2, max_iter=0 or 1).fit(X)
-        assert not np.allclose(np.asarray(c1.cluster_centers_), np.asarray(c2.cluster_centers_))
+        from dask_ml_tpu.cluster.k_means import _ingest_float
+        from dask_ml_tpu.core.prng import as_key
+
+        km = dc.KMeans(n_clusters=4, init="k-means++")
+        Xi = _ingest_float(km, X)
+        c1 = km._init_centers(Xi, as_key(1))
+        c2 = km._init_centers(Xi, as_key(2))
+        assert not np.allclose(np.asarray(c1), np.asarray(c2))
 
     def test_make_blobs_seed_changes_centers(self):
         X1, _ = datasets.make_blobs(n_samples=50, n_features=2, centers=3, random_state=1)

@@ -50,8 +50,9 @@ def test_shard_rows_pads_and_masks(n):
 def test_sharding_is_row_partitioned():
     x = np.ones((16, 4), dtype=np.float32)
     s = shard_rows(x)
-    spec = s.data.sharding.spec
-    assert spec[0] == DATA_AXIS
+    from conftest import spec_axis
+
+    assert spec_axis(s.data.sharding.spec[0]) == DATA_AXIS
 
 
 def test_masked_reductions_match_numpy():

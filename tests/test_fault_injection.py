@@ -5,72 +5,48 @@ are resubmitted and lineage recomputes their inputs; a handful of its tests
 kill workers mid-search (SURVEY.md §5 failure detection).  The analogue
 here is process-local: a training unit that raises is retried ONCE from a
 deep-copied round-start snapshot (exact-state recovery —
-``model_selection/_incremental.py :: run_unit``), persistent faults
-propagate, and round-granular checkpoints (tests/test_checkpoint.py) cover
-whole-process death.  These tests inject faults at the partial_fit level
-and assert recovery semantics, determinism, and failure accounting.
-"""
+``model_selection/_incremental.py :: run_unit``, riding the shared
+``resilience.retry`` primitive), persistent faults propagate, and
+round-granular checkpoints (tests/test_checkpoint.py) cover whole-process
+death.
 
-import threading
+Faults are scheduled DECLARATIVELY through ``resilience.testing``: the
+fake model's ``partial_fit`` is an injection SITE and a ``FaultPlan``
+owns the schedule — the plan's call counter coordinates across model
+clones and search threads, replacing the class-level counters these
+tests used to hand-roll per fake estimator.
+"""
 
 import numpy as np
 import pytest
 from sklearn.base import BaseEstimator
 
-from dask_ml_tpu.model_selection import IncrementalSearchCV, GridSearchCV
+from dask_ml_tpu.model_selection import GridSearchCV, IncrementalSearchCV
+from dask_ml_tpu.resilience import FaultInjected, FaultPlan, fault_plan, maybe_fault
+from dask_ml_tpu.resilience.retry import fault_stats, reset_fault_stats
+
+pytestmark = pytest.mark.faults
+
+#: the search-plane injection point (a caller-private point name; the
+#: canonical runtime points are ingest/step/checkpoint-write/collective)
+POINT = "search-step"
 
 
-class FlakyOnce(BaseEstimator):
-    """Linear-score fake model whose partial_fit raises once, globally
-    coordinated: call number ``fail_at`` (1-based, across ALL instances)
-    raises RuntimeError, every other call succeeds.  Deterministic score
-    keeps search results comparable across runs."""
+class PlanModel(BaseEstimator):
+    """Linear-score fake model whose ``partial_fit`` is an injection site:
+    the active :class:`FaultPlan` decides which (globally-numbered) call
+    faults.  Deterministic score keeps search results comparable."""
 
-    # class-level so all clones share the fault schedule
-    _calls = 0
-    _failed = False
-    _lock = threading.Lock()
-    fail_at = None
-
-    def __init__(self, slope=1.0, fail_marker=0):
+    def __init__(self, slope=1.0):
         self.slope = slope
-        self.fail_marker = fail_marker
-
-    @classmethod
-    def reset(cls, fail_at=None):
-        cls._calls = 0
-        cls._failed = False
-        cls.fail_at = fail_at
 
     def partial_fit(self, X, y, **kw):
-        cls = type(self)
-        with cls._lock:
-            cls._calls += 1
-            should_fail = (
-                cls.fail_at is not None
-                and cls._calls == cls.fail_at
-                and not cls._failed
-            )
-            if should_fail:
-                cls._failed = True
-        if should_fail:
-            raise RuntimeError("injected fault")
+        maybe_fault(POINT)
         self.n_calls_ = getattr(self, "n_calls_", 0) + 1
         return self
 
     def score(self, X, y):
         return self.slope * getattr(self, "n_calls_", 0)
-
-
-class AlwaysFails(BaseEstimator):
-    def __init__(self, dummy=0):
-        self.dummy = dummy
-
-    def partial_fit(self, X, y, **kw):
-        raise RuntimeError("persistent injected fault")
-
-    def score(self, X, y):  # pragma: no cover
-        return 0.0
 
 
 class FailingFit(BaseEstimator):
@@ -96,33 +72,47 @@ def xy(rng):
     return X, y
 
 
+@pytest.fixture(autouse=True)
+def _clean_fault_stats():
+    reset_fault_stats()
+    yield
+    reset_fault_stats()
+
+
 class TestIncrementalFaultRecovery:
     def _search(self, **kw):
         kw.setdefault("n_initial_parameters", 3)
         kw.setdefault("max_iter", 4)
         kw.setdefault("random_state", 0)
         return IncrementalSearchCV(
-            FlakyOnce(), {"slope": [1.0, 2.0, 3.0]}, **kw
+            PlanModel(), {"slope": [1.0, 2.0, 3.0]}, **kw
         )
 
     def test_transient_fault_recovers(self, xy):
         X, y = xy
-        FlakyOnce.reset(fail_at=5)
-        search = self._search().fit(X, y)
+        with fault_plan() as plan:
+            plan.inject(POINT, at_call=5)
+            search = self._search().fit(X, y)
+        assert plan.fired[POINT] == 1
         assert search.fit_failures_ == 1
         # the search still trained every model to budget and ranked them
         assert search.best_score_ == max(
             r["score"] for r in search.history_
         )
+        # the retry rode the shared primitive: observable in fault_stats
+        s = fault_stats().snapshot()
+        assert s["faults"].get("search-unit") == 1
+        assert s["retries"].get("search-unit") == 1
+        assert "search-unit" not in s["failures"]
 
     def test_recovery_is_exact_state(self, xy):
         """A retried unit restarts from its round-start snapshot, so the
         final fitted state matches an entirely fault-free run."""
         X, y = xy
-        FlakyOnce.reset(fail_at=None)
         clean = self._search().fit(X, y)
-        FlakyOnce.reset(fail_at=4)
-        faulty = self._search().fit(X, y)
+        with fault_plan() as plan:
+            plan.inject(POINT, at_call=4)
+            faulty = self._search().fit(X, y)
         assert faulty.fit_failures_ == 1
         assert clean.best_params_ == faulty.best_params_
         assert clean.best_score_ == faulty.best_score_
@@ -139,18 +129,36 @@ class TestIncrementalFaultRecovery:
 
     def test_no_fault_counts_zero(self, xy):
         X, y = xy
-        FlakyOnce.reset(fail_at=None)
-        search = self._search().fit(X, y)
+        with fault_plan() as plan:  # an EMPTY plan: counts, never fires
+            search = self._search().fit(X, y)
         assert search.fit_failures_ == 0
+        assert plan.fired[POINT] == 0
+        assert plan.calls[POINT] > 0
+        assert fault_stats().total("faults") == 0
 
     def test_persistent_fault_raises(self, xy):
         X, y = xy
         search = IncrementalSearchCV(
-            AlwaysFails(), {"dummy": [0, 1]},
+            PlanModel(), {"slope": [1.0, 2.0]},
             n_initial_parameters=2, max_iter=2, random_state=0,
         )
-        with pytest.raises(RuntimeError, match="persistent injected fault"):
-            search.fit(X, y)
+        with fault_plan() as plan:
+            plan.persistent(POINT)
+            with pytest.raises(FaultInjected, match=POINT):
+                search.fit(X, y)
+        # the unit's single retry hit the persistent fault again: the
+        # second failure propagated (loud), and the books say so
+        s = fault_stats().snapshot()
+        assert s["failures"].get("search-unit", 0) >= 1
+
+    def test_scheduled_exception_type_propagates(self, xy):
+        """A plan can inject ANY exception type — the search's retry
+        treats it like any transient unit fault."""
+        X, y = xy
+        with fault_plan() as plan:
+            plan.inject(POINT, at_call=3, exc=OSError("disk vanished"))
+            search = self._search().fit(X, y)
+        assert search.fit_failures_ == 1
 
 
 class TestGridSearchErrorScore:
@@ -178,16 +186,54 @@ class TestHyperbandFaultRollup:
         from dask_ml_tpu.model_selection import HyperbandSearchCV
 
         X, y = xy
-        FlakyOnce.reset(fail_at=6)
-        hb = HyperbandSearchCV(
-            FlakyOnce(), {"slope": [1.0, 2.0, 3.0]},
-            max_iter=4, random_state=0,
-        ).fit(X, y)
-        assert hb.fit_failures_ == 1
-        FlakyOnce.reset(fail_at=None)
-        clean = HyperbandSearchCV(
-            FlakyOnce(), {"slope": [1.0, 2.0, 3.0]},
-            max_iter=4, random_state=0,
-        ).fit(X, y)
+
+        def hb():
+            return HyperbandSearchCV(
+                PlanModel(), {"slope": [1.0, 2.0, 3.0]},
+                max_iter=4, random_state=0,
+            )
+
+        with fault_plan() as plan:
+            plan.inject(POINT, at_call=6)
+            faulty = hb().fit(X, y)
+        assert faulty.fit_failures_ == 1
+        clean = hb().fit(X, y)
         assert clean.fit_failures_ == 0
-        assert clean.best_params_ == hb.best_params_
+        assert clean.best_params_ == faulty.best_params_
+
+
+class TestFaultPlanRegistry:
+    """The harness itself: schedules, probes, accounting."""
+
+    def test_at_call_list_and_times(self):
+        plan = FaultPlan()
+        plan.inject("p", at_call=(2, 4), times=2)
+        with fault_plan(plan):
+            for i in range(1, 6):
+                if i in (2, 4):
+                    with pytest.raises(FaultInjected):
+                        maybe_fault("p")
+                else:
+                    maybe_fault("p")
+        assert plan.calls["p"] == 5
+        assert plan.fired["p"] == 2
+
+    def test_probe_side_effect_without_raise(self):
+        hits = []
+        with fault_plan() as plan:
+            plan.on_call("p", lambda: hits.append(plan.calls["p"]),
+                         at_call=3)
+            for _ in range(4):
+                maybe_fault("p")
+        assert hits == [3]
+
+    def test_no_active_plan_is_noop(self):
+        maybe_fault("anything")  # must not raise, must not record
+
+    def test_plans_nest_and_restore(self):
+        with fault_plan() as outer:
+            with fault_plan() as inner:
+                maybe_fault("p")
+            maybe_fault("p")
+            assert inner.calls["p"] == 1
+            assert outer.calls["p"] == 1

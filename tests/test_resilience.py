@@ -1,0 +1,558 @@
+"""Fault matrix for the resilience runtime (ISSUE 1 acceptance gate).
+
+For every long-running estimator: a fit KILLED at an arbitrary iteration
+and resumed from its ``FitCheckpoint`` must produce fitted attributes
+numerically close (rtol <= 1e-5) to an uninterrupted fit; a TRANSIENT
+ingest fault is absorbed by ``retry`` with backoff while a PERSISTENT
+fault propagates loudly — with accurate ``FaultStats`` books either way.
+
+Everything here is tier-1-safe on the 8-device CPU mesh: tiny data, few
+iterations, zero-length backoffs.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu.resilience import (
+    FaultInjected,
+    FitCheckpoint,
+    PreemptionWatcher,
+    TrainingPreempted,
+    fault_plan,
+)
+from dask_ml_tpu.resilience.retry import (
+    Deadline,
+    DeadlineExceeded,
+    FaultStats,
+    fault_stats,
+    reset_fault_stats,
+    retry,
+)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_stats():
+    reset_fault_stats()
+    yield
+    reset_fault_stats()
+
+
+@pytest.fixture
+def X(rng):
+    x = rng.normal(size=(192, 6)).astype(np.float32)
+    x[:96] += 4.0  # two separable blobs for the clusterers
+    return x
+
+
+@pytest.fixture
+def y_cls(X, rng):
+    return (X @ rng.normal(size=X.shape[1]) > 0).astype(np.float32)
+
+
+@pytest.fixture
+def y_reg(X, rng):
+    return (X @ rng.normal(size=X.shape[1])).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# retry / Deadline / FaultStats primitives
+# ---------------------------------------------------------------------------
+class TestRetryPrimitives:
+    def test_exponential_backoff_schedule(self):
+        delays, calls = [], []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 4:
+                raise OSError("transient")
+            return "ok"
+
+        out = retry(flaky, retries=3, backoff=0.1, factor=2.0, jitter=0.0,
+                    sleep=delays.append)
+        assert out == "ok"
+        np.testing.assert_allclose(delays, [0.1, 0.2, 0.4])
+
+    def test_jitter_multiplies_up_to_fraction(self):
+        delays = []
+
+        def boom():
+            raise OSError("x")
+
+        with pytest.raises(OSError):
+            retry(boom, retries=3, backoff=1.0, factor=1.0, jitter=0.5,
+                  sleep=delays.append)
+        assert len(delays) == 3
+        assert all(1.0 <= d <= 1.5 for d in delays)
+
+    def test_stats_invariant_faults_eq_retries_plus_failures(self):
+        stats = FaultStats()
+
+        def boom():
+            raise ValueError("persistent")
+
+        with pytest.raises(ValueError):
+            retry(boom, retries=2, backoff=0.0, jitter=0.0, stats=stats,
+                  tag="t")
+        s = stats.snapshot()
+        assert s["faults"]["t"] == 3
+        assert s["retries"]["t"] == 2
+        assert s["failures"]["t"] == 1
+        assert s["faults"]["t"] == s["retries"]["t"] + s["failures"]["t"]
+
+    def test_non_retryable_propagates_immediately_uncounted(self):
+        stats = FaultStats()
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise TypeError("a bug, not a fault")
+
+        with pytest.raises(TypeError):
+            retry(boom, retries=5, backoff=0.0, retryable=(OSError,),
+                  stats=stats)
+        assert len(calls) == 1
+        assert stats.total("faults") == 0
+
+    def test_on_error_hook_sees_every_fault(self):
+        seen = []
+
+        def boom():
+            raise OSError(f"fault {len(seen)}")
+
+        with pytest.raises(OSError):
+            retry(boom, retries=2, backoff=0.0, jitter=0.0,
+                  on_error=lambda e, k: seen.append(k))
+        assert seen == [0, 1, 2]
+
+    def test_deadline_stops_retry_loop(self):
+        """An expired deadline stops retrying even with retry budget left;
+        the LAST FAULT propagates (the deadline is a budget, not a fault),
+        and the propagated failure is on the books."""
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise OSError("x")
+
+        with pytest.raises(OSError):
+            retry(boom, retries=10_000, backoff=0.05, factor=1.0,
+                  jitter=0.0, deadline=Deadline(0.2), tag="dl")
+        assert len(calls) < 100  # the deadline cut the 10k-retry budget
+        s = fault_stats().snapshot()
+        assert s["failures"]["dl"] == 1
+        # the books stay exact even on the deadline path
+        assert s["faults"]["dl"] == s["retries"]["dl"] + s["failures"]["dl"]
+
+    def test_deadline_expired_before_first_attempt(self):
+        import time
+
+        dl = Deadline(0.01)
+        time.sleep(0.02)
+        with pytest.raises(DeadlineExceeded):
+            retry(lambda: "never runs", deadline=dl)
+
+    def test_deadline_exceeded_inside_fn_never_absorbed(self):
+        def boom():
+            raise DeadlineExceeded("budget blown inside the unit")
+
+        with pytest.raises(DeadlineExceeded):
+            retry(boom, retries=5, backoff=0.0)  # retryable=Exception
+
+    def test_zero_retries_single_attempt_still_counted(self):
+        def boom():
+            raise OSError("x")
+
+        with pytest.raises(OSError):
+            retry(boom, retries=0, tag="once")
+        s = fault_stats().snapshot()
+        assert s["faults"]["once"] == 1 and s["failures"]["once"] == 1
+
+
+# ---------------------------------------------------------------------------
+# ingest layer: transient absorbed, persistent loud, books accurate
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def csv_path(tmp_path, rng):
+    p = tmp_path / "data.csv"
+    arr = rng.normal(size=(40, 3)).astype(np.float32)
+    np.savetxt(p, arr, delimiter=",", fmt="%.6f")
+    return str(p), arr
+
+
+class TestIngestFaults:
+    def test_transient_ingest_fault_absorbed(self, csv_path):
+        from dask_ml_tpu.io import read_csv
+
+        path, arr = csv_path
+        with fault_plan() as plan:
+            plan.inject("ingest", at_call=1)
+            out = read_csv(path, retries=2, retry_backoff=0.0)
+        np.testing.assert_allclose(out, arr, rtol=1e-4)
+        s = fault_stats().snapshot()
+        assert s["faults"]["ingest"] == 1
+        assert s["retries"]["ingest"] == 1
+        assert "ingest" not in s["failures"]
+
+    def test_persistent_ingest_fault_propagates(self, csv_path):
+        from dask_ml_tpu.io import read_csv
+
+        path, _ = csv_path
+        with fault_plan() as plan:
+            plan.persistent("ingest")
+            with pytest.raises(FaultInjected, match="ingest"):
+                read_csv(path, retries=2, retry_backoff=0.0)
+        s = fault_stats().snapshot()
+        assert s["faults"]["ingest"] == 3      # initial + 2 re-attempts
+        assert s["retries"]["ingest"] == 2
+        assert s["failures"]["ingest"] == 1
+
+    def test_stream_blocks_retry_never_skips_rows(self, csv_path):
+        from dask_ml_tpu.io import stream_csv_blocks
+
+        path, arr = csv_path
+        with fault_plan() as plan:
+            plan.inject("ingest", at_call=2)  # fault fetching block 2
+            blocks = list(
+                stream_csv_blocks(path, 16, retries=1, retry_backoff=0.0)
+            )
+        np.testing.assert_allclose(np.vstack(blocks), arr, rtol=1e-4)
+        assert fault_stats().snapshot()["retries"]["ingest"] == 1
+
+    def test_stream_blocks_no_retry_budget_propagates(self, csv_path):
+        from dask_ml_tpu.io import stream_csv_blocks
+
+        path, _ = csv_path
+        with fault_plan() as plan:
+            plan.inject("ingest", at_call=1)
+            with pytest.raises(FaultInjected):
+                list(stream_csv_blocks(path, 16))  # retries=0 default
+
+
+# ---------------------------------------------------------------------------
+# the kill/resume estimator matrix
+# ---------------------------------------------------------------------------
+def _factories():
+    from dask_ml_tpu.cluster import KMeans, MiniBatchKMeans
+    from dask_ml_tpu.decomposition import IncrementalPCA
+    from dask_ml_tpu.linear_model import (
+        LinearRegression,
+        LogisticRegression,
+        SGDClassifier,
+        SGDRegressor,
+    )
+
+    return {
+        # name -> (factory(ckpt), fit(est, X, y_cls, y_reg), fitted attr)
+        "kmeans": (
+            lambda c: KMeans(n_clusters=2, init="random", random_state=0,
+                             max_iter=8, tol=0.0, fit_checkpoint=c),
+            lambda e, X, yc, yr: e.fit(X),
+            "cluster_centers_",
+        ),
+        "minibatch-kmeans": (
+            lambda c: MiniBatchKMeans(n_clusters=2, random_state=0,
+                                      max_iter=6, batch_size=64,
+                                      fit_checkpoint=c),
+            lambda e, X, yc, yr: e.fit(X),
+            "cluster_centers_",
+        ),
+        "sgd-classifier": (
+            lambda c: SGDClassifier(random_state=0, max_iter=8, tol=None,
+                                    fit_checkpoint=c),
+            lambda e, X, yc, yr: e.fit(X, yc),
+            "coef_",
+        ),
+        "sgd-regressor": (
+            lambda c: SGDRegressor(random_state=0, max_iter=8, tol=None,
+                                   fit_checkpoint=c),
+            lambda e, X, yc, yr: e.fit(X, yr),
+            "coef_",
+        ),
+        "glm-logistic": (
+            lambda c: LogisticRegression(solver="gradient_descent",
+                                         max_iter=24,
+                                         fit_checkpoint=FitCheckpoint(
+                                             c.path, every_n_iters=6)),
+            lambda e, X, yc, yr: e.fit(X, yc),
+            "coef_",
+        ),
+        "glm-linear": (
+            lambda c: LinearRegression(solver="lbfgs", max_iter=24,
+                                       fit_checkpoint=FitCheckpoint(
+                                           c.path, every_n_iters=6)),
+            lambda e, X, yc, yr: e.fit(X, yr),
+            "coef_",
+        ),
+        "incremental-pca": (
+            lambda c: IncrementalPCA(n_components=2, batch_size=48,
+                                     fit_checkpoint=c),
+            lambda e, X, yc, yr: e.fit(X),
+            "components_",
+        ),
+    }
+
+
+@pytest.mark.parametrize("name", sorted(_factories()))
+@pytest.mark.parametrize("kill_at", [2, 3])
+def test_kill_resume_matches_uninterrupted(name, kill_at, tmp_path, X,
+                                           y_cls, y_reg):
+    """A fit killed at step-boundary ``kill_at`` and resumed from its
+    snapshot converges to the SAME fitted attributes as an uninterrupted
+    (identically-configured) fit."""
+    make, fit, attr = _factories()[name]
+
+    clean = make(FitCheckpoint(str(tmp_path / "clean.pkl"),
+                               every_n_iters=1))
+    fit(clean, X, y_cls, y_reg)
+    ref = np.asarray(getattr(clean, attr))
+
+    path = str(tmp_path / "killed.pkl")
+    est = make(FitCheckpoint(path, every_n_iters=1))
+    with fault_plan() as plan:
+        plan.inject("step", at_call=kill_at)
+        with pytest.raises(FaultInjected):
+            fit(est, X, y_cls, y_reg)
+    assert os.path.exists(path), "no snapshot survived the kill"
+
+    resumed = make(FitCheckpoint(path, every_n_iters=1))
+    fit(resumed, X, y_cls, y_reg)
+    np.testing.assert_allclose(
+        np.asarray(getattr(resumed, attr)), ref, rtol=1e-5, atol=1e-6
+    )
+    assert not os.path.exists(path), "completed fit must clear its snapshot"
+
+
+def test_search_kill_resume_matches_uninterrupted(tmp_path, rng):
+    """The adaptive-search row of the matrix: IncrementalSearchCV killed
+    mid-search resumes from its round-granular SearchCheckpoint and ranks
+    the identical models."""
+    from dask_ml_tpu.model_selection import IncrementalSearchCV
+    from test_fault_injection import POINT, PlanModel
+
+    X = rng.normal(size=(64, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+
+    def search(path):
+        return IncrementalSearchCV(
+            PlanModel(), {"slope": [1.0, 2.0, 3.0]},
+            n_initial_parameters=3, max_iter=4, random_state=0,
+            checkpoint=path,
+        )
+
+    clean = search(str(tmp_path / "clean.pkl")).fit(X, y)
+
+    path = str(tmp_path / "killed.pkl")
+    with fault_plan() as plan:
+        # persistent from call 5 on: the unit's single retry hits it
+        # again, so the search dies after round 1 is checkpointed
+        plan.inject(POINT, at_call=range(5, 500), times=None)
+        with pytest.raises(FaultInjected):
+            search(path).fit(X, y)
+    assert os.path.exists(path)
+
+    resumed = search(path).fit(X, y)
+    assert resumed.best_params_ == clean.best_params_
+    assert resumed.best_score_ == clean.best_score_
+    assert {m: r[-1]["partial_fit_calls"]
+            for m, r in resumed.model_history_.items()} == {
+        m: r[-1]["partial_fit_calls"]
+        for m, r in clean.model_history_.items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-write crash window + fingerprint policy
+# ---------------------------------------------------------------------------
+class TestCheckpointWriteCrash:
+    def test_crash_mid_write_keeps_previous_snapshot(self, tmp_path, X):
+        """The checkpoint-write injection point fires BETWEEN the tmp
+        write and the atomic rename — the exact window the tmp+rename
+        protocol defends: the previous snapshot must survive, and the fit
+        must be resumable from it."""
+        from dask_ml_tpu.linear_model import SGDRegressor
+
+        path = str(tmp_path / "ck.pkl")
+        yr = np.asarray(X @ np.ones(X.shape[1]), np.float32)
+
+        def make():
+            return SGDRegressor(random_state=0, max_iter=6, tol=None,
+                                fit_checkpoint=FitCheckpoint(
+                                    path, every_n_iters=1))
+
+        clean = make()
+        clean.fit(X, yr)
+        ref = np.asarray(clean.coef_)
+
+        est = make()
+        with fault_plan() as plan:
+            plan.inject("checkpoint-write", at_call=3)
+            with pytest.raises(FaultInjected):
+                est.fit(X, yr)
+        # epoch-2 snapshot (written at checkpoint-write call 2) survives
+        assert os.path.exists(path)
+        snap = FitCheckpoint(path).load_if_matches(make())
+        assert snap is not None and snap[0] == 2
+
+        resumed = make()
+        resumed.fit(X, yr)
+        np.testing.assert_allclose(np.asarray(resumed.coef_), ref,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fingerprint_mismatch_starts_fresh_keeps_foreign_file(
+            self, tmp_path, X):
+        from dask_ml_tpu.cluster import KMeans
+
+        path = str(tmp_path / "ck.pkl")
+        a = KMeans(n_clusters=2, init="random", random_state=0, max_iter=3,
+                   tol=0.0,
+                   fit_checkpoint=FitCheckpoint(path, every_n_iters=1,
+                                                keep_on_complete=True))
+        a.fit(X)
+        assert os.path.exists(path)
+        foreign_bytes = open(path, "rb").read()
+
+        # differently-configured fit against the same path: the snapshot
+        # must be IGNORED (fresh trajectory), not consumed or deleted
+        b = KMeans(n_clusters=3, init="random", random_state=0, max_iter=3,
+                   tol=0.0,
+                   fit_checkpoint=FitCheckpoint(path, every_n_iters=1,
+                                                keep_on_complete=True))
+        assert b.fit_checkpoint.load_if_matches(b) is None
+        assert open(path, "rb").read() == foreign_bytes
+
+
+# ---------------------------------------------------------------------------
+# preemption: signal -> boundary stop -> final snapshot -> resume
+# ---------------------------------------------------------------------------
+class TestPreemption:
+    def test_trigger_checkpoints_and_stops_then_resumes(self, tmp_path, X,
+                                                        y_reg):
+        from dask_ml_tpu.linear_model import SGDRegressor
+
+        path = str(tmp_path / "pre.pkl")
+
+        def make():
+            return SGDRegressor(random_state=0, max_iter=8, tol=None,
+                                fit_checkpoint=FitCheckpoint(
+                                    path, every_n_iters=100))
+
+        clean = make()
+        clean.fit(X, y_reg)
+        ref = np.asarray(clean.coef_)
+
+        est = make()
+        with PreemptionWatcher() as w:
+            with fault_plan() as plan:
+                # the "signal" lands mid-epoch-3; the stop must land at
+                # the epoch-3 BOUNDARY with a final snapshot even though
+                # the cadence (every 100) never fired on its own
+                plan.on_call("step", w.trigger, at_call=3)
+                with pytest.raises(TrainingPreempted) as ei:
+                    est.fit(X, y_reg)
+        assert ei.value.iteration == 3
+        assert ei.value.checkpoint_path == path
+        assert os.path.exists(path)
+
+        resumed = make()
+        resumed.fit(X, y_reg)
+        np.testing.assert_allclose(np.asarray(resumed.coef_), ref,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_real_sigterm_sets_flag_without_raising(self):
+        import signal
+
+        with PreemptionWatcher() as w:
+            assert not w.requested
+            signal.raise_signal(signal.SIGTERM)
+            assert w.requested  # flag only — no exception mid-collective
+
+    def test_no_checkpoint_still_stops_cleanly(self, X):
+        from dask_ml_tpu.cluster import KMeans
+
+        est = KMeans(n_clusters=2, init="random", random_state=0,
+                     max_iter=8, tol=0.0)  # NO fit_checkpoint
+        with PreemptionWatcher() as w:
+            with fault_plan() as plan:
+                plan.on_call("step", w.trigger, at_call=1)
+                with pytest.raises(TrainingPreempted) as ei:
+                    est.fit(X)
+        assert ei.value.checkpoint_path is None
+
+    def test_uninstall_restores_handlers(self):
+        import signal
+
+        prev = signal.getsignal(signal.SIGTERM)
+        with PreemptionWatcher():
+            assert signal.getsignal(signal.SIGTERM) != prev
+        assert signal.getsignal(signal.SIGTERM) == prev
+
+
+# ---------------------------------------------------------------------------
+# collective-layer injection point
+# ---------------------------------------------------------------------------
+class TestCollectivePoint:
+    def test_shard_rows_faults_on_schedule(self, rng):
+        from dask_ml_tpu.core.sharded import shard_rows, unshard
+
+        x = rng.normal(size=(16, 4)).astype(np.float32)
+        with fault_plan() as plan:
+            plan.inject("collective", at_call=2)
+            s = shard_rows(x)  # call 1: fine
+            with pytest.raises(FaultInjected, match="collective"):
+                unshard(s)  # call 2: the injected transport fault
+            np.testing.assert_allclose(unshard(s), x)  # call 3: fine
+
+
+# ---------------------------------------------------------------------------
+# FitCheckpoint policy
+# ---------------------------------------------------------------------------
+class TestFitCheckpointPolicy:
+    def test_complete_forgets_last_save_iteration(self, tmp_path):
+        """A FitCheckpoint reused across fits must not skip the final
+        preemption snapshot because an EARLIER fit saved at the same
+        iteration count (check_preemption dedups on _last_save_iter)."""
+        from dask_ml_tpu.resilience.preemption import (
+            PreemptionWatcher, TrainingPreempted, check_preemption,
+        )
+
+        from dask_ml_tpu.cluster import KMeans
+
+        ck = FitCheckpoint(str(tmp_path / "x"), every_s=3600.0)
+        est = KMeans(n_clusters=2, init="random", random_state=0)
+        ck.save(est, {"w": 4.0}, iteration=4)
+        ck.complete()  # fit A finished: snapshot deleted, iter forgotten
+        assert not ck.exists()
+        with PreemptionWatcher() as w:
+            w.trigger()
+            with pytest.raises(TrainingPreempted) as ei:
+                check_preemption(ck, est, {"w": 7.0}, iteration=4)
+        # the final snapshot was WRITTEN, not skipped as a duplicate
+        assert ck.exists() and ei.value.checkpoint_path == ck.path
+        assert ck.load_if_matches(est)[1]["w"] == 7.0
+
+    def test_cadence_validation(self, tmp_path):
+        with pytest.raises(ValueError):
+            FitCheckpoint(str(tmp_path / "x"), every_n_iters=0)
+        with pytest.raises(ValueError):
+            FitCheckpoint(str(tmp_path / "x"), every_s=0.0)
+
+    def test_due_iteration_cadence(self, tmp_path):
+        ck = FitCheckpoint(str(tmp_path / "x"), every_n_iters=3)
+        assert [i for i in range(1, 10) if ck.due(i)] == [3, 6, 9]
+
+    def test_due_time_cadence_fires_then_rearms(self, tmp_path):
+        ck = FitCheckpoint(str(tmp_path / "x"), every_s=10_000.0)
+        # cadence anchors at construction: the first boundary is NOT due
+        assert not ck.due(1)
+        ck._last_save_t -= 20_000.0  # pretend every_s elapsed
+        assert ck.due(2)
+        ck._last_save_t = __import__("time").monotonic()  # a save re-arms
+        assert not ck.due(3)
+
+    def test_default_cadence_every_boundary(self, tmp_path):
+        ck = FitCheckpoint(str(tmp_path / "x"))
+        assert ck.every_n_iters == 1 and all(ck.due(i) for i in (1, 2, 3))
