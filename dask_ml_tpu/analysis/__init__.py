@@ -1,0 +1,49 @@
+"""graftlint: the repo's JAX/SPMD-aware static-analysis pass.
+
+AST-only (never imports jax): cheap enough to run as a pre-commit hook
+(tools/lint.sh), a tier-1 self-gate (tests/test_graftlint.py), and a CI
+trend metric (diagnostics.lint_report).  Rules encode the hazard classes
+this codebase has actually hit — see docs/design.md, "Concurrency & SPMD
+contract".
+
+CLI::
+
+    python -m dask_ml_tpu.analysis [paths...] [--format json]
+    python -m dask_ml_tpu.analysis --list-rules
+
+Library::
+
+    from dask_ml_tpu.analysis import lint_paths, lint_source
+    findings, errors = lint_paths(["dask_ml_tpu"])
+    assert not [f for f in findings if not f.suppressed]
+"""
+
+from .core import (  # noqa: F401
+    RULES,
+    Context,
+    Finding,
+    Rule,
+    all_rules,
+    lint_paths,
+    lint_source,
+    register,
+)
+from .reporters import (  # noqa: F401
+    per_rule_counts,
+    render_json,
+    render_text,
+)
+
+__all__ = [
+    "RULES", "Context", "Finding", "Rule", "all_rules", "register",
+    "lint_paths", "lint_source",
+    "per_rule_counts", "render_json", "render_text",
+    "main",
+]
+
+
+def main(argv=None) -> int:
+    """CLI entry point (also ``python -m dask_ml_tpu.analysis``)."""
+    from .cli import main as _main
+
+    return _main(argv)

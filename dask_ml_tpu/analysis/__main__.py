@@ -1,0 +1,5 @@
+"""``python -m dask_ml_tpu.analysis`` → the graftlint CLI."""
+
+from .cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,71 @@
+"""graftlint CLI: ``python -m dask_ml_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean, 1 unsuppressed findings or parse errors, 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .core import RULES, all_rules, lint_paths
+from .reporters import render_json, render_text
+
+
+def _default_target() -> str:
+    # the package's own parent directory: `python -m dask_ml_tpu.analysis`
+    # with no args lints the library itself
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m dask_ml_tpu.analysis",
+        description="graftlint: JAX/SPMD-aware static analysis",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the "
+                        "dask_ml_tpu package)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="include suppressed findings in text output")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    all_rules()  # populate the registry before touching RULES
+    if args.list_rules:
+        for rid, cls in sorted(RULES.items()):
+            print(f"{rid}: {cls.summary}")
+        return 0
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+        try:
+            all_rules(select)
+        except KeyError as e:
+            print(f"graftlint: {e.args[0]}", file=sys.stderr)
+            return 2
+    paths = args.paths or [_default_target()]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"graftlint: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    findings, errors = lint_paths(paths, select)
+    if args.format == "json":
+        print(render_json(findings, errors))
+    else:
+        print(render_text(findings, errors,
+                          show_suppressed=args.show_suppressed))
+    active = [f for f in findings if not f.suppressed]
+    return 1 if (active or errors) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

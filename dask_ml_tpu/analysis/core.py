@@ -1,0 +1,299 @@
+"""graftlint core: findings, rule registry, suppressions, file walking.
+
+The analyzer is pure-AST and deliberately does NOT import jax: it must be
+cheap enough to run as a pre-commit gate (tools/lint.sh) and inside tier-1
+(tests/test_graftlint.py) without paying backend startup.  Rules encode
+SPMD hazards this repo has actually hit (see docs/design.md, "Concurrency
+& SPMD contract"): threaded multi-device dispatch, process-divergent
+collectives, PRNG key reuse, host sync in fit loops, jit retracing,
+tracer-dependent Python control flow, and swallowed exceptions around
+collectives.
+
+Suppression syntax (inline, same line / the call's line span / the line
+directly above)::
+
+    flags = process_allgather(x)  # graftlint: disable=divergent-collective -- why it is safe
+
+Every suppression MUST carry a justification after the rule list (``--``
+separator or plain trailing text); a bare ``disable=`` is itself reported
+as a ``bad-suppression`` finding, as is an unknown rule id.  ``disable=all``
+suppresses every rule on that line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import tokenize
+from typing import Iterable, Iterator
+
+__all__ = [
+    "Context",
+    "Finding",
+    "Rule",
+    "RULES",
+    "register",
+    "all_rules",
+    "lint_source",
+    "lint_paths",
+    "dotted_name",
+    "iter_py_files",
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\-]*)\s*(?:--\s*)?(.*)$"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnostic: a rule violation at a source location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    justification: str | None = None
+    end_line: int | None = None
+
+    def render(self) -> str:
+        state = " (suppressed)" if self.suppressed else ""
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"[{self.rule}]{state} {self.message}"
+        )
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``summary`` and implement ``run``."""
+
+    id: str = ""
+    summary: str = ""
+
+    def run(self, ctx: "Context") -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    # -- shared AST helpers (rules are pure functions of the Context) ----
+    @staticmethod
+    def in_loop_body(ctx: "Context", node: ast.AST) -> bool:
+        """Is ``node`` inside the body of a for/while loop (not merely in
+        the iterable/condition expression)?  Stops at the enclosing
+        function boundary: a nested def's body runs when called, not once
+        per iteration of the loop that defines it."""
+        child = node
+        for parent in ctx.parents(node):
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                return False
+            if isinstance(parent, (ast.For, ast.AsyncFor, ast.While)):
+                if child in parent.body or child in parent.orelse:
+                    return True
+            child = parent
+        return False
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class Context:
+    """Everything a rule needs about one module: tree (with parent links),
+    raw lines, and the parsed suppression table."""
+
+    def __init__(self, source: str, path: str):
+        self.source = source
+        self.path = path
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._parent: dict[int, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parent[id(child)] = parent
+        # line -> (rule ids | {"all"}, justification, standalone-comment?)
+        self.suppressions: dict[int, tuple[set, str, bool]] = {}
+        self.bad_suppressions: list[Finding] = []
+        self._scan_suppressions()
+
+    # -- navigation ------------------------------------------------------
+    def parents(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parent.get(id(node))
+        while cur is not None:
+            yield cur
+            cur = self._parent.get(id(cur))
+
+    def enclosing_function(self, node: ast.AST):
+        for p in self.parents(node):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return p
+        return None
+
+    # -- suppressions ----------------------------------------------------
+    def _scan_suppressions(self) -> None:
+        import io
+
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except tokenize.TokenError:  # unterminated something: best effort
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m is None:
+                continue
+            line = tok.start[0]
+            ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+            justification = m.group(2).strip()
+            if not ids:
+                self.bad_suppressions.append(Finding(
+                    "bad-suppression", self.path, line, tok.start[1],
+                    "empty graftlint disable: name the rule ids",
+                ))
+                continue
+            unknown = sorted(i for i in ids if i != "all" and i not in RULES)
+            if unknown:
+                self.bad_suppressions.append(Finding(
+                    "bad-suppression", self.path, line, tok.start[1],
+                    f"unknown rule id(s) in suppression: {', '.join(unknown)}",
+                ))
+            if not justification:
+                self.bad_suppressions.append(Finding(
+                    "bad-suppression", self.path, line, tok.start[1],
+                    "suppression without justification: append '-- <why this "
+                    "is safe>' after the rule list",
+                ))
+            # standalone = the line holds only this comment; only those
+            # apply to the NEXT line (an inline suppression covers its own
+            # statement, and must not bleed onto the line below)
+            text = self.lines[line - 1] if line - 1 < len(self.lines) else ""
+            standalone = text.lstrip().startswith("#")
+            self.suppressions[line] = (ids, justification, standalone)
+
+    def suppression_for(self, rule_id: str, line: int,
+                        end_line: int | None) -> tuple[set, str] | None:
+        """A disable on the finding line, anywhere in the node's line span,
+        or a STANDALONE comment on the line directly above the finding."""
+        above = self.suppressions.get(line - 1)
+        candidates = [above] if (above and above[2]) else []
+        candidates.extend(self.suppressions.get(ln)
+                          for ln in range(line, (end_line or line) + 1))
+        for entry in candidates:
+            if entry and (rule_id in entry[0] or "all" in entry[0]):
+                return entry[:2]
+        return None
+
+    # -- finding factory -------------------------------------------------
+    def finding(self, rule_id: str, node: ast.AST, message: str,
+                end_line: int | None = None) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        if end_line is None:
+            end_line = getattr(node, "end_lineno", line)
+        f = Finding(rule_id, self.path, line, col, message,
+                    end_line=end_line)
+        sup = self.suppression_for(rule_id, line, end_line)
+        if sup is not None:
+            f.suppressed = True
+            f.justification = sup[1] or None
+        return f
+
+
+# -- registry ------------------------------------------------------------
+RULES: dict[str, type] = {}
+
+
+def register(cls):
+    """Class decorator: add a Rule subclass to the global registry."""
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.id in RULES:
+        raise ValueError(f"duplicate rule id {cls.id!r}")
+    RULES[cls.id] = cls
+    return cls
+
+
+def all_rules(select: Iterable[str] | None = None) -> list[Rule]:
+    # import for side effect: rule modules self-register on first use
+    from . import rules  # noqa: F401
+
+    ids = sorted(RULES) if select is None else list(select)
+    missing = [i for i in ids if i not in RULES]
+    if missing:
+        raise KeyError(f"unknown rule id(s): {', '.join(missing)}")
+    return [RULES[i]() for i in ids]
+
+
+# -- entry points --------------------------------------------------------
+def lint_source(source: str, path: str = "<string>",
+                select: Iterable[str] | None = None) -> list[Finding]:
+    """Lint one module's source.  Returns ALL findings; suppressed ones
+    carry ``suppressed=True`` (callers filter)."""
+    rules = all_rules(select)
+    ctx = Context(source, path)
+    findings: list[Finding] = list(ctx.bad_suppressions)
+    for rule in rules:
+        findings.extend(rule.run(ctx))
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def iter_py_files(paths: Iterable[str] | str) -> Iterator[str]:
+    if isinstance(paths, (str, os.PathLike)):
+        # a bare string would iterate character-by-character and lint
+        # nothing — treat it as the single path it obviously means
+        paths = [paths]
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def lint_paths(paths: Iterable[str] | str,
+               select: Iterable[str] | None = None,
+               ) -> tuple[list[Finding], list[str]]:
+    """Lint files/directories.  Returns (findings, errors) where errors
+    are human-readable strings for missing paths and unreadable or
+    unparsable files (reported, never silently skipped — a typo'd path
+    or a syntax error must FAIL the gate, not pass it empty)."""
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    paths = list(paths)
+    findings: list[Finding] = []
+    errors: list[str] = [
+        f"{p}: no such file or directory"
+        for p in paths if not os.path.exists(p)
+    ]
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError as e:
+            errors.append(f"{path}: unreadable: {e}")
+            continue
+        try:
+            findings.extend(lint_source(src, path, select))
+        except SyntaxError as e:
+            errors.append(f"{path}: syntax error: {e}")
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, errors

@@ -1,0 +1,57 @@
+"""Finding reporters: text for humans/pre-commit, JSON for CI trending."""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, Sequence
+
+from .core import RULES, Finding
+
+__all__ = ["per_rule_counts", "render_text", "render_json"]
+
+
+def per_rule_counts(findings: Iterable[Finding]) -> dict:
+    """``{rule_id: {"active": n, "suppressed": m}}`` for every rule that
+    produced at least one finding."""
+    counts: dict[str, dict[str, int]] = {}
+    for f in findings:
+        entry = counts.setdefault(f.rule, {"active": 0, "suppressed": 0})
+        entry["suppressed" if f.suppressed else "active"] += 1
+    return dict(sorted(counts.items()))
+
+
+def render_text(findings: Sequence[Finding], errors: Sequence[str] = (),
+                show_suppressed: bool = False) -> str:
+    active = [f for f in findings if not f.suppressed]
+    shown = list(findings) if show_suppressed else active
+    out = [f.render() for f in shown]
+    out.extend(f"error: {e}" for e in errors)
+    n_sup = len(findings) - len(active)
+    out.append(
+        f"graftlint: {len(active)} finding(s), {n_sup} suppressed, "
+        f"{len(errors)} error(s)"
+    )
+    return "\n".join(out)
+
+
+def render_json(findings: Sequence[Finding], errors: Sequence[str] = ()
+                ) -> str:
+    payload = {
+        "version": 1,
+        "findings": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "message": f.message,
+                "suppressed": f.suppressed,
+                "justification": f.justification,
+            }
+            for f in findings
+        ],
+        "counts": per_rule_counts(findings),
+        "errors": list(errors),
+        "rules": {rid: cls.summary for rid, cls in sorted(RULES.items())},
+    }
+    return json.dumps(payload, indent=2)

@@ -1,0 +1,11 @@
+"""graftlint rules: importing this package registers every rule.
+
+Each module groups one hazard family; the registry (``core.RULES``) is
+populated by the ``@register`` decorators at import time.
+"""
+
+from . import collectives  # noqa: F401
+from . import host_sync  # noqa: F401
+from . import jit_hazards  # noqa: F401
+from . import prng  # noqa: F401
+from . import threads  # noqa: F401

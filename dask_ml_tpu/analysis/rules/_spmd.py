@@ -1,0 +1,62 @@
+"""Shared SPMD vocabulary for rules: what counts as a collective, and
+what counts as a process-divergent value source."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import dotted_name
+
+# Callables whose dispatch is a cross-device/cross-process rendezvous.
+# Matched on the LAST dotted segment so `jax.lax.psum`, `lax.psum`, and a
+# bare imported `psum` all hit.  Includes this repo's own flag collectives
+# (resilience.preemption) — they ride process_allgather and inherit the
+# same every-process-must-participate contract.
+COLLECTIVE_SUFFIXES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+    "all_gather", "all_to_all", "psum_scatter",
+    "process_allgather", "broadcast_one_to_all", "sync_global_devices",
+    "preemption_requested", "check_preemption",
+})
+
+# Last-segment callable names whose RESULT differs across processes of an
+# SPMD group: branching a collective on one of these is the gloo-hang
+# class (divergent-collective).
+DIVERGENT_CALL_SUFFIXES = frozenset({
+    "process_index", "getpid", "gethostname", "thread_ident",
+    "time", "monotonic", "perf_counter", "time_ns", "monotonic_ns",
+    "perf_counter_ns",
+    "random", "randint", "randrange", "gauss", "getrandbits", "urandom",
+})
+
+# Dotted-name substrings that read process-local environment state.
+DIVERGENT_NAME_PARTS = ("environ",)
+
+
+def is_collective_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    return bool(name) and name.rsplit(".", 1)[-1] in COLLECTIVE_SUFFIXES
+
+
+def collective_calls(tree: ast.AST):
+    for node in ast.walk(tree):
+        if is_collective_call(node):
+            yield node
+
+
+def divergent_source(test: ast.AST) -> str | None:
+    """The first process-divergent value source referenced by a condition
+    expression, or None when the condition looks process-uniform."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name:
+                last = name.rsplit(".", 1)[-1]
+                if last in DIVERGENT_CALL_SUFFIXES:
+                    return f"{name}()"
+        name = dotted_name(node)
+        if name and any(part in name for part in DIVERGENT_NAME_PARTS):
+            return name
+    return None

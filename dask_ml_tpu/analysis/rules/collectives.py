@@ -1,0 +1,113 @@
+"""Collective-safety rules.
+
+The SPMD contract (docs/design.md): every process of the group must reach
+every collective, in the same order.  One process skipping a
+``process_allgather`` while its peers wait is not an error you debug from
+a traceback — it is a gloo/ICI hang that eats the whole pytest timeout.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule, register
+from ._spmd import divergent_source, is_collective_call
+
+
+@register
+class DivergentCollectiveRule(Rule):
+    """A collective dispatched under a process-divergent condition."""
+
+    id = "divergent-collective"
+    summary = (
+        "collective call guarded by a condition that can differ across "
+        "processes (process_index, wall-clock, PRNG, environ) — peers "
+        "that skip the rendezvous hang the group"
+    )
+
+    def run(self, ctx: Context):
+        for node in ast.walk(ctx.tree):
+            if not is_collective_call(node):
+                continue
+            child: ast.AST = node
+            for parent in ctx.parents(node):
+                if isinstance(parent, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.Lambda)):
+                    break
+                test = None
+                if isinstance(parent, (ast.If, ast.While)):
+                    # only when the collective is in the guarded body, not
+                    # in the test expression itself
+                    if child is not parent.test:
+                        test = parent.test
+                elif isinstance(parent, ast.IfExp):
+                    if child is not parent.test:
+                        test = parent.test
+                if test is not None:
+                    src = divergent_source(test)
+                    if src is not None:
+                        yield ctx.finding(
+                            self.id, node,
+                            f"collective under a process-divergent "
+                            f"condition (reads {src}): every process must "
+                            f"reach every collective — hoist the call or "
+                            f"derive the condition from a collective "
+                            f"(e.g. allgather the flag first)",
+                        )
+                        break
+                child = parent
+
+
+@register
+class SwallowedCollectiveRule(Rule):
+    """Broad except around collective code without re-raise."""
+
+    id = "swallowed-collective"
+    summary = (
+        "bare/broad except around a collective that does not re-raise — "
+        "one process absorbing the failure and carrying on desyncs the "
+        "group at the next rendezvous"
+    )
+
+    _BROAD = frozenset({"Exception", "BaseException"})
+
+    def _is_broad(self, handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return True
+        types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+                 else [handler.type])
+        for t in types:
+            name = ast.unparse(t) if not isinstance(t, ast.Name) else t.id
+            if name.rsplit(".", 1)[-1] in self._BROAD:
+                return True
+        return False
+
+    def run(self, ctx: Context):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            has_collective = any(
+                is_collective_call(n)
+                for stmt in node.body for n in ast.walk(stmt)
+            )
+            if not has_collective:
+                continue
+            for handler in node.handlers:
+                if not self._is_broad(handler):
+                    continue
+                reraises = any(
+                    isinstance(n, ast.Raise)
+                    for stmt in handler.body for n in ast.walk(stmt)
+                )
+                if reraises:
+                    continue
+                anchor_end = (handler.body[0].lineno if handler.body
+                              else handler.lineno)
+                yield ctx.finding(
+                    self.id, handler,
+                    "broad except swallows failures around a collective: "
+                    "a process that absorbs the error stops participating "
+                    "while peers wait at the next rendezvous — re-raise, "
+                    "or narrow the except to host-only failure types",
+                    end_line=anchor_end,
+                )

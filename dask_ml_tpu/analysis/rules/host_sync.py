@@ -1,0 +1,111 @@
+"""Host↔device sync inside fit-path iteration loops.
+
+``float(x)`` / ``np.asarray(x)`` / ``x.item()`` on a device value blocks
+the host until the device flushes — inside a fit loop that serializes
+dispatch and can dominate wall time (the async-dispatch pipeline is the
+whole reason warm steps are fast; see diagnostics.benchmark_step's notes).
+Legitimate round-boundary syncs (convergence checks, checkpoint pulls)
+exist — they get a suppression that SAYS they are boundary syncs, so the
+next reader knows the stall is intentional.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Context, Rule, dotted_name, register
+
+# function names that are an estimator fit path / solver iteration driver
+_FIT_NAME_RE = re.compile(
+    r"fit|lloyd|admm|lbfgs|gradient|proximal|newton|solve|train|_sgd",
+    re.IGNORECASE,
+)
+
+_SYNC_BUILTINS = frozenset({"float", "bool"})
+_SYNC_NP = frozenset({"asarray", "array", "device_get"})
+_SYNC_METHODS = frozenset({"item", "tolist"})
+
+# argument shapes that are host-side already: constants, len()/range(),
+# .shape/.ndim/.size touches, time stamps.  BARE builtins only for the
+# reductions — `float(jnp.max(shift))` is the canonical per-iteration
+# device sync this rule exists to catch, so a dotted `jnp.max`/`np.max`
+# must NOT read as host-side
+_HOST_BARE_CALLS = frozenset({
+    "len", "range", "int", "float", "min", "max",
+})
+_HOST_DOTTED_CALLS = frozenset({"time", "perf_counter", "monotonic"})
+_HOST_ATTRS = frozenset({"shape", "ndim", "size", "dtype"})
+
+
+def _looks_host_side(arg: ast.AST) -> bool:
+    if isinstance(arg, ast.Constant):
+        return True
+    for n in ast.walk(arg):
+        if isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Name) and n.func.id in _HOST_BARE_CALLS:
+                return True
+            name = dotted_name(n.func)
+            if name and name.rsplit(".", 1)[-1] in _HOST_DOTTED_CALLS:
+                return True
+        if isinstance(n, ast.Attribute) and n.attr in _HOST_ATTRS:
+            return True
+    return False
+
+
+@register
+class HostSyncLoopRule(Rule):
+    id = "host-sync-loop"
+    summary = (
+        "host-sync call (float/bool/np.asarray/.item/.tolist/device_get) "
+        "inside a fit-path iteration loop — stalls the async dispatch "
+        "pipeline once per iteration"
+    )
+
+    def _sync_call(self, node: ast.Call) -> str | None:
+        name = dotted_name(node.func)
+        if name is None:
+            return None
+        if name in _SYNC_BUILTINS and len(node.args) == 1:
+            return name
+        head, _, last = name.rpartition(".")
+        if last in _SYNC_NP and head in ("np", "numpy", "jax", "onp"):
+            return name
+        return None
+
+    def run(self, ctx: Context):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _FIT_NAME_RE.search(fn.name):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                # attribute each call to its INNERMOST function only: a
+                # nested def is its own (possibly non-fit) path, and
+                # scanning it from every ancestor double-reports
+                if ctx.enclosing_function(node) is not fn:
+                    continue
+                label = None
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _SYNC_METHODS and not node.args:
+                    label = f".{node.func.attr}()"
+                    arg: ast.AST = node.func.value
+                else:
+                    label = self._sync_call(node)
+                    arg = node.args[0] if node.args else None
+                if label is None or arg is None:
+                    continue
+                if _looks_host_side(arg):
+                    continue
+                if not self.in_loop_body(ctx, node):
+                    continue
+                yield ctx.finding(
+                    self.id, node,
+                    f"{label} inside an iteration loop of {fn.name}(): "
+                    f"this blocks the host on device completion every "
+                    f"iteration — keep the value on device (lax.cond / "
+                    f"jnp reductions), sync only at round boundaries, or "
+                    f"suppress with the boundary-sync justification",
+                )

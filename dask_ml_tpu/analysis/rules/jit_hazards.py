@@ -1,0 +1,156 @@
+"""jit compilation-cache and tracing hazards.
+
+Two silent performance/correctness sinks:
+
+- ``jit-in-loop``: constructing a jitted callable per iteration
+  (``jax.jit(f)`` / ``partial(jax.jit, ...)`` inside a for/while body)
+  defeats the compile cache when the wrapped callable is a fresh closure —
+  every iteration pays a retrace.  Python-scalar static args have the same
+  failure shape: a new cache entry per distinct value.
+- ``tracer-branch``: ``if``/``while`` on a traced argument inside a
+  jit-decorated function raises ``TracerBoolConversionError`` at best and
+  at worst (via ``static_argnums`` drift) silently specializes — use
+  ``lax.cond`` / ``lax.while_loop`` or mark the argument static.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule, dotted_name, register
+
+
+def _is_jit_name(name: str | None) -> bool:
+    return bool(name) and name.rsplit(".", 1)[-1] == "jit"
+
+
+@register
+class JitInLoopRule(Rule):
+    id = "jit-in-loop"
+    summary = (
+        "jax.jit(...) constructed inside a loop body — a fresh closure "
+        "per iteration retraces/recompiles every time"
+    )
+
+    def run(self, ctx: Context):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            is_jit = _is_jit_name(name)
+            if not is_jit and name and name.rsplit(".", 1)[-1] == "partial":
+                is_jit = any(_is_jit_name(dotted_name(a))
+                             for a in node.args[:1])
+            if not is_jit:
+                continue
+            if not self.in_loop_body(ctx, node):
+                continue
+            yield ctx.finding(
+                self.id, node,
+                "jax.jit constructed inside a loop: wrapping a fresh "
+                "function object each iteration misses the compile cache "
+                "and retraces every pass — hoist the jit out of the loop "
+                "(close over loop-invariants via static args)",
+            )
+
+
+def _jit_decorator(fn: ast.FunctionDef | ast.AsyncFunctionDef):
+    """The jit decorator call/name on ``fn``, else None."""
+    for dec in fn.decorator_list:
+        if _is_jit_name(dotted_name(dec)):
+            return dec
+        if isinstance(dec, ast.Call):
+            name = dotted_name(dec.func)
+            if _is_jit_name(name):
+                return dec
+            if name and name.rsplit(".", 1)[-1] == "partial" and dec.args \
+                    and _is_jit_name(dotted_name(dec.args[0])):
+                return dec
+    return None
+
+
+def _static_params(dec, fn) -> set[str]:
+    """Parameter names excluded from tracing via static_argnames/nums."""
+    static: set[str] = set()
+    if not isinstance(dec, ast.Call):
+        return static
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in dec.keywords:
+        try:
+            val = ast.literal_eval(kw.value)
+        except (ValueError, SyntaxError):
+            continue
+        names = val if isinstance(val, (tuple, list)) else [val]
+        if kw.arg == "static_argnames":
+            static.update(str(n) for n in names)
+        elif kw.arg == "static_argnums":
+            for i in names:
+                if isinstance(i, int) and 0 <= i < len(params):
+                    static.add(params[i])
+    return static
+
+
+# condition shapes that are static at trace time even on a traced name:
+# shape/dtype/rank touches, None-ness, isinstance
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+
+
+def _prune_static(test: ast.AST):
+    """Yield sub-nodes of a condition that remain AFTER removing
+    trace-time-static constructs."""
+    skip: set[int] = set()
+    for n in ast.walk(test):
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            skip.update(id(s) for s in ast.walk(n))
+        elif isinstance(n, ast.Call):
+            name = dotted_name(n.func)
+            if name and name.rsplit(".", 1)[-1] in (
+                    "len", "isinstance", "callable", "hasattr"):
+                skip.update(id(s) for s in ast.walk(n))
+        elif isinstance(n, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+            skip.update(id(s) for s in ast.walk(n))
+    for n in ast.walk(test):
+        if id(n) not in skip:
+            yield n
+
+
+@register
+class TracerBranchRule(Rule):
+    id = "tracer-branch"
+    summary = (
+        "Python if/while on a traced argument inside a jit-decorated "
+        "function — TracerBoolConversionError, or silent per-value "
+        "specialization via static args"
+    )
+
+    def run(self, ctx: Context):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            dec = _jit_decorator(fn)
+            if dec is None:
+                continue
+            static = _static_params(dec, fn)
+            traced = {
+                a.arg
+                for a in (fn.args.posonlyargs + fn.args.args
+                          + fn.args.kwonlyargs)
+                if a.arg not in static and a.arg not in ("self", "cls")
+            }
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                    continue
+                hits = sorted({
+                    n.id for n in _prune_static(node.test)
+                    if isinstance(n, ast.Name) and n.id in traced
+                })
+                if not hits:
+                    continue
+                yield ctx.finding(
+                    self.id, node.test,
+                    f"Python control flow on traced argument(s) "
+                    f"{', '.join(hits)} inside jit-decorated {fn.name}(): "
+                    f"use lax.cond/lax.while_loop, or declare the "
+                    f"argument in static_argnames",
+                )

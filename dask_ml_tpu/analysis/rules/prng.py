@@ -1,0 +1,225 @@
+"""PRNG key hygiene: a consumed key must not be consumed again.
+
+``jax.random`` is splittable-PRNG: sampling twice from the same key gives
+CORRELATED (identical) draws, silently.  ``split`` consumes its argument
+too — two ``split(key)`` calls yield the same children.  ``fold_in`` and
+``PRNGKey`` are exempt: folding distinct data into one key is the
+idiomatic per-shard derivation (core/prng.py).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule, dotted_name, register
+
+# jax.random callables that do NOT consume their key argument
+_NON_CONSUMING = frozenset({
+    "PRNGKey", "key", "fold_in", "key_data", "wrap_key_data", "clone",
+    "key_impl",
+})
+# bare stdlib `random` deliberately absent: it has no key argument, so a
+# repeated first-arg Name there is data, not key reuse
+_RANDOM_MODULES = frozenset({"jax.random", "jrandom", "jr"})
+
+
+def _consuming_key_use(node: ast.AST) -> tuple[str, str] | None:
+    """(key_var, fn_name) when ``node`` is a jax.random call consuming a
+    plain-Name key argument."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if not name or "." not in name:
+        return None
+    mod, fn = name.rsplit(".", 1)
+    # `jax.random.X` / `jrandom.X` / any `*.random.X` EXCEPT numpy's host
+    # RNG (np.random has no key argument: its first-arg Name is data, and
+    # matching it would flag repeated host draws as key reuse)
+    if mod in ("np.random", "numpy.random", "random"):
+        return None
+    if not mod.endswith(".random") and mod not in _RANDOM_MODULES:
+        return None
+    if fn in _NON_CONSUMING:
+        return None
+    if not node.args or not isinstance(node.args[0], ast.Name):
+        return None
+    return node.args[0].id, fn
+
+
+def _assigned_names(stmt: ast.stmt) -> set[str]:
+    out: set[str] = set()
+
+    def collect(target: ast.AST):
+        if isinstance(target, ast.Name):
+            out.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                collect(elt)
+        elif isinstance(target, ast.Starred):
+            collect(target.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            collect(t)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        collect(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        collect(stmt.target)
+    # walrus anywhere inside the statement
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.NamedExpr):
+            collect(n.target)
+    return out
+
+
+def _expr_uses(stmt: ast.stmt) -> list[tuple[str, str, ast.Call]]:
+    """Consuming key uses in a statement's expressions (nested defs and
+    lambdas excluded: they execute later, in their own order)."""
+    uses = []
+    for n in _walk_no_defs(stmt):
+        got = _consuming_key_use(n)
+        if got:
+            uses.append((got[0], got[1], n))
+    return uses
+
+
+def _terminates(stmts) -> bool:
+    """Does this statement list always leave the enclosing flow?"""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue)
+    )
+
+
+def _walk_no_defs(node: ast.AST):
+    from collections import deque
+
+    todo = deque([node])
+    while todo:
+        n = todo.popleft()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            todo.append(child)
+
+
+@register
+class KeyReuseRule(Rule):
+    id = "key-reuse"
+    summary = (
+        "a jax.random key consumed twice (or loop-carried without "
+        "re-split): identical draws, silently — split/fold_in first"
+    )
+
+    def run(self, ctx: Context):
+        self._findings: list = []
+        scopes = [ctx.tree] + [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            body = scope.body
+            self._scan(ctx, body, {})
+        yield from self._findings
+
+    # -- recursive statement-list scan -----------------------------------
+    def _scan(self, ctx: Context, stmts, used: dict) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope (scanned from its own entry)
+            if isinstance(stmt, ast.If):
+                self._uses_in_expr(ctx, stmt.test, used)
+                b1, b2 = dict(used), dict(used)
+                self._scan(ctx, stmt.body, b1)
+                self._scan(ctx, stmt.orelse, b2)
+                # the post-if state is the UNION of the branch-final
+                # states (consumed on either surviving path counts), and
+                # nothing more: a branch-rebound key is popped from that
+                # branch's dict, so a key refreshed on EVERY surviving
+                # path comes out clean.  A branch that leaves the flow
+                # (return/raise/...) contributes nothing — the
+                # `if init == "random": return choice(key)` ladder is
+                # exclusive, not a reuse.
+                used.clear()
+                if not _terminates(stmt.body):
+                    used.update(b1)
+                if not _terminates(stmt.orelse):
+                    used.update(b2)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                if isinstance(stmt, ast.While):
+                    self._uses_in_expr(ctx, stmt.test, used)
+                else:
+                    self._uses_in_expr(ctx, stmt.iter, used)
+                self._loop_carried(ctx, stmt)
+                inner = dict(used)
+                self._scan(ctx, stmt.body, inner)
+                self._scan(ctx, stmt.orelse, inner)
+                used.update(inner)
+            elif isinstance(stmt, ast.Try):
+                branches = [stmt.body] + [h.body for h in stmt.handlers]
+                merged = dict(used)
+                for branch in branches:
+                    b = dict(used)
+                    self._scan(ctx, branch, b)
+                    merged.update(b)
+                used.update(merged)
+                self._scan(ctx, stmt.finalbody, used)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._uses_in_expr(ctx, item.context_expr, used)
+                self._scan(ctx, stmt.body, used)
+            else:
+                for name, fn, call in _expr_uses(stmt):
+                    self._mark(ctx, name, fn, call, used)
+                for name in _assigned_names(stmt):
+                    used.pop(name, None)
+                continue
+            # compound statements: clear names (re)bound anywhere inside
+            for name in _assigned_names(stmt):
+                used.pop(name, None)
+
+    def _uses_in_expr(self, ctx: Context, expr, used: dict) -> None:
+        if expr is None:
+            return
+        for n in _walk_no_defs(expr):
+            got = _consuming_key_use(n)
+            if got:
+                self._mark(ctx, got[0], got[1], n, used)
+
+    def _mark(self, ctx: Context, name, fn, call, used: dict) -> None:
+        if name in used:
+            prev_fn, prev_line = used[name]
+            self._findings.append(ctx.finding(
+                self.id, call,
+                f"key {name!r} already consumed by jax.random.{prev_fn} "
+                f"on line {prev_line}; sampling again yields identical "
+                f"bits — split the key (or fold_in distinct data) first",
+            ))
+        else:
+            used[name] = (fn, call.lineno)
+
+    def _loop_carried(self, ctx: Context, loop) -> None:
+        """A consuming use inside the loop body of a key never reassigned
+        in that body draws the SAME bits every iteration."""
+        assigned: set[str] = set()
+        if isinstance(loop, (ast.For, ast.AsyncFor)):
+            assigned |= _assigned_names(loop)
+        for stmt in loop.body + loop.orelse:
+            for n in _walk_no_defs(stmt):
+                if isinstance(n, ast.stmt):
+                    assigned |= _assigned_names(n)
+        seen: set[str] = set()
+        for stmt in loop.body + loop.orelse:
+            for name, fn, call in _expr_uses(stmt):
+                if name not in assigned and name not in seen:
+                    seen.add(name)
+                    self._findings.append(ctx.finding(
+                        self.id, call,
+                        f"key {name!r} consumed by jax.random.{fn} every "
+                        f"loop iteration but never re-split in the loop: "
+                        f"each iteration draws identical bits — "
+                        f"`{name}, sub = jax.random.split({name})` inside "
+                        f"the loop, or fold_in the iteration index",
+                    ))

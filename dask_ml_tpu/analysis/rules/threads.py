@@ -1,0 +1,54 @@
+"""Threaded multi-device dispatch (the PR-1 deadlock class).
+
+Two threads interleaving multi-device program enqueues on the one shared
+mesh can deadlock the runtime: device A executes thread-1's program while
+device B executes thread-2's, and each program's collective waits for the
+other's devices forever.  ``model_selection/_search.py`` owns the fix —
+``_uses_device_estimator`` forces ``n_workers = 1`` before any pool is
+built.  This rule flags every thread-pool/Thread construction in library
+code that is NOT visibly behind that guard, so a new call site must either
+adopt the guard or justify (suppress) why its work is host-only.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule, dotted_name, register
+
+_CTOR_SUFFIXES = frozenset({"ThreadPoolExecutor", "Thread"})
+_GUARD_NAME = "_uses_device_estimator"
+
+
+@register
+class ThreadDispatchRule(Rule):
+    id = "thread-dispatch"
+    summary = (
+        "thread pool / Thread constructed without the device-estimator "
+        "serialization guard — concurrent multi-device dispatch on a "
+        "shared mesh can interleave enqueue order and deadlock"
+    )
+
+    def run(self, ctx: Context):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name or name.rsplit(".", 1)[-1] not in _CTOR_SUFFIXES:
+                continue
+            fn = ctx.enclosing_function(node)
+            guarded = fn is not None and any(
+                isinstance(n, ast.Name) and n.id == _GUARD_NAME
+                or isinstance(n, ast.Attribute) and n.attr == _GUARD_NAME
+                for n in ast.walk(fn)
+            )
+            if guarded:
+                continue
+            yield ctx.finding(
+                self.id, node,
+                f"{name}(...) without the {_GUARD_NAME} serialization "
+                f"guard: threads submitting multi-device programs on the "
+                f"shared mesh can deadlock the runtime — gate worker count "
+                f"on the guard (see model_selection/_search.py) or "
+                f"suppress with a host-only justification",
+            )

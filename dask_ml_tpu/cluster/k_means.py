@@ -450,6 +450,7 @@ class KMeans(TransformerMixin, TPUEstimator):
                     # converged: the segment stopped early, or the final
                     # shift cleared tol exactly at the boundary (the fused
                     # loop's cond — boundaries must not add iterations)
+                    # graftlint: disable=host-sync-loop -- segment-boundary sync: one scalar fetch per fused 32-iteration segment, not per Lloyd iteration
                     if seg_n < seg or float(shift) <= float(tol):
                         break
                 if ckpt is not None:

@@ -339,7 +339,8 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
                 centers, counts, X.data, X.mask, start,
                 batch_size=bs, n_batches=n_batches,
             )
-            cur = float(mean_inertia)  # one scalar sync per epoch
+            # graftlint: disable=host-sync-loop -- epoch-boundary convergence check: one scalar sync per epoch (n_batches fused steps), sklearn's max_no_improvement contract needs the host value
+            cur = float(mean_inertia)
             stop = False
             if self.max_no_improvement is not None:
                 if cur > best - self.tol * max(abs(best), 1.0):

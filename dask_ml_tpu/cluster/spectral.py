@@ -321,6 +321,7 @@ class SpectralClustering(TPUEstimator):
                 C, V, mesh_holder=mh, iters=int(n_power_iters),
                 qr_strategy=_tsqr_strategy(),
             )
+            # graftlint: disable=host-sync-loop -- chunk-boundary Ritz convergence check: one (kp,) fetch per n_power_iters-deep fused chunk (<= 10 total)
             lam_now = np.asarray(_ritz_values(C, V))[-k:]
             if prev is not None and np.max(np.abs(lam_now - prev)) < tol:
                 break

@@ -147,15 +147,19 @@ class TruncatedSVD(ComponentsOutMixin, TransformerMixin, TPUEstimator):
             first_iter = None
             for B in src:
                 Y = _mm(B, Q)
+                # graftlint: disable=host-sync-loop -- host streaming path: B blocks are host numpy/scipy arrays, these asarray calls never touch a device
                 H += np.asarray(B.T @ Y, dtype=np.float64)
                 if p == 0:
                     n_rows += B.shape[0]
                     if scipy.sparse.issparse(B):
+                        # graftlint: disable=host-sync-loop -- host streaming path: scipy sparse matrix densification, no device value involved
                         col_sum += np.asarray(B.sum(axis=0)).ravel()
+                        # graftlint: disable=host-sync-loop -- host streaming path: scipy sparse matrix densification, no device value involved
                         col_sumsq += np.asarray(
                             B.multiply(B).sum(axis=0)
                         ).ravel()
                     else:
+                        # graftlint: disable=host-sync-loop -- host streaming path: B is a host numpy block from the caller's iterator
                         Bd = np.asarray(B, np.float64)
                         col_sum += Bd.sum(axis=0)
                         col_sumsq += (Bd * Bd).sum(axis=0)

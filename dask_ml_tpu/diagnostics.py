@@ -33,7 +33,38 @@ from .resilience.retry import (  # noqa: F401
 __all__ = [
     "trace", "benchmark_step", "benchmark_slope", "_timer",
     "FaultStats", "fault_stats", "reset_fault_stats",
+    "lint_report",
 ]
+
+
+def lint_report(paths=None) -> dict:
+    """Per-rule graftlint finding counts for benches and CI trending.
+
+    Runs the repo's static analyzer (:mod:`dask_ml_tpu.analysis`) over
+    ``paths`` (default: this installed package) and returns::
+
+        {"counts": {rule_id: {"active": n, "suppressed": m}},
+         "active": total_active, "suppressed": total_suppressed,
+         "errors": [parse errors]}
+
+    ``active`` must trend to (and stay at) zero — tier-1 gates on it via
+    tests/test_graftlint.py; ``suppressed`` is the debt metric to trend
+    down release over release.
+    """
+    import os
+
+    from . import analysis
+
+    if paths is None:
+        paths = [os.path.dirname(os.path.abspath(__file__))]
+    findings, errors = analysis.lint_paths(paths)
+    counts = analysis.per_rule_counts(findings)
+    return {
+        "counts": counts,
+        "active": sum(c["active"] for c in counts.values()),
+        "suppressed": sum(c["suppressed"] for c in counts.values()),
+        "errors": list(errors),
+    }
 
 
 @contextlib.contextmanager

@@ -99,10 +99,21 @@ class _BlockwiseBase(TPUEstimator):
                     est.fit(Xh[lo:hi], **kwargs)
             return est
 
-        with ThreadPoolExecutor(
-            max_workers=min(8, max(4, len(members)))
-        ) as pool:
-            members = list(pool.map(fit_one, zip(members, spans)))
+        from ..model_selection._search import _uses_device_estimator
+
+        if _uses_device_estimator(self.estimator):
+            # collective-safety (the PR-1 deadlock class): non-packable
+            # DEVICE configs land here too (class_weight / adaptive lr /
+            # early_stopping route past _try_fit_packed), and threads
+            # interleaving their multi-device dispatch on the shared mesh
+            # can deadlock the runtime.  A device fit occupies every
+            # device, so threads buy no overlap for them: serialize.
+            members = [fit_one(pair) for pair in zip(members, spans)]
+        else:
+            with ThreadPoolExecutor(
+                max_workers=min(8, max(4, len(members)))
+            ) as pool:
+                members = list(pool.map(fit_one, zip(members, spans)))
         self.estimators_ = members
         self.n_features_in_ = Xh.shape[1]
         return self
@@ -209,6 +220,7 @@ class _BlockwiseBase(TPUEstimator):
             )
             # the host sync happens only when a tol check is active —
             # tol=None epochs pipeline without a device round-trip
+            # graftlint: disable=host-sync-loop -- epoch-boundary tol check, and only when tol is set; tol=None epochs pipeline freely
             if stop.active and stop.update(float(jnp.mean(losses))):
                 break
         for i, m in enumerate(members):

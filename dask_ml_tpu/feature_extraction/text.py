@@ -73,6 +73,7 @@ def _map_chunks(fn, chunked, n_threads=None, max_in_flight=None):
     """
     from collections import deque
 
+    # graftlint: disable=thread-dispatch -- host-only work: fn is tokenize/hash over python strings (GIL-releasing C), no jax program is dispatched from these threads
     with ThreadPoolExecutor(max_workers=n_threads) as pool:
         window = max_in_flight or (pool._max_workers or 4) * 2
         out = []

@@ -49,6 +49,7 @@ def _train_executor():
             # training threads mostly wait inside GIL-releasing kernels
             # (sklearn C, XLA dispatch), so size past the core count the
             # way an IO pool would — never below 4
+            # graftlint: disable=thread-dispatch -- shared HOST pool: device-estimator units never race here (run_round's _uses_device_estimator gate serializes them before dispatch)
             _EXECUTOR = ThreadPoolExecutor(
                 max_workers=min(16, max(4, os.cpu_count() or 1)),
                 thread_name_prefix="dask_ml_tpu_train",
@@ -369,6 +370,7 @@ class BaseIncrementalSearchCV(TPUEstimator):
                 meta["partial_fit_calls"] += n_calls
                 meta["partial_fit_time"] = pf_time
                 if packed_scores is not None:
+                    # graftlint: disable=host-sync-loop -- packed_scores is host numpy already: packed_accuracy fetched the whole (M,) vector in ONE round-trip
                     meta["score"] = float(packed_scores[i])
                     meta["score_time"] = packed_score_time
                 else:
@@ -409,6 +411,17 @@ class BaseIncrementalSearchCV(TPUEstimator):
             lockstep = _jax.process_count() > 1
         except Exception:
             lockstep = False
+
+        # intra-process collective-safety (the PR-1 deadlock class, same
+        # contract as _search.py): a device estimator's partial_fit
+        # dispatches multi-device programs on the one shared mesh, and
+        # thread-scheduled units can interleave enqueue order across
+        # devices and deadlock the runtime.  A device fit occupies every
+        # device anyway, so the pool buys no overlap for these — run
+        # device units sequentially; host (sklearn) units keep the pool.
+        from ._search import _uses_device_estimator
+
+        serialize_units = lockstep or _uses_device_estimator(self.estimator)
 
         def run_unit(fn, unit_ids, first_arg, n_calls):
             """One training unit with single-retry fault recovery.
@@ -477,14 +490,17 @@ class BaseIncrementalSearchCV(TPUEstimator):
                 with use_mesh(mesh):
                     return fn(*args)
 
-            # lockstep (computed above): the round's units run sequentially
-            # in a deterministic order (sorted pack keys, then sorted
-            # single idents) instead of racing on the thread pool —
-            # collectives emitted from thread-scheduled units would
-            # interleave differently per process and deadlock the fleet
+            # serialize_units (computed above): the round's units run
+            # sequentially in a deterministic order (sorted pack keys,
+            # then sorted single idents) instead of racing on the thread
+            # pool — cross-process, collectives emitted from
+            # thread-scheduled units would interleave differently per
+            # process and deadlock the fleet; single-process, device
+            # units interleaving multi-device enqueues deadlock the
+            # runtime the same way
             packed_items = sorted(packed.items(), key=lambda kv: repr(kv[0]))
             singles_items = sorted(singles)
-            if lockstep:
+            if serialize_units:
                 for (key, n_calls, _), idents in packed_items:
                     on_mesh(run_unit, train_cohort, list(idents), idents,
                             n_calls)

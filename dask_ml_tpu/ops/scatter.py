@@ -66,8 +66,31 @@ def bucket_sum(values, ids, num_segments: int, *, precision=None,
     strategy.  ``None`` (eager callers) resolves at call time.  The
     large-segment OOM guard binds either way.
     """
+    if getattr(values, "ndim", None) not in (1, 2):
+        raise ValueError(
+            f"values must be 1-d or 2-d, got ndim={getattr(values, 'ndim', None)}"
+        )
+    if getattr(ids, "ndim", None) != 1:
+        raise ValueError(
+            f"ids must be 1-d, got ndim={getattr(ids, 'ndim', None)}"
+        )
+    if values.shape[0] != ids.shape[0]:
+        # the sharding-mismatch class: a row-sharded/padded `values` zipped
+        # with an unpadded `ids` (or vice versa) silently misaligns rows to
+        # buckets — surface it as shapes, at trace time, not as wrong sums
+        raise ValueError(
+            f"values and ids disagree on the row count: values has "
+            f"{values.shape[0]} rows, ids has {ids.shape[0]} — were they "
+            f"padded/sharded differently before the scatter?"
+        )
     if strategy is None:
         strategy = scatter_strategy(num_segments)
+    elif strategy not in ("segsum", "onehot"):
+        # validate BEFORE the large-segment override: a typo from a
+        # large-segment caller must surface, not silently coerce
+        raise ValueError(
+            f"strategy must be 'segsum' or 'onehot', got {strategy!r}"
+        )
     elif num_segments > _ONEHOT_MAX_SEGMENTS:
         strategy = "segsum"
     if strategy == "segsum":

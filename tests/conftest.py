@@ -81,6 +81,50 @@ def mesh():
     return get_mesh()
 
 
+def retry_flaky(attempts=2, match=None):
+    """Auto-retry decorator for LOAD-flaky tests (not logic-flaky ones).
+
+    Re-runs the test up to ``attempts`` times, but ONLY when the failure
+    text matches ``match`` (a regex) — a real assertion failure must
+    surface on the first run, not burn retries.  Use sparingly: the only
+    legitimate customer is resource-starvation noise like
+    ``test_three_process_group``'s coordination-service heartbeat
+    timeouts when 3 jax processes starve the 2-core box (ROADMAP env
+    note); that class passes in isolation and wastes a tier-1 lane when
+    it loses the scheduling lottery.
+    """
+    import functools
+    import re as _re
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            last = None
+            for attempt in range(attempts):
+                try:
+                    return fn(*args, **kwargs)
+                except Exception as e:  # noqa: BLE001 - filtered below
+                    text = f"{type(e).__name__}: {e}"
+                    if match is not None and not _re.search(
+                            match, text, _re.IGNORECASE | _re.DOTALL):
+                        raise
+                    last = e
+                    if attempt + 1 < attempts:
+                        import warnings
+
+                        warnings.warn(
+                            f"retry_flaky: {fn.__name__} attempt "
+                            f"{attempt + 1}/{attempts} hit a matched "
+                            f"flake, retrying: {text[:200]}",
+                            stacklevel=2,
+                        )
+            raise last
+
+        return wrapper
+
+    return deco
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)

@@ -1,0 +1,742 @@
+"""graftlint: the analyzer gates itself (tier-1 self-gate) and every rule
+is exercised on a positive (flagging) and negative (clean) snippet.
+
+The snippets are synthetic distillations of the bug each rule encodes —
+the PR-1 thread deadlock, the gloo divergent-collective hang, key reuse,
+host sync in fit loops, jit retracing, tracer branches, and swallowed
+exceptions around collectives (see docs/design.md, "Concurrency & SPMD
+contract").
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from dask_ml_tpu.analysis import (
+    RULES,
+    all_rules,
+    lint_paths,
+    lint_source,
+    main,
+    per_rule_counts,
+    render_json,
+    render_text,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "dask_ml_tpu")
+
+
+def lint(src, **kw):
+    return lint_source(textwrap.dedent(src), **kw)
+
+
+def active(findings):
+    return [f for f in findings if not f.suppressed]
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 self-gate: the library must lint clean
+# ---------------------------------------------------------------------------
+
+class TestPackageGate:
+    def test_package_has_zero_unsuppressed_findings(self):
+        findings, errors = lint_paths([PKG])
+        assert not errors, errors
+        bad = active(findings)
+        assert not bad, "\n".join(f.render() for f in bad)
+
+    def test_every_suppression_carries_a_justification(self):
+        # bad-suppression findings are themselves active findings, so the
+        # gate above covers this — but assert directly so a regression in
+        # THAT wiring is also caught
+        findings, _ = lint_paths([PKG])
+        for f in findings:
+            if f.suppressed:
+                assert f.justification, f.render()
+
+    def test_cli_gate_exit_zero(self, capsys):
+        assert main([PKG]) == 0
+        out = capsys.readouterr().out
+        assert "0 finding(s)" in out
+
+
+# ---------------------------------------------------------------------------
+# per-rule positive / negative snippets
+# ---------------------------------------------------------------------------
+
+class TestThreadDispatch:
+    def test_flags_unguarded_pool(self):
+        findings = lint("""
+            from concurrent.futures import ThreadPoolExecutor
+
+            def fan_out(run, tasks):
+                with ThreadPoolExecutor(max_workers=4) as pool:
+                    return list(pool.map(run, tasks))
+        """)
+        assert rule_ids(active(findings)) == ["thread-dispatch"]
+
+    def test_flags_bare_thread(self):
+        findings = lint("""
+            import threading
+
+            def go(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+        """)
+        assert rule_ids(active(findings)) == ["thread-dispatch"]
+
+    def test_guarded_pool_is_clean(self):
+        findings = lint("""
+            from concurrent.futures import ThreadPoolExecutor
+
+            def fan_out(est, run, tasks):
+                n_workers = 4
+                if _uses_device_estimator(est):
+                    n_workers = 1
+                with ThreadPoolExecutor(max_workers=n_workers) as pool:
+                    return list(pool.map(run, tasks))
+        """)
+        assert not active(findings)
+
+
+class TestDivergentCollective:
+    def test_flags_process_index_guard(self):
+        findings = lint("""
+            import jax
+
+            def maybe_sync(x):
+                if jax.process_index() == 0:
+                    return jax.lax.psum(x, "data")
+                return x
+        """)
+        assert rule_ids(active(findings)) == ["divergent-collective"]
+
+    def test_flags_wall_clock_guard(self):
+        findings = lint("""
+            import time
+            from jax.experimental import multihost_utils
+
+            def heartbeat(flag, deadline):
+                while time.monotonic() < deadline:
+                    flag = multihost_utils.process_allgather(flag)
+                return flag
+        """)
+        assert rule_ids(active(findings)) == ["divergent-collective"]
+
+    def test_uniform_condition_is_clean(self):
+        findings = lint("""
+            import jax
+
+            def sync(x, every_process_same_flag):
+                if every_process_same_flag:
+                    return jax.lax.psum(x, "data")
+                return x
+        """)
+        assert not active(findings)
+
+    def test_collective_outside_branch_is_clean(self):
+        findings = lint("""
+            import jax
+
+            def sync(x):
+                y = jax.lax.psum(x, "data")
+                if jax.process_index() == 0:
+                    log(y)
+                return y
+        """)
+        assert not active(findings)
+
+
+class TestKeyReuse:
+    def test_flags_double_sample(self):
+        findings = lint("""
+            import jax
+
+            def sample(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))
+                return a + b
+        """)
+        fs = active(findings)
+        assert rule_ids(fs) == ["key-reuse"]
+        assert "already consumed" in fs[0].message
+
+    def test_flags_double_split(self):
+        findings = lint("""
+            import jax
+
+            def children(key):
+                a = jax.random.split(key)
+                b = jax.random.split(key)
+                return a, b
+        """)
+        assert rule_ids(active(findings)) == ["key-reuse"]
+
+    def test_flags_loop_carried_reuse(self):
+        findings = lint("""
+            import jax
+
+            def draws(key, n):
+                out = []
+                for _ in range(n):
+                    out.append(jax.random.normal(key, (3,)))
+                return out
+        """)
+        fs = active(findings)
+        assert rule_ids(fs) == ["key-reuse"]
+        assert "loop iteration" in fs[0].message
+
+    def test_split_chain_is_clean(self):
+        findings = lint("""
+            import jax
+
+            def sample(key):
+                key, k1 = jax.random.split(key)
+                a = jax.random.normal(k1, (3,))
+                key, k2 = jax.random.split(key)
+                b = jax.random.uniform(k2, (3,))
+                return a + b
+        """)
+        assert not active(findings)
+
+    def test_loop_with_resplit_is_clean(self):
+        findings = lint("""
+            import jax
+
+            def draws(key, n):
+                out = []
+                for _ in range(n):
+                    key, sub = jax.random.split(key)
+                    out.append(jax.random.normal(sub, (3,)))
+                return out
+        """)
+        assert not active(findings)
+
+    def test_fold_in_is_exempt(self):
+        findings = lint("""
+            import jax
+
+            def per_shard(key, n):
+                return [jax.random.fold_in(key, i) for i in range(n)]
+        """)
+        assert not active(findings)
+
+    def test_rebind_in_both_branches_is_clean(self):
+        # a key refreshed on EVERY surviving path is fresh afterwards
+        findings = lint("""
+            import jax
+
+            def sample(key, cond):
+                a = jax.random.normal(key, (3,))
+                if cond:
+                    key = jax.random.PRNGKey(0)
+                else:
+                    key = jax.random.PRNGKey(1)
+                b = jax.random.uniform(key, (3,))
+                return a + b
+        """)
+        assert not active(findings)
+
+    def test_rebind_in_one_branch_still_flags(self):
+        # ...but refreshed on only ONE path is still a reuse on the other
+        findings = lint("""
+            import jax
+
+            def sample(key, cond):
+                a = jax.random.normal(key, (3,))
+                if cond:
+                    key = jax.random.PRNGKey(0)
+                b = jax.random.uniform(key, (3,))
+                return a + b
+        """)
+        assert rule_ids(active(findings)) == ["key-reuse"]
+
+    def test_host_rng_modules_are_exempt(self):
+        # stdlib random / np.random have no key argument: a repeated
+        # first-arg Name there is data, not key reuse
+        findings = lint("""
+            import random
+            import numpy as np
+
+            def pick(xs):
+                a = random.choice(xs)
+                b = random.choice(xs)
+                n = np.random.choice(xs)
+                m = np.random.choice(xs)
+                return a, b, n, m
+        """)
+        assert not active(findings)
+
+    def test_exclusive_return_branches_are_clean(self):
+        # the k_means init ladder: `if mode == a: return sample(key)`
+        # followed by another use — exclusive via return, not a reuse
+        findings = lint("""
+            import jax
+
+            def init(key, mode):
+                if mode == "random":
+                    return jax.random.normal(key, (3,))
+                if mode == "choice":
+                    return jax.random.choice(key, 10, (3,))
+                raise ValueError(mode)
+        """)
+        assert not active(findings)
+
+
+class TestHostSyncLoop:
+    def test_flags_float_in_fit_loop(self):
+        findings = lint("""
+            def fit(self, X):
+                for _ in range(10):
+                    loss = step(X)
+                    if float(loss) < 1e-3:
+                        break
+                return self
+        """)
+        assert rule_ids(active(findings)) == ["host-sync-loop"]
+
+    def test_flags_item_and_asarray(self):
+        findings = lint("""
+            import numpy as np
+
+            def fit_loop(state, xs):
+                for x in xs:
+                    state = step(state, x)
+                    history.append(state.loss.item())
+                    snap = np.asarray(state.w)
+                return state
+        """)
+        assert len(active(findings)) == 2
+
+    def test_boundary_sync_outside_loop_is_clean(self):
+        findings = lint("""
+            def fit(self, X):
+                for _ in range(10):
+                    loss = step(X)
+                return float(loss)
+        """)
+        assert not active(findings)
+
+    def test_non_fit_function_is_clean(self):
+        findings = lint("""
+            def render(self, rows):
+                for r in rows:
+                    print(float(r))
+        """)
+        assert not active(findings)
+
+    def test_device_reduction_wrapped_sync_is_flagged(self):
+        # the canonical convergence check: float(jnp.max(shift)) is a
+        # per-iteration device sync — a dotted jnp/np reduction must not
+        # read as host-side (only the BARE builtins do)
+        findings = lint("""
+            import jax.numpy as jnp
+
+            def fit(self, X, tol):
+                for _ in range(10):
+                    shift = step(X)
+                    if float(jnp.max(shift)) < tol:
+                        break
+                return self
+        """)
+        assert rule_ids(active(findings)) == ["host-sync-loop"]
+
+    def test_shape_touch_is_clean(self):
+        findings = lint("""
+            def fit(self, X):
+                for _ in range(10):
+                    n = float(X.shape[0])
+                return n
+        """)
+        assert not active(findings)
+
+
+class TestJitInLoop:
+    def test_flags_jit_in_loop(self):
+        findings = lint("""
+            import jax
+
+            def train(xs):
+                out = []
+                for x in xs:
+                    f = jax.jit(lambda v: v * 2)
+                    out.append(f(x))
+                return out
+        """)
+        assert rule_ids(active(findings)) == ["jit-in-loop"]
+
+    def test_flags_partial_jit_in_loop(self):
+        findings = lint("""
+            import jax
+            from functools import partial
+
+            def train(xs):
+                while xs:
+                    step = partial(jax.jit, static_argnums=0)(make_step())
+                    xs = step(xs)
+        """)
+        assert rule_ids(active(findings)) == ["jit-in-loop"]
+
+    def test_hoisted_jit_is_clean(self):
+        findings = lint("""
+            import jax
+
+            def train(xs):
+                f = jax.jit(lambda v: v * 2)
+                return [f(x) for x in xs]
+        """)
+        assert not active(findings)
+
+
+class TestTracerBranch:
+    def test_flags_branch_on_traced_arg(self):
+        findings = lint("""
+            import jax
+
+            @jax.jit
+            def absval(x):
+                if x > 0:
+                    return x
+                return -x
+        """)
+        fs = active(findings)
+        assert rule_ids(fs) == ["tracer-branch"]
+        assert "absval" in fs[0].message
+
+    def test_static_argnames_is_clean(self):
+        findings = lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("mode",))
+            def step(x, mode):
+                if mode == "fast":
+                    return x * 2
+                return x
+        """)
+        assert not active(findings)
+
+    def test_shape_and_none_checks_are_clean(self):
+        findings = lint("""
+            import jax
+
+            @jax.jit
+            def norm(x, w):
+                if w is None:
+                    return x
+                if x.ndim == 2:
+                    return x * w
+                return x
+        """)
+        assert not active(findings)
+
+    def test_undecorated_function_is_clean(self):
+        findings = lint("""
+            def absval(x):
+                if x > 0:
+                    return x
+                return -x
+        """)
+        assert not active(findings)
+
+
+class TestSwallowedCollective:
+    def test_flags_broad_except(self):
+        findings = lint("""
+            import jax
+
+            def agree(x):
+                try:
+                    return jax.lax.psum(x, "data")
+                except Exception:
+                    return x
+        """)
+        assert rule_ids(active(findings)) == ["swallowed-collective"]
+
+    def test_flags_bare_except(self):
+        findings = lint("""
+            from jax.experimental import multihost_utils
+
+            def agree(flag):
+                try:
+                    return multihost_utils.process_allgather(flag)
+                except:
+                    return flag
+        """)
+        assert rule_ids(active(findings)) == ["swallowed-collective"]
+
+    def test_reraise_is_clean(self):
+        findings = lint("""
+            import jax
+
+            def agree(x):
+                try:
+                    return jax.lax.psum(x, "data")
+                except Exception:
+                    log_failure()
+                    raise
+        """)
+        assert not active(findings)
+
+    def test_narrow_except_is_clean(self):
+        findings = lint("""
+            import jax
+
+            def agree(x):
+                try:
+                    return jax.lax.psum(x, "data")
+                except ValueError:
+                    return x
+        """)
+        assert not active(findings)
+
+    def test_no_collective_in_try_is_clean(self):
+        findings = lint("""
+            def host_only(path):
+                try:
+                    return open(path).read()
+                except Exception:
+                    return None
+        """)
+        assert not active(findings)
+
+
+# ---------------------------------------------------------------------------
+# suppression mechanics
+# ---------------------------------------------------------------------------
+
+class TestSuppressions:
+    SRC = """
+        import jax
+
+        def sample(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))  # graftlint: disable=key-reuse -- correlated draws are intentional here
+            return a + b
+    """
+
+    def test_inline_suppression(self):
+        findings = lint(self.SRC)
+        assert not active(findings)
+        sup = [f for f in findings if f.suppressed]
+        assert len(sup) == 1
+        assert sup[0].justification == "correlated draws are intentional here"
+
+    def test_suppression_on_line_above(self):
+        findings = lint("""
+            import jax
+
+            def sample(key):
+                a = jax.random.normal(key, (3,))
+                # graftlint: disable=key-reuse -- intentional
+                b = jax.random.uniform(key, (3,))
+                return a + b
+        """)
+        assert not active(findings)
+
+    def test_disable_all(self):
+        findings = lint("""
+            import jax
+
+            def sample(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))  # graftlint: disable=all -- test fixture
+                return a + b
+        """)
+        assert not active(findings)
+
+    def test_bare_suppression_is_a_finding(self):
+        findings = lint("""
+            import jax
+
+            def sample(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))  # graftlint: disable=key-reuse
+                return a + b
+        """)
+        assert "bad-suppression" in rule_ids(active(findings))
+
+    def test_unknown_rule_id_is_a_finding(self):
+        findings = lint("""
+            x = 1  # graftlint: disable=no-such-rule -- whatever
+        """)
+        fs = active(findings)
+        assert rule_ids(fs) == ["bad-suppression"]
+        assert "no-such-rule" in fs[0].message
+
+    def test_inline_suppression_does_not_bleed_to_next_line(self):
+        # an INLINE disable covers its own statement only; the next
+        # line's unjustified violation must still fail the gate
+        findings = lint("""
+            import jax
+
+            def sample(key, key2):
+                a = jax.random.normal(key, (3,))
+                c = jax.random.normal(key2, (3,))
+                b = jax.random.uniform(key, (3,))  # graftlint: disable=key-reuse -- intentional
+                d = jax.random.uniform(key2, (3,))
+                return a + b + c + d
+        """)
+        assert rule_ids(active(findings)) == ["key-reuse"]
+
+    def test_wrong_rule_id_does_not_suppress(self):
+        findings = lint("""
+            import jax
+
+            def sample(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))  # graftlint: disable=jit-in-loop -- wrong id
+                return a + b
+        """)
+        assert "key-reuse" in rule_ids(active(findings))
+
+
+# ---------------------------------------------------------------------------
+# framework: registry, reporters, CLI
+# ---------------------------------------------------------------------------
+
+class TestFramework:
+    def test_registry_has_all_rules(self):
+        all_rules()  # force registration
+        assert set(RULES) == {
+            "thread-dispatch", "divergent-collective", "key-reuse",
+            "host-sync-loop", "jit-in-loop", "tracer-branch",
+            "swallowed-collective",
+        }
+
+    def test_select_unknown_rule_raises(self):
+        with pytest.raises(KeyError):
+            all_rules(["no-such-rule"])
+
+    def test_select_filters(self):
+        src = """
+            import jax
+
+            def fit(self, key, xs):
+                for x in xs:
+                    v = jax.random.normal(key, (3,))
+                    print(float(v))
+        """
+        both = lint(src)
+        assert set(rule_ids(active(both))) == {"key-reuse", "host-sync-loop"}
+        only = lint(src, select=["key-reuse"])
+        assert rule_ids(active(only)) == ["key-reuse"]
+
+    def test_json_reporter(self):
+        findings = lint("""
+            import jax
+
+            def sample(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))
+                return a + b
+        """)
+        payload = json.loads(render_json(findings))
+        assert payload["version"] == 1
+        assert payload["counts"]["key-reuse"]["active"] == 1
+        assert payload["findings"][0]["rule"] == "key-reuse"
+        assert "key-reuse" in payload["rules"]
+
+    def test_text_reporter_counts_line(self):
+        out = render_text([], [])
+        assert "0 finding(s)" in out
+
+    def test_per_rule_counts(self):
+        findings = lint(TestSuppressions.SRC)
+        counts = per_rule_counts(findings)
+        assert counts["key-reuse"] == {"active": 0, "suppressed": 1}
+
+    def test_bare_string_path_accepted(self):
+        # a bare str must lint the path, not iterate its characters
+        findings_str, errors_str = lint_paths(PKG)
+        findings_list, errors_list = lint_paths([PKG])
+        assert not errors_str
+        assert len(findings_str) == len(findings_list)
+        assert findings_str  # the 13 justified suppressions, at least
+
+    def test_missing_path_is_an_error_not_a_clean_pass(self):
+        findings, errors = lint_paths(["/no/such/dir/anywhere"])
+        assert not findings
+        assert errors and "no such file" in errors[0]
+
+    def test_syntax_error_is_reported_not_skipped(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def broken(:\n")
+        findings, errors = lint_paths([str(bad)])
+        assert errors and "syntax error" in errors[0]
+
+
+class TestCLI:
+    def test_exit_one_on_findings(self, tmp_path, capsys):
+        f = tmp_path / "mod.py"
+        f.write_text(textwrap.dedent("""
+            import jax
+
+            def sample(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))
+                return a + b
+        """))
+        assert main([str(f)]) == 1
+        assert "key-reuse" in capsys.readouterr().out
+
+    def test_exit_zero_on_clean(self, tmp_path, capsys):
+        f = tmp_path / "mod.py"
+        f.write_text("x = 1\n")
+        assert main([str(f)]) == 0
+
+    def test_exit_two_on_missing_path(self, capsys):
+        assert main(["/no/such/dir/anywhere"]) == 2
+
+    def test_exit_two_on_bad_select(self, tmp_path, capsys):
+        f = tmp_path / "mod.py"
+        f.write_text("x = 1\n")
+        assert main([str(f), "--select", "bogus"]) == 2
+
+    def test_json_format(self, tmp_path, capsys):
+        f = tmp_path / "mod.py"
+        f.write_text("x = 1\n")
+        assert main([str(f), "--format", "json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["findings"] == []
+
+    def test_list_rules(self, capsys):
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        assert "key-reuse" in out and "thread-dispatch" in out
+
+
+class TestDiagnosticsLintReport:
+    def test_lint_report_shape(self):
+        from dask_ml_tpu import diagnostics
+
+        report = diagnostics.lint_report()
+        assert report["active"] == 0, report
+        assert report["errors"] == []
+        assert report["suppressed"] >= 1  # the library's justified debt
+        for rule, c in report["counts"].items():
+            assert set(c) == {"active", "suppressed"}
+            assert rule in RULES
+
+    def test_lint_report_explicit_paths(self, tmp_path):
+        from dask_ml_tpu import diagnostics
+
+        f = tmp_path / "mod.py"
+        f.write_text(textwrap.dedent("""
+            import jax
+
+            def sample(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))
+                return a + b
+        """))
+        report = diagnostics.lint_report([str(tmp_path)])
+        assert report["active"] == 1
+        assert report["counts"]["key-reuse"]["active"] == 1

@@ -13,6 +13,7 @@ import sys
 import jax
 import pytest
 
+from conftest import retry_flaky
 from dask_ml_tpu.core._multihost_worker import spawn_group
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -89,12 +90,24 @@ class TestMultihost:
         single = [round(s, 6) for s in search.cv_results_["test_score"]]
         np.testing.assert_allclose(single, parsed[0], atol=1e-4)
 
+    @retry_flaky(
+        attempts=2,
+        match=(r"heartbeat|coordination.?service|barrier.*timed?.?out|"
+               r"deadline.?exceeded|unavailable"),
+    )
     def test_three_process_group(self):
         """Odd process count (3 × 2 devices): the mesh math, the
         hierarchical dcn axis (size 3), and the cross-controller
         agreement must all be nproc-generic, not 2-hardcoded.  All
         three processes must report identical search scores and
-        Hyperband results."""
+        Hyperband results.
+
+        Auto-retried on heartbeat/coordination noise only: 3 jax
+        processes on the 2-core box intermittently starve the
+        coordination service (ROADMAP env note) — that flake class
+        passes in isolation and must not eat a tier-1 lane, while any
+        real score/agreement assertion still fails on the first run.
+        """
         import re
 
         outs = []
@@ -119,6 +132,46 @@ class TestMultihost:
             g.dryrun_multihost(2, local_devices=2)
         finally:
             sys.path.remove(REPO)
+
+
+class TestRetryFlaky:
+    """The auto-retry harness itself: retries ONLY the matched flake
+    class, surfaces real failures immediately."""
+
+    def test_matched_flake_is_retried(self):
+        calls = []
+
+        @retry_flaky(attempts=2, match="heartbeat")
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                raise AssertionError("coordination heartbeat timed out")
+            return "ok"
+
+        with pytest.warns(UserWarning, match="retrying"):
+            assert flaky() == "ok"
+        assert len(calls) == 2
+
+    def test_unmatched_failure_is_not_retried(self):
+        calls = []
+
+        @retry_flaky(attempts=3, match="heartbeat")
+        def broken():
+            calls.append(1)
+            raise AssertionError("scores diverged across processes")
+
+        with pytest.raises(AssertionError, match="diverged"):
+            broken()
+        assert len(calls) == 1
+
+    def test_exhausted_retries_raise_the_flake(self):
+        @retry_flaky(attempts=2, match="heartbeat")
+        def always():
+            raise RuntimeError("heartbeat lost")
+
+        with pytest.warns(UserWarning, match="retrying"):
+            with pytest.raises(RuntimeError, match="heartbeat"):
+                always()
 
 
 class TestGlobalMeshSingleProcess:

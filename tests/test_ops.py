@@ -93,6 +93,85 @@ class TestScatterPolicy:
         with pytest.raises(ValueError, match="DASK_ML_TPU_SCATTER"):
             scatter_strategy(8)
 
+    def test_sharding_mismatch_raises(self, rng):
+        # the error path: a row-sharded/PADDED values zipped with an
+        # unpadded ids (the shard_rows pad divergence) must fail loudly at
+        # trace time, not misalign rows to buckets
+        from dask_ml_tpu.ops import bucket_sum
+
+        vals = jnp.asarray(rng.normal(size=(48, 3)).astype(np.float32))
+        ids = jnp.asarray(rng.randint(0, 4, size=40).astype(np.int32))
+        with pytest.raises(ValueError, match="padded/sharded"):
+            bucket_sum(vals, ids, 4)
+
+    def test_sharded_padded_inputs_align(self, rng, mesh):
+        # positive twin of the mismatch case: when values AND ids ride the
+        # same padded row sharding, the scatter sums match the host oracle
+        # (pad rows neutralized by zero pre-weighting, as consumers do)
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.ops import bucket_sum
+
+        n, k = 37, 5  # deliberately not divisible by the 8-device mesh
+        x = rng.normal(size=(n, 3)).astype(np.float32)
+        ids = rng.randint(0, k, size=n).astype(np.int32)
+        sx = shard_rows(x)
+        sids = shard_rows(ids)
+        w = np.asarray(shard_rows(np.ones(n, np.float32)).mask)[
+            : sx.data.shape[0]]
+        got = np.asarray(bucket_sum(
+            sx.data * jnp.asarray(w)[:, None], sids.data, k))
+        want = np.zeros((k, 3), np.float32)
+        np.add.at(want, ids, x)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_bad_rank_rejected(self, rng):
+        from dask_ml_tpu.ops import bucket_sum
+
+        ids = jnp.asarray(rng.randint(0, 4, size=8).astype(np.int32))
+        with pytest.raises(ValueError, match="1-d or 2-d"):
+            bucket_sum(jnp.zeros((8, 2, 2)), ids, 4)
+        with pytest.raises(ValueError, match="ids must be 1-d"):
+            bucket_sum(jnp.zeros((8,)), jnp.zeros((8, 2), jnp.int32), 4)
+
+    def test_bad_explicit_strategy_rejected(self, rng):
+        from dask_ml_tpu.ops import bucket_sum
+
+        ids = jnp.asarray(rng.randint(0, 4, size=8).astype(np.int32))
+        with pytest.raises(ValueError, match="strategy"):
+            bucket_sum(jnp.zeros((8,)), ids, 4, strategy="matmulish")
+        # ...and the typo must surface even when the large-segment OOM
+        # guard would have overridden the strategy anyway
+        big_ids = jnp.asarray(rng.randint(0, 2000, size=8).astype(np.int32))
+        with pytest.raises(ValueError, match="strategy"):
+            bucket_sum(jnp.zeros((8,)), big_ids, 2000, strategy="matmulish")
+
+    def test_explicit_strategy_pass_through(self, rng):
+        # callers inside jit resolve the strategy OUTSIDE the trace and
+        # pass it through; both explicit forms must agree with the oracle
+        from dask_ml_tpu.ops import bucket_sum
+
+        vals = rng.normal(size=16).astype(np.float32)
+        ids = rng.randint(0, 4, size=16).astype(np.int32)
+        want = np.zeros(4, np.float32)
+        np.add.at(want, ids, vals)
+        for strat in ("segsum", "onehot"):
+            got = np.asarray(bucket_sum(
+                jnp.asarray(vals), jnp.asarray(ids), 4, strategy=strat))
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_explicit_onehot_overridden_above_segment_cap(self, rng):
+        # the OOM guard binds even for an explicit strategy argument:
+        # 4096 one-hot columns is memory-quadratic everywhere
+        from dask_ml_tpu.ops import bucket_sum
+
+        vals = rng.normal(size=32).astype(np.float32)
+        ids = rng.randint(0, 4096, size=32).astype(np.int32)
+        want = np.zeros(4096, np.float32)
+        np.add.at(want, ids, vals)
+        got = np.asarray(bucket_sum(
+            jnp.asarray(vals), jnp.asarray(ids), 4096, strategy="onehot"))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
     def test_kmeans_equal_under_both_strategies(self, rng, monkeypatch,
                                                 mesh):
         import jax as _jax

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Pre-commit check: graftlint (the repo's JAX/SPMD-aware static analyzer)
+# plus a bytecode-compile sweep.  Fast (no tests, no jax programs) — run
+# it before every commit; tier-1 runs the same gate via
+# tests/test_graftlint.py.
+#
+# Usage: tools/lint.sh [extra graftlint args, e.g. --format json]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== graftlint =="
+JAX_PLATFORMS=cpu python -m dask_ml_tpu.analysis dask_ml_tpu "$@"
+
+echo "== compileall =="
+python -m compileall -q dask_ml_tpu
+echo "lint OK"
