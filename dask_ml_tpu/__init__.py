@@ -34,6 +34,7 @@ from . import linear_model  # noqa: F401
 from . import feature_extraction  # noqa: F401
 from . import impute  # noqa: F401
 from . import io  # noqa: F401
+from . import pipeline  # noqa: F401
 from . import ops  # noqa: F401
 from . import naive_bayes  # noqa: F401
 from . import ensemble  # noqa: F401
@@ -58,6 +59,7 @@ __all__ = [
     "feature_extraction",
     "impute",
     "io",
+    "pipeline",
     "ops",
     "naive_bayes",
     "ensemble",

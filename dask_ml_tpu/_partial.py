@@ -6,6 +6,14 @@ graph*, with the model hopping worker→worker.  On TPU the inversion is the
 design (SURVEY.md §3.5): the model state stays put (device arrays for our
 estimators, host object for wrapped sklearn estimators) and the data
 streams through in row chunks.
+
+The stream rides :mod:`dask_ml_tpu.pipeline`: block *k+1*'s slice/parse
+and host→device staging run on a prefetch thread while block *k*'s
+device step executes (``DASK_ML_TPU_PREFETCH_DEPTH``; 0 restores the
+strictly serial seed behavior).  ``x`` may also be an ITERATOR of blocks
+(``io.stream_csv_blocks``, ``io.stream_binary_blocks``, or any generator
+yielding ``X`` or ``(X, y)``) for out-of-core streams that never exist
+as one array.
 """
 
 from __future__ import annotations
@@ -25,15 +33,56 @@ def _row_chunks(n: int, chunk_size: int):
         yield start, min(start + chunk_size, n)
 
 
+def _iter_block_pairs(x):
+    """Normalize an iterator source's items to ``(X, y_or_None)``."""
+    for item in x:
+        if isinstance(item, tuple):
+            if len(item) != 2:
+                raise ValueError(
+                    f"block tuples must be (X, y); got length {len(item)}"
+                )
+            yield item
+        else:
+            yield item, None
+
+
 def fit(model, x, y=None, *, chunk_size: int | None = None, shuffle_blocks=False,
-        random_state=None, **kwargs):
+        random_state=None, prefetch_depth: int | None = None, **kwargs):
     """Stream row chunks of (x, y) through ``model.partial_fit`` in order.
 
     Reference: ``dask_ml/_partial.py :: fit``.  ``shuffle_blocks`` permutes
     the chunk visit order (the reference shuffles dask blocks the same way).
     ``chunk_size`` defaults to the shared device bucket size so
     default-chunk streams pad zero extra rows per ``partial_fit``.
+
+    ``x`` may be an iterator/generator of blocks (each ``X`` or
+    ``(X, y)``); then ``y`` must be None (targets ride the stream) and
+    ``shuffle_blocks`` is IGNORED — a one-shot stream has no random
+    access to permute, and ``Incremental``'s default (True) must not
+    make direct reader feeds error; blocks train in stream order.
+    ``prefetch_depth`` (default: the ``DASK_ML_TPU_PREFETCH_DEPTH``
+    knob) overlaps the next block's parse + H2D staging with the
+    current block's device step; results are bit-identical at every
+    depth.
     """
+    from .pipeline import stream_partial_fit
+
+    if hasattr(x, "__next__"):
+        if y is not None:
+            raise ValueError(
+                "with an iterator of blocks, y must ride the stream as "
+                "(X, y) tuples, not be passed separately"
+            )
+        if shuffle_blocks:
+            logger.debug(
+                "shuffle_blocks ignored for an iterator source: a "
+                "one-shot stream has no random access to permute"
+            )
+        return stream_partial_fit(
+            model, _iter_block_pairs(x), depth=prefetch_depth,
+            fit_kwargs=kwargs,
+        )
+
     xv = unshard(x) if isinstance(x, ShardedRows) else np.asarray(x)
     if chunk_size is None:
         from .linear_model._sgd import DEFAULT_STREAM_CHUNK
@@ -55,17 +104,35 @@ def fit(model, x, y=None, *, chunk_size: int | None = None, shuffle_blocks=False
     if shuffle_blocks:
         rng = check_random_state(random_state)
         rng.shuffle(spans)
-    for i, (lo, hi) in enumerate(spans):
-        if yv is not None:
-            model.partial_fit(xv[lo:hi], yv[lo:hi], **kwargs)
-        else:
-            model.partial_fit(xv[lo:hi], **kwargs)
-        logger.debug("partial_fit chunk %d/%d", i + 1, len(spans))
-    return model
+
+    def _blocks():
+        for i, (lo, hi) in enumerate(spans):
+            logger.debug("partial_fit chunk %d/%d", i + 1, len(spans))
+            yield xv[lo:hi], (None if yv is None else yv[lo:hi])
+
+    return stream_partial_fit(
+        model, _blocks(), depth=prefetch_depth, fit_kwargs=kwargs,
+    )
 
 
-def predict(model, x, *, chunk_size: int = 100_000):
-    """Chunked predict (reference ``_partial.predict``: blockwise)."""
-    xv = unshard(x) if isinstance(x, ShardedRows) else np.asarray(x)
-    outs = [model.predict(xv[lo:hi]) for lo, hi in _row_chunks(xv.shape[0], chunk_size)]
+def predict(model, x, *, chunk_size: int = 100_000,
+            prefetch_depth: int | None = None):
+    """Chunked predict (reference ``_partial.predict``: blockwise).
+
+    ``x`` may be an iterator of blocks (out-of-core inference); array
+    input is sliced as before.  The prefetch thread pulls/parses block
+    k+1 while the model predicts block k.
+    """
+    from .pipeline import prefetch_blocks
+
+    if hasattr(x, "__next__"):
+        blocks = x
+    else:
+        xv = unshard(x) if isinstance(x, ShardedRows) else np.asarray(x)
+        blocks = (xv[lo:hi] for lo, hi in _row_chunks(xv.shape[0], chunk_size))
+    outs = [
+        np.asarray(model.predict(xb))
+        for xb in prefetch_blocks(blocks, depth=prefetch_depth,
+                                  label="partial_predict")
+    ]
     return np.concatenate(outs)

@@ -241,6 +241,41 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
             self.n_features_in_ = X.data.shape[1]
             self.n_steps_ = 0
 
+    # -- staged streaming protocol (pipeline.stream_partial_fit) -----------
+    def _pf_stage(self, X, y=None, sample_weight=None, **kwargs):
+        """Host bucket-pad + device upload of one stream block (the
+        ``partial_fit`` host branch, run ahead on the prefetch worker).
+        Declines device-resident input (staging it would dispatch
+        programs off-thread) and per-block weighting (``reweight_rows``
+        is a device program); ``y`` is accepted and ignored, matching
+        ``partial_fit``."""
+        if (kwargs or sample_weight is not None
+                or isinstance(X, (ShardedRows, jnp.ndarray))):
+            return None
+        from ..linear_model._sgd import _bucket_pad
+
+        Xh = np.asarray(X, dtype=np.float32)
+        n = Xh.shape[0]
+        Xh, _, mask = _bucket_pad(Xh)
+        return ShardedRows(
+            data=jnp.asarray(Xh), mask=jnp.asarray(mask), n_samples=n
+        )
+
+    def _pf_consume(self, staged):
+        """One fused Sculley update on a pre-staged block (consumer
+        thread: the only thread dispatching device programs)."""
+        from ..resilience.testing import maybe_fault
+
+        maybe_fault("step")
+        X = _ingest_float(self, staged)
+        self._ensure_state(X)
+        self.cluster_centers_, self._counts, inertia = _mbk_step(
+            self.cluster_centers_, self._counts, X.data, X.mask
+        )
+        self.n_steps_ += 1
+        self._inertia_last = inertia  # device scalar; fetch only on demand
+        return self
+
     # -- streaming contract ------------------------------------------------
     def partial_fit(self, X, y=None, sample_weight=None, **kwargs):
         """One fused device update on this block (the budget unit).
@@ -249,31 +284,26 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
         (``linear_model._sgd._BUCKETS``) before ingest, so a stream of
         ragged chunk sizes compiles a handful of programs, not one per
         distinct length.  ``sample_weight`` folds into the mask (sklearn
-        semantics: weighted center means, weighted 1/n_c decay)."""
-        from ..resilience.testing import maybe_fault
+        semantics: weighted center means, weighted 1/n_c decay).
 
-        maybe_fault("step")
+        Composed from the staged-protocol hooks — ``_pf_stage`` (host
+        pad + upload) then ``_pf_consume`` (ingest cast + device step)
+        — so the serial path and the prefetch pipeline can never drift
+        apart.  The weighted path reweights between the two:
+        ``reweight_rows`` only rebuilds the MASK, so it commutes with
+        ``_pf_consume``'s dtype ingest of the data."""
         if not isinstance(X, ShardedRows):
-            from ..linear_model._sgd import _bucket_pad
-
-            Xh = np.asarray(X, dtype=np.float32)
-            n = Xh.shape[0]
-            Xh, _, mask = _bucket_pad(Xh)
-            X = ShardedRows(
-                data=jnp.asarray(Xh), mask=jnp.asarray(mask), n_samples=n
-            )
-        X = _ingest_float(self, X)
+            staged = self._pf_stage(X)
+            if staged is None:
+                # device-born jax.Array block: the D2H fetch is legal
+                # HERE (consumer thread), then the same host pad path
+                staged = self._pf_stage(np.asarray(X))
+            X = staged
         if sample_weight is not None:
             from ..utils import reweight_rows
 
             X = reweight_rows(X, sample_weight=sample_weight)
-        self._ensure_state(X)
-        self.cluster_centers_, self._counts, inertia = _mbk_step(
-            self.cluster_centers_, self._counts, X.data, X.mask
-        )
-        self.n_steps_ += 1
-        self._inertia_last = inertia  # device scalar; fetch only on demand
-        return self
+        return self._pf_consume(X)
 
     # -- whole-array fit ---------------------------------------------------
     def fit(self, X, y=None, sample_weight=None):

@@ -78,15 +78,43 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
         self.var_ = jnp.zeros((d,), dtype=dtype)
         self.n_samples_seen_ = 0
 
+    # -- staged streaming protocol (pipeline.stream_partial_fit) -----------
+    def _pf_stage(self, X, y=None, check_input=True, **kwargs):
+        """Host validate/cast + device upload of one batch, run ahead on
+        the prefetch worker while the previous batch's rank-update SVD
+        executes.  Declines device-resident input (ShardedRows or
+        jax.Array): staging those would mean a device fetch — or a
+        device cast program — off the consumer thread."""
+        if kwargs or isinstance(X, (ShardedRows, jnp.ndarray)):
+            return None
+        if check_input:
+            X = check_array(X)
+        xh = np.asarray(X)
+        if not np.issubdtype(xh.dtype, np.inexact):
+            # cast on HOST: a device astype is a program, which the
+            # worker thread must never dispatch
+            xh = xh.astype(np.float32)
+        return jnp.asarray(xh)
+
     def partial_fit(self, X, y=None, check_input=True):
+        # composed from the staged hooks so serial and prefetched paths
+        # cannot drift; device-resident input takes the consumer-thread
+        # ingest _pf_stage declines (jnp cast is a program — legal here)
+        x = self._pf_stage(X, check_input=check_input)
+        if x is None:
+            if check_input:
+                X = check_array(X)
+            x = jnp.asarray(unshard(X) if isinstance(X, ShardedRows) else X)
+            if not jnp.issubdtype(x.dtype, jnp.inexact):
+                x = x.astype(jnp.float32)
+        return self._pf_consume(x)
+
+    def _pf_consume(self, x):
+        """One incremental rank-update on a device-staged batch (the
+        ``partial_fit`` body below the ingest; consumer thread only)."""
         from ..resilience.testing import maybe_fault
 
         maybe_fault("step")
-        if check_input:
-            X = check_array(X)
-        x = jnp.asarray(unshard(X) if isinstance(X, ShardedRows) else X)
-        if not jnp.issubdtype(x.dtype, jnp.inexact):
-            x = x.astype(jnp.float32)
         d = x.shape[1]
         k = self.n_components or min(x.shape[0], d)
         if not hasattr(self, "components_"):
@@ -167,18 +195,34 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
         # resolved rank: explicit, else inferred from the first batch as
         # partial_fit will (sklearn drops tails < rank via gen_batches)
         k = self.n_components or min(batch, n, d)
-        i = 0
+        spans = []
         for start in range(0, n, batch):
             stop = min(start + batch, n)
             if stop - start < k:
                 break
-            i += 1
-            if i <= done_batches:
-                continue  # already folded into the resumed state
-            self.partial_fit(x[start:stop], check_input=False)
+            spans.append((start, stop))
+
+        def _boundary(j, _model):
+            # consumer-thread hook between device steps: the snapshot
+            # reflects exactly the first ``i`` batches; prefetched
+            # in-flight batches never touched the state, so a resume
+            # re-slices and replays them identically
+            i = done_batches + j
             if ckpt is not None and ckpt.due(i):
                 ckpt.save(self, self._fit_state(), i)
             check_preemption(ckpt, self, self._fit_state(), i)
+
+        from ..pipeline import stream_partial_fit
+
+        # batches after the resume point stream through the prefetch
+        # pipeline: batch i+1's slice + upload overlaps batch i's SVD
+        stream_partial_fit(
+            self,
+            ((x[s:e], None) for s, e in spans[done_batches:]),
+            fit_kwargs={"check_input": False},
+            on_block=_boundary,
+            label="incremental_pca_fit",
+        )
         if ckpt is not None:
             ckpt.complete()
         return self

@@ -30,9 +30,19 @@ from .resilience.retry import (  # noqa: F401
     reset_fault_stats,
 )
 
+# input-pipeline observability (re-export): the parse/transfer/compute
+# stage split every streamed fit records (pipeline.stats) — the round-5
+# verdict's "measure the disk->device bottleneck instead of asserting
+# it", kept in the same "what happened during that fit" namespace
+from .pipeline import (  # noqa: F401
+    pipeline_report,
+    reset_pipeline_stats,
+)
+
 __all__ = [
     "trace", "benchmark_step", "benchmark_slope", "_timer",
     "FaultStats", "fault_stats", "reset_fault_stats",
+    "pipeline_report", "reset_pipeline_stats",
     "lint_report",
 ]
 

@@ -21,6 +21,7 @@ __all__ = [
     "read_csv",
     "read_binary",
     "stream_csv_blocks",
+    "stream_binary_blocks",
     "read_csv_sharded",
     "stream_text_lines",
 ]
@@ -209,6 +210,54 @@ def stream_csv_blocks(path: str, block_rows: int, *, has_header: bool = False,
             yield buf[: got.value]
     finally:
         lib.dmlt_stream_close(handle)
+
+
+def stream_binary_blocks(path: str, block_rows: int, n_features: int, *,
+                         n_rows: int | None = None, offset_bytes: int = 0,
+                         retries: int = 0, retry_backoff: float = 0.1):
+    """Yield float32 row blocks of (at most) ``block_rows`` from a raw
+    little-endian float32 file — the binary twin of
+    :func:`stream_csv_blocks`, for out-of-core streams whose parse cost
+    is pure disk read.
+
+    ``n_rows`` defaults to every complete row after ``offset_bytes``
+    (the file may carry a trailing partial row, e.g. an interrupted
+    writer — it is ignored, matching the complete-blocks contract).
+    Feed the generator to ``_partial.fit`` / ``wrappers.Incremental`` to
+    ride the prefetch pipeline (:mod:`dask_ml_tpu.pipeline`): block
+    *k+1*'s read + H2D staging then overlaps block *k*'s device step.
+
+    ``retries`` re-attempts each BLOCK read on a transient fault
+    (:func:`dask_ml_tpu.resilience.retry`, tag ``"ingest"``); reads are
+    offset-addressed, so a failed attempt never skips rows.
+    """
+    if block_rows < 1:
+        raise ValueError(f"block_rows must be >= 1, got {block_rows}")
+    if n_features < 1:
+        raise ValueError(f"n_features must be >= 1, got {n_features}")
+    from .resilience.retry import retry as _retry
+    from .resilience.testing import maybe_fault
+
+    row_bytes = 4 * int(n_features)
+    if n_rows is None:
+        try:
+            total = os.path.getsize(path)
+        except OSError as e:
+            raise OSError(e.errno or 2, e.strerror or "stat failed", path)
+        n_rows = max(total - int(offset_bytes), 0) // row_bytes
+    n_rows = int(n_rows)
+
+    def _read_block(lo, rows):
+        maybe_fault("ingest")
+        return read_binary(
+            path, (rows, int(n_features)),
+            offset_bytes=int(offset_bytes) + lo * row_bytes,
+        )
+
+    for lo in range(0, n_rows, int(block_rows)):
+        rows = min(int(block_rows), n_rows - lo)
+        yield _retry(_read_block, lo, rows, retries=int(retries),
+                     backoff=retry_backoff, tag="ingest")
 
 
 def stream_text_lines(path: str, block_lines: int = 10_000):

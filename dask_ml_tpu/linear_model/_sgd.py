@@ -581,6 +581,36 @@ class _BaseSGD(TPUEstimator):
         )
         return loss
 
+    # -- staged streaming protocol (pipeline.stream_partial_fit) ----------
+    def _pf_consume(self, staged):
+        """Device step on a block pre-staged by :meth:`_pf_stage` —
+        ``partial_fit`` minus the host encode/pad/upload, which the
+        pipeline's worker thread already ran for this block while the
+        previous one computed.  Runs on the consumer thread (program
+        dispatch stays single-threaded, design.md §7)."""
+        from ..resilience.testing import maybe_fault
+
+        maybe_fault("step")
+        xb, yb, mask = staged
+        self._ensure_state(xb.shape[1])
+        self._loss_ = self._step_block(xb, yb, mask)
+        return self
+
+    def _pf_stage_ok(self, X, y, sample_weight, kwargs) -> bool:
+        """Eligibility gate shared by the staged-protocol probes: host
+        blocks only — staging a device-resident block (ShardedRows OR a
+        bare jax.Array) would fetch/cast/dispatch on the worker thread,
+        the thread-dispatch hazard — and no per-block weighting
+        (``effective_mask`` is itself a device program; those calls
+        keep the serial path)."""
+        return not (
+            kwargs
+            or sample_weight is not None
+            or y is None
+            or isinstance(X, (ShardedRows, jnp.ndarray))
+            or isinstance(y, (ShardedRows, jnp.ndarray))
+        )
+
     # device state lives in a non-underscore-suffixed private attr; tell
     # checkpoint.save_estimator to persist it with the fitted attrs
     _checkpoint_private_attrs = ("_state",)
@@ -728,11 +758,34 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
             classes=classes, n_samples=n_real,
         )
 
+    def _pf_stage(self, X, y, classes=None, sample_weight=None, **kwargs):
+        """Host parse → ±1 OvA encode → bucket-pad → device upload for
+        ONE stream block; returns the staged ``(xb, yb, mask)`` payload
+        for :meth:`_BaseSGD._pf_consume`, or None to decline THAT block
+        (the pipeline then routes it through serial ``partial_fit``).
+        Safe on the prefetch worker thread: pure host work plus H2D
+        puts, no device program dispatched.  ``classes_`` thread
+        contract: the first writer wins-and-matches — stage k+1 happens
+        strictly after stage k on the one worker (queue order), the
+        consumer only consumes blocks whose stage already finished, and
+        both the staged and the serial-fallback first call derive
+        ``classes_`` from the SAME constant ``classes`` kwarg, so every
+        writer writes the identical value and later calls only read."""
+        if not self._pf_stage_ok(X, y, sample_weight, kwargs):
+            return None
+        if getattr(self, "class_weight", None) is not None:
+            return None  # effective_mask is a device program: serial path
+        self._validate()
+        if not hasattr(self, "classes_"):
+            if classes is None:
+                raise ValueError(
+                    "classes must be passed on the first partial_fit call"
+                )
+            self._set_classes(classes)
+        return self._prep_block(X, self._encode_targets(np.asarray(y)))
+
     def partial_fit(self, X, y, classes=None, sample_weight=None, **kwargs):
         self._validate()
-        from ..resilience.testing import maybe_fault
-
-        maybe_fault("step")
         if not hasattr(self, "classes_"):
             if classes is None:
                 raise ValueError(
@@ -755,9 +808,9 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
         mask = self._apply_weights(
             yb, mask, sample_weight, n_real, allow_balanced=False
         )
-        self._ensure_state(xb.shape[1])
-        self._loss_ = self._step_block(xb, yb, mask)
-        return self
+        # the device step is the shared _pf_consume tail, so the serial
+        # path and the prefetch pipeline can never drift apart
+        return self._pf_consume((xb, yb, mask))
 
     def fit(self, X, y, sample_weight=None, **kwargs):
         self._validate()
@@ -935,16 +988,19 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
             mask, sample_weight=sample_weight, n_samples=n_real
         )
 
+    def _pf_stage(self, X, y, sample_weight=None, **kwargs):
+        """Regressor twin of :meth:`SGDClassifier._pf_stage`: host
+        reshape + bucket-pad + upload, no device program dispatch."""
+        if not self._pf_stage_ok(X, y, sample_weight, kwargs):
+            return None
+        self._validate()
+        return self._prep_block(X, self._targets(y))
+
     def partial_fit(self, X, y, sample_weight=None, **kwargs):
         self._validate()
-        from ..resilience.testing import maybe_fault
-
-        maybe_fault("step")
         xb, yb, mask = self._prep_block(X, self._targets(y, X))
         mask = self._weighted_mask(X, mask, sample_weight)
-        self._ensure_state(xb.shape[1])
-        self._loss_ = self._step_block(xb, yb, mask)
-        return self
+        return self._pf_consume((xb, yb, mask))
 
     def fit(self, X, y, sample_weight=None, **kwargs):
         self._validate()

@@ -1,0 +1,28 @@
+"""Input pipeline: prefetch, double-buffering, and stage-split timing.
+
+See :mod:`dask_ml_tpu.pipeline.core` for the overlap design and
+:mod:`dask_ml_tpu.pipeline.stats` for the parse/transfer/compute books
+(surfaced via :func:`dask_ml_tpu.diagnostics.pipeline_report`).
+"""
+
+from .core import (  # noqa: F401
+    DEPTH_ENV,
+    prefetch_blocks,
+    resolve_depth,
+    stream_partial_fit,
+)
+from .stats import (  # noqa: F401
+    PipelineStats,
+    pipeline_report,
+    reset_pipeline_stats,
+)
+
+__all__ = [
+    "DEPTH_ENV",
+    "resolve_depth",
+    "prefetch_blocks",
+    "stream_partial_fit",
+    "PipelineStats",
+    "pipeline_report",
+    "reset_pipeline_stats",
+]

@@ -1,0 +1,285 @@
+"""Overlapped host→device input pipeline: bounded-depth block prefetch.
+
+Every streaming fit in this repo moves blocks through three stages:
+
+1. **parse** — the host reads/parses the next block (native CSV/binary
+   loader, a generator, or a slice of an in-memory array);
+2. **transfer** — the block is staged onto the device (bucket-pad +
+   ``device_put``-style upload, target encoding for classifiers);
+3. **compute** — the device step consumes it (``partial_fit`` — one
+   fused XLA program for the device-native estimators).
+
+The seed ran them strictly serially: the device idled through every
+parse and upload (``streamed_loader_fed`` measured ~151k rows/s against
+a 12.5M rows/s device consumer, BENCH_r05.json).  This module is the
+tf.data-style fix: a single **host-only worker thread** runs stages 1–2
+for block *k+1* while the consumer thread runs stage 3 for block *k*,
+through a bounded queue of ``depth`` staged blocks — double-buffering at
+``depth=1``, deeper pipelining above.
+
+Concurrency contract (docs/design.md §7, enforced by graftlint): the
+worker thread NEVER dispatches a device program.  It parses host bytes
+and issues host→device transfers (``jnp.asarray`` of numpy blocks — a
+put, not a program); all program dispatch — the jitted step, any dtype
+cast or reshard of device-resident data — stays on the consumer thread.
+That is why the staged protocol below declines device-resident
+(``ShardedRows``) inputs: "staging" those would mean dispatching
+programs off-thread, the exact PR-1 deadlock class.
+
+Determinism contract: blocks are consumed in source order at every
+depth, and staging is the same pure host→device conversion the serial
+path performs — so results are bit-identical to ``depth=0`` by
+construction (asserted across estimators in tests/test_pipeline.py).
+
+Resilience: the io readers' per-block ``retry`` runs INSIDE the worker
+(a transient read fault is absorbed without stalling the device longer
+than the backoff); a propagated failure surfaces on the consumer thread
+at the failed block's position.  Prefetched-but-unconsumed blocks are
+dropped on close and never reach the model, so a ``FitCheckpoint``
+resume replays exactly the blocks after the last consumed one.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+
+from .stats import PipelineStats
+
+__all__ = [
+    "DEPTH_ENV",
+    "resolve_depth",
+    "prefetch_blocks",
+    "stream_partial_fit",
+]
+
+#: policy knob: default prefetch depth for every streaming consumer.
+#: 0 = the seed's serial behavior; k >= 1 = k blocks staged ahead.
+DEPTH_ENV = "DASK_ML_TPU_PREFETCH_DEPTH"
+
+_DEFAULT_DEPTH = 2
+
+_DONE = object()  # worker sentinel: source exhausted
+
+
+class _WorkerError:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def resolve_depth(depth: int | None = None) -> int:
+    """Resolve a prefetch depth: explicit argument, else the
+    ``DASK_ML_TPU_PREFETCH_DEPTH`` env knob, else the default (2)."""
+    if depth is None:
+        raw = os.environ.get(DEPTH_ENV, "").strip()
+        if raw:
+            try:
+                depth = int(raw)
+            except ValueError:
+                raise ValueError(
+                    f"{DEPTH_ENV} must be an integer, got {raw!r}"
+                ) from None
+        else:
+            depth = _DEFAULT_DEPTH
+    depth = int(depth)
+    if depth < 0:
+        raise ValueError(f"prefetch depth must be >= 0, got {depth}")
+    return depth
+
+
+def _staged_iter(src, stage, depth: int, stats: PipelineStats):
+    """Yield ``stage(item)`` for each item of ``src``, staged up to
+    ``depth`` blocks ahead on a host worker thread.
+
+    ``depth <= 0`` degrades to the inline serial loop (same timings
+    recorded, no thread).  Worker faults re-raise on the consumer thread
+    at the failed block's position; closing the generator stops the
+    worker promptly even when it is blocked on a full queue.
+    """
+    if depth <= 0:
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(src)
+            except StopIteration:
+                return
+            finally:
+                stats.parse_s += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            staged = stage(item)
+            stats.transfer_s += time.perf_counter() - t0
+            yield staged
+
+    # depth >= 1: bounded queue + one host-only staging worker
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def _put(msg) -> bool:
+        """Queue-put that stays responsive to consumer shutdown."""
+        while not stop.is_set():
+            try:
+                q.put(msg, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _work():
+        try:
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    item = next(src)
+                except StopIteration:
+                    _put(_DONE)
+                    return
+                finally:
+                    stats.parse_s += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                staged = stage(item)
+                stats.transfer_s += time.perf_counter() - t0
+                if not _put(staged):
+                    return
+        except BaseException as exc:  # propagate to the consumer
+            _put(_WorkerError(exc))
+
+    # host-only staging worker: parses blocks and issues host->device
+    # transfers; it never dispatches a device program (the jitted step
+    # and any device-resident cast/reshard stay on the consumer thread
+    # -- module docstring / design.md "input pipeline"), so it cannot
+    # interleave multi-device enqueue order
+    # graftlint: disable=thread-dispatch -- host-only prefetch worker: parse + H2D staging puts, never device program dispatch (design.md input-pipeline contract)
+    worker = threading.Thread(
+        target=_work, daemon=True, name="dask-ml-tpu-prefetch",
+    )
+    worker.start()
+    try:
+        while True:
+            t0 = time.perf_counter()
+            msg = q.get()
+            stats.stall_s += time.perf_counter() - t0
+            if msg is _DONE:
+                return
+            if isinstance(msg, _WorkerError):
+                raise msg.exc
+            yield msg
+    finally:
+        stop.set()
+        try:  # unblock a worker stuck in q.put full-wait
+            q.get_nowait()
+        except queue.Empty:
+            pass
+        worker.join(timeout=5.0)
+
+
+def _identity(x):
+    return x
+
+
+def prefetch_blocks(blocks, *, depth: int | None = None,
+                    stage=None, label: str = "stream"):
+    """Generator over ``blocks`` with bounded host-thread prefetch.
+
+    The building block the consumers share: ``stage`` (default identity)
+    runs on the worker thread — host parse is timed around the source
+    pull, staging around ``stage``.  Records a :class:`PipelineStats`
+    when the stream completes or closes.
+    """
+    depth = resolve_depth(depth)
+    stage = stage or _identity
+    stats = PipelineStats(label=label, depth=depth, staged=stage is not _identity)
+    feed = _staged_iter(iter(blocks), stage, depth, stats)
+    try:
+        for staged in feed:
+            t0 = time.perf_counter()
+            yield staged
+            stats.compute_s += time.perf_counter() - t0
+            stats.blocks += 1
+    finally:
+        feed.close()  # stop the worker promptly on early exit
+        stats.finish()
+
+
+def _supports_staging(model) -> bool:
+    return hasattr(model, "_pf_stage") and hasattr(model, "_pf_consume")
+
+
+def stream_partial_fit(model, blocks, *, depth: int | None = None,
+                       fit_kwargs: dict | None = None, on_block=None,
+                       label: str = "partial_fit_stream"):
+    """Drive ``model.partial_fit`` over an iterator of ``(X, y)`` block
+    pairs with prefetch + early H2D staging.
+
+    When the model implements the staged protocol (``_pf_stage``/
+    ``_pf_consume``) and ``depth >= 1``, the worker stages each block
+    ahead — block k+1's parse/pad/upload overlaps block k's device
+    step.  ``_pf_stage`` decides PER BLOCK: a ``None`` return (device-
+    resident input, unsupported kwargs) routes that block — and only
+    that block — through plain ``partial_fit`` on the consumer thread,
+    so heterogeneous streams degrade gracefully instead of erroring.
+    Models without the protocol get raw-block prefetch (still hiding
+    reader latency behind host estimators' compute).  ``depth=0`` is
+    the serial seed path: plain ``partial_fit`` per block, no thread,
+    no staging.
+
+    ``on_block(i, model)`` (1-based consumed count) fires after each
+    consumed block — the checkpoint/preemption hook: it runs on the
+    consumer thread between device steps, so a ``FitCheckpoint`` save or
+    a ``TrainingPreempted`` raise sees a model state that reflects
+    exactly the first ``i`` blocks, never an in-flight prefetched one.
+
+    Returns ``model``.  Records a :class:`PipelineStats` either way.
+    """
+    kw = dict(fit_kwargs or {})
+    depth = resolve_depth(depth)
+    staged_proto = depth > 0 and _supports_staging(model)
+    stats = PipelineStats(label=label, depth=depth, staged=staged_proto)
+
+    def _raw_consume(blk):
+        bx, by = blk
+        if by is None:
+            model.partial_fit(bx, **kw)
+        else:
+            model.partial_fit(bx, by, **kw)
+
+    if staged_proto:
+        # the raw block rides along ONLY when staging declined (None),
+        # so the fallback can serial-partial_fit exactly that block;
+        # a successfully staged block drops its host copy immediately —
+        # queued memory stays one copy per block, not two
+        def _stage(blk):
+            staged = model._pf_stage(blk[0], blk[1], **kw)
+            return (blk if staged is None else None), staged
+
+        def _consume(item):
+            blk, staged = item
+            if staged is None:
+                _raw_consume(blk)
+            else:
+                model._pf_consume(staged)
+    else:
+        def _stage(blk):
+            return blk
+
+        _consume = _raw_consume
+
+    feed = _staged_iter(iter(blocks), _stage, depth, stats)
+    done = 0
+    try:
+        for item in feed:
+            t0 = time.perf_counter()
+            _consume(item)
+            stats.compute_s += time.perf_counter() - t0
+            stats.blocks += 1
+            done += 1
+            del item  # release the staged buffers: bounded HBM = depth+1 blocks
+            if on_block is not None:
+                on_block(done, model)
+        return model
+    finally:
+        feed.close()
+        stats.finish()

@@ -161,16 +161,21 @@ class Incremental(ParallelPostFit):
     def __init__(self, estimator=None, scoring=None, shuffle_blocks=True,
                  random_state=None, assume_equal_chunks=True,
                  predict_meta=None, predict_proba_meta=None,
-                 transform_meta=None, chunk_size=None):
+                 transform_meta=None, chunk_size=None, prefetch_depth=None):
         # chunk_size=None resolves (in _partial.fit, at use time — the
         # sklearn init contract forbids transforming params here) to the
         # shared device bucket size ``_sgd.DEFAULT_STREAM_CHUNK``: an
         # off-bucket chunk pads every block up to the bucket anyway —
-        # wasted compute per partial_fit on the streaming path
+        # wasted compute per partial_fit on the streaming path.
+        # prefetch_depth=None likewise resolves at use time to the
+        # DASK_ML_TPU_PREFETCH_DEPTH knob (pipeline.resolve_depth): the
+        # next block's parse + H2D staging overlaps the current block's
+        # device step; 0 keeps the strictly serial stream
         self.shuffle_blocks = shuffle_blocks
         self.random_state = random_state
         self.assume_equal_chunks = assume_equal_chunks
         self.chunk_size = chunk_size
+        self.prefetch_depth = prefetch_depth
         super().__init__(
             estimator=estimator, scoring=scoring, predict_meta=predict_meta,
             predict_proba_meta=predict_proba_meta, transform_meta=transform_meta,
@@ -182,6 +187,7 @@ class Incremental(ParallelPostFit):
             chunk_size=self.chunk_size,
             shuffle_blocks=self.shuffle_blocks,
             random_state=self.random_state,
+            prefetch_depth=self.prefetch_depth,
             **fit_kwargs,
         )
         self.estimator_ = estimator

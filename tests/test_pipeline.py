@@ -1,0 +1,465 @@
+"""Input-pipeline tests (ISSUE 3 tentpole): overlap is real, results are
+bit-identical at every prefetch depth, the stage split is measured, and
+checkpoint/fault semantics survive prefetched in-flight blocks."""
+
+import time
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import _partial, diagnostics
+from dask_ml_tpu.pipeline import (
+    DEPTH_ENV,
+    prefetch_blocks,
+    resolve_depth,
+    stream_partial_fit,
+)
+
+
+@pytest.fixture
+def xy_blocks(rng):
+    X = rng.normal(size=(1200, 6)).astype(np.float32)
+    w = rng.normal(size=6)
+    y = (X @ w > 0).astype(np.int32)
+    return X, y
+
+
+class TestResolveDepth:
+    def test_explicit_wins(self):
+        assert resolve_depth(0) == 0
+        assert resolve_depth(5) == 5
+
+    def test_env_knob(self, monkeypatch):
+        monkeypatch.setenv(DEPTH_ENV, "7")
+        assert resolve_depth(None) == 7
+        monkeypatch.setenv(DEPTH_ENV, "0")
+        assert resolve_depth(None) == 0
+
+    def test_default_overlaps(self, monkeypatch):
+        monkeypatch.delenv(DEPTH_ENV, raising=False)
+        assert resolve_depth(None) >= 1
+
+    def test_invalid(self, monkeypatch):
+        with pytest.raises(ValueError):
+            resolve_depth(-1)
+        monkeypatch.setenv(DEPTH_ENV, "two")
+        with pytest.raises(ValueError):
+            resolve_depth(None)
+
+
+class _SleepModel:
+    """partial_fit consumer whose compute is a GIL-releasing sleep —
+    the deterministic stand-in for a device step in the overlap A/B."""
+
+    def __init__(self, step_s):
+        self.step_s = step_s
+        self.seen = []
+
+    def partial_fit(self, X, y=None, **kw):
+        time.sleep(self.step_s)
+        self.seen.append(np.asarray(X).copy())
+        return self
+
+
+def _slow_reader(blocks, delay_s):
+    for b in blocks:
+        time.sleep(delay_s)  # artificially slowed parse stage
+        yield b, None
+
+
+class TestOverlap:
+    def test_depth2_hides_reader_latency(self, rng):
+        """Acceptance criterion: with an artificially slowed reader the
+        depth>=2 streaming fit is measurably faster than depth=0 —
+        overlap, not just buffering: the saving must approach the
+        smaller stage's total, not merely beat noise."""
+        blocks = [rng.normal(size=(64, 4)).astype(np.float32)
+                  for _ in range(8)]
+        delay = 0.03
+
+        def run(depth):
+            model = _SleepModel(step_s=delay)
+            t0 = time.perf_counter()
+            stream_partial_fit(
+                model, _slow_reader(blocks, delay), depth=depth,
+            )
+            return time.perf_counter() - t0, model
+
+        t_serial, m_serial = run(0)
+        t_overlap, m_overlap = run(2)
+        # serial ~ 16*delay, overlapped ~ 9*delay; require >= 20% saving
+        assert t_overlap < t_serial * 0.8, (t_serial, t_overlap)
+        # ...and identical consumption: same blocks, same order
+        assert len(m_serial.seen) == len(m_overlap.seen) == 8
+        for a, b in zip(m_serial.seen, m_overlap.seen):
+            np.testing.assert_array_equal(a, b)
+
+    def test_prefetch_blocks_orders_and_completes(self, rng):
+        blocks = [rng.normal(size=(8, 3)) for _ in range(20)]
+        for depth in (0, 1, 4):
+            got = list(prefetch_blocks(iter(blocks), depth=depth))
+            assert len(got) == 20
+            for a, b in zip(blocks, got):
+                np.testing.assert_array_equal(a, b)
+
+    def test_early_close_stops_worker(self, rng):
+        """Breaking out of a prefetched stream must not hang or keep
+        consuming the source unboundedly."""
+        pulled = []
+
+        def src():
+            for i in range(10_000):
+                pulled.append(i)
+                yield np.zeros((4, 2))
+
+        it = prefetch_blocks(src(), depth=2)
+        next(it)
+        it.close()
+        assert len(pulled) <= 8  # 1 consumed + bounded lookahead
+
+
+class TestBitIdentical:
+    """Acceptance criterion: every streaming estimator produces
+    bit-identical results at every depth (0 = the serial seed path)."""
+
+    DEPTHS = (0, 1, 3)
+
+    def test_sgd_classifier(self, xy_blocks):
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X, y = xy_blocks
+        outs = {}
+        for depth in self.DEPTHS:
+            clf = SGDClassifier(random_state=0)
+            _partial.fit(clf, X, y, chunk_size=256, prefetch_depth=depth,
+                         classes=[0, 1])
+            outs[depth] = (clf.coef_.copy(), clf.intercept_.copy())
+        for depth in self.DEPTHS[1:]:
+            np.testing.assert_array_equal(outs[0][0], outs[depth][0])
+            np.testing.assert_array_equal(outs[0][1], outs[depth][1])
+
+    def test_sgd_regressor(self, xy_blocks):
+        from dask_ml_tpu.linear_model import SGDRegressor
+
+        X, _ = xy_blocks
+        yr = (X @ np.arange(6, dtype=np.float32)).astype(np.float32)
+        outs = {}
+        for depth in self.DEPTHS:
+            reg = SGDRegressor(random_state=0)
+            _partial.fit(reg, X, yr, chunk_size=256, prefetch_depth=depth)
+            outs[depth] = reg.coef_.copy()
+        for depth in self.DEPTHS[1:]:
+            np.testing.assert_array_equal(outs[0], outs[depth])
+
+    def test_minibatch_kmeans(self, xy_blocks):
+        from dask_ml_tpu.cluster import MiniBatchKMeans
+
+        X, _ = xy_blocks
+        outs = {}
+        for depth in self.DEPTHS:
+            mbk = MiniBatchKMeans(n_clusters=5, random_state=0)
+            _partial.fit(mbk, X, chunk_size=300, prefetch_depth=depth)
+            outs[depth] = np.asarray(mbk.cluster_centers_).copy()
+        for depth in self.DEPTHS[1:]:
+            np.testing.assert_array_equal(outs[0], outs[depth])
+
+    def test_incremental_pca(self, xy_blocks, monkeypatch):
+        from dask_ml_tpu.decomposition import IncrementalPCA
+
+        X, _ = xy_blocks
+        outs = {}
+        for depth in self.DEPTHS:
+            monkeypatch.setenv(DEPTH_ENV, str(depth))
+            ipca = IncrementalPCA(n_components=3, batch_size=256)
+            ipca.fit(X)
+            outs[depth] = (
+                np.asarray(ipca.components_).copy(),
+                np.asarray(ipca.mean_).copy(),
+            )
+        for depth in self.DEPTHS[1:]:
+            np.testing.assert_array_equal(outs[0][0], outs[depth][0])
+            np.testing.assert_array_equal(outs[0][1], outs[depth][1])
+
+    def test_wrapped_sklearn_estimator(self, xy_blocks):
+        """Host estimators take the raw-block fallback path — identical
+        results there too (prefetch only reorders WHEN work happens,
+        never WHAT or in what order)."""
+        from sklearn.linear_model import SGDClassifier as SkSGD
+
+        from dask_ml_tpu.wrappers import Incremental
+
+        X, y = xy_blocks
+        outs = {}
+        for depth in self.DEPTHS:
+            inc = Incremental(
+                SkSGD(random_state=0, max_iter=5, tol=None),
+                shuffle_blocks=False, chunk_size=256, prefetch_depth=depth,
+            )
+            inc.fit(X, y, classes=[0, 1])
+            outs[depth] = inc.estimator_.coef_.copy()
+        for depth in self.DEPTHS[1:]:
+            np.testing.assert_array_equal(outs[0], outs[depth])
+
+    def test_shuffled_spans_still_identical(self, xy_blocks):
+        """shuffle_blocks permutes the visit order BEFORE the stream —
+        the permutation is a function of random_state, not of depth."""
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X, y = xy_blocks
+        outs = {}
+        for depth in (0, 2):
+            clf = SGDClassifier(random_state=0)
+            _partial.fit(clf, X, y, chunk_size=256, shuffle_blocks=True,
+                         random_state=42, prefetch_depth=depth,
+                         classes=[0, 1])
+            outs[depth] = clf.coef_.copy()
+        np.testing.assert_array_equal(outs[0], outs[2])
+
+
+class TestIteratorSource:
+    def test_stream_of_tuples(self, xy_blocks):
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X, y = xy_blocks
+        ref = SGDClassifier(random_state=0)
+        _partial.fit(ref, X, y, chunk_size=300, prefetch_depth=0,
+                     classes=[0, 1])
+        stream = ((X[i:i + 300], y[i:i + 300])
+                  for i in range(0, len(X), 300))
+        clf = SGDClassifier(random_state=0)
+        _partial.fit(clf, iter(stream), prefetch_depth=2, classes=[0, 1])
+        np.testing.assert_array_equal(ref.coef_, clf.coef_)
+
+    def test_iterator_rejects_separate_y(self, xy_blocks):
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X, y = xy_blocks
+        with pytest.raises(ValueError, match="ride the stream"):
+            _partial.fit(SGDClassifier(), iter([(X, y)]), y,
+                         classes=[0, 1])
+
+    def test_iterator_ignores_shuffle(self, xy_blocks):
+        """shuffle_blocks is a no-op for one-shot streams — crucially,
+        Incremental's DEFAULT (True) must not make direct reader feeds
+        error; blocks train in stream order either way."""
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X, y = xy_blocks
+        mk = lambda: ((X[i:i + 300], y[i:i + 300])  # noqa: E731
+                      for i in range(0, len(X), 300))
+        ref = SGDClassifier(random_state=0)
+        _partial.fit(ref, iter(mk()), classes=[0, 1])
+        clf = SGDClassifier(random_state=0)
+        _partial.fit(clf, iter(mk()), shuffle_blocks=True, classes=[0, 1])
+        np.testing.assert_array_equal(ref.coef_, clf.coef_)
+
+    def test_incremental_default_args_accept_stream(self, xy_blocks):
+        """The advertised direct feed — Incremental(est).fit(reader) —
+        must work with an all-default constructor."""
+        from dask_ml_tpu.linear_model import SGDClassifier
+        from dask_ml_tpu.wrappers import Incremental
+
+        X, y = xy_blocks
+        stream = ((X[i:i + 300], y[i:i + 300])
+                  for i in range(0, len(X), 300))
+        inc = Incremental(SGDClassifier(random_state=0))
+        inc.fit(iter(stream), classes=[0, 1])
+        ref = SGDClassifier(random_state=0)
+        _partial.fit(ref, X, y, chunk_size=300, prefetch_depth=0,
+                     classes=[0, 1])
+        np.testing.assert_array_equal(ref.coef_, inc.estimator_.coef_)
+
+    def test_mid_stream_stage_decline_falls_back(self, xy_blocks, mesh):
+        """A heterogeneous stream — host blocks with a device-resident
+        (ShardedRows) block in the middle — must degrade that one block
+        to serial partial_fit, not crash the staged pipeline."""
+        from dask_ml_tpu.core import shard_rows
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X, y = xy_blocks
+
+        def mixed():
+            for i in range(0, len(X), 300):
+                xb, yb = X[i:i + 300], y[i:i + 300]
+                if i == 300:  # second block arrives device-resident
+                    yield shard_rows(xb), shard_rows(
+                        yb.astype(np.float32))
+                else:
+                    yield xb, yb
+
+        clf = SGDClassifier(random_state=0)
+        _partial.fit(clf, mixed(), prefetch_depth=2, classes=[0, 1])
+        ref = SGDClassifier(random_state=0)
+        _partial.fit(ref, mixed(), prefetch_depth=0, classes=[0, 1])
+        np.testing.assert_array_equal(ref.coef_, clf.coef_)
+
+    def test_predict_iterator_and_depths(self, xy_blocks):
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X, y = xy_blocks
+        clf = SGDClassifier(random_state=0)
+        _partial.fit(clf, X, y, chunk_size=300, classes=[0, 1])
+        p0 = _partial.predict(clf, X, chunk_size=250, prefetch_depth=0)
+        p2 = _partial.predict(clf, X, chunk_size=250, prefetch_depth=2)
+        pit = _partial.predict(
+            clf, iter(X[i:i + 250] for i in range(0, len(X), 250)),
+            prefetch_depth=2,
+        )
+        np.testing.assert_array_equal(p0, p2)
+        np.testing.assert_array_equal(p0, pit)
+
+
+class TestStageSplit:
+    def test_pipeline_report_has_split(self, xy_blocks):
+        """Acceptance criterion: pipeline_report() returns a
+        parse/transfer/compute split for a streamed fit."""
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X, y = xy_blocks
+        diagnostics.reset_pipeline_stats()
+        clf = SGDClassifier(random_state=0)
+        _partial.fit(clf, X, y, chunk_size=256, prefetch_depth=2,
+                     classes=[0, 1])
+        rep = diagnostics.pipeline_report()
+        assert rep["streams"] == 1
+        assert rep["blocks"] == 5  # ceil(1200/256)
+        assert rep["staged"] is True and rep["depth"] == 2
+        for key in ("parse_s", "transfer_s", "compute_s", "stall_s",
+                    "wall_s", "hidden_s"):
+            assert rep[key] >= 0.0
+        assert rep["compute_s"] > 0.0
+        assert rep["cumulative"]["streams"] == 1
+
+    def test_report_empty_when_reset(self):
+        diagnostics.reset_pipeline_stats()
+        assert diagnostics.pipeline_report() == {"streams": 0}
+
+
+class TestFaultSemantics:
+    def test_worker_fault_surfaces_at_position(self, rng):
+        """A reader fault propagates to the consumer at the failed
+        block's position: earlier blocks are consumed, later never."""
+
+        def src():
+            for i in range(6):
+                if i == 3:
+                    raise OSError("disk went away")
+                yield rng.normal(size=(16, 3)).astype(np.float32), None
+
+        model = _SleepModel(step_s=0.0)
+        with pytest.raises(OSError, match="disk went away"):
+            stream_partial_fit(model, src(), depth=2)
+        assert len(model.seen) == 3
+
+    def test_ingest_retry_inside_worker(self, tmp_path, rng):
+        """io-reader retries run INSIDE the prefetch worker: an absorbed
+        transient fault changes nothing about the delivered stream."""
+        from dask_ml_tpu import io as dio
+        from dask_ml_tpu.resilience.testing import FaultPlan, fault_plan
+
+        X = rng.normal(size=(400, 5)).astype(np.float32)
+        p = tmp_path / "r.bin"
+        X.tofile(p)
+        clean = [
+            b.copy() for b in prefetch_blocks(
+                dio.stream_binary_blocks(str(p), 100, 5), depth=2)
+        ]
+        plan = FaultPlan()
+        plan.inject("ingest", at_call=2, times=1)
+        with fault_plan(plan):
+            got = [
+                b.copy() for b in prefetch_blocks(
+                    dio.stream_binary_blocks(str(p), 100, 5, retries=2),
+                    depth=2)
+            ]
+        assert plan.fired["ingest"] == 1
+        assert len(got) == len(clean) == 4
+        for a, b in zip(clean, got):
+            np.testing.assert_array_equal(a, b)
+
+    def test_step_fault_count_matches_serial(self, xy_blocks):
+        """The staged path fires the 'step' injection point once per
+        consumed block, exactly like serial partial_fit."""
+        from dask_ml_tpu.linear_model import SGDClassifier
+        from dask_ml_tpu.resilience.testing import FaultPlan, fault_plan
+
+        X, y = xy_blocks
+        counts = {}
+        for depth in (0, 2):
+            plan = FaultPlan()  # no injections: just count arrivals
+            with fault_plan(plan):
+                clf = SGDClassifier(random_state=0)
+                _partial.fit(clf, X, y, chunk_size=256,
+                             prefetch_depth=depth, classes=[0, 1])
+            counts[depth] = plan.calls["step"]
+        assert counts[0] == counts[2] == 5
+
+
+class TestCheckpointResume:
+    def test_ipca_resume_under_prefetch_matches_serial(self, tmp_path,
+                                                       xy_blocks,
+                                                       monkeypatch):
+        """FitCheckpoint safety: a fit killed mid-stream (prefetched
+        blocks in flight) resumes to the SAME result as an
+        uninterrupted serial fit — in-flight staged blocks never touch
+        the state, so the snapshot boundary is exact."""
+        from dask_ml_tpu.decomposition import IncrementalPCA
+        from dask_ml_tpu.resilience import FitCheckpoint
+        from dask_ml_tpu.resilience.testing import (
+            FaultInjected, FaultPlan, fault_plan,
+        )
+
+        X, _ = xy_blocks
+        monkeypatch.setenv(DEPTH_ENV, "2")
+        ref = IncrementalPCA(n_components=3, batch_size=200).fit(X)
+
+        path = str(tmp_path / "ipca.ckpt")
+        ipca = IncrementalPCA(
+            n_components=3, batch_size=200,
+            fit_checkpoint=FitCheckpoint(path, every_n_iters=1),
+        )
+        plan = FaultPlan()
+        plan.inject("step", at_call=3, times=1)
+        with fault_plan(plan):
+            with pytest.raises(FaultInjected):
+                ipca.fit(X)
+        assert plan.fired["step"] == 1
+        ipca.fit(X)  # resumes from the snapshot, finishes the sweep
+        np.testing.assert_allclose(
+            np.asarray(ipca.components_), np.asarray(ref.components_),
+            rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ipca.mean_), np.asarray(ref.mean_), rtol=1e-6,
+        )
+
+
+class TestSearchIngest:
+    def test_incremental_search_depth_invariant(self, xy_blocks):
+        """The adaptive-search ingest path (train_one streamed bursts)
+        returns the same winner and scores at every depth."""
+        from dask_ml_tpu.linear_model import SGDClassifier
+        from dask_ml_tpu.model_selection import IncrementalSearchCV
+
+        X, y = xy_blocks
+        results = {}
+        for depth in (0, 2):
+            import os
+            os.environ[DEPTH_ENV] = str(depth)
+            try:
+                search = IncrementalSearchCV(
+                    SGDClassifier(random_state=0),
+                    {"alpha": [1e-4, 1e-2], "eta0": [0.01, 0.1]},
+                    n_initial_parameters="grid",
+                    random_state=0, max_iter=6, fits_per_score=3,
+                )
+                search.fit(X, y, classes=[0, 1])
+            finally:
+                os.environ.pop(DEPTH_ENV, None)
+            results[depth] = (
+                search.best_params_,
+                {m: r[-1]["partial_fit_calls"]
+                 for m, r in search.model_history_.items()},
+            )
+        assert results[0][0] == results[2][0]
+        assert results[0][1] == results[2][1]
