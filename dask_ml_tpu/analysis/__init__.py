@@ -6,10 +6,19 @@ trend metric (diagnostics.lint_report).  Rules encode the hazard classes
 this codebase has actually hit — see docs/design.md, "Concurrency & SPMD
 contract".
 
+v2 is project-wide: a module index + call graph (``analysis/graph.py``)
+and per-function def-use chains (``analysis/dataflow.py``) let rules
+follow hazards across call and module boundaries, and a committed
+findings baseline turns the gate into a ratchet (``analysis/baseline.py``:
+fail on NEW findings and on stale entries; unused suppressions are
+themselves findings).
+
 CLI::
 
     python -m dask_ml_tpu.analysis [paths...] [--format json]
     python -m dask_ml_tpu.analysis --list-rules
+    python -m dask_ml_tpu.analysis dask_ml_tpu --baseline tools/graftlint_baseline.json
+    python -m dask_ml_tpu.analysis dask_ml_tpu --write-baseline tools/graftlint_baseline.json
 
 Library::
 
@@ -33,10 +42,12 @@ from .reporters import (  # noqa: F401
     render_json,
     render_text,
 )
+from . import baseline  # noqa: F401
+from .graph import Project  # noqa: F401
 
 __all__ = [
     "RULES", "Context", "Finding", "Rule", "all_rules", "register",
-    "lint_paths", "lint_source",
+    "lint_paths", "lint_source", "Project", "baseline",
     "per_rule_counts", "render_json", "render_text",
     "main",
 ]

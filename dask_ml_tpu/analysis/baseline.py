@@ -1,0 +1,186 @@
+"""Findings baseline + ratchet: CI fails on NEW findings, not old debt.
+
+The tier-1 gate already demands zero unsuppressed findings; what it
+cannot see is the *suppressed* debt drifting up, a suppression going
+stale, or a rule upgrade silently changing what the package produces.
+The baseline closes that: ``--write-baseline`` snapshots every finding
+(suppressed included) into a committed JSON file, and ``--baseline``
+compares a fresh run against it —
+
+* a finding not in the snapshot is **new** → fail (the ratchet);
+* a snapshot entry not in the run is **stale** → fail too, so the
+  committed file always matches reality (refresh with
+  ``tools/lint.sh --rebaseline`` after intentional changes).
+
+Findings are matched by a line-number-free fingerprint — rule id,
+root-relative path, the stripped source line text, and a duplicate
+index — so pure line drift (code added above a finding) does not churn
+the baseline, while edits to the flagged line itself do."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from collections import Counter
+from typing import Iterable, Sequence
+
+from .core import Finding
+
+__all__ = [
+    "baseline_root",
+    "compare",
+    "emit",
+    "fingerprints",
+    "load",
+    "write",
+]
+
+_VERSION = 1
+
+
+def baseline_root(paths: Iterable[str]) -> str:
+    """The directory findings are stored relative to: the single target
+    directory, else the common ancestor of the targets.  Emitting and
+    comparing with the same targets yields the same relative paths
+    regardless of the invoking process's cwd."""
+    paths = [os.path.abspath(p) for p in paths]
+    if len(paths) == 1:
+        return paths[0] if os.path.isdir(paths[0]) \
+            else os.path.dirname(paths[0])
+    return os.path.commonpath(paths) if paths else os.getcwd()
+
+
+def _rel(path: str, root: str) -> str:
+    try:
+        rel = os.path.relpath(os.path.abspath(path), root)
+    except ValueError:  # different drive (windows)
+        return path.replace(os.sep, "/")
+    return rel.replace(os.sep, "/")
+
+
+def fingerprints(findings: Sequence[Finding], root: str) -> list:
+    """One ``(fingerprint, finding)`` pair per finding.  The fingerprint
+    hashes (rule, relpath, stripped line text, duplicate-index): stable
+    under line renumbering, distinct for repeated identical lines."""
+    ordered = sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+    dup: Counter = Counter()
+    out = []
+    for f in ordered:
+        base = (f.rule, _rel(f.path, root), f.line_text.strip())
+        idx = dup[base]
+        dup[base] += 1
+        fp = hashlib.sha1(
+            "|".join((*base, str(idx))).encode("utf-8", "replace")
+        ).hexdigest()[:16]
+        out.append((fp, f))
+    return out
+
+
+def emit(findings: Sequence[Finding], errors: Sequence[str],
+         root: str, rules: Sequence[str] | None = None) -> dict:
+    """The committed snapshot payload.  ``rules`` records the rule set
+    the snapshot was produced with (default: every registered rule) so
+    a later compare under ``--select`` is refused as a scope mismatch
+    instead of exploding into bogus stale entries."""
+    if rules is None:
+        from .core import RULES, all_rules
+
+        all_rules()
+        rules = sorted(RULES)
+    entries = [
+        {
+            "fingerprint": fp,
+            "rule": f.rule,
+            "path": _rel(f.path, root),
+            "line": f.line,
+            "suppressed": f.suppressed,
+            "justification": f.justification,
+        }
+        for fp, f in fingerprints(findings, root)
+    ]
+    return {
+        "version": _VERSION,
+        "tool": "graftlint",
+        "rules": sorted(rules),
+        "root_name": os.path.basename(os.path.abspath(root)),
+        "findings": entries,
+        "counts": {
+            "total": len(entries),
+            "suppressed": sum(1 for e in entries if e["suppressed"]),
+        },
+        "errors": list(errors),
+    }
+
+
+def write(path: str, payload: dict) -> None:
+    from .cache import atomic_write_json
+
+    atomic_write_json(path, payload, indent=2, sort_keys=True)
+
+
+def load(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        payload = json.load(fh)
+    if payload.get("version", 0) > _VERSION:
+        raise ValueError(
+            f"baseline {path} has version {payload['version']}, newer "
+            f"than this analyzer understands ({_VERSION})"
+        )
+    if not isinstance(payload.get("findings"), list):
+        raise ValueError(f"baseline {path} is malformed: no findings list")
+    return payload
+
+
+def compare(snapshot: dict, findings: Sequence[Finding],
+            root: str, rules: Sequence[str] | None = None) -> dict:
+    """The ratchet delta::
+
+        {"new":   [Finding, ...],   # in the run, not in the snapshot
+         "fixed": [entry, ...]}     # in the snapshot, not in the run
+
+    Matching is multiset-by-fingerprint, so two identical findings in
+    one file need two baseline entries.  A compare whose scope differs
+    from the snapshot's — a ``--select`` subset, or a different target
+    root — would read as a mass new+stale explosion; it raises
+    ``ValueError`` instead (the CLI maps that to exit 2, not a lint
+    verdict)."""
+    # ``rules`` is passed ONLY for explicitly-selected runs (--select):
+    # those are refused on mismatch.  A full run is never refused on
+    # rule-set drift — registering a new rule must flow through the
+    # NORMAL ratchet (its findings read as new → exit 1 → rebaseline),
+    # not read as an analyzer failure.
+    snap_rules = snapshot.get("rules")
+    if snap_rules is not None and rules is not None and \
+            sorted(rules) != sorted(snap_rules):
+        raise ValueError(
+            "baseline was written with a different rule set "
+            f"({', '.join(snap_rules)}): a --select subset cannot be "
+            "ratcheted against it — run the full rule set or write a "
+            "dedicated baseline"
+        )
+    snap_root = snapshot.get("root_name")
+    root_name = os.path.basename(os.path.abspath(root))
+    if snap_root is not None and snap_root != root_name:
+        raise ValueError(
+            f"baseline was written for target root {snap_root!r} but "
+            f"this run's root is {root_name!r}: paths would not line "
+            f"up — lint the same target the baseline covers"
+        )
+    snap_counts: Counter = Counter(
+        e["fingerprint"] for e in snapshot["findings"])
+    new = []
+    seen: Counter = Counter()
+    for fp, f in fingerprints(findings, root):
+        seen[fp] += 1
+        if seen[fp] > snap_counts.get(fp, 0):
+            new.append(f)
+    fixed = []
+    remaining = Counter(seen)
+    for e in snapshot["findings"]:
+        fp = e["fingerprint"]
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+        else:
+            fixed.append(e)
+    return {"new": new, "fixed": fixed}

@@ -1,6 +1,24 @@
 """graftlint CLI: ``python -m dask_ml_tpu.analysis [paths...]``.
 
-Exit codes: 0 clean, 1 unsuppressed findings or parse errors, 2 usage.
+Exit codes — the contract the CI ratchet depends on:
+
+* **0** — clean: no unsuppressed findings, no parse errors, and (with
+  ``--baseline``) no new findings and no stale baseline entries.
+* **1** — findings: the gate should fail, the analyzer worked.
+* **2** — the analyzer did NOT produce a verdict: bad arguments,
+  unknown rules, missing paths, unreadable baseline, or an internal
+  crash.  A crash must never look like either "clean" or "findings" —
+  a ratchet that treats analyzer death as a passing run has no teeth
+  (the traceback goes to stderr).
+
+Baseline workflow::
+
+    python -m dask_ml_tpu.analysis dask_ml_tpu --write-baseline tools/graftlint_baseline.json
+    python -m dask_ml_tpu.analysis dask_ml_tpu --baseline tools/graftlint_baseline.json
+
+The compare run fails on findings that are NEW vs the snapshot and on
+snapshot entries the code no longer produces (stale — refresh the
+baseline), so the committed file always matches reality.
 """
 
 from __future__ import annotations
@@ -9,6 +27,7 @@ import argparse
 import os
 import sys
 
+from . import baseline as _baseline
 from .core import RULES, all_rules, lint_paths
 from .reporters import render_json, render_text
 
@@ -33,16 +52,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--show-suppressed", action="store_true",
                    help="include suppressed findings in text output")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--baseline", metavar="PATH", default=None,
+                   help="compare against a committed findings snapshot "
+                        "(the ratchet): additionally fail on NEW "
+                        "findings (suppressed included) and on STALE "
+                        "entries; active findings always fail")
+    p.add_argument("--write-baseline", metavar="PATH", default=None,
+                   help="write the findings snapshot for --baseline "
+                        "and exit")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the whole-project lint cache "
+                        "(DASK_ML_TPU_LINT_CACHE)")
     return p
 
 
-def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
-    all_rules()  # populate the registry before touching RULES
-    if args.list_rules:
-        for rid, cls in sorted(RULES.items()):
-            print(f"{rid}: {cls.summary}")
-        return 0
+def _run(args) -> int:
     select = None
     if args.select:
         select = [s.strip() for s in args.select.split(",") if s.strip()]
@@ -57,14 +81,72 @@ def main(argv=None) -> int:
         print(f"graftlint: no such path(s): {', '.join(missing)}",
               file=sys.stderr)
         return 2
-    findings, errors = lint_paths(paths, select)
+
+    snapshot = None
+    # --write-baseline wins over --baseline: the bootstrap invocation
+    # (both flags, no snapshot on disk yet) must write, not fail to read
+    if args.baseline is not None and args.write_baseline is None:
+        try:
+            snapshot = _baseline.load(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"graftlint: cannot read baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    findings, errors = lint_paths(paths, select,
+                                  cache=not args.no_cache)
+    root = _baseline.baseline_root(paths)
+    run_rules = select if select is not None else sorted(RULES)
+
+    if args.write_baseline is not None:
+        payload = _baseline.emit(findings, errors, root, rules=run_rules)
+        _baseline.write(args.write_baseline, payload)
+        n = payload["counts"]
+        print(f"graftlint: baseline written to {args.write_baseline} "
+              f"({n['total']} finding(s), {n['suppressed']} suppressed)")
+        return 1 if errors else 0
+
+    delta = None
+    if snapshot is not None:
+        try:
+            # rules passed only under --select: a full run must ratchet
+            # normally across rule-set drift (new rule → new findings →
+            # exit 1 → rebaseline), never read as a scope error
+            delta = _baseline.compare(snapshot, findings, root,
+                                      rules=select)
+        except ValueError as e:
+            print(f"graftlint: {e}", file=sys.stderr)
+            return 2
+
     if args.format == "json":
-        print(render_json(findings, errors))
+        print(render_json(findings, errors, delta=delta))
     else:
         print(render_text(findings, errors,
-                          show_suppressed=args.show_suppressed))
+                          show_suppressed=args.show_suppressed,
+                          delta=delta))
     active = [f for f in findings if not f.suppressed]
-    return 1 if (active or errors) else 0
+    failed = bool(active or errors)
+    if delta is not None:
+        failed = failed or bool(delta["new"] or delta["fixed"])
+    return 1 if failed else 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    all_rules()  # populate the registry before touching RULES
+    if args.list_rules:
+        for rid, cls in sorted(RULES.items()):
+            print(f"{rid}: {cls.summary}")
+        return 0
+    try:
+        return _run(args)
+    except Exception:  # noqa: BLE001 -- a crash must exit 2, not 1
+        import traceback
+
+        traceback.print_exc()
+        print("graftlint: internal error — this is an analyzer crash, "
+              "not a lint verdict", file=sys.stderr)
+        return 2
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -49,7 +49,11 @@ _SUPPRESS_RE = re.compile(
 
 @dataclasses.dataclass
 class Finding:
-    """One diagnostic: a rule violation at a source location."""
+    """One diagnostic: a rule violation at a source location.
+
+    ``line_text`` carries the stripped source line so the baseline
+    fingerprint survives line-number drift (see :mod:`.baseline`).
+    """
 
     rule: str
     path: str
@@ -59,6 +63,7 @@ class Finding:
     suppressed: bool = False
     justification: str | None = None
     end_line: int | None = None
+    line_text: str = ""
 
     def render(self) -> str:
         state = " (suppressed)" if self.suppressed else ""
@@ -69,12 +74,21 @@ class Finding:
 
 
 class Rule:
-    """Base class: subclasses set ``id``/``summary`` and implement ``run``."""
+    """Base class: subclasses set ``id``/``summary`` and implement ``run``
+    (per module) or — with ``project_wide = True`` — ``run_project``
+    (once per lint, over the whole :class:`~.graph.Project`)."""
 
     id: str = ""
     summary: str = ""
+    #: project-wide rules run once per lint with the Project, not once
+    #: per module — for findings whose scope crosses module boundaries
+    #: (stage-purity reaches through the call graph)
+    project_wide: bool = False
 
     def run(self, ctx: "Context") -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def run_project(self, project) -> Iterable[Finding]:  # pragma: no cover
         raise NotImplementedError
 
     # -- shared AST helpers (rules are pure functions of the Context) ----
@@ -117,13 +131,20 @@ class Context:
         self.path = path
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
+        #: the whole-program view; set by the lint driver before rules run
+        #: (single-module lint gets a one-module project)
+        self.project = None
         self._parent: dict[int, ast.AST] = {}
         for parent in ast.walk(self.tree):
             for child in ast.iter_child_nodes(parent):
                 self._parent[id(child)] = parent
-        # line -> (rule ids | {"all"}, justification, standalone-comment?)
-        self.suppressions: dict[int, tuple[set, str, bool]] = {}
+        # line -> (rule ids | {"all"}, justification, standalone?, col)
+        self.suppressions: dict[int, tuple] = {}
         self.bad_suppressions: list[Finding] = []
+        #: suppression lines that matched at least one finding — the
+        #: complement becomes ``unused-suppression`` findings after all
+        #: rules have run
+        self.matched_suppressions: set[int] = set()
         self._scan_suppressions()
 
     # -- navigation ------------------------------------------------------
@@ -180,20 +201,47 @@ class Context:
             # statement, and must not bleed onto the line below)
             text = self.lines[line - 1] if line - 1 < len(self.lines) else ""
             standalone = text.lstrip().startswith("#")
-            self.suppressions[line] = (ids, justification, standalone)
+            self.suppressions[line] = (ids, justification, standalone,
+                                       tok.start[1])
 
     def suppression_for(self, rule_id: str, line: int,
                         end_line: int | None) -> tuple[set, str] | None:
         """A disable on the finding line, anywhere in the node's line span,
-        or a STANDALONE comment on the line directly above the finding."""
-        above = self.suppressions.get(line - 1)
-        candidates = [above] if (above and above[2]) else []
-        candidates.extend(self.suppressions.get(ln)
+        or a STANDALONE comment on the line directly above the finding.
+        A match is recorded — a suppression that never matches anything
+        is itself reported (``unused-suppression``)."""
+        above_line = line - 1
+        above = self.suppressions.get(above_line)
+        candidates = [(above_line, above)] if (above and above[2]) else []
+        candidates.extend((ln, self.suppressions.get(ln))
                           for ln in range(line, (end_line or line) + 1))
-        for entry in candidates:
+        for ln, entry in candidates:
             if entry and (rule_id in entry[0] or "all" in entry[0]):
+                self.matched_suppressions.add(ln)
                 return entry[:2]
         return None
+
+    def unused_suppression_findings(self) -> list[Finding]:
+        """One active ``unused-suppression`` finding per disable comment
+        that matched no finding this run.  Deliberately NOT suppressible:
+        the fix is deleting the stale comment, and letting ``disable=all``
+        hide its own unusedness would defeat the check."""
+        out = []
+        for line, (ids, _just, _standalone, col) in \
+                sorted(self.suppressions.items()):
+            if line in self.matched_suppressions:
+                continue
+            if any(f.line == line for f in self.bad_suppressions):
+                continue  # already reported as bad-suppression
+            out.append(Finding(
+                "unused-suppression", self.path, line, col,
+                f"suppression ({', '.join(sorted(ids))}) matches no "
+                f"finding: the hazard it justified is gone — delete the "
+                f"comment (stale suppressions hide future regressions)",
+                line_text=(self.lines[line - 1].strip()
+                           if line - 1 < len(self.lines) else ""),
+            ))
+        return out
 
     # -- finding factory -------------------------------------------------
     def finding(self, rule_id: str, node: ast.AST, message: str,
@@ -203,7 +251,9 @@ class Context:
         if end_line is None:
             end_line = getattr(node, "end_lineno", line)
         f = Finding(rule_id, self.path, line, col, message,
-                    end_line=end_line)
+                    end_line=end_line,
+                    line_text=(self.lines[line - 1].strip()
+                               if line - 1 < len(self.lines) else ""))
         sup = self.suppression_for(rule_id, line, end_line)
         if sup is not None:
             f.suppressed = True
@@ -237,15 +287,45 @@ def all_rules(select: Iterable[str] | None = None) -> list[Rule]:
 
 
 # -- entry points --------------------------------------------------------
+def _lint_project(contexts: list["Context"],
+                  select: Iterable[str] | None = None) -> list[Finding]:
+    """Run every selected rule over a set of parsed modules that share
+    one :class:`~.graph.Project` (module rules per module, project-wide
+    rules once), then synthesize ``unused-suppression`` findings.
+
+    Unused suppressions are only computed on FULL runs (``select`` is
+    None): a partial run legitimately leaves the unselected rules'
+    suppressions unmatched."""
+    from .graph import Project
+
+    rules = all_rules(select)
+    project = Project(contexts)
+    for ctx in contexts:
+        ctx.project = project
+    findings: list[Finding] = []
+    for ctx in contexts:
+        findings.extend(ctx.bad_suppressions)
+        for rule in rules:
+            if not rule.project_wide:
+                findings.extend(rule.run(ctx))
+    for rule in rules:
+        if rule.project_wide:
+            findings.extend(rule.run_project(project))
+    if select is None:
+        for ctx in contexts:
+            findings.extend(ctx.unused_suppression_findings())
+    return findings
+
+
 def lint_source(source: str, path: str = "<string>",
                 select: Iterable[str] | None = None) -> list[Finding]:
-    """Lint one module's source.  Returns ALL findings; suppressed ones
-    carry ``suppressed=True`` (callers filter)."""
-    rules = all_rules(select)
+    """Lint one module's source (a single-module project: interprocedural
+    rules resolve what they can within the module).  Returns ALL
+    findings; suppressed ones carry ``suppressed=True`` (callers
+    filter)."""
+    all_rules()  # populate the registry before suppression scanning
     ctx = Context(source, path)
-    findings: list[Finding] = list(ctx.bad_suppressions)
-    for rule in rules:
-        findings.extend(rule.run(ctx))
+    findings = _lint_project([ctx], select)
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
 
@@ -271,11 +351,23 @@ def iter_py_files(paths: Iterable[str] | str) -> Iterator[str]:
 
 def lint_paths(paths: Iterable[str] | str,
                select: Iterable[str] | None = None,
+               cache: str | bool | None = None,
                ) -> tuple[list[Finding], list[str]]:
-    """Lint files/directories.  Returns (findings, errors) where errors
-    are human-readable strings for missing paths and unreadable or
+    """Lint files/directories as ONE project (interprocedural rules see
+    across every module passed in).  Returns (findings, errors) where
+    errors are human-readable strings for missing paths and unreadable or
     unparsable files (reported, never silently skipped — a typo'd path
-    or a syntax error must FAIL the gate, not pass it empty)."""
+    or a syntax error must FAIL the gate, not pass it empty).
+
+    ``cache``: a path to a lint-cache file, or True for the default
+    location (see :mod:`.cache`).  The cache is keyed on a digest of
+    every source file (plus the engine version and rule set), so a warm
+    re-run of an unchanged tree skips parsing and analysis entirely; any
+    edit anywhere invalidates the whole entry — interprocedural findings
+    depend on other modules, so per-file caching would be unsound."""
+    from . import cache as _cache
+
+    all_rules()  # populate the registry before suppression scanning
     if isinstance(paths, (str, os.PathLike)):
         paths = [paths]
     paths = list(paths)
@@ -284,16 +376,35 @@ def lint_paths(paths: Iterable[str] | str,
         f"{p}: no such file or directory"
         for p in paths if not os.path.exists(p)
     ]
+    sources: list[tuple[str, str]] = []
     for path in iter_py_files(paths):
         try:
             with open(path, encoding="utf-8") as fh:
-                src = fh.read()
+                sources.append((path, fh.read()))
         except OSError as e:
             errors.append(f"{path}: unreadable: {e}")
-            continue
+
+    cache_path = _cache.resolve_cache_path(cache, paths)
+    digest = None
+    if cache_path is not None:
+        digest = _cache.project_digest(sources, select)
+        hit = _cache.load(cache_path, digest)
+        if hit is not None:
+            cached_findings, cached_errors = hit
+            return cached_findings, errors + cached_errors
+
+    contexts: list[Context] = []
+    for path, src in sources:
         try:
-            findings.extend(lint_source(src, path, select))
+            contexts.append(Context(src, path))
         except SyntaxError as e:
             errors.append(f"{path}: syntax error: {e}")
+    findings.extend(_lint_project(contexts, select))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    if cache_path is not None:
+        # syntax errors are part of the cached result (they re-occur on
+        # an identical tree); missing-path and unreadable errors are not
+        # (recomputed from the live filesystem every call)
+        syntax_errors = [e for e in errors if ": syntax error:" in e]
+        _cache.store(cache_path, digest, findings, syntax_errors)
     return findings, errors

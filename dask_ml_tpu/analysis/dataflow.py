@@ -1,0 +1,242 @@
+"""Per-function def-use chains and local value resolution.
+
+The dataflow half of the graftlint v2 engine: where :mod:`.graph` answers
+"who calls whom", this module answers "what value does this name hold" —
+within one function, conservatively, with no execution.  Rules use it to
+chase a checkpoint ``state`` variable back to its dict literal, an env
+read's knob name back to its module-level constant, and a thread pool's
+variable forward to its ``submit``/``map`` work items.
+
+Chains are line-ordered approximations (a use binds to the nearest
+preceding definition of its name), which is exact for the straight-line
+and single-assignment code these rules target and conservative (union of
+candidate values) everywhere else.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+__all__ = [
+    "DefUse",
+    "assigned_values",
+    "def_use",
+    "resolve_dict_keys",
+    "resolve_str_constant",
+]
+
+
+def _def_line(node: ast.AST) -> int:
+    """A definition node's source line.  ``ast.withitem`` carries no
+    position info — fall back to its context expression's line, else a
+    ``with``-bound name would read as line 0 and every later use would
+    bind to an earlier same-name assignment instead."""
+    line = getattr(node, "lineno", None)
+    if line is None:
+        ctx_expr = getattr(node, "context_expr", None)
+        line = getattr(ctx_expr, "lineno", 0) if ctx_expr is not None \
+            else 0
+    return line
+
+
+def _target_names(target: ast.AST) -> Iterable[tuple]:
+    """(name, is_whole_value) pairs bound by an assignment target —
+    ``is_whole_value`` is False for tuple-unpack elements (the name holds
+    a PIECE of the value expression, not the expression)."""
+    if isinstance(target, ast.Name):
+        yield target.id, True
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            for name, _ in _target_names(elt):
+                yield name, False
+    elif isinstance(target, ast.Starred):
+        yield from _target_names(target.value)
+
+
+class DefUse:
+    """Def-use chains for one function (or module) body.
+
+    ``defs`` maps a name to its ordered definition sites
+    ``(def_node, value_expr_or_None, uses)`` where ``uses`` are the Load
+    contexts attributed to that definition (nearest preceding def of the
+    same name, by line).  Parameters are definitions with no value.
+    Nested function/lambda bodies are excluded — they execute on their
+    own schedule.
+    """
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.defs: dict[str, list] = {}
+        self._collect()
+
+    # -- construction ----------------------------------------------------
+    def _own_nodes(self, root: ast.AST):
+        from collections import deque
+
+        todo = deque(ast.iter_child_nodes(root))
+        while todo:
+            n = todo.popleft()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            yield n
+            todo.extend(ast.iter_child_nodes(n))
+
+    def _add_def(self, name: str, node: ast.AST, value) -> None:
+        self.defs.setdefault(name, []).append((node, value, []))
+
+    def _collect(self) -> None:
+        fn = self.fn
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = fn.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                self._add_def(p.arg, p, None)
+            for v in (a.vararg, a.kwarg):
+                if v is not None:
+                    self._add_def(v.arg, v, None)
+        for n in self._own_nodes(fn):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    for name, whole in _target_names(t):
+                        self._add_def(name, n, n.value if whole else None)
+            elif isinstance(n, (ast.AnnAssign, ast.AugAssign)):
+                if isinstance(n.target, ast.Name):
+                    val = n.value if isinstance(n, ast.AnnAssign) else None
+                    self._add_def(n.target.id, n, val)
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                for name, _ in _target_names(n.target):
+                    self._add_def(name, n, None)
+            elif isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    if item.optional_vars is not None:
+                        for name, whole in _target_names(item.optional_vars):
+                            self._add_def(name, item,
+                                          item.context_expr if whole
+                                          else None)
+            elif isinstance(n, ast.NamedExpr):
+                if isinstance(n.target, ast.Name):
+                    self._add_def(n.target.id, n, n.value)
+            elif isinstance(n, ast.ExceptHandler) and n.name:
+                self._add_def(n.name, n, None)
+        # attribute uses to the nearest preceding def of the same name —
+        # nearest by LINE NUMBER, not by collection order (BFS can visit
+        # a later top-level def before an earlier nested one)
+        for n in self._own_nodes(fn):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in self.defs:
+                best = None
+                best_line = -1
+                for entry in self.defs[n.id]:
+                    dline = _def_line(entry[0])
+                    if best_line <= dline <= n.lineno:
+                        best = entry
+                        best_line = dline
+                if best is None:
+                    best = self.defs[n.id][0]
+                best[2].append(n)
+
+    # -- queries ---------------------------------------------------------
+    def values_of(self, name: str) -> list:
+        """Every whole-value expression ever assigned to ``name`` in this
+        scope (parameters and unpack targets contribute none)."""
+        return [v for (_n, v, _u) in self.defs.get(name, ())
+                if v is not None]
+
+    def uses_of(self, name: str) -> list:
+        out = []
+        for (_n, _v, uses) in self.defs.get(name, ()):
+            out.extend(uses)
+        return out
+
+    def unpack_sources(self, name: str) -> list:
+        """Assignment statements that bind ``name`` via tuple unpack —
+        the ``it, state = snap`` shape checkpoint resume code uses."""
+        out = []
+        for (node, value, _u) in self.defs.get(name, ()):
+            if value is None and isinstance(node, ast.Assign):
+                out.append(node)
+        return out
+
+
+def def_use(fn: ast.AST) -> DefUse:
+    """Build (and return) the def-use chains for one function node."""
+    return DefUse(fn)
+
+
+def assigned_values(fn: ast.AST) -> dict:
+    """name → list of whole-value exprs assigned in ``fn``'s own body."""
+    du = DefUse(fn)
+    return {name: du.values_of(name) for name in du.defs}
+
+
+def resolve_str_constant(name_node: ast.AST, du: "DefUse | None",
+                         module) -> str | None:
+    """The string constant a Name refers to: a literal, a function-local
+    single assignment, or a module-level constant (``DEPTH_ENV = "..."``).
+    None when the value is not a provable string."""
+    if isinstance(name_node, ast.Constant):
+        return name_node.value if isinstance(name_node.value, str) else None
+    if not isinstance(name_node, ast.Name):
+        return None
+    if du is not None:
+        vals = du.values_of(name_node.id)
+        strs = {v.value for v in vals
+                if isinstance(v, ast.Constant) and isinstance(v.value, str)}
+        if len(strs) == 1 and len(vals) == len(strs):
+            return next(iter(strs))
+        if vals:
+            return None
+    if module is not None:
+        return module.str_constants.get(name_node.id)
+    return None
+
+
+def resolve_dict_keys(expr: ast.AST, du, module, project,
+                      _depth: int = 0) -> frozenset | None:
+    """The set of string keys ``expr`` evaluates to when it is provably a
+    dict with constant keys — through dict literals, local Name
+    assignments (union over all of them), and calls to resolvable
+    functions whose every return is such a dict.  None = unknowable
+    (callers must treat the write/read as wildcard, not clean)."""
+    if _depth > 6:
+        return None
+    if isinstance(expr, ast.Dict):
+        keys = set()
+        for k in expr.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.add(k.value)
+            else:
+                return None  # **spread or computed key
+        return frozenset(keys)
+    if isinstance(expr, ast.Name) and du is not None:
+        vals = du.values_of(expr.id)
+        if not vals:
+            return None
+        keys: set = set()
+        for v in vals:
+            sub = resolve_dict_keys(v, du, module, project, _depth + 1)
+            if sub is None:
+                return None
+            keys |= sub
+        return frozenset(keys)
+    if isinstance(expr, ast.Call) and project is not None \
+            and module is not None:
+        res = project.resolve_call(module, expr)
+        if res.kind != "function":
+            return None
+        body_fn = res.target.node
+        sub_du = DefUse(body_fn)
+        returns = [n for n in sub_du._own_nodes(body_fn)
+                   if isinstance(n, ast.Return) and n.value is not None]
+        if not returns:
+            return None
+        keys = set()
+        for r in returns:
+            sub = resolve_dict_keys(r.value, sub_du, res.target.module,
+                                    project, _depth + 1)
+            if sub is None:
+                return None
+            keys |= sub
+        return frozenset(keys)
+    return None

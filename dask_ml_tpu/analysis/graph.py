@@ -1,0 +1,608 @@
+"""Project-wide module index and call graph (graftlint v2's engine).
+
+PR-2's graftlint saw one module at a time, so every cross-module hazard
+had to be pattern-matched at the call site and justified with a
+suppression when the pattern over-fired.  This module is the whole-program
+half: it indexes every linted module's imports, classes, methods and
+functions, resolves call expressions across module boundaries (aliased
+imports, relative imports, ``self.``/``super().`` method dispatch), and
+answers reachability questions ("does anything transitively called from
+this function dispatch a device program?") that a single-module rule
+cannot.
+
+Still pure ``ast`` — the analyzer never imports jax (or the package under
+analysis): resolution is name-based and deliberately conservative.  A
+call the index cannot resolve is reported as such (``Resolution.kind``)
+and each rule decides whether "unknown" means hazard (thread targets) or
+noise (stage-purity).
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import os
+import re
+from typing import Iterable, Iterator
+
+from .core import Context, dotted_name
+
+__all__ = [
+    "ClassInfo",
+    "FunctionInfo",
+    "ModuleInfo",
+    "Project",
+    "Resolution",
+    "calls_in",
+    "module_name_for",
+]
+
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name for a file, found by walking up through
+    ``__init__.py`` package markers (``.../dask_ml_tpu/pipeline/core.py``
+    → ``dask_ml_tpu.pipeline.core``).  Files outside any package keep
+    their bare stem."""
+    path = os.path.abspath(path)
+    stem = os.path.splitext(os.path.basename(path))[0]
+    parts = [] if stem == "__init__" else [stem]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.insert(0, os.path.basename(d))
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return ".".join(parts) or stem
+
+
+def calls_in(node: ast.AST) -> Iterator[ast.Call]:
+    """Call expressions lexically in ``node``'s own body — nested function
+    and lambda bodies are excluded (they run when *called*, and the call
+    graph reaches them through their call sites, not their definition
+    site)."""
+    from collections import deque
+
+    todo = deque(ast.iter_child_nodes(node))
+    while todo:
+        n = todo.popleft()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        todo.extend(ast.iter_child_nodes(n))
+
+
+class FunctionInfo:
+    """One indexed function/method: its AST node, home module, and (for
+    methods) the owning class."""
+
+    __slots__ = ("name", "qualname", "module", "node", "cls")
+
+    def __init__(self, name, qualname, module, node, cls=None):
+        self.name = name
+        self.qualname = qualname
+        self.module = module
+        self.node = node
+        self.cls = cls
+
+    def param_names(self) -> list:
+        a = self.node.args
+        return [p.arg for p in a.posonlyargs + a.args]
+
+    def __repr__(self):
+        return f"FunctionInfo({self.qualname})"
+
+
+class ClassInfo:
+    __slots__ = ("name", "qualname", "module", "node", "base_names",
+                 "methods")
+
+    def __init__(self, name, qualname, module, node):
+        self.name = name
+        self.qualname = qualname
+        self.module = module
+        self.node = node
+        self.base_names = [dotted_name(b) for b in node.bases]
+        self.methods: dict = {}
+
+    def __repr__(self):
+        return f"ClassInfo({self.qualname})"
+
+
+class Resolution:
+    """Outcome of resolving one call expression.
+
+    ``kind`` is one of:
+
+    * ``"function"`` — resolved to an indexed :class:`FunctionInfo`
+      (``target``); ``bound`` marks method calls through an instance
+      (``self.m()``), whose positional args are offset by one vs the def.
+    * ``"class"`` — an indexed class constructor (``target`` is the
+      :class:`ClassInfo`; ``init`` holds its ``__init__`` if indexed).
+    * ``"external"`` — a dotted name outside the project; ``name`` is the
+      alias-expanded full path (``jnp.sum`` → ``jax.numpy.sum``).
+    * ``"builtin"`` — a Python builtin.
+    * ``"dynamic"`` — calling a bare name that is a function parameter:
+      the callee is decided by the caller at runtime.
+    * ``"method"`` — an attribute call on an unresolvable receiver;
+      ``name`` is the attribute, all the pattern-matching rules get.
+    * ``"unknown"`` — none of the above.
+    """
+
+    __slots__ = ("kind", "target", "name", "bound")
+
+    def __init__(self, kind, target=None, name=None, bound=False):
+        self.kind = kind
+        self.target = target
+        self.name = name
+        self.bound = bound
+
+    def __repr__(self):
+        return f"Resolution({self.kind}, {self.target or self.name})"
+
+
+class ModuleInfo:
+    """Index of one module: import aliases (fully resolved, including
+    relative imports), top-level functions/classes, and module-level
+    string constants (env-knob names are bound to constants, e.g.
+    ``DEPTH_ENV = "DASK_ML_TPU_PREFETCH_DEPTH"``)."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.path = ctx.path
+        self.name = module_name_for(ctx.path) if os.sep in ctx.path or \
+            ctx.path.endswith(".py") else ctx.path
+        self.package = self.name.rpartition(".")[0]
+        self.imports: dict[str, str] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.str_constants: dict[str, str] = {}
+        # id(function node) -> {name: directly-nested FunctionDef}, one
+        # pass here so lexical resolution is dict lookups, not re-walks
+        self.nested_fns: dict[int, dict] = {}
+        self._index()
+
+    def _index(self) -> None:
+        tree = self.ctx.tree
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                parent_fn = None
+                for p in self.ctx.parents(node):
+                    if isinstance(p, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                        parent_fn = p
+                        break
+                if parent_fn is not None:
+                    self.nested_fns.setdefault(
+                        id(parent_fn), {})[node.name] = node
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.imports[a.asname] = a.name
+                    else:
+                        head = a.name.split(".", 1)[0]
+                        self.imports.setdefault(head, head)
+            elif isinstance(node, ast.ImportFrom):
+                base = self._from_base(node)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    target = f"{base}.{a.name}" if base else a.name
+                    self.imports[a.asname or a.name] = target
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{self.name}.{stmt.name}"
+                self.functions[stmt.name] = FunctionInfo(
+                    stmt.name, q, self, stmt)
+            elif isinstance(stmt, ast.ClassDef):
+                q = f"{self.name}.{stmt.name}"
+                cls = ClassInfo(stmt.name, q, self, stmt)
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        cls.methods[sub.name] = FunctionInfo(
+                            sub.name, f"{q}.{sub.name}", self, sub, cls)
+                self.classes[stmt.name] = cls
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                t = stmt.targets[0]
+                if isinstance(t, ast.Name) and \
+                        isinstance(stmt.value, ast.Constant) and \
+                        isinstance(stmt.value.value, str):
+                    self.str_constants[t.id] = stmt.value.value
+
+    def _from_base(self, node: ast.ImportFrom) -> str:
+        if node.level == 0:
+            return node.module or ""
+        # relative: level 1 = this module's package, each extra level one up
+        parts = self.package.split(".") if self.package else []
+        up = node.level - 1
+        base_parts = parts[: len(parts) - up] if up <= len(parts) else []
+        if node.module:
+            base_parts = base_parts + node.module.split(".")
+        return ".".join(base_parts)
+
+    def expand_alias(self, dotted: str) -> str:
+        """Expand the first segment through the import table:
+        ``jnp.asarray`` → ``jax.numpy.asarray``."""
+        head, sep, rest = dotted.partition(".")
+        full = self.imports.get(head)
+        if full is None:
+            return dotted
+        return f"{full}.{rest}" if rest else full
+
+
+# dotted-name heads that mean jax even without an import to expand
+# (snippet code and conventional aliases)
+_JAX_HEADS = frozenset({"jax", "jnp", "lax", "jrandom", "jr"})
+
+
+class Project:
+    """The whole-program view: every linted module's index, plus memoized
+    cross-module queries (call resolution, reachability, collective
+    reachability, key-consuming parameters)."""
+
+    def __init__(self, contexts: Iterable[Context]):
+        self.modules: list[ModuleInfo] = [ModuleInfo(c) for c in contexts]
+        self.by_path = {m.path: m for m in self.modules}
+        self.by_name = {m.name: m for m in self.modules}
+        self._reaches_collective: dict = {}
+        self._key_params: dict = {}
+        self._resolve_memo: dict = {}
+        self._doc_knobs: tuple | None | bool = False  # False = not probed
+
+    def module_for(self, ctx: Context) -> ModuleInfo:
+        return self.by_path[ctx.path]
+
+    # -- name expansion ---------------------------------------------------
+    def full_call_name(self, mod: ModuleInfo, func: ast.AST) -> str | None:
+        """Alias-expanded dotted name of a call's callee, or None."""
+        name = dotted_name(func)
+        return mod.expand_alias(name) if name else None
+
+    def is_jax_name(self, mod: ModuleInfo, func: ast.AST) -> str | None:
+        """The full name when the callee lives under jax (via import
+        expansion, or conventional alias heads as fallback), else None."""
+        name = dotted_name(func)
+        if not name:
+            return None
+        full = mod.expand_alias(name)
+        head = full.split(".", 1)[0]
+        if head == "jax":
+            return full
+        if name.split(".", 1)[0] in _JAX_HEADS:
+            return name
+        return None
+
+    # -- call resolution --------------------------------------------------
+    def resolve_call(self, mod: ModuleInfo, call: ast.Call) -> Resolution:
+        memo = self._resolve_memo.get(id(call))
+        if memo is not None:
+            return memo
+        func = call.func
+        if isinstance(func, ast.Name):
+            res = self._resolve_name(mod, call, func.id)
+        elif isinstance(func, ast.Attribute):
+            res = self._resolve_attribute(mod, call, func)
+        elif isinstance(func, ast.Lambda):
+            res = Resolution("dynamic", name="<lambda>")
+        else:
+            res = Resolution("unknown")
+        self._resolve_memo[id(call)] = res
+        return res
+
+    def resolve_callable(self, mod: ModuleInfo,
+                         expr: ast.AST) -> Resolution:
+        """Resolve a bare callable expression — a ``Thread(target=...)``
+        value, a ``pool.submit`` argument — exactly as if it were
+        called.  Deliberately BYPASSES the id()-keyed call memo: the
+        Call node synthesized here is transient, and after it is
+        garbage-collected CPython can reuse its address for the next
+        synthesized node, which would hand that node the previous
+        target's cached Resolution (a device-dispatching thread target
+        judged host-only).  The borrowed parent-map entry is removed on
+        the way out for the same reason."""
+        if not isinstance(expr, (ast.Name, ast.Attribute)):
+            return Resolution("unknown")
+        call = ast.Call(func=expr, args=[], keywords=[])
+        parent = mod.ctx._parent.get(id(expr))
+        if parent is not None:
+            mod.ctx._parent[id(call)] = parent
+        try:
+            if isinstance(expr, ast.Name):
+                return self._resolve_name(mod, call, expr.id)
+            return self._resolve_attribute(mod, call, expr)
+        finally:
+            mod.ctx._parent.pop(id(call), None)
+
+    def _resolve_name(self, mod: ModuleInfo, at: ast.AST,
+                      name: str) -> Resolution:
+        # 1. a def lexically visible from the call site (nested defs in
+        #    the enclosing function chain, innermost first)
+        fn = self._lexical_function(mod, at, name)
+        if fn is not None:
+            return Resolution("function", target=fn)
+        # 2. module-level function/class
+        if name in mod.functions:
+            return Resolution("function", target=mod.functions[name])
+        if name in mod.classes:
+            cls = mod.classes[name]
+            return Resolution("class", target=cls)
+        # 3. imported symbol
+        if name in mod.imports:
+            return self._resolve_dotted(mod.imports[name])
+        # 4. parameter of an enclosing function → dynamic callable
+        for p in mod.ctx.parents(at):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                a = p.args
+                params = {x.arg for x in
+                          a.posonlyargs + a.args + a.kwonlyargs}
+                if a.vararg:
+                    params.add(a.vararg.arg)
+                if a.kwarg:
+                    params.add(a.kwarg.arg)
+                if name in params:
+                    return Resolution("dynamic", name=name)
+        if name in _BUILTIN_NAMES:
+            return Resolution("builtin", name=name)
+        return Resolution("unknown", name=name)
+
+    def _resolve_attribute(self, mod: ModuleInfo, call: ast.Call,
+                           func: ast.Attribute) -> Resolution:
+        attr = func.attr
+        base = func.value
+        # self.m() / cls.m() → method lookup through the enclosing class
+        if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+            owner = self._enclosing_class(mod, call)
+            if owner is not None:
+                m = self.lookup_method(owner, attr)
+                if m is not None:
+                    return Resolution("function", target=m, bound=True)
+            return Resolution("method", name=attr, bound=True)
+        # super().m() → lookup starting at the first base
+        if isinstance(base, ast.Call) and \
+                isinstance(base.func, ast.Name) and base.func.id == "super":
+            owner = self._enclosing_class(mod, call)
+            if owner is not None:
+                for b in owner.base_names:
+                    bc = self.resolve_class_name(mod, b)
+                    if bc is not None:
+                        m = self.lookup_method(bc, attr)
+                        if m is not None:
+                            return Resolution("function", target=m,
+                                              bound=True)
+            return Resolution("method", name=attr, bound=True)
+        # module-alias attribute: pkg.mod.f(), jnp.f(), helper-module f()
+        name = dotted_name(func)
+        if name is not None:
+            head = name.split(".", 1)[0]
+            if head in mod.imports:
+                return self._resolve_dotted(mod.expand_alias(name))
+        return Resolution("method", name=attr)
+
+    def _resolve_dotted(self, dotted: str, _depth: int = 0) -> Resolution:
+        """An absolute dotted path → project function/class if the module
+        part is indexed, else external.  Follows re-export chains
+        (``pipeline/__init__`` importing ``stream_partial_fit`` from
+        ``pipeline/core``) through the target module's import table."""
+        modpart, _, attr = dotted.rpartition(".")
+        target_mod = self.by_name.get(modpart)
+        if target_mod is not None and attr:
+            if attr in target_mod.functions:
+                return Resolution("function",
+                                  target=target_mod.functions[attr])
+            if attr in target_mod.classes:
+                return Resolution("class", target=target_mod.classes[attr])
+            reexport = target_mod.imports.get(attr)
+            if reexport is not None and reexport != dotted and _depth < 8:
+                return self._resolve_dotted(reexport, _depth + 1)
+        return Resolution("external", name=dotted)
+
+    def _lexical_function(self, mod: ModuleInfo, at: ast.AST,
+                          name: str) -> FunctionInfo | None:
+        for p in mod.ctx.parents(at):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stmt = mod.nested_fns.get(id(p), {}).get(name)
+                if stmt is not None and stmt is not at:
+                    return FunctionInfo(
+                        name, f"{mod.name}.<local>.{name}", mod, stmt)
+        return None
+
+    def _enclosing_class(self, mod: ModuleInfo,
+                         node: ast.AST) -> ClassInfo | None:
+        fn = None
+        for p in mod.ctx.parents(node):
+            if fn is None and isinstance(p, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                fn = p
+            elif fn is not None and isinstance(p, ast.ClassDef):
+                return mod.classes.get(p.name)
+        return None
+
+    def resolve_class_name(self, mod: ModuleInfo,
+                           name: str | None) -> ClassInfo | None:
+        if not name:
+            return None
+        if name in mod.classes:
+            return mod.classes[name]
+        head = name.split(".", 1)[0]
+        if head in mod.imports or "." in name:
+            dotted = mod.expand_alias(name)
+            res = self._resolve_dotted(dotted)
+            if res.kind == "class":
+                return res.target
+        return None
+
+    def lookup_method(self, cls: ClassInfo, name: str,
+                      _seen=None) -> FunctionInfo | None:
+        """MRO-ish lookup: the class, then its AST bases breadth-first
+        (good enough for single-inheritance estimator hierarchies)."""
+        _seen = _seen if _seen is not None else set()
+        if cls.qualname in _seen:
+            return None
+        _seen.add(cls.qualname)
+        if name in cls.methods:
+            return cls.methods[name]
+        for b in cls.base_names:
+            bc = self.resolve_class_name(cls.module, b)
+            if bc is not None:
+                m = self.lookup_method(bc, name, _seen)
+                if m is not None:
+                    return m
+        return None
+
+    # -- reachability -----------------------------------------------------
+    def reachable(self, root: FunctionInfo, max_depth: int = 16
+                  ) -> Iterator[tuple]:
+        """BFS over resolvable calls: yields ``(FunctionInfo, chain)``
+        where chain is the qualname path from ``root`` (root itself is
+        yielded first with an empty chain)."""
+        from collections import deque
+
+        seen = {id(root.node)}
+        todo = deque([(root, ())])
+        while todo:
+            info, chain = todo.popleft()
+            yield info, chain
+            if len(chain) >= max_depth:
+                continue
+            for call in calls_in(info.node):
+                res = self.resolve_call(info.module, call)
+                tgt = None
+                if res.kind == "function":
+                    tgt = res.target
+                elif res.kind == "class" and res.target is not None:
+                    tgt = res.target.methods.get("__init__")
+                if tgt is not None and id(tgt.node) not in seen:
+                    seen.add(id(tgt.node))
+                    todo.append((tgt, chain + (tgt.name,)))
+
+    def reaches_collective(self, info: FunctionInfo) -> bool:
+        """Does ``info`` (or anything resolvably called from it)
+        dispatch a collective?  Memoized per function node."""
+        from .rules._spmd import is_collective_call
+
+        key = id(info.node)
+        if key in self._reaches_collective:
+            return self._reaches_collective[key]
+        self._reaches_collective[key] = False  # cycle guard
+        hit = False
+        for fn, _chain in self.reachable(info):
+            for call in calls_in(fn.node):
+                if is_collective_call(call):
+                    hit = True
+                    break
+            if hit:
+                break
+        self._reaches_collective[key] = hit
+        return hit
+
+    def key_consuming_params(self, info: FunctionInfo) -> frozenset:
+        """Parameter names of ``info`` that flow (directly or through
+        resolvable callees) into the key slot of a consuming
+        ``jax.random`` call — calling such a helper consumes the caller's
+        key exactly like a direct ``jax.random.split``."""
+        from .rules.prng import _consuming_key_use
+
+        key = id(info.node)
+        if key in self._key_params:
+            return self._key_params[key]
+        self._key_params[key] = frozenset()  # cycle guard
+        a = info.node.args
+        params = [p.arg for p in a.posonlyargs + a.args]
+        consumed: set = set()
+        for call in calls_in(info.node):
+            got = _consuming_key_use(call)
+            if got is not None:
+                if got[0] in params:
+                    consumed.add(got[0])
+                continue
+            res = self.resolve_call(info.module, call)
+            if res.kind != "function":
+                continue
+            sub = self.key_consuming_params(res.target)
+            if not sub:
+                continue
+            for pname, arg in self.map_call_args(res, call):
+                if isinstance(arg, ast.Name) and pname in sub \
+                        and arg.id in params:
+                    consumed.add(arg.id)
+        out = frozenset(consumed)
+        self._key_params[key] = out
+        return out
+
+    @staticmethod
+    def map_call_args(res: Resolution, call: ast.Call):
+        """Pairs of (callee parameter name, call argument expr) for a
+        resolved function call — positional args offset by one for bound
+        method calls (the receiver fills ``self``)."""
+        info = res.target
+        names = info.param_names()
+        offset = 1 if (res.bound and names and
+                       names[0] in ("self", "cls")) else 0
+        for i, arg in enumerate(call.args):
+            j = i + offset
+            if j < len(names):
+                yield names[j], arg
+        for kw in call.keywords:
+            if kw.arg:
+                yield kw.arg, kw.value
+
+    def is_own_package_name(self, dotted: str) -> bool:
+        """Does a dotted name live under a package this project has
+        modules from?  True for ``dask_ml_tpu.ops.foo`` when any indexed
+        module is ``dask_ml_tpu.*`` — the target SHOULD be resolvable,
+        so failing to resolve it means the lint scope is partial, not
+        that the callee is external."""
+        head = dotted.split(".", 1)[0]
+        return any(m.name.split(".", 1)[0] == head and "." in m.name
+                   for m in self.modules)
+
+    # -- documentation cross-reference (undocumented-knob) ----------------
+    def documented_knobs(self) -> tuple | None:
+        """``(exact_names, prefixes)`` parsed from the nearest
+        ``docs/api.md`` above the linted files, or None when no knob
+        table is in reach (snippet linting).  ``DASK_ML_TPU_FOO_*``
+        entries become prefix allowances."""
+        if self._doc_knobs is not False:
+            return self._doc_knobs
+        self._doc_knobs = None
+        path = find_api_md(m.path for m in self.modules)
+        if path is not None:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    text = fh.read()
+            except OSError:
+                text = ""
+            exact, prefixes = set(), []
+            for m in re.finditer(r"(DASK_ML_TPU_\w+)(\*)?", text):
+                if m.group(2):
+                    prefixes.append(m.group(1))
+                else:
+                    exact.add(m.group(1))
+            self._doc_knobs = (frozenset(exact), tuple(prefixes))
+        return self._doc_knobs
+
+
+def find_api_md(paths: Iterable[str]) -> str | None:
+    """The nearest ``docs/api.md`` at or above any of ``paths`` (each
+    probed up to 4 directory levels) — the knob table the
+    ``undocumented-knob`` rule checks against."""
+    seen: set = set()
+    for p in paths:
+        d = os.path.dirname(os.path.abspath(p))
+        for _ in range(4):
+            if d in seen:
+                break
+            seen.add(d)
+            cand = os.path.join(d, "docs", "api.md")
+            if os.path.isfile(cand):
+                return cand
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    return None

@@ -1,4 +1,5 @@
-"""Finding reporters: text for humans/pre-commit, JSON for CI trending."""
+"""Finding reporters: text for humans/pre-commit, JSON for CI trending —
+both carry the baseline ratchet delta when a compare ran."""
 
 from __future__ import annotations
 
@@ -21,23 +22,40 @@ def per_rule_counts(findings: Iterable[Finding]) -> dict:
 
 
 def render_text(findings: Sequence[Finding], errors: Sequence[str] = (),
-                show_suppressed: bool = False) -> str:
+                show_suppressed: bool = False, delta: dict | None = None,
+                ) -> str:
     active = [f for f in findings if not f.suppressed]
     shown = list(findings) if show_suppressed else active
     out = [f.render() for f in shown]
     out.extend(f"error: {e}" for e in errors)
+    if delta is not None:
+        for f in delta["new"]:
+            if f.suppressed and f not in shown:
+                # a NEW suppressed finding fails the ratchet but is
+                # hidden from the default listing — surface it
+                out.append(f"{f.render()}  [new vs baseline]")
+        for e in delta["fixed"]:
+            out.append(
+                f"stale baseline entry: {e['path']}:{e['line']} "
+                f"[{e['rule']}] no longer produced — refresh the "
+                f"baseline (tools/lint.sh --rebaseline)"
+            )
     n_sup = len(findings) - len(active)
-    out.append(
+    summary = (
         f"graftlint: {len(active)} finding(s), {n_sup} suppressed, "
         f"{len(errors)} error(s)"
     )
+    if delta is not None:
+        summary += (f"; ratchet: {len(delta['new'])} new, "
+                    f"{len(delta['fixed'])} stale vs baseline")
+    out.append(summary)
     return "\n".join(out)
 
 
-def render_json(findings: Sequence[Finding], errors: Sequence[str] = ()
-                ) -> str:
+def render_json(findings: Sequence[Finding], errors: Sequence[str] = (),
+                delta: dict | None = None) -> str:
     payload = {
-        "version": 1,
+        "version": 2,
         "findings": [
             {
                 "rule": f.rule,
@@ -54,4 +72,13 @@ def render_json(findings: Sequence[Finding], errors: Sequence[str] = ()
         "errors": list(errors),
         "rules": {rid: cls.summary for rid, cls in sorted(RULES.items())},
     }
+    if delta is not None:
+        payload["baseline"] = {
+            "new": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "suppressed": f.suppressed}
+                for f in delta["new"]
+            ],
+            "stale": list(delta["fixed"]),
+        }
     return json.dumps(payload, indent=2)

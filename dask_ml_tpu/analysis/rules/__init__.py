@@ -1,11 +1,18 @@
 """graftlint rules: importing this package registers every rule.
 
 Each module groups one hazard family; the registry (``core.RULES``) is
-populated by the ``@register`` decorators at import time.
+populated by the ``@register`` decorators at import time.  The v2
+additions (stage-purity, unbounded-retry, checkpoint-schema-drift,
+undocumented-knob) ride the project-wide engine in ``analysis/graph.py``
+and ``analysis/dataflow.py``.
 """
 
+from . import checkpoints  # noqa: F401
 from . import collectives  # noqa: F401
 from . import host_sync  # noqa: F401
 from . import jit_hazards  # noqa: F401
+from . import knobs  # noqa: F401
 from . import prng  # noqa: F401
+from . import retries  # noqa: F401
+from . import stage_purity  # noqa: F401
 from . import threads  # noqa: F401

@@ -60,3 +60,93 @@ def divergent_source(test: ast.AST) -> str | None:
         if name and any(part in name for part in DIVERGENT_NAME_PARTS):
             return name
     return None
+
+
+# -- device work markers (interprocedural rules) --------------------------
+# Method names whose invocation dispatches device programs regardless of
+# receiver — the pattern-match fallback when the call graph cannot
+# resolve the receiver (estimator fit surfaces, the staged-protocol
+# consume hook, explicit device syncs).
+DISPATCH_METHOD_SUFFIXES = frozenset({
+    "partial_fit", "fit", "fit_transform", "fit_predict", "predict",
+    "transform", "score", "_pf_consume", "_step_block",
+    "block_until_ready",
+})
+
+# jax.* callables that are SAFE on a non-dispatch thread: host→device
+# puts and host-side metadata queries, NOT programs.  Everything else
+# under jax is treated as compiling/dispatching (design.md §8: "staging
+# is transfers only — jnp.asarray of host numpy is a put, not a
+# program").
+TRANSFER_SAFE_JAX_SUFFIXES = frozenset({
+    "asarray", "device_put", "issubdtype", "result_type", "dtype",
+})
+
+# callables that FETCH device values to host (a sync, and on a worker
+# thread a cross-thread device wait)
+FETCH_SUFFIXES = frozenset({"unshard"})
+
+
+def device_work_in(project, mod, fn_node):
+    """Yield ``(node, kind, detail)`` for every call in ``fn_node``'s own
+    body that is (or may be) device work:
+
+    * ``"collective"`` — a rendezvous (always device work);
+    * ``"program"`` — a jax call that compiles/dispatches (anything
+      jax-rooted outside the transfer-safe set);
+    * ``"device-cast"`` — ``x.astype(jnp.*)``: a cast program on a
+      device array;
+    * ``"dispatch"`` — an unresolved method call whose name is an
+      estimator dispatch surface (``partial_fit``/``_pf_consume``/...);
+    * ``"fetch"`` — a device→host pull (``unshard``);
+    * ``"dynamic"`` — calling a bare-name parameter or otherwise
+      unresolvable callable: the callee is chosen by the caller at
+      runtime, so nothing can be proven about it.
+
+    Callers filter kinds: thread-dispatch treats ``dynamic`` as a hazard
+    (an arbitrary callable on a worker thread is exactly the deadlock
+    class), stage-purity ignores it (the staged roots are concrete).
+    """
+    from ..graph import calls_in
+
+    for call in calls_in(fn_node):
+        if is_collective_call(call):
+            yield call, "collective", dotted_name(call.func)
+            continue
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr == "astype" \
+                and call.args:
+            arg0 = project.is_jax_name(mod, call.args[0])
+            if arg0 is not None:
+                yield call, "device-cast", f".astype({arg0})"
+                continue
+        jax_name = project.is_jax_name(mod, func)
+        if jax_name is not None:
+            if jax_name.rsplit(".", 1)[-1] not in TRANSFER_SAFE_JAX_SUFFIXES:
+                yield call, "program", jax_name
+            continue
+        name = dotted_name(func)
+        last = name.rsplit(".", 1)[-1] if name else None
+        if last in FETCH_SUFFIXES:
+            yield call, "fetch", name
+            continue
+        res = project.resolve_call(mod, call)
+        if res.kind == "dynamic":
+            yield call, "dynamic", res.name or "<callable>"
+        elif res.kind == "method" and res.name in DISPATCH_METHOD_SUFFIXES:
+            yield call, "dispatch", f".{res.name}()"
+        elif res.kind == "unknown":
+            # a bare name the index cannot place (star-import, injected
+            # global) or a callee expression it cannot model at all
+            # (subscripted registry, call-of-call): unprovable — same
+            # bucket as dynamic, never silently host-only
+            yield call, "dynamic", res.name or "<unresolved>"
+        elif res.kind == "external" and res.name and \
+                project.is_own_package_name(res.name):
+            # a dotted path INTO the package under analysis whose module
+            # is not in this lint's index (single-file invocation): the
+            # body exists but cannot be seen — unprovable, not host-only.
+            # Genuinely third-party non-jax callees stay clean by design:
+            # flagging numpy/stdlib would re-create v1's flag-everything
+            # noise and drown the rule.
+            yield call, "dynamic", res.name

@@ -1,0 +1,196 @@
+"""Checkpoint schema drift: snapshot writers vs resume readers.
+
+A :class:`~dask_ml_tpu.resilience.FitCheckpoint` snapshot is a dict the
+estimator writes at a boundary (``ckpt.save(self, {"centers": c}, i)``,
+or the preemption path ``check_preemption(ckpt, self, state, i)``) and
+reads back on resume (``it, state = snap; state["centers"]``).  The two
+sides live lines apart but nothing ties them together — rename a key in
+the writer and the reader raises ``KeyError`` only in the
+resumed-after-preemption path, the one no ordinary test run exercises.
+
+This rule reconstructs both sides per module through the def-use
+chains: consumed keys that no snapshot writes are flagged (a resume
+crash waiting for a preemption), written keys that no reader consumes
+are flagged as drift (dead snapshot weight).  Modules where either side
+is unresolvable (state built by a dict comprehension, consumed by a
+generic ``.items()`` loop) are skipped — wildcard, not clean."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule, dotted_name, register
+from .. import dataflow
+
+#: receiver-variable evidence that a ``.save``/``.load_if_matches`` call
+#: is checkpoint traffic (and not, say, ``np.save``)
+_CKPT_HINTS = ("fit_checkpoint", "FitCheckpoint", "checkpoint")
+_CKPT_PARAM_NAMES = frozenset({"ckpt", "checkpoint", "fit_checkpoint"})
+
+
+def _expr_mentions_checkpoint(expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute) and any(
+                h in n.attr for h in _CKPT_HINTS):
+            return True
+        if isinstance(n, ast.Name) and any(h in n.id for h in _CKPT_HINTS):
+            return True
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) and \
+                any(h in n.value for h in _CKPT_HINTS):
+            return True
+    return False
+
+
+def _ckpt_receivers(fn: ast.AST, du: dataflow.DefUse) -> set:
+    """Names in this scope that hold a checkpoint object: assigned from
+    something mentioning ``fit_checkpoint``/``FitCheckpoint``, or a
+    parameter conventionally named for one."""
+    out = set()
+    for name, entries in du.defs.items():
+        if name in _CKPT_PARAM_NAMES:
+            out.add(name)
+            continue
+        for (_node, value, _uses) in entries:
+            if value is not None and _expr_mentions_checkpoint(value):
+                out.add(name)
+    return out
+
+
+class _ModuleSchema:
+    def __init__(self):
+        self.written: set = set()
+        self.write_sites: list = []   # (keys|None(wildcard), node)
+        self.consumed: dict = {}      # key -> first consuming node
+        self.wildcard_write = False
+        self.wildcard_consume = False
+        self.has_load = False
+
+
+@register
+class CheckpointSchemaRule(Rule):
+    id = "checkpoint-schema-drift"
+    summary = (
+        "FitCheckpoint snapshot schema drift: a resume path reads a "
+        "state key no snapshot writes (KeyError on the "
+        "resumed-after-preemption path), or a snapshot writes a key no "
+        "resume consumes"
+    )
+
+    def run(self, ctx: Context):
+        project = getattr(ctx, "project", None)
+        mod = project.module_for(ctx) if project is not None else None
+        schema = _ModuleSchema()
+        fns = [n for n in ast.walk(ctx.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in fns:
+            self._scan_function(ctx, mod, project, fn, schema)
+        if not schema.has_load and not schema.write_sites:
+            return
+        # consumed keys nothing writes: only judged when every write
+        # site resolved (a wildcard write could supply anything)
+        missing = False
+        if not schema.wildcard_write and schema.write_sites:
+            for key, node in sorted(schema.consumed.items()):
+                if key not in schema.written:
+                    missing = True
+                    yield ctx.finding(
+                        self.id, node,
+                        f"resume reads state[{key!r}] but no snapshot in "
+                        f"this module writes that key (writers produce "
+                        f"{sorted(schema.written)}): the resumed-after-"
+                        f"preemption path will raise KeyError — align "
+                        f"the snapshot dict and the resume reads",
+                    )
+        # written keys nothing consumes: only when the module HAS
+        # resolvable consumers (else the resume side is elsewhere/generic)
+        # and the schema is not already reported broken from the read
+        # side — one coherent complaint per drift, not two
+        if schema.consumed and not schema.wildcard_consume and \
+                not schema.wildcard_write and not missing:
+            dead = schema.written - set(schema.consumed)
+            for keys, node in schema.write_sites:
+                if keys is None:
+                    continue
+                for key in sorted(keys & dead):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"snapshot writes state[{key!r}] but no resume "
+                        f"path in this module reads it: dead snapshot "
+                        f"weight, or the resume forgot to restore it — "
+                        f"drop the key or consume it on resume",
+                    )
+
+    # -- per-function collection -----------------------------------------
+    def _scan_function(self, ctx, mod, project, fn, schema) -> None:
+        from ..graph import calls_in
+
+        du = dataflow.DefUse(fn)
+        receivers = _ckpt_receivers(fn, du)
+        snap_names: set = set()
+        snap_direct: set = set()
+        for call in calls_in(fn):
+            func = call.func
+            name = dotted_name(func) or ""
+            last = name.rsplit(".", 1)[-1]
+            state_arg = None
+            if isinstance(func, ast.Attribute) and func.attr == "save" \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id in receivers \
+                    and len(call.args) >= 2:
+                state_arg = call.args[1]
+            elif last == "check_preemption" and len(call.args) >= 3:
+                state_arg = call.args[2]
+            if state_arg is not None:
+                keys = dataflow.resolve_dict_keys(state_arg, du, mod,
+                                                  project)
+                if keys is None:
+                    schema.wildcard_write = True
+                    schema.write_sites.append((None, call))
+                else:
+                    schema.written |= keys
+                    schema.write_sites.append((keys, call))
+                continue
+            if isinstance(func, ast.Attribute) and \
+                    func.attr == "load_if_matches":
+                schema.has_load = True
+                parent = next(ctx.parents(call), None)
+                if isinstance(parent, ast.Assign) and \
+                        len(parent.targets) == 1 and \
+                        isinstance(parent.targets[0], ast.Name):
+                    # snap = ckpt.load_if_matches(...); unpacked later
+                    snap_names.add(parent.targets[0].id)
+                elif isinstance(parent, ast.Assign) and \
+                        isinstance(parent.targets[0], ast.Tuple):
+                    # it, state = ckpt.load_if_matches(...) directly
+                    self._state_from_tuple(parent.targets[0], snap_direct)
+        # snap → `it, state = snap` → subscripts of state
+        state_names: set = set(snap_direct)
+        if snap_names:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id in snap_names and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Tuple):
+                    self._state_from_tuple(node.targets[0], state_names)
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Subscript) and \
+                    isinstance(n.value, ast.Name) and \
+                    n.value.id in state_names:
+                if isinstance(n.slice, ast.Constant) and \
+                        isinstance(n.slice.value, str):
+                    schema.consumed.setdefault(n.slice.value, n)
+                else:
+                    schema.wildcard_consume = True
+            elif isinstance(n, ast.Attribute) and \
+                    isinstance(n.value, ast.Name) and \
+                    n.value.id in state_names and \
+                    n.attr in ("items", "keys", "values", "get", "pop"):
+                schema.wildcard_consume = True
+
+    @staticmethod
+    def _state_from_tuple(tup: ast.Tuple, out: set) -> None:
+        """``it, state = ...``: the LAST element is the state dict by the
+        FitCheckpoint convention ``(iteration, state)``."""
+        if tup.elts and isinstance(tup.elts[-1], ast.Name):
+            out.add(tup.elts[-1].id)

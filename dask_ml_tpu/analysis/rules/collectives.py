@@ -14,48 +14,77 @@ from ..core import Context, Rule, register
 from ._spmd import divergent_source, is_collective_call
 
 
+def _divergent_guard(ctx: Context, node: ast.AST) -> str | None:
+    """The first process-divergent value source guarding ``node`` within
+    its enclosing function, or None."""
+    child: ast.AST = node
+    for parent in ctx.parents(node):
+        if isinstance(parent, (ast.FunctionDef,
+                               ast.AsyncFunctionDef, ast.Lambda)):
+            return None
+        test = None
+        if isinstance(parent, (ast.If, ast.While)):
+            # only when the node is in the guarded body, not in the
+            # test expression itself
+            if child is not parent.test:
+                test = parent.test
+        elif isinstance(parent, ast.IfExp):
+            if child is not parent.test:
+                test = parent.test
+        if test is not None:
+            src = divergent_source(test)
+            if src is not None:
+                return src
+        child = parent
+    return None
+
+
 @register
 class DivergentCollectiveRule(Rule):
-    """A collective dispatched under a process-divergent condition."""
+    """A collective dispatched under a process-divergent condition —
+    directly, or (since v2) through any resolvable chain of helpers
+    that reaches one."""
 
     id = "divergent-collective"
     summary = (
-        "collective call guarded by a condition that can differ across "
-        "processes (process_index, wall-clock, PRNG, environ) — peers "
-        "that skip the rendezvous hang the group"
+        "collective call (direct, or through helpers) guarded by a "
+        "condition that can differ across processes (process_index, "
+        "wall-clock, PRNG, environ) — peers that skip the rendezvous "
+        "hang the group"
     )
 
     def run(self, ctx: Context):
+        project = ctx.project
+        mod = project.module_for(ctx) if project is not None else None
         for node in ast.walk(ctx.tree):
-            if not is_collective_call(node):
+            if not isinstance(node, ast.Call):
                 continue
-            child: ast.AST = node
-            for parent in ctx.parents(node):
-                if isinstance(parent, (ast.FunctionDef,
-                                       ast.AsyncFunctionDef, ast.Lambda)):
-                    break
-                test = None
-                if isinstance(parent, (ast.If, ast.While)):
-                    # only when the collective is in the guarded body, not
-                    # in the test expression itself
-                    if child is not parent.test:
-                        test = parent.test
-                elif isinstance(parent, ast.IfExp):
-                    if child is not parent.test:
-                        test = parent.test
-                if test is not None:
-                    src = divergent_source(test)
-                    if src is not None:
-                        yield ctx.finding(
-                            self.id, node,
-                            f"collective under a process-divergent "
-                            f"condition (reads {src}): every process must "
-                            f"reach every collective — hoist the call or "
-                            f"derive the condition from a collective "
-                            f"(e.g. allgather the flag first)",
-                        )
-                        break
-                child = parent
+            # the guard check is a cheap parent-walk and rejects almost
+            # every call; do it BEFORE any call-graph work
+            src = _divergent_guard(ctx, node)
+            if src is None:
+                continue
+            via = None
+            if is_collective_call(node):
+                pass  # the direct case
+            elif project is not None:
+                res = project.resolve_call(mod, node)
+                if res.kind != "function" or \
+                        not project.reaches_collective(res.target):
+                    continue
+                via = res.target.name
+            else:
+                continue
+            through = (f" (reached through {via}(), which dispatches a "
+                       f"collective)" if via else "")
+            yield ctx.finding(
+                self.id, node,
+                f"collective under a process-divergent condition "
+                f"(reads {src}){through}: every process must reach "
+                f"every collective — hoist the call or derive the "
+                f"condition from a collective (e.g. allgather the "
+                f"flag first)",
+            )
 
 
 @register

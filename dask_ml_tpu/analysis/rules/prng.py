@@ -72,22 +72,59 @@ def _assigned_names(stmt: ast.stmt) -> set[str]:
     return out
 
 
-def _expr_uses(stmt: ast.stmt) -> list[tuple[str, str, ast.Call]]:
-    """Consuming key uses in a statement's expressions (nested defs and
-    lambdas excluded: they execute later, in their own order)."""
+def _helper_key_uses(ctx, call: ast.Call) -> list[tuple[str, str, ast.Call]]:
+    """Key names consumed by passing them into a resolvable helper whose
+    parameter flows into a consuming ``jax.random`` call — since v2,
+    ``init_centers(X, key)`` consumes ``key`` exactly like a direct
+    ``jax.random.split(key)`` would."""
+    project = getattr(ctx, "project", None)
+    if project is None:
+        return []
+    mod = project.module_for(ctx)
+    res = project.resolve_call(mod, call)
+    if res.kind != "function":
+        return []
+    consuming = project.key_consuming_params(res.target)
+    if not consuming:
+        return []
+    uses = []
+    for pname, arg in project.map_call_args(res, call):
+        if pname in consuming and isinstance(arg, ast.Name):
+            uses.append((arg.id, f"{res.target.name}·consumes·{pname}",
+                         call))
+    return uses
+
+
+def _expr_uses(stmt: ast.stmt, ctx=None) -> list[tuple[str, str, ast.Call]]:
+    """Consuming key uses in a statement's expressions — direct
+    ``jax.random`` calls plus (when a project is available) helper calls
+    that consume a key parameter.  Nested defs and lambdas excluded:
+    they execute later, in their own order."""
     uses = []
     for n in _walk_no_defs(stmt):
         got = _consuming_key_use(n)
         if got:
             uses.append((got[0], got[1], n))
+        elif ctx is not None and isinstance(n, ast.Call):
+            uses.extend(_helper_key_uses(ctx, n))
     return uses
 
 
 def _terminates(stmts) -> bool:
-    """Does this statement list always leave the enclosing flow?"""
-    return bool(stmts) and isinstance(
-        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue)
-    )
+    """Does this statement list always leave the enclosing flow?  Looks
+    through trailing ``with`` bodies and fully-terminating ``if``/
+    ``else`` pairs (``with _timer(...): return f(key)`` is as exclusive
+    as a bare return — the k-means|| init ladder)."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, (ast.Return, ast.Raise, ast.Break, ast.Continue)):
+        return True
+    if isinstance(last, (ast.With, ast.AsyncWith)):
+        return _terminates(last.body)
+    if isinstance(last, ast.If):
+        return _terminates(last.body) and _terminates(last.orelse)
+    return False
 
 
 def _walk_no_defs(node: ast.AST):
@@ -171,7 +208,7 @@ class KeyReuseRule(Rule):
                     self._uses_in_expr(ctx, item.context_expr, used)
                 self._scan(ctx, stmt.body, used)
             else:
-                for name, fn, call in _expr_uses(stmt):
+                for name, fn, call in _expr_uses(stmt, ctx):
                     self._mark(ctx, name, fn, call, used)
                 for name in _assigned_names(stmt):
                     used.pop(name, None)
@@ -187,15 +224,26 @@ class KeyReuseRule(Rule):
             got = _consuming_key_use(n)
             if got:
                 self._mark(ctx, got[0], got[1], n, used)
+            elif isinstance(n, ast.Call):
+                for name, fn, call in _helper_key_uses(ctx, n):
+                    self._mark(ctx, name, fn, call, used)
+
+    @staticmethod
+    def _describe(fn: str) -> str:
+        if "·" in fn:  # helper-call use: "helper·consumes·param"
+            helper, _, param = fn.split("·")
+            return f"{helper}() (which consumes its {param!r} parameter)"
+        return f"jax.random.{fn}"
 
     def _mark(self, ctx: Context, name, fn, call, used: dict) -> None:
         if name in used:
             prev_fn, prev_line = used[name]
             self._findings.append(ctx.finding(
                 self.id, call,
-                f"key {name!r} already consumed by jax.random.{prev_fn} "
-                f"on line {prev_line}; sampling again yields identical "
-                f"bits — split the key (or fold_in distinct data) first",
+                f"key {name!r} already consumed by "
+                f"{self._describe(prev_fn)} on line {prev_line}; sampling "
+                f"again yields identical bits — split the key (or fold_in "
+                f"distinct data) first",
             ))
         else:
             used[name] = (fn, call.lineno)
@@ -212,14 +260,14 @@ class KeyReuseRule(Rule):
                     assigned |= _assigned_names(n)
         seen: set[str] = set()
         for stmt in loop.body + loop.orelse:
-            for name, fn, call in _expr_uses(stmt):
+            for name, fn, call in _expr_uses(stmt, ctx):
                 if name not in assigned and name not in seen:
                     seen.add(name)
                     self._findings.append(ctx.finding(
                         self.id, call,
-                        f"key {name!r} consumed by jax.random.{fn} every "
-                        f"loop iteration but never re-split in the loop: "
-                        f"each iteration draws identical bits — "
+                        f"key {name!r} consumed by {self._describe(fn)} "
+                        f"every loop iteration but never re-split in the "
+                        f"loop: each iteration draws identical bits — "
                         f"`{name}, sub = jax.random.split({name})` inside "
                         f"the loop, or fold_in the iteration index",
                     ))

@@ -1,0 +1,91 @@
+"""Retry boundedness: every ``resilience.retry`` call must have a
+provable stopping bound.
+
+``retry``'s own default (``retries=3``) is bounded; the hazard is the
+call site that forwards a caller-supplied budget (``retries=int(n)``,
+``retries=cfg.attempts``) with no ``deadline=``: nothing in the code
+proves the loop ever gives up, and a persistent fault behind such a site
+retries silently for as long as the caller's arithmetic says — the
+fault-observability contract (resilience/retry.py: recovery must be
+loud, never silent) inverted.  The fix is either a literal re-attempt
+budget or a :class:`~dask_ml_tpu.resilience.Deadline` that converts
+"still failing at T" into an exception."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule, dotted_name, register
+
+_RETRY_NAMES = frozenset({"retry", "_retry"})
+
+
+def _const_int(node: ast.AST) -> int | None:
+    """A compile-time int bound: a literal, or an IfExp whose branches
+    both are (the ``0 if lockstep else 1`` shape)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand)
+        return -v if v is not None else None
+    if isinstance(node, ast.IfExp):
+        a, b = _const_int(node.body), _const_int(node.orelse)
+        if a is not None and b is not None:
+            return max(a, b)
+    return None
+
+
+@register
+class UnboundedRetryRule(Rule):
+    id = "unbounded-retry"
+    summary = (
+        "resilience.retry call whose re-attempt budget is not a "
+        "compile-time constant and that carries no Deadline — nothing "
+        "proves the retry loop ever gives up"
+    )
+
+    def _is_retry_call(self, ctx: Context, node: ast.Call) -> bool:
+        name = dotted_name(node.func)
+        if not name or name.rsplit(".", 1)[-1] not in _RETRY_NAMES:
+            return False
+        project = getattr(ctx, "project", None)
+        if project is not None:
+            full = project.full_call_name(project.module_for(ctx),
+                                          node.func)
+            if full and "." in full:
+                # resolved through an import: accept only the repo's
+                # retry primitive, not some other library's
+                return full.endswith("resilience.retry.retry") or \
+                    full.rsplit(".", 1)[-1] in _RETRY_NAMES and \
+                    ".resilience." in full
+        return True
+
+    def run(self, ctx: Context):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not self._is_retry_call(ctx, node):
+                continue
+            kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+            deadline = kwargs.get("deadline")
+            has_deadline = deadline is not None and not (
+                isinstance(deadline, ast.Constant)
+                and deadline.value is None
+            )
+            if has_deadline:
+                continue
+            retries = kwargs.get("retries")
+            if retries is None:
+                continue  # the bounded default (retries=3)
+            bound = _const_int(retries)
+            if bound is not None and bound >= 0:
+                continue
+            yield ctx.finding(
+                self.id, node,
+                f"retry(...) with retries={ast.unparse(retries)} and no "
+                f"deadline: the re-attempt budget is not a compile-time "
+                f"bound, so nothing proves this loop gives up under a "
+                f"persistent fault — pass deadline=Deadline(...)/seconds, "
+                f"or make the budget a literal",
+            )

@@ -1,0 +1,79 @@
+"""Stage purity: the prefetch worker thread must never dispatch.
+
+design.md §8's contract, mechanized: ``_pf_stage`` implementations run
+on the input pipeline's host worker thread (``pipeline/core.py``), so
+anything REACHABLE from a ``_pf_stage`` body — through any chain of
+helpers and ``self.`` methods the call graph can resolve — must be pure
+host work plus host→device transfers.  A device program (any jax call
+outside the transfer-safe set, an ``.astype(jnp.*)`` cast, an estimator
+dispatch method), a device→host fetch (``unshard``), or a collective on
+that path is the PR-1 deadlock class running one thread away from where
+anyone is looking.
+
+This is a project-wide rule: the roots live in estimator modules, the
+helpers they reach can live anywhere in the package, and the finding is
+reported at the offending call (with the chain from the root in the
+message) so the suppression/fix lands where the hazard is."""
+
+from __future__ import annotations
+
+from ..core import Rule, register
+from ._spmd import device_work_in
+
+#: call-kinds from device_work_in that violate stage purity.  "dynamic"
+#: is deliberately excluded: the roots are concrete implementations and
+#: flagging every unresolvable call would bury the real signal.
+_IMPURE_KINDS = frozenset({
+    "collective", "program", "device-cast", "dispatch", "fetch",
+})
+
+_KIND_LABEL = {
+    "collective": "a collective rendezvous",
+    "program": "a device program dispatch",
+    "device-cast": "a device cast program",
+    "dispatch": "an estimator dispatch method",
+    "fetch": "a device→host fetch",
+}
+
+
+@register
+class StagePurityRule(Rule):
+    id = "stage-purity"
+    project_wide = True
+    summary = (
+        "device dispatch/fetch/collective reachable from a _pf_stage "
+        "implementation — _pf_stage runs on the prefetch worker thread, "
+        "which must only parse and issue host→device puts "
+        "(design.md §8)"
+    )
+
+    def run_project(self, project):
+        seen: set = set()
+        for mod in project.modules:
+            for cls in mod.classes.values():
+                root = cls.methods.get("_pf_stage")
+                if root is None:
+                    continue
+                root_label = f"{cls.name}._pf_stage"
+                for fn, chain in project.reachable(root):
+                    for node, kind, detail in device_work_in(
+                            project, fn.module, fn.node):
+                        if kind not in _IMPURE_KINDS:
+                            continue
+                        key = (fn.module.path, node.lineno,
+                               node.col_offset)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        via = " -> ".join((root_label,) + chain) \
+                            if chain else root_label
+                        yield fn.module.ctx.finding(
+                            self.id, node,
+                            f"{_KIND_LABEL[kind]} ({detail}) reachable "
+                            f"from {via}: _pf_stage runs on the prefetch "
+                            f"worker thread, which must never "
+                            f"compile/dispatch/fetch (design.md §8) "
+                            f"— move this to _pf_consume (consumer "
+                            f"thread), decline the block from _pf_stage, "
+                            f"or split the helper into a host-only tail",
+                        )

@@ -1,33 +1,132 @@
-"""Threaded multi-device dispatch (the PR-1 deadlock class).
+"""Threaded multi-device dispatch (the PR-1 deadlock class) —
+interprocedural since graftlint v2.
 
 Two threads interleaving multi-device program enqueues on the one shared
 mesh can deadlock the runtime: device A executes thread-1's program while
 device B executes thread-2's, and each program's collective waits for the
 other's devices forever.  ``model_selection/_search.py`` owns the fix —
 ``_uses_device_estimator`` forces ``n_workers = 1`` before any pool is
-built.  This rule flags every thread-pool/Thread construction in library
-code that is NOT visibly behind that guard, so a new call site must either
-adopt the guard or justify (suppress) why its work is host-only.
-"""
+built.
+
+v1 flagged every pool/Thread construction not visibly behind that guard.
+v2 follows the WORK first: for each construction it collects the
+submitted callables (``Thread(target=f)``, ``pool.submit(f)``,
+``pool.map(f, ...)``, ``loop.run_in_executor(pool, f)``), resolves them
+through the project call graph, and scans their transitive bodies for
+device work.  A thread whose every target is provably host-only is
+clean — no guard, no suppression needed.  A target that dispatches (or
+calls a dynamic callable nothing can be proven about) still flags, now
+with the evidence chain in the message."""
 
 from __future__ import annotations
 
 import ast
 
 from ..core import Context, Rule, dotted_name, register
+from ._spmd import device_work_in
 
 _CTOR_SUFFIXES = frozenset({"ThreadPoolExecutor", "Thread"})
 _GUARD_NAME = "_uses_device_estimator"
+_SUBMIT_METHODS = frozenset({"submit", "map", "apply_async"})
+
+
+def _pool_binding(ctx: Context, ctor: ast.Call) -> str | None:
+    """The variable name a pool constructor binds to (``pool = ...`` or
+    ``with ... as pool:``), for finding its submit sites."""
+    parent = next(ctx.parents(ctor), None)
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1 and \
+            isinstance(parent.targets[0], ast.Name):
+        return parent.targets[0].id
+    if isinstance(parent, ast.withitem) and \
+            isinstance(parent.optional_vars, ast.Name):
+        return parent.optional_vars.id
+    return None
+
+
+def _work_targets(ctx: Context, ctor: ast.Call) -> list | None:
+    """The callables handed to this thread/pool, or None when none are
+    visible from the construction site (pool escapes the function —
+    nothing can be proven, stay conservative).
+
+    Pool submit sites are found through the def-use chains: only uses
+    attributed to THIS constructor's binding count, so a rebound pool
+    variable never borrows another pool's submissions."""
+    from .. import dataflow
+
+    name = dotted_name(ctor.func) or ""
+    if name.rsplit(".", 1)[-1] == "Thread":
+        for kw in ctor.keywords:
+            if kw.arg == "target":
+                return [kw.value]
+        return None
+    pool_var = _pool_binding(ctx, ctor)
+    if pool_var is None:
+        return None
+    scope = ctx.enclosing_function(ctor) or ctx.tree
+    du = dataflow.DefUse(scope)
+    targets = []
+    for def_node, _value, uses in du.defs.get(pool_var, ()):
+        if not any(n is ctor for n in ast.walk(def_node)):
+            continue  # a different binding of the same name
+        for use in uses:
+            parent = ctx._parent.get(id(use))
+            if isinstance(parent, ast.Attribute) and \
+                    parent.attr in _SUBMIT_METHODS:
+                call = ctx._parent.get(id(parent))
+                if isinstance(call, ast.Call) and call.func is parent \
+                        and call.args:
+                    targets.append(call.args[0])
+            elif isinstance(parent, ast.Call) and \
+                    isinstance(parent.func, ast.Attribute) and \
+                    parent.func.attr == "run_in_executor" and \
+                    len(parent.args) >= 2 and parent.args[0] is use:
+                targets.append(parent.args[1])
+    return targets or None
 
 
 @register
 class ThreadDispatchRule(Rule):
     id = "thread-dispatch"
     summary = (
-        "thread pool / Thread constructed without the device-estimator "
-        "serialization guard — concurrent multi-device dispatch on a "
-        "shared mesh can interleave enqueue order and deadlock"
+        "thread pool / Thread whose submitted work is not provably "
+        "host-only and is not behind the device-estimator serialization "
+        "guard — concurrent multi-device dispatch on a shared mesh can "
+        "interleave enqueue order and deadlock"
     )
+
+    def _target_evidence(self, ctx: Context, target: ast.AST) -> list | None:
+        """Device-work evidence for one submitted callable: [] when the
+        target resolves and its transitive body is provably host-only,
+        a non-empty list of reasons when it is not, None when the target
+        itself cannot be resolved."""
+        project = ctx.project
+        mod = project.module_for(ctx)
+        if isinstance(target, ast.Lambda):
+            # scan the lambda body directly as a pseudo-function
+            root_nodes = [(None, target)]
+        else:
+            res = project.resolve_callable(mod, target)
+            if res.kind != "function":
+                return None
+            root_nodes = [(res.target, res.target.node)]
+        evidence = []
+        for info, node in root_nodes:
+            if info is None:
+                from ..graph import FunctionInfo
+
+                info = FunctionInfo("<lambda>", f"{mod.name}.<lambda>",
+                                    mod, node)
+            for fn, chain in project.reachable(info):
+                via = " -> ".join((info.name,) + chain)
+                for _node, kind, detail in device_work_in(
+                        project, fn.module, fn.node):
+                    if kind == "dynamic":
+                        evidence.append(
+                            f"{via} calls dynamic callable {detail}() — "
+                            f"unprovable")
+                    else:
+                        evidence.append(f"{via} reaches {kind} {detail}")
+        return evidence
 
     def run(self, ctx: Context):
         for node in ast.walk(ctx.tree):
@@ -44,11 +143,31 @@ class ThreadDispatchRule(Rule):
             )
             if guarded:
                 continue
+            targets = _work_targets(ctx, node)
+            why = None
+            if targets is not None:
+                all_evidence: list = []
+                unresolved = False
+                for t in targets:
+                    ev = self._target_evidence(ctx, t)
+                    if ev is None:
+                        unresolved = True
+                    else:
+                        all_evidence.extend(ev)
+                if not unresolved and not all_evidence:
+                    continue  # every submitted callable is host-only
+                if all_evidence:
+                    why = "; ".join(all_evidence[:3])
+                elif unresolved:
+                    why = "submitted callable could not be resolved"
+            else:
+                why = "no submitted work visible from the construction site"
             yield ctx.finding(
                 self.id, node,
                 f"{name}(...) without the {_GUARD_NAME} serialization "
-                f"guard: threads submitting multi-device programs on the "
-                f"shared mesh can deadlock the runtime — gate worker count "
-                f"on the guard (see model_selection/_search.py) or "
-                f"suppress with a host-only justification",
+                f"guard and not provably host-only ({why}): threads "
+                f"submitting multi-device programs on the shared mesh can "
+                f"deadlock the runtime — gate worker count on the guard "
+                f"(see model_selection/_search.py), keep the worker "
+                f"host-only, or suppress with a justification",
             )

@@ -290,7 +290,8 @@ def spawn_group(n_processes: int = 2, local_devices: int = 4,
             outs[i] = (e.stdout or "") if isinstance(e.stdout, str) else ""
 
     threads = [
-        # graftlint: disable=thread-dispatch -- host-only pipe drain: each thread blocks in p.communicate() reading child stdout, no device dispatch
+        # no suppression needed: graftlint v2 resolves `drain` and proves
+        # it host-only (p.communicate() pipe reads, no device dispatch)
         threading.Thread(target=drain, args=(i, p), daemon=True)
         for i, p in enumerate(procs)
     ]

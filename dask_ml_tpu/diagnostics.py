@@ -47,7 +47,7 @@ __all__ = [
 ]
 
 
-def lint_report(paths=None) -> dict:
+def lint_report(paths=None, baseline="auto") -> dict:
     """Per-rule graftlint finding counts for benches and CI trending.
 
     Runs the repo's static analyzer (:mod:`dask_ml_tpu.analysis`) over
@@ -55,25 +55,70 @@ def lint_report(paths=None) -> dict:
 
         {"counts": {rule_id: {"active": n, "suppressed": m}},
          "active": total_active, "suppressed": total_suppressed,
-         "errors": [parse errors]}
+         "errors": [parse errors],
+         "baseline": {"path": ..., "new": n, "fixed": m,
+                      "per_rule": {rule_id: {"new": x, "fixed": y}}}}
 
     ``active`` must trend to (and stay at) zero — tier-1 gates on it via
     tests/test_graftlint.py; ``suppressed`` is the debt metric to trend
-    down release over release.
+    down release over release.  The ``baseline`` block is the per-PR
+    delta vs the committed ratchet snapshot — what CHANGES/bench tooling
+    trends ("this PR removed two suppressions, added none").
+    ``baseline="auto"`` finds the committed snapshot next to a repo
+    checkout (``tools/graftlint_baseline.json``); pass a path to pin it
+    or ``None`` to skip; the block is ``None`` when no snapshot exists.
     """
     import os
 
     from . import analysis
 
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
     if paths is None:
-        paths = [os.path.dirname(os.path.abspath(__file__))]
-    findings, errors = analysis.lint_paths(paths)
+        paths = [pkg_dir]
+    # cache=True: trending callers re-lint an unchanged tree constantly;
+    # the digest-keyed cache makes that free and can never serve stale
+    # results (any source edit changes the digest)
+    findings, errors = analysis.lint_paths(paths, cache=True)
     counts = analysis.per_rule_counts(findings)
+    if baseline == "auto":
+        cand = os.path.join(os.path.dirname(pkg_dir), "tools",
+                            "graftlint_baseline.json")
+        baseline = cand if os.path.isfile(cand) else None
+    delta_block = None
+    if baseline is not None:
+        try:
+            snap = analysis.baseline.load(baseline)
+        except (OSError, ValueError):
+            snap = None
+        if snap is not None:
+            root = analysis.baseline.baseline_root(paths)
+            try:
+                delta = analysis.baseline.compare(snap, findings, root)
+            except ValueError:
+                # scope mismatch (an auto-discovered baseline vs
+                # explicit non-package paths): no comparable snapshot,
+                # report no delta rather than crash a trending call
+                snap = None
+        if snap is not None:
+            per_rule: dict = {}
+            for f in delta["new"]:
+                per_rule.setdefault(f.rule, {"new": 0, "fixed": 0})
+                per_rule[f.rule]["new"] += 1
+            for e in delta["fixed"]:
+                per_rule.setdefault(e["rule"], {"new": 0, "fixed": 0})
+                per_rule[e["rule"]]["fixed"] += 1
+            delta_block = {
+                "path": baseline,
+                "new": len(delta["new"]),
+                "fixed": len(delta["fixed"]),
+                "per_rule": dict(sorted(per_rule.items())),
+            }
     return {
         "counts": counts,
         "active": sum(c["active"] for c in counts.values()),
         "suppressed": sum(c["suppressed"] for c in counts.values()),
         "errors": list(errors),
+        "baseline": delta_block,
     }
 
 

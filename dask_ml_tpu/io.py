@@ -106,7 +106,8 @@ def csv_dims(path: str, *, has_header: bool = False) -> tuple[int, int]:
 
 def read_csv(path: str, *, has_header: bool = False,
              n_threads: int | None = None, retries: int = 0,
-             retry_backoff: float = 0.1) -> np.ndarray:
+             retry_backoff: float = 0.1,
+             retry_deadline_s: float | None = 120.0) -> np.ndarray:
     """Parse a numeric CSV into a float32 (rows, cols) array, one parser
     thread per row range.
 
@@ -115,7 +116,12 @@ def read_csv(path: str, *, has_header: bool = False,
     via :func:`dask_ml_tpu.resilience.retry` — absorbed faults and
     propagated failures are both counted in the global
     :func:`~dask_ml_tpu.diagnostics.fault_stats` under the ``"ingest"``
-    tag, so recovery is observable, never silent.
+    tag, so recovery is observable, never silent.  ``retry_deadline_s``
+    wall-clock-bounds the retry loop (the re-attempt budget is caller
+    input, so the bound must not depend on it — graftlint's
+    ``unbounded-retry`` contract): a persistently failing mount raises
+    :class:`~dask_ml_tpu.resilience.DeadlineExceeded` loudly instead of
+    backing off for as long as the budget arithmetic allows.
     """
     from .resilience.retry import retry as _retry
     from .resilience.testing import maybe_fault
@@ -134,7 +140,7 @@ def read_csv(path: str, *, has_header: bool = False,
         return out
 
     return _retry(_parse, retries=int(retries), backoff=retry_backoff,
-                  tag="ingest")
+                  deadline=retry_deadline_s, tag="ingest")
 
 
 def read_binary(path: str, shape: tuple[int, ...], *,
@@ -152,7 +158,8 @@ def read_binary(path: str, shape: tuple[int, ...], *,
 
 def stream_csv_blocks(path: str, block_rows: int, *, has_header: bool = False,
                       n_threads: int | None = None, prefetch: int = 2,
-                      retries: int = 0, retry_backoff: float = 0.1):
+                      retries: int = 0, retry_backoff: float = 0.1,
+                      retry_deadline_s: float | None = 120.0):
     """Yield float32 row blocks of (at most) ``block_rows`` — the
     out-of-core ingest feeding ``wrappers.Incremental`` (the reference's
     sequential block streaming, SURVEY.md §2.2).
@@ -168,7 +175,8 @@ def stream_csv_blocks(path: str, block_rows: int, *, has_header: bool = False,
     ``retries`` re-attempts each BLOCK fetch on a transient fault with
     exponential backoff (:func:`dask_ml_tpu.resilience.retry`, tag
     ``"ingest"``) — the native session keeps the stream position, so a
-    failed attempt never skips rows."""
+    failed attempt never skips rows.  ``retry_deadline_s`` wall-clock
+    bounds each block's retry loop (see :func:`read_csv`)."""
     if block_rows < 1:
         raise ValueError(f"block_rows must be >= 1, got {block_rows}")
     from .resilience.retry import retry as _retry
@@ -204,7 +212,8 @@ def stream_csv_blocks(path: str, block_rows: int, *, has_header: bool = False,
 
         while True:
             buf = _retry(_next_block, retries=int(retries),
-                         backoff=retry_backoff, tag="ingest")
+                         backoff=retry_backoff,
+                         deadline=retry_deadline_s, tag="ingest")
             if got.value == 0:
                 break
             yield buf[: got.value]
@@ -214,7 +223,8 @@ def stream_csv_blocks(path: str, block_rows: int, *, has_header: bool = False,
 
 def stream_binary_blocks(path: str, block_rows: int, n_features: int, *,
                          n_rows: int | None = None, offset_bytes: int = 0,
-                         retries: int = 0, retry_backoff: float = 0.1):
+                         retries: int = 0, retry_backoff: float = 0.1,
+                         retry_deadline_s: float | None = 120.0):
     """Yield float32 row blocks of (at most) ``block_rows`` from a raw
     little-endian float32 file — the binary twin of
     :func:`stream_csv_blocks`, for out-of-core streams whose parse cost
@@ -230,6 +240,8 @@ def stream_binary_blocks(path: str, block_rows: int, n_features: int, *,
     ``retries`` re-attempts each BLOCK read on a transient fault
     (:func:`dask_ml_tpu.resilience.retry`, tag ``"ingest"``); reads are
     offset-addressed, so a failed attempt never skips rows.
+    ``retry_deadline_s`` wall-clock bounds each block's retry loop (see
+    :func:`read_csv`).
     """
     if block_rows < 1:
         raise ValueError(f"block_rows must be >= 1, got {block_rows}")
@@ -257,7 +269,8 @@ def stream_binary_blocks(path: str, block_rows: int, n_features: int, *,
     for lo in range(0, n_rows, int(block_rows)):
         rows = min(int(block_rows), n_rows - lo)
         yield _retry(_read_block, lo, rows, retries=int(retries),
-                     backoff=retry_backoff, tag="ingest")
+                     backoff=retry_backoff, deadline=retry_deadline_s,
+                     tag="ingest")
 
 
 def stream_text_lines(path: str, block_lines: int = 10_000):

@@ -566,6 +566,14 @@ class _BaseSGD(TPUEstimator):
                              np.float32),
                 ])
             return xd, jnp.asarray(t), X.mask
+        return self._prep_block_host(X, targets)
+
+    def _prep_block_host(self, X, targets):
+        """Host-block tail of :meth:`_prep_block`: bucket-pad + H2D puts
+        only.  The ONLY prep entry the staged protocol may use — the
+        prefetch worker thread runs it, so it must never compile,
+        dispatch, or fetch (graftlint's ``stage-purity`` rule holds the
+        whole reachable set to that)."""
         X, targets, mask = _bucket_pad(
             np.asarray(X, dtype=np.float32),
             np.asarray(targets, dtype=np.float32),
@@ -782,7 +790,10 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
                     "classes must be passed on the first partial_fit call"
                 )
             self._set_classes(classes)
-        return self._prep_block(X, self._encode_targets(np.asarray(y)))
+        # host tail directly: _pf_stage_ok declined device-resident X, so
+        # _prep_block's ShardedRows branch (a device cast program) must
+        # stay structurally unreachable from the worker thread
+        return self._prep_block_host(X, self._encode_targets(np.asarray(y)))
 
     def partial_fit(self, X, y, classes=None, sample_weight=None, **kwargs):
         self._validate()
@@ -969,6 +980,13 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
             from ..core.sharded import unshard
 
             y = unshard(y)
+        return self._targets_host(y)
+
+    @staticmethod
+    def _targets_host(y):
+        """Host-only tail of :meth:`_targets` — the staged protocol's
+        entry (worker thread: no device cast, no unshard fetch;
+        ``_pf_stage_ok`` already declined device-resident ``y``)."""
         return np.asarray(y, dtype=np.float32).reshape(-1, 1)
 
     def _ensure_state(self, n_features: int):
@@ -990,11 +1008,14 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
 
     def _pf_stage(self, X, y, sample_weight=None, **kwargs):
         """Regressor twin of :meth:`SGDClassifier._pf_stage`: host
-        reshape + bucket-pad + upload, no device program dispatch."""
+        reshape + bucket-pad + upload, no device program dispatch —
+        host-only tails directly (``_pf_stage_ok`` declined device
+        input, so ``_targets``/``_prep_block``'s device branches must
+        stay structurally unreachable from the worker thread)."""
         if not self._pf_stage_ok(X, y, sample_weight, kwargs):
             return None
         self._validate()
-        return self._prep_block(X, self._targets(y))
+        return self._prep_block_host(X, self._targets_host(y))
 
     def partial_fit(self, X, y, sample_weight=None, **kwargs):
         self._validate()

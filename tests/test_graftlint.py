@@ -27,6 +27,7 @@ from dask_ml_tpu.analysis import (
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "dask_ml_tpu")
+BASELINE = os.path.join(REPO, "tools", "graftlint_baseline.json")
 
 
 def lint(src, **kw):
@@ -41,30 +42,63 @@ def rule_ids(findings):
     return sorted({f.rule for f in findings})
 
 
+@pytest.fixture(scope="module")
+def pkg_lint(tmp_path_factory):
+    """ONE full-package lint shared by every gate test (through the
+    whole-project cache, so repeat calls inside the module are free)."""
+    cache = str(tmp_path_factory.mktemp("graftlint") / "cache.json")
+    findings, errors = lint_paths([PKG], cache=cache)
+    return findings, errors
+
+
 # ---------------------------------------------------------------------------
 # the tier-1 self-gate: the library must lint clean
 # ---------------------------------------------------------------------------
 
 class TestPackageGate:
-    def test_package_has_zero_unsuppressed_findings(self):
-        findings, errors = lint_paths([PKG])
+    def test_package_has_zero_unsuppressed_findings(self, pkg_lint):
+        findings, errors = pkg_lint
         assert not errors, errors
         bad = active(findings)
         assert not bad, "\n".join(f.render() for f in bad)
 
-    def test_every_suppression_carries_a_justification(self):
+    def test_every_suppression_carries_a_justification(self, pkg_lint):
         # bad-suppression findings are themselves active findings, so the
         # gate above covers this — but assert directly so a regression in
         # THAT wiring is also caught
-        findings, _ = lint_paths([PKG])
+        findings, _ = pkg_lint
         for f in findings:
             if f.suppressed:
                 assert f.justification, f.render()
+
+    def test_no_unused_suppressions(self, pkg_lint):
+        # the zero-active gate covers this too (unused-suppression
+        # findings are active), but assert by name: every justified
+        # suppression in the library must still be EARNING its keep
+        findings, _ = pkg_lint
+        assert not [f for f in findings if f.rule == "unused-suppression"]
+
+    def test_committed_baseline_matches(self, pkg_lint):
+        # the ratchet's committed snapshot must match reality exactly:
+        # no new findings, no stale entries (refresh via
+        # `tools/lint.sh --rebaseline` after intentional changes)
+        from dask_ml_tpu.analysis import baseline as bl
+
+        findings, _ = pkg_lint
+        snap = bl.load(BASELINE)
+        delta = bl.compare(snap, findings, bl.baseline_root([PKG]))
+        assert not delta["new"], [f.render() for f in delta["new"]]
+        assert not delta["fixed"], delta["fixed"]
 
     def test_cli_gate_exit_zero(self, capsys):
         assert main([PKG]) == 0
         out = capsys.readouterr().out
         assert "0 finding(s)" in out
+
+    def test_cli_ratchet_gate_exit_zero(self, capsys):
+        assert main([PKG, "--baseline", BASELINE]) == 0
+        out = capsys.readouterr().out
+        assert "0 new, 0 stale" in out
 
 
 # ---------------------------------------------------------------------------
@@ -509,6 +543,720 @@ class TestSwallowedCollective:
 
 
 # ---------------------------------------------------------------------------
+# v2 rules: stage-purity, unbounded-retry, checkpoint-schema-drift,
+# undocumented-knob — pos+neg snippet per rule
+# ---------------------------------------------------------------------------
+
+class TestStagePurity:
+    def test_flags_dispatch_in_pf_stage_reachable_helper(self):
+        # the acceptance drill: inject a device program into a helper a
+        # _pf_stage implementation reaches — the chain must be flagged
+        findings = lint("""
+            import numpy as np
+            import jax.numpy as jnp
+
+            class Est:
+                def _prep(self, X):
+                    x = np.asarray(X, np.float32)
+                    return jnp.dot(jnp.asarray(x), jnp.asarray(x).T)
+
+                def _pf_stage(self, X, y=None, **kwargs):
+                    if kwargs:
+                        return None
+                    return self._prep(X)
+        """)
+        fs = [f for f in active(findings) if f.rule == "stage-purity"]
+        assert fs, rule_ids(findings)
+        assert "_pf_stage" in fs[0].message and "_prep" in fs[0].message
+
+    def test_flags_collective_and_consume(self):
+        findings = lint("""
+            import jax
+
+            class Est:
+                def _pf_stage(self, X, y=None):
+                    flag = jax.lax.psum(1, "data")
+                    return self._pf_consume(X)
+        """)
+        ids = [f.rule for f in active(findings)]
+        assert ids.count("stage-purity") == 2
+
+    def test_transfer_only_stage_is_clean(self):
+        # the real contract: host parse + jnp.asarray puts are LEGAL on
+        # the worker thread (design.md §8: a put is not a program)
+        findings = lint("""
+            import numpy as np
+            import jax.numpy as jnp
+
+            class Est:
+                def _host_pad(self, X):
+                    x = np.asarray(X, np.float32)
+                    return np.concatenate([x, np.zeros_like(x)])
+
+                def _pf_stage(self, X, y=None, **kwargs):
+                    if kwargs or isinstance(X, jnp.ndarray):
+                        return None
+                    return jnp.asarray(self._host_pad(X))
+        """)
+        assert not active(findings)
+
+    def test_device_cast_flagged_host_cast_clean(self):
+        findings = lint("""
+            import numpy as np
+            import jax.numpy as jnp
+
+            class Bad:
+                def _pf_stage(self, X, y=None):
+                    return X.astype(jnp.float32)
+
+            class Good:
+                def _pf_stage(self, X, y=None):
+                    return jnp.asarray(X.astype(np.float32))
+        """)
+        fs = [f for f in active(findings) if f.rule == "stage-purity"]
+        assert len(fs) == 1
+        assert fs[0].line == 7  # the jnp cast, not the np one
+
+
+class TestUnboundedRetry:
+    def test_flags_nonliteral_budget_without_deadline(self):
+        findings = lint("""
+            from dask_ml_tpu.resilience.retry import retry
+
+            def pull(fetch, retries):
+                return retry(fetch, retries=int(retries), backoff=0.1)
+        """)
+        assert rule_ids(active(findings)) == ["unbounded-retry"]
+
+    def test_deadline_bounds_it(self):
+        findings = lint("""
+            from dask_ml_tpu.resilience.retry import retry
+
+            def pull(fetch, retries):
+                return retry(fetch, retries=int(retries), deadline=120.0)
+        """)
+        assert not active(findings)
+
+    def test_literal_budget_is_clean(self):
+        findings = lint("""
+            from dask_ml_tpu.resilience.retry import retry
+
+            def pull(fetch, lockstep):
+                a = retry(fetch)                       # default budget
+                b = retry(fetch, retries=5)            # literal
+                c = retry(fetch, retries=0 if lockstep else 1)  # both literal
+                return a, b, c
+        """)
+        assert not active(findings)
+
+    def test_deadline_none_does_not_count(self):
+        findings = lint("""
+            from dask_ml_tpu.resilience.retry import retry
+
+            def pull(fetch, n):
+                return retry(fetch, retries=n, deadline=None)
+        """)
+        assert rule_ids(active(findings)) == ["unbounded-retry"]
+
+    def test_unrelated_retry_suffixes_ignored(self):
+        findings = lint("""
+            def note(stats):
+                stats.record_retry("tag")
+        """)
+        assert not active(findings)
+
+
+class TestCheckpointSchemaDrift:
+    def test_flags_consumed_key_never_written(self):
+        findings = lint("""
+            class KM:
+                def fit(self, X):
+                    ckpt = self.fit_checkpoint
+                    snap = ckpt.load_if_matches(self)
+                    if snap is not None:
+                        it, state = snap
+                        centers = state["centres"]
+                    for i in range(10):
+                        centers = step(X)
+                        ckpt.save(self, {"centers": centers}, i)
+                    return self
+        """)
+        fs = [f for f in active(findings)
+              if f.rule == "checkpoint-schema-drift"]
+        assert len(fs) == 1
+        assert "centres" in fs[0].message and "centers" in fs[0].message
+
+    def test_flags_written_key_never_consumed(self):
+        findings = lint("""
+            class KM:
+                def fit(self, X):
+                    ckpt = self.fit_checkpoint
+                    snap = ckpt.load_if_matches(self)
+                    if snap is not None:
+                        it, state = snap
+                        centers = state["centers"]
+                    for i in range(10):
+                        centers, counts = step(X)
+                        ckpt.save(self, {"centers": centers,
+                                         "counts": counts}, i)
+                    return self
+        """)
+        fs = [f for f in active(findings)
+              if f.rule == "checkpoint-schema-drift"]
+        assert len(fs) == 1 and "'counts'" in fs[0].message
+
+    def test_matching_schema_is_clean(self):
+        findings = lint("""
+            class KM:
+                def fit(self, X):
+                    ckpt = self.fit_checkpoint
+                    snap = ckpt.load_if_matches(self)
+                    if snap is not None:
+                        it, state = snap
+                        centers = state["centers"]
+                        counts = state["counts"]
+                    for i in range(10):
+                        centers, counts = step(X)
+                        state = {"centers": centers, "counts": counts}
+                        ckpt.save(self, state, i)
+                        check_preemption(ckpt, self, state, i)
+                    return self
+        """)
+        assert not active(findings)
+
+    def test_state_through_local_helper_function(self):
+        # the _sgd shape: the snapshot dict is built by a nested helper
+        findings = lint("""
+            def fit(est, X):
+                ckpt = getattr(est, "fit_checkpoint", None)
+                def _snapshot_state():
+                    return {"state": est._state, "best": est._best}
+                snap = ckpt.load_if_matches(est)
+                if snap is not None:
+                    epoch0, st = snap
+                    est._state = st["state"]
+                    est._best = st["best"]
+                for e in range(10):
+                    ckpt.save(est, _snapshot_state(), e)
+        """)
+        assert not active(findings)
+
+    def test_wildcard_write_skips_module(self):
+        # unresolvable snapshot (dict comprehension): wildcard, NOT clean
+        # evidence and NOT a finding either
+        findings = lint("""
+            class IPCA:
+                def _fit_state(self):
+                    return {a: getattr(self, a) for a in self._ATTRS}
+
+                def fit(self, X):
+                    ckpt = self.fit_checkpoint
+                    snap = ckpt.load_if_matches(self)
+                    if snap is not None:
+                        it, state = snap
+                        anything = state["whatever"]
+                    ckpt.save(self, self._fit_state(), 1)
+        """)
+        assert not active(findings)
+
+    def test_np_save_is_not_checkpoint_traffic(self):
+        findings = lint("""
+            import numpy as np
+
+            def dump(path, arr, meta):
+                np.save(path, arr)
+        """)
+        assert not active(findings)
+
+
+class TestUndocumentedKnob:
+    def _tree(self, tmp_path, documented, read_name, via_constant=False):
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "api.md").write_text(
+            f"| `{documented}` | int | a knob | — |\n"
+            f"`DASK_ML_TPU_BENCH_*` harness knobs\n"
+        )
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        if via_constant:
+            body = (f'KNOB = "{read_name}"\n'
+                    f'import os\n'
+                    f'def depth():\n'
+                    f'    return int(os.environ.get(KNOB, "2"))\n')
+        else:
+            body = (f'import os\n'
+                    f'def depth():\n'
+                    f'    return int(os.environ.get("{read_name}", "2"))\n')
+        (pkg / "mod.py").write_text(body)
+        return str(pkg)
+
+    def test_flags_undocumented_read(self, tmp_path):
+        pkg = self._tree(tmp_path, "DASK_ML_TPU_DEPTH",
+                         "DASK_ML_TPU_SECRET")
+        findings, errors = lint_paths([pkg])
+        assert not errors
+        fs = [f for f in active(findings) if f.rule == "undocumented-knob"]
+        assert len(fs) == 1 and "DASK_ML_TPU_SECRET" in fs[0].message
+
+    def test_documented_read_is_clean(self, tmp_path):
+        pkg = self._tree(tmp_path, "DASK_ML_TPU_DEPTH", "DASK_ML_TPU_DEPTH")
+        findings, _ = lint_paths([pkg])
+        assert not active(findings)
+
+    def test_name_resolved_through_module_constant(self, tmp_path):
+        # the pipeline/core.py shape: DEPTH_ENV = "DASK_ML_TPU_..." then
+        # os.environ.get(DEPTH_ENV)
+        pkg = self._tree(tmp_path, "DASK_ML_TPU_DEPTH",
+                         "DASK_ML_TPU_HIDDEN", via_constant=True)
+        findings, _ = lint_paths([pkg])
+        fs = [f for f in active(findings) if f.rule == "undocumented-knob"]
+        assert len(fs) == 1 and "DASK_ML_TPU_HIDDEN" in fs[0].message
+
+    def test_wildcard_prefix_allows(self, tmp_path):
+        pkg = self._tree(tmp_path, "DASK_ML_TPU_DEPTH",
+                         "DASK_ML_TPU_BENCH_SEED")
+        findings, _ = lint_paths([pkg])
+        assert not active(findings)
+
+    def test_env_write_is_not_a_read(self, tmp_path):
+        # propagating a knob into a spawned worker's env is a WRITE —
+        # the _multihost_worker pattern — and must not flag
+        pkg = self._tree(tmp_path, "DASK_ML_TPU_DEPTH", "DASK_ML_TPU_DEPTH")
+        (tmp_path / "pkg" / "spawn.py").write_text(
+            'import os\n'
+            'def child_env():\n'
+            '    env = dict(os.environ)\n'
+            '    os.environ["DASK_ML_TPU_UNLISTED"] = "1"\n'
+            '    return env\n')
+        findings, _ = lint_paths([pkg])
+        assert not [f for f in active(findings)
+                    if f.rule == "undocumented-knob"]
+
+    def test_no_api_md_in_reach_is_silent(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            'import os\nV = os.environ.get("DASK_ML_TPU_ANYTHING")\n')
+        findings, _ = lint_paths([str(pkg)])
+        assert not active(findings)
+
+
+# ---------------------------------------------------------------------------
+# interprocedural upgrades of the v1 rules
+# ---------------------------------------------------------------------------
+
+class TestInterproceduralThreadDispatch:
+    def test_host_only_target_is_clean_without_guard(self):
+        # the _multihost_worker drain shape: resolvable target, pipe
+        # reads only — v1 forced a suppression here, v2 proves it clean
+        findings = lint("""
+            import threading
+
+            def run_all(procs):
+                outs = [None] * len(procs)
+
+                def drain(i, p):
+                    outs[i], _ = p.communicate(timeout=60)
+
+                threads = [threading.Thread(target=drain, args=(i, p))
+                           for i, p in enumerate(procs)]
+                for t in threads:
+                    t.start()
+        """)
+        assert not active(findings)
+
+    def test_target_reaching_device_work_is_flagged(self):
+        findings = lint("""
+            import threading
+            import jax.numpy as jnp
+
+            def go(xs):
+                def work():
+                    return jnp.dot(xs, xs.T)
+
+                threading.Thread(target=work).start()
+        """)
+        fs = active(findings)
+        assert rule_ids(fs) == ["thread-dispatch"]
+        assert "work" in fs[0].message
+
+    def test_dynamic_callable_target_still_flags(self):
+        # the pipeline worker shape: the staged callable is a parameter —
+        # nothing can be proven, the (justified) suppression stays
+        findings = lint("""
+            import threading
+
+            def staged_iter(src, stage):
+                def work():
+                    return stage(next(src))
+
+                threading.Thread(target=work).start()
+        """)
+        assert rule_ids(active(findings)) == ["thread-dispatch"]
+
+    def test_pool_with_host_only_submit_is_clean(self):
+        findings = lint("""
+            from concurrent.futures import ThreadPoolExecutor
+
+            def hash_all(chunks):
+                def hash_chunk(c):
+                    return hash(tuple(c))
+
+                with ThreadPoolExecutor(max_workers=4) as pool:
+                    return list(pool.map(hash_chunk, chunks))
+        """)
+        assert not active(findings)
+
+    def test_second_device_target_not_masked_by_first_host_target(self):
+        # regression: resolving thread targets went through an
+        # id()-keyed memo with a transient synthesized Call node —
+        # after GC the next target could inherit the PREVIOUS target's
+        # resolution, judging a device-dispatching thread host-only
+        findings = lint("""
+            import threading
+            import jax.numpy as jnp
+
+            def host_work():
+                return sum(range(10))
+
+            def device_work(xs):
+                return jnp.dot(xs, xs.T)
+
+            def go(xs):
+                t1 = threading.Thread(target=host_work)
+                t2 = threading.Thread(target=device_work)
+                t3 = threading.Thread(target=host_work)
+                for t in (t1, t2, t3):
+                    t.start()
+        """)
+        fs = [f for f in active(findings) if f.rule == "thread-dispatch"]
+        assert len(fs) == 1
+        assert "device_work" in fs[0].message
+
+    def test_pool_submitting_partial_fit_is_flagged(self):
+        findings = lint("""
+            from concurrent.futures import ThreadPoolExecutor
+
+            def train(model, blocks):
+                def unit(b):
+                    return model.partial_fit(b)
+
+                with ThreadPoolExecutor(max_workers=4) as pool:
+                    return list(pool.map(unit, blocks))
+        """)
+        assert rule_ids(active(findings)) == ["thread-dispatch"]
+
+    def test_unmodelable_callee_shape_is_not_proven_host_only(self):
+        # a registry-dispatched callable (subscript call) in the target:
+        # nothing can be proven about it, so the Thread must still flag
+        findings = lint("""
+            import threading
+
+            _CALLBACKS = []
+
+            def worker():
+                _CALLBACKS[0]()
+
+            def go():
+                threading.Thread(target=worker).start()
+        """)
+        assert rule_ids(active(findings)) == ["thread-dispatch"]
+
+    def test_with_bound_pool_after_earlier_binding_stays_clean(self):
+        # regression: ast.withitem has no lineno, so the with-pool's
+        # submit used to bind to the EARLIER assignment, leaving the
+        # with-pool "no submitted work visible" — a false positive
+        findings = lint("""
+            from concurrent.futures import ThreadPoolExecutor
+
+            def run(chunks):
+                def host(c):
+                    return hash(c)
+
+                pool = ThreadPoolExecutor(2)
+                pool.submit(host, chunks[0])
+                pool.shutdown()
+                with ThreadPoolExecutor(2) as pool:
+                    pool.submit(host, chunks[1])
+        """)
+        assert not active(findings)
+
+    def test_unindexed_own_package_callee_is_not_proven_host_only(
+            self, tmp_path):
+        # single-FILE lint: the target calls into a sibling module of
+        # the same package that is NOT in this lint's scope — the body
+        # exists but cannot be seen, so the Thread must still flag
+        pkg = tmp_path / "mypkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "ops.py").write_text(
+            "import jax.numpy as jnp\n"
+            "def device_helper(x):\n    return jnp.sum(x)\n")
+        (pkg / "runner.py").write_text(
+            "import threading\n"
+            "from .ops import device_helper\n"
+            "def go(x):\n"
+            "    def work():\n"
+            "        return device_helper(x)\n"
+            "    threading.Thread(target=work).start()\n")
+        # partial scope (runner only): unprovable → flags
+        findings, _ = lint_paths([str(pkg / "runner.py")])
+        assert "thread-dispatch" in rule_ids(active(findings))
+        # full scope: resolvable, genuinely device-reaching → still flags
+        findings_full, _ = lint_paths([str(pkg)])
+        assert "thread-dispatch" in rule_ids(active(findings_full))
+
+    def test_rebound_pool_variable_judged_per_binding(self):
+        # two pools under one name: each constructor is judged on ITS
+        # binding's submissions only (def-use chains, not a name match)
+        findings = lint("""
+            from concurrent.futures import ThreadPoolExecutor
+            import jax.numpy as jnp
+
+            def run(xs):
+                def host(c):
+                    return hash(c)
+
+                def dev(c):
+                    return jnp.sum(c)
+
+                pool = ThreadPoolExecutor(2)
+                pool.submit(host, xs)
+                pool = ThreadPoolExecutor(2)
+                pool.submit(dev, xs)
+        """)
+        fs = [f for f in active(findings) if f.rule == "thread-dispatch"]
+        assert len(fs) == 1
+        assert "dev" in fs[0].message
+
+
+class TestInterproceduralDivergentCollective:
+    def test_collective_through_helper_under_divergent_guard(self):
+        findings = lint("""
+            import jax
+            from jax.experimental import multihost_utils
+
+            def agree(flag):
+                return multihost_utils.process_allgather(flag)
+
+            def maybe(flag):
+                if jax.process_index() == 0:
+                    return agree(flag)
+                return flag
+        """)
+        fs = active(findings)
+        assert rule_ids(fs) == ["divergent-collective"]
+        assert "agree()" in fs[0].message
+
+    def test_helper_without_collective_is_clean(self):
+        findings = lint("""
+            import jax
+
+            def log_it(flag):
+                print(flag)
+
+            def maybe(flag):
+                if jax.process_index() == 0:
+                    log_it(flag)
+                return flag
+        """)
+        assert not active(findings)
+
+
+class TestInterproceduralKeyReuse:
+    def test_helper_consuming_key_counts_as_use(self):
+        findings = lint("""
+            import jax
+
+            def init_centers(X, key):
+                return jax.random.choice(key, X.shape[0], (3,))
+
+            def fit(X, key):
+                c = init_centers(X, key)
+                noise = jax.random.normal(key, (3,))
+                return c + noise
+        """)
+        fs = active(findings)
+        assert rule_ids(fs) == ["key-reuse"]
+        assert "init_centers" in fs[0].message
+
+    def test_exclusive_helper_branches_are_clean(self):
+        # the k_means _init_centers ladder, incl. a `with` body return
+        findings = lint("""
+            import jax
+
+            def init_scalable(X, key):
+                return jax.random.choice(key, X.shape[0], (3,))
+
+            def init(X, key, mode, timer):
+                if mode == "scalable":
+                    with timer():
+                        return init_scalable(X, key)
+                if mode == "random":
+                    return jax.random.choice(key, X.shape[0], (3,))
+                key, sub = jax.random.split(key)
+                return jax.random.normal(sub, (3,))
+        """)
+        assert not active(findings)
+
+    def test_transitive_helper_consumption(self):
+        findings = lint("""
+            import jax
+
+            def inner(k):
+                return jax.random.normal(k, (3,))
+
+            def outer(key):
+                return inner(key)
+
+            def fit(key):
+                a = outer(key)
+                b = outer(key)
+                return a + b
+        """)
+        assert rule_ids(active(findings)) == ["key-reuse"]
+
+    def test_helper_taking_fresh_subkeys_is_clean(self):
+        findings = lint("""
+            import jax
+
+            def draw(k):
+                return jax.random.normal(k, (3,))
+
+            def fit(key, n):
+                out = []
+                for _ in range(n):
+                    key, sub = jax.random.split(key)
+                    out.append(draw(sub))
+                return out
+        """)
+        assert not active(findings)
+
+
+# ---------------------------------------------------------------------------
+# unused suppressions
+# ---------------------------------------------------------------------------
+
+class TestUnusedSuppressions:
+    def test_stale_suppression_is_flagged(self):
+        findings = lint("""
+            import jax
+
+            def sample(key):
+                k1, k2 = jax.random.split(key)
+                a = jax.random.normal(k1, (3,))  # graftlint: disable=key-reuse -- left over from an old refactor
+                return a
+        """)
+        fs = active(findings)
+        assert rule_ids(fs) == ["unused-suppression"]
+        assert "key-reuse" in fs[0].message
+
+    def test_used_suppression_is_not_flagged(self):
+        findings = lint(TestSuppressions.SRC)
+        assert not active(findings)
+
+    def test_unused_not_reported_on_partial_runs(self):
+        # --select runs a subset: the unselected rules' suppressions are
+        # legitimately unmatched and must not be called stale
+        src = """
+            import jax
+
+            def fit(self, key, xs):
+                for x in xs:
+                    print(float(step(x)))  # graftlint: disable=host-sync-loop -- boundary sync
+        """
+        assert not active(lint(src, select=["key-reuse"]))
+        # ...but the full run DOES judge them (here the suppression is
+        # used, so still clean)
+        assert not active(lint(src))
+
+    def test_unused_disable_all_cannot_hide_itself(self):
+        findings = lint("""
+            x = 1  # graftlint: disable=all -- nothing here ever flags
+        """)
+        assert rule_ids(active(findings)) == ["unused-suppression"]
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet
+# ---------------------------------------------------------------------------
+
+class TestBaseline:
+    SRC_V1 = textwrap.dedent("""
+        import jax
+
+        def sample(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))  # graftlint: disable=key-reuse -- intentional correlated draws
+            return a + b
+    """)
+
+    def _write_pkg(self, tmp_path, src):
+        mod = tmp_path / "mod.py"
+        mod.write_text(src)
+        return str(tmp_path)
+
+    def test_round_trip_and_clean_compare(self, tmp_path):
+        from dask_ml_tpu.analysis import baseline as bl
+
+        pkg = self._write_pkg(tmp_path, self.SRC_V1)
+        findings, errors = lint_paths([pkg])
+        root = bl.baseline_root([pkg])
+        payload = bl.emit(findings, errors, root)
+        path = tmp_path / "baseline.json"
+        bl.write(str(path), payload)
+        delta = bl.compare(bl.load(str(path)), findings, root)
+        assert not delta["new"] and not delta["fixed"]
+
+    def test_new_finding_detected(self, tmp_path):
+        from dask_ml_tpu.analysis import baseline as bl
+
+        pkg = self._write_pkg(tmp_path, self.SRC_V1)
+        findings, errors = lint_paths([pkg])
+        root = bl.baseline_root([pkg])
+        snap = bl.emit(findings, errors, root)
+        # add a second violation
+        self._write_pkg(tmp_path, self.SRC_V1 + textwrap.dedent("""
+            def more(key):
+                c = jax.random.normal(key, (3,))
+                d = jax.random.normal(key, (3,))
+                return c + d
+        """))
+        findings2, _ = lint_paths([pkg])
+        delta = bl.compare(snap, findings2, root)
+        assert len(delta["new"]) == 1 and delta["new"][0].rule == "key-reuse"
+        assert not delta["fixed"]
+
+    def test_fixed_finding_reported_stale(self, tmp_path):
+        from dask_ml_tpu.analysis import baseline as bl
+
+        pkg = self._write_pkg(tmp_path, self.SRC_V1)
+        findings, errors = lint_paths([pkg])
+        root = bl.baseline_root([pkg])
+        snap = bl.emit(findings, errors, root)
+        self._write_pkg(tmp_path, "x = 1\n")
+        findings2, _ = lint_paths([pkg])
+        delta = bl.compare(snap, findings2, root)
+        assert not delta["new"]
+        assert {e["rule"] for e in delta["fixed"]} == {"key-reuse"}
+
+    def test_fingerprint_survives_line_drift(self, tmp_path):
+        # code inserted ABOVE the finding must not churn the baseline
+        from dask_ml_tpu.analysis import baseline as bl
+
+        pkg = self._write_pkg(tmp_path, self.SRC_V1)
+        findings, errors = lint_paths([pkg])
+        root = bl.baseline_root([pkg])
+        snap = bl.emit(findings, errors, root)
+        self._write_pkg(tmp_path, "# a new header comment\nVERSION = 1\n"
+                        + self.SRC_V1)
+        findings2, _ = lint_paths([pkg])
+        delta = bl.compare(snap, findings2, root)
+        assert not delta["new"] and not delta["fixed"]
+
+
+# ---------------------------------------------------------------------------
 # suppression mechanics
 # ---------------------------------------------------------------------------
 
@@ -609,6 +1357,9 @@ class TestFramework:
             "thread-dispatch", "divergent-collective", "key-reuse",
             "host-sync-loop", "jit-in-loop", "tracer-branch",
             "swallowed-collective",
+            # v2: project-wide contracts
+            "stage-purity", "unbounded-retry", "checkpoint-schema-drift",
+            "undocumented-knob",
         }
 
     def test_select_unknown_rule_raises(self):
@@ -639,7 +1390,7 @@ class TestFramework:
                 return a + b
         """)
         payload = json.loads(render_json(findings))
-        assert payload["version"] == 1
+        assert payload["version"] == 2
         assert payload["counts"]["key-reuse"]["active"] == 1
         assert payload["findings"][0]["rule"] == "key-reuse"
         assert "key-reuse" in payload["rules"]

@@ -1,15 +1,44 @@
 #!/usr/bin/env bash
 # Pre-commit check: graftlint (the repo's JAX/SPMD-aware static analyzer)
-# plus a bytecode-compile sweep.  Fast (no tests, no jax programs) — run
-# it before every commit; tier-1 runs the same gate via
-# tests/test_graftlint.py.
+# plus a bytecode-compile sweep.  Fast (no tests, no jax programs; a warm
+# whole-project cache makes the re-run near-free) — run it before every
+# commit; tier-1 runs the same gate via tests/test_graftlint.py.
 #
-# Usage: tools/lint.sh [extra graftlint args, e.g. --format json]
+# Default run is the RATCHET: compares against the committed baseline
+# (tools/graftlint_baseline.json) and fails on NEW findings, on STALE
+# baseline entries, and on unused suppressions — exit 1.  Exit 2 means
+# the analyzer itself failed (bad args / crash), which must never be
+# confused with a clean run.
+#
+# Usage:
+#   tools/lint.sh                 # ratchet gate (text output)
+#   tools/lint.sh --json          # same, JSON output (CI trending)
+#   tools/lint.sh --rebaseline    # refresh the committed baseline after
+#                                 # intentional changes, then re-gate
+#   tools/lint.sh [extra graftlint args]   # passed through
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== graftlint =="
-JAX_PLATFORMS=cpu python -m dask_ml_tpu.analysis dask_ml_tpu "$@"
+BASELINE=tools/graftlint_baseline.json
+MODE=gate
+EXTRA=()
+for a in "$@"; do
+  case "$a" in
+    --json) EXTRA+=(--format json) ;;
+    --rebaseline) MODE=rebaseline ;;
+    *) EXTRA+=("$a") ;;
+  esac
+done
+
+if [[ "$MODE" == rebaseline ]]; then
+  echo "== graftlint (rebaseline) =="
+  JAX_PLATFORMS=cpu python -m dask_ml_tpu.analysis dask_ml_tpu \
+    --write-baseline "$BASELINE"
+fi
+
+echo "== graftlint (ratchet vs $BASELINE) =="
+JAX_PLATFORMS=cpu python -m dask_ml_tpu.analysis dask_ml_tpu \
+  --baseline "$BASELINE" ${EXTRA[@]+"${EXTRA[@]}"}
 
 echo "== compileall =="
 python -m compileall -q dask_ml_tpu
