@@ -13,6 +13,7 @@ from . import host_sync  # noqa: F401
 from . import jit_hazards  # noqa: F401
 from . import knobs  # noqa: F401
 from . import prng  # noqa: F401
+from . import recompile  # noqa: F401
 from . import retries  # noqa: F401
 from . import stage_purity  # noqa: F401
 from . import threads  # noqa: F401

@@ -62,6 +62,34 @@ def divergent_source(test: ast.AST) -> str | None:
     return None
 
 
+# Thread names allowed to COMPILE device programs off the main thread —
+# the ROADMAP `[compile]` lane's dedicated compile-ahead worker.  Shared
+# single source of truth between the static rules (stage-purity /
+# thread-dispatch bless compiles, and only compiles, reachable from a
+# Thread constructed with one of these literal names) and the runtime
+# sanitizer (sanitize/core.py treats these thread names as non-violating
+# for compile/dispatch attribution).  A blessed thread may compile; it
+# must still never fetch, join a collective, or run an estimator
+# dispatch surface.
+BLESSED_COMPILE_THREADS = frozenset({"dask-ml-tpu-compile-ahead"})
+
+
+def blessed_thread_name(ctor: ast.Call) -> str | None:
+    """The literal ``name=`` of a ``threading.Thread(...)`` construction
+    when it is in :data:`BLESSED_COMPILE_THREADS`, else None.  Only a
+    string LITERAL blesses — a computed name is unprovable and stays
+    under the ordinary rules."""
+    name = dotted_name(ctor.func)
+    if not name or name.rsplit(".", 1)[-1] != "Thread":
+        return None
+    for kw in ctor.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str) \
+                and kw.value.value in BLESSED_COMPILE_THREADS:
+            return kw.value.value
+    return None
+
+
 # -- device work markers (interprocedural rules) --------------------------
 # Method names whose invocation dispatches device programs regardless of
 # receiver — the pattern-match fallback when the call graph cannot

@@ -1,0 +1,240 @@
+"""recompile-risk: traced Python scalars flowing into shape positions.
+
+The static twin of graftsan's compile sanitizer (``dask_ml_tpu/sanitize``):
+the sanitizer *counts* recompiles at runtime; this rule flags the code
+shape that mints them.  A ``jax.jit``-wrapped function whose
+Python-scalar/shape-like parameter is NOT in ``static_argnames`` but
+flows into a shape-determining position (``reshape``/``arange``/
+``iota``/``zeros``/...) either fails at trace time (a traced value is
+not a shape) or — via a later "fix" that marks it static — silently
+specializes: one compiled program per distinct value, the
+heterogeneous-hyperparameter recompile tax SURVEY §7 hard part (c)
+names and the ROADMAP ``[compile]`` lane exists to kill.
+
+Recognized jit forms: the decorator (``@jax.jit`` /
+``@partial(jax.jit, static_argnames=...)``) and this repo's
+assignment idiom ``jitted = partial(jax.jit, ...)(fn)`` /
+``jitted = jax.jit(fn, ...)`` where ``fn`` is a def in the same module.
+
+Flow is tracked through simple local assignments (``m = n * 2;
+jnp.zeros(m)`` flags), and ``.shape``/``.ndim``/``.size``/``len()``
+touches shield a name — ``x.shape[0]`` is static at trace time however
+traced ``x`` is."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule, dotted_name, register
+from .jit_hazards import _jit_decorator, _static_params
+
+#: shape-determining callables, by last dotted segment, mapped to the
+#: positional args that determine shape (None = every positional arg).
+#: For function-form reshape/broadcast_to/tile arg 0 is the data.
+_SHAPE_CALLS: dict = {
+    "reshape": 1, "broadcast_to": 1, "tile": 1, "repeat": 1,
+    "arange": None, "linspace": None, "iota": None,
+    "zeros": 0, "ones": 0, "empty": 0, "full": 0, "eye": None,
+}
+
+#: attribute touches that make a traced name trace-time-static
+_SHIELD_ATTRS = frozenset({"shape", "ndim", "size", "dtype"})
+
+
+def _unshielded_names(expr: ast.AST):
+    """Bare Names in ``expr`` not under a static shield.
+
+    Shields: ``x.shape``/``.ndim``/``.size``/``.dtype`` touches and ANY
+    call — ``len(x)`` is static at trace time, and an arbitrary helper's
+    result (``_pdim(x)``) is unknowable, so treating it as tainted would
+    flag every shape helper in the package.  The rule therefore tracks
+    flow through *names and arithmetic only*: that is exactly the
+    "Python scalar handed straight into a shape position" pattern, the
+    high-signal core of the hazard."""
+    skip: set = set()
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute) and n.attr in _SHIELD_ATTRS:
+            skip.update(id(s) for s in ast.walk(n))
+        elif isinstance(n, ast.Call):
+            skip.update(id(s) for s in ast.walk(n))
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and id(n) not in skip:
+            yield n
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    return bool(name) and name.rsplit(".", 1)[-1] == "jit"
+
+
+def _partial_jit_kwargs(call: ast.Call):
+    """``partial(jax.jit, **kw)`` / ``jax.jit(fn, **kw)`` → the keyword
+    list carrying static_argnames/nums, else None."""
+    name = dotted_name(call.func)
+    if not name:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    if last == "jit":
+        return call.keywords
+    if last == "partial" and call.args and _is_jit_call(call.args[0]):
+        return call.keywords
+    return None
+
+
+def _static_from_keywords(keywords, params: list) -> set:
+    """static_argnames/static_argnums keyword values → param-name set."""
+    static: set = set()
+    for kw in keywords or ():
+        try:
+            val = ast.literal_eval(kw.value)
+        except (ValueError, SyntaxError):
+            continue
+        names = val if isinstance(val, (tuple, list)) else [val]
+        if kw.arg == "static_argnames":
+            static.update(str(n) for n in names)
+        elif kw.arg == "static_argnums":
+            for i in names:
+                if isinstance(i, int) and 0 <= i < len(params):
+                    static.add(params[i])
+    return static
+
+
+def _module_defs(tree: ast.Module) -> dict:
+    return {n.name: n for n in tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _jitted_functions(ctx: Context):
+    """Yield ``(fn_node, static_param_names, evidence_label)`` for every
+    jit-wrapped function this module defines — decorator form and the
+    wrap-at-assignment idiom."""
+    defs = _module_defs(ctx.tree)
+    seen: set = set()
+    for fn in defs.values():
+        dec = _jit_decorator(fn)
+        if dec is not None:
+            seen.add(fn.name)
+            yield fn, _static_params(dec, fn), f"@jit {fn.name}()"
+    # wrapped = partial(jax.jit, ...)(fn)  |  wrapped = jax.jit(fn, ...)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        target = node.args[0]
+        if not isinstance(target, ast.Name) or target.id not in defs \
+                or target.id in seen:
+            continue
+        if isinstance(node.func, ast.Call):
+            kws = _partial_jit_kwargs(node.func)  # partial(jax.jit,...)(f)
+        elif _is_jit_call(node.func):
+            kws = node.keywords  # jax.jit(f, ...)
+        else:
+            kws = None
+        if kws is None:
+            continue
+        fn = defs[target.id]
+        params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        seen.add(fn.name)
+        yield fn, _static_from_keywords(kws, params), \
+            f"jit-wrapped {fn.name}()"
+
+
+def _shape_args(ctx: Context, call: ast.Call):
+    """The argument expressions of ``call`` that determine output shape,
+    or None when the callee is not a shape constructor.
+
+    Spec per callee (module-qualified function form): ``None`` = every
+    positional arg determines shape (arange/linspace/iota/eye), ``0`` =
+    only arg 0 (zeros/ones/empty/full — later args are fill/dtype),
+    ``1`` = args 1+ (reshape/broadcast_to/tile/repeat — arg 0 is the
+    data).  The METHOD form ``x.reshape(...)`` has no data arg, so every
+    positional arg is shape.  Function-vs-method is decided through the
+    module's IMPORT TABLE (``expand_alias``), not a hardcoded alias
+    list — ``import jax.numpy as jn; jn.reshape(x, (2, -1))`` must read
+    as the function form however the module spells the alias."""
+    func = call.func
+    name = dotted_name(func)
+    if not name:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    if last not in _SHAPE_CALLS:
+        return None
+    spec = _SHAPE_CALLS[last]
+    method_form = False
+    if isinstance(func, ast.Attribute) and spec == 1:
+        expanded = name
+        if ctx.project is not None:
+            mod = ctx.project.module_for(ctx)
+            expanded = mod.expand_alias(name)
+        head = expanded.split(".", 1)[0]
+        method_form = head not in ("jax", "numpy", "np", "jnp", "lax")
+    if spec is None or method_form:
+        args = list(call.args)
+    elif spec == 0:
+        args = list(call.args[:1])
+    else:
+        args = list(call.args[spec:])
+    args += [kw.value for kw in call.keywords
+             if kw.arg in ("shape", "newshape")]
+    return args
+
+
+@register
+class RecompileRiskRule(Rule):
+    id = "recompile-risk"
+    summary = (
+        "non-static traced parameter flows into a shape-determining "
+        "position (reshape/arange/iota/zeros/...) inside a jit-wrapped "
+        "function — per-value retrace/recompile once it is 'fixed' by "
+        "marking it static, a trace error until then"
+    )
+
+    def run(self, ctx: Context):
+        for fn, static, label in _jitted_functions(ctx):
+            tainted = {
+                a.arg
+                for a in (fn.args.posonlyargs + fn.args.args
+                          + fn.args.kwonlyargs)
+                if a.arg not in static and a.arg not in ("self", "cls")
+            }
+            if not tainted:
+                continue
+            # propagate through simple local assignments to fixpoint
+            # (n2 = n * 2 taints n2; n2 = x.shape[0] does not)
+            changed = True
+            while changed:
+                changed = False
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Assign) or \
+                            not isinstance(node.value, ast.AST):
+                        continue
+                    if not any(n.id in tainted
+                               for n in _unshielded_names(node.value)):
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id not in tainted:
+                            tainted.add(t.id)
+                            changed = True
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                shape_args = _shape_args(ctx, call)
+                if not shape_args:
+                    continue
+                hits = sorted({
+                    n.id
+                    for arg in shape_args
+                    for n in _unshielded_names(arg)
+                    if n.id in tainted
+                })
+                if not hits:
+                    continue
+                callee = dotted_name(call.func) or "<call>"
+                yield ctx.finding(
+                    self.id, call,
+                    f"traced value(s) {', '.join(hits)} flow into the "
+                    f"shape position of {callee}() inside {label}: a "
+                    f"shape must be static — declare the driving "
+                    f"parameter in static_argnames (accepting one "
+                    f"compile per distinct value) or restructure so the "
+                    f"shape comes from an input array's .shape",
+                )

@@ -17,8 +17,10 @@ message) so the suppression/fix lands where the hazard is."""
 
 from __future__ import annotations
 
+import ast
+
 from ..core import Rule, register
-from ._spmd import device_work_in
+from ._spmd import blessed_thread_name, device_work_in
 
 #: call-kinds from device_work_in that violate stage purity.  "dynamic"
 #: is deliberately excluded: the roots are concrete implementations and
@@ -26,6 +28,15 @@ from ._spmd import device_work_in
 _IMPURE_KINDS = frozenset({
     "collective", "program", "device-cast", "dispatch", "fetch",
 })
+
+#: the contract for a BLESSED compile-ahead thread (ROADMAP `[compile]`:
+#: a dedicated thread allowlisted by name in
+#: ``_spmd.BLESSED_COMPILE_THREADS`` may compile — "program" and
+#: "device-cast" are its job description — but a collective rendezvous,
+#: a device→host fetch, or an estimator dispatch surface off-thread is
+#: still the §7 deadlock/divergence class.  ``_pf_stage`` workers stay
+#: under the full _IMPURE_KINDS set: staging threads never compile.
+_BLESSED_IMPURE_KINDS = frozenset({"collective", "dispatch", "fetch"})
 
 _KIND_LABEL = {
     "collective": "a collective rendezvous",
@@ -47,6 +58,25 @@ class StagePurityRule(Rule):
         "(design.md §8)"
     )
 
+    def _findings_from_root(self, project, root, root_label, impure,
+                            seen, why: str):
+        for fn, chain in project.reachable(root):
+            for node, kind, detail in device_work_in(
+                    project, fn.module, fn.node):
+                if kind not in impure:
+                    continue
+                key = (fn.module.path, node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                via = " -> ".join((root_label,) + chain) \
+                    if chain else root_label
+                yield fn.module.ctx.finding(
+                    self.id, node,
+                    f"{_KIND_LABEL[kind]} ({detail}) reachable "
+                    f"from {via}: {why}",
+                )
+
     def run_project(self, project):
         seen: set = set()
         for mod in project.modules:
@@ -54,26 +84,39 @@ class StagePurityRule(Rule):
                 root = cls.methods.get("_pf_stage")
                 if root is None:
                     continue
-                root_label = f"{cls.name}._pf_stage"
-                for fn, chain in project.reachable(root):
-                    for node, kind, detail in device_work_in(
-                            project, fn.module, fn.node):
-                        if kind not in _IMPURE_KINDS:
-                            continue
-                        key = (fn.module.path, node.lineno,
-                               node.col_offset)
-                        if key in seen:
-                            continue
-                        seen.add(key)
-                        via = " -> ".join((root_label,) + chain) \
-                            if chain else root_label
-                        yield fn.module.ctx.finding(
-                            self.id, node,
-                            f"{_KIND_LABEL[kind]} ({detail}) reachable "
-                            f"from {via}: _pf_stage runs on the prefetch "
-                            f"worker thread, which must never "
-                            f"compile/dispatch/fetch (design.md §8) "
-                            f"— move this to _pf_consume (consumer "
-                            f"thread), decline the block from _pf_stage, "
-                            f"or split the helper into a host-only tail",
-                        )
+                yield from self._findings_from_root(
+                    project, root, f"{cls.name}._pf_stage",
+                    _IMPURE_KINDS, seen,
+                    "_pf_stage runs on the prefetch worker thread, "
+                    "which must never compile/dispatch/fetch "
+                    "(design.md §8) — move this to _pf_consume "
+                    "(consumer thread), decline the block from "
+                    "_pf_stage, or split the helper into a host-only "
+                    "tail",
+                )
+            # blessed compile-ahead threads: allowed to compile, still
+            # forbidden from collectives / fetches / dispatch surfaces
+            for node in ast.walk(mod.ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                tname = blessed_thread_name(node)
+                if tname is None:
+                    continue
+                target = next((kw.value for kw in node.keywords
+                               if kw.arg == "target"), None)
+                if target is None:
+                    continue
+                res = project.resolve_callable(mod, target)
+                if res.kind != "function":
+                    continue
+                yield from self._findings_from_root(
+                    project, res.target,
+                    f"blessed thread {tname!r} target "
+                    f"{res.target.name}",
+                    _BLESSED_IMPURE_KINDS, seen,
+                    f"a blessed compile-ahead thread ({tname!r}) may "
+                    f"compile device programs but must never join a "
+                    f"collective, fetch to host, or run an estimator "
+                    f"dispatch surface — only the consumer thread may "
+                    f"(design.md §7/§8)",
+                )

@@ -23,11 +23,18 @@ from __future__ import annotations
 import ast
 
 from ..core import Context, Rule, dotted_name, register
-from ._spmd import device_work_in
+from ._spmd import blessed_thread_name, device_work_in
 
 _CTOR_SUFFIXES = frozenset({"ThreadPoolExecutor", "Thread"})
 _GUARD_NAME = "_uses_device_estimator"
 _SUBMIT_METHODS = frozenset({"submit", "map", "apply_async"})
+
+#: device-work kinds a BLESSED compile thread may perform: compiling (a
+#: jax "program" call — jit/lower/compile) and the cast programs the
+#: warmup path mints.  Everything else — collectives, fetches, estimator
+#: dispatch surfaces, dynamic callables — stays forbidden even for a
+#: blessed thread (stage_purity enforces that half).
+_BLESSED_OK_KINDS = frozenset({"program", "device-cast"})
 
 
 def _pool_binding(ctx: Context, ctor: ast.Call) -> str | None:
@@ -94,9 +101,11 @@ class ThreadDispatchRule(Rule):
         "interleave enqueue order and deadlock"
     )
 
-    def _target_evidence(self, ctx: Context, target: ast.AST) -> list | None:
+    def _target_evidence(self, ctx: Context, target: ast.AST,
+                         ok_kinds=frozenset()) -> list | None:
         """Device-work evidence for one submitted callable: [] when the
-        target resolves and its transitive body is provably host-only,
+        target resolves and its transitive body is provably host-only
+        (modulo ``ok_kinds`` — a blessed compile thread's allowance),
         a non-empty list of reasons when it is not, None when the target
         itself cannot be resolved."""
         project = ctx.project
@@ -120,6 +129,8 @@ class ThreadDispatchRule(Rule):
                 via = " -> ".join((info.name,) + chain)
                 for _node, kind, detail in device_work_in(
                         project, fn.module, fn.node):
+                    if kind in ok_kinds:
+                        continue
                     if kind == "dynamic":
                         evidence.append(
                             f"{via} calls dynamic callable {detail}() — "
@@ -144,12 +155,18 @@ class ThreadDispatchRule(Rule):
             if guarded:
                 continue
             targets = _work_targets(ctx, node)
+            # a Thread constructed with a blessed compile-ahead name may
+            # compile (and only compile) off-thread: filter the compile
+            # kinds from its evidence, keep everything else flagging
+            ok_kinds = (_BLESSED_OK_KINDS
+                        if blessed_thread_name(node) is not None
+                        else frozenset())
             why = None
             if targets is not None:
                 all_evidence: list = []
                 unresolved = False
                 for t in targets:
-                    ev = self._target_evidence(ctx, t)
+                    ev = self._target_evidence(ctx, t, ok_kinds)
                     if ev is None:
                         unresolved = True
                     else:

@@ -27,8 +27,19 @@ from ..core.prng import as_key
 from ..core.sharded import ShardedRows, unshard
 from ..preprocessing.data import _ingest_float as _ingest_float_any
 from ..utils import _timer, safe_denominator
+from .. import sanitize as _san
 
 logger = logging.getLogger(__name__)
+
+#: runtime-verified twin of the segment-boundary host-sync-loop
+#: suppression in fit's checkpointed Lloyd loop (two findings on the one
+#: convergence line: float(shift) and float(tol)) — see sanitize/sites.py
+_SEG_SYNC = _san.AllowSite(
+    "kmeans-segment-sync", rule="host-sync-loop",
+    cites=("648c6eac595ea7e4", "dfd1ac1a1b0ae4ba"),
+    note="one shift/tol scalar pair per fused 32-iteration Lloyd "
+         "segment, not per iteration",
+)
 
 
 def _ingest_float(est, X):
@@ -411,7 +422,8 @@ class KMeans(TransformerMixin, TPUEstimator):
         tol = self.tol * jnp.mean(masked_var(x, valid_mask))  # on device
         from ..resilience.preemption import active_watcher, check_preemption
 
-        with _timer("Lloyd loop", logger, logging.DEBUG):
+        with _timer("Lloyd loop", logger, logging.DEBUG), \
+                _san.region("kmeans.fit.lloyd"):
             from ..ops.scatter import scatter_strategy
 
             # policy knobs resolve OUTSIDE the jit so they participate in
@@ -450,9 +462,10 @@ class KMeans(TransformerMixin, TPUEstimator):
                     # converged: the segment stopped early, or the final
                     # shift cleared tol exactly at the boundary (the fused
                     # loop's cond — boundaries must not add iterations)
-                    # graftlint: disable=host-sync-loop -- segment-boundary sync: one scalar fetch per fused 32-iteration segment, not per Lloyd iteration
-                    if seg_n < seg or float(shift) <= float(tol):
-                        break
+                    with _SEG_SYNC.allow():
+                        # graftlint: disable=host-sync-loop -- segment-boundary sync: one scalar fetch per fused 32-iteration segment, not per Lloyd iteration
+                        if seg_n < seg or float(shift) <= float(tol):
+                            break
                 if ckpt is not None:
                     ckpt.complete()
         labels, inertia = _assign(x, mask, centers)

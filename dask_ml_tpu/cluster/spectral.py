@@ -31,6 +31,16 @@ from ..core.sharded import ShardedRows
 from ..metrics.pairwise import PAIRWISE_KERNEL_FUNCTIONS
 from ..preprocessing.data import _ingest_float
 from .k_means import KMeans
+from .. import sanitize as _san
+
+#: runtime-verified twin of the chunk-boundary host-sync-loop
+#: suppression in the exact-eigensolver loop (see sanitize/sites.py)
+_RITZ_SYNC = _san.AllowSite(
+    "spectral-ritz-sync", rule="host-sync-loop",
+    cites="348729a2df9b2736",
+    note="one (kp,) Ritz-value fetch per fused n_power_iters-deep "
+         "subspace chunk, <= 10 per fit",
+)
 
 logger = logging.getLogger(__name__)
 
@@ -321,8 +331,9 @@ class SpectralClustering(TPUEstimator):
                 C, V, mesh_holder=mh, iters=int(n_power_iters),
                 qr_strategy=_tsqr_strategy(),
             )
-            # graftlint: disable=host-sync-loop -- chunk-boundary Ritz convergence check: one (kp,) fetch per n_power_iters-deep fused chunk (<= 10 total)
-            lam_now = np.asarray(_ritz_values(C, V))[-k:]
+            with _RITZ_SYNC.allow():
+                # graftlint: disable=host-sync-loop -- chunk-boundary Ritz convergence check: one (kp,) fetch per n_power_iters-deep fused chunk (<= 10 total)
+                lam_now = np.asarray(_ritz_values(C, V))[-k:]
             if prev is not None and np.max(np.abs(lam_now - prev)) < tol:
                 break
             prev = lam_now

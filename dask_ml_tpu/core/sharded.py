@@ -147,8 +147,14 @@ def as_sharded(x):
 def unshard(x) -> np.ndarray:
     """Bring a (possibly sharded) array back to host memory."""
     from ..resilience.testing import maybe_fault
+    # instrumented AT THE DEFINITION, not by patching the module attr:
+    # most call sites bound `unshard` by name at import time, so a patch
+    # would miss them — and the bulk device_get below rides numpy's
+    # buffer protocol, invisible to the sanitizer's ArrayImpl hook
+    from ..sanitize.core import record_d2h
 
     maybe_fault("collective")
+    record_d2h()
     if isinstance(x, ShardedRows):
         x = x.unpad()
     return np.asarray(jax.device_get(x))

@@ -20,25 +20,42 @@ from ..base import ComponentsOutMixin, TPUEstimator, TransformerMixin
 from ..core.sharded import ShardedRows, unshard
 from ..preprocessing.data import _like_input, _masked_or_plain
 from ..utils import check_array, svd_flip
+from .. import sanitize as _san
 
 
 @partial(jax.jit, static_argnames=("k",))
 def _update(components, singular_values, mean, var, n_seen, batch, *, k):
-    """One incremental rank-update (Ross et al. 2008, as in sklearn)."""
+    """One incremental rank-update (Ross et al. 2008, as in sklearn).
+
+    ``n_seen`` is a DEVICE scalar and every derived reporting attribute
+    (explained variance, ratio, noise variance) is computed in-program:
+    the streaming hot loop performs zero host↔device scalar crossings
+    per block — the graftsan transfer sanitizer holds ``partial_fit``
+    to that under ``jax.transfer_guard("disallow")``, and the seed's
+    per-block ``int(n_samples_seen_)`` round-trip was exactly the
+    host-sync-loop class it existed to catch.
+    """
     n_batch = batch.shape[0]
+    d = batch.shape[1]
+    # the carry stays int32 (exact to 2^31 rows — an f32 count would
+    # silently stop increasing past 2^24); the weighting arithmetic runs
+    # in the batch dtype, where 1e-7 relative error on a WEIGHT is noise
     n_total = n_seen + n_batch
+    ns = n_seen.astype(batch.dtype)
+    nb = jnp.asarray(float(n_batch), batch.dtype)
+    nt = ns + nb
     batch_mean = jnp.mean(batch, axis=0)
     batch_var = jnp.var(batch, axis=0)
 
-    new_mean = (n_seen * mean + n_batch * batch_mean) / n_total
+    new_mean = (ns * mean + nb * batch_mean) / nt
     new_var = (
-        n_seen * var
-        + n_batch * batch_var
-        + (n_seen * n_batch / n_total) * (mean - batch_mean) ** 2
-    ) / n_total
+        ns * var
+        + nb * batch_var
+        + (ns * nb / nt) * (mean - batch_mean) ** 2
+    ) / nt
 
     centered = batch - batch_mean
-    correction = jnp.sqrt((n_seen * n_batch) / n_total) * (mean - batch_mean)
+    correction = jnp.sqrt((ns * nb) / nt) * (mean - batch_mean)
     stacked = jnp.vstack(
         [
             singular_values[:, None] * components,
@@ -48,7 +65,20 @@ def _update(components, singular_values, mean, var, n_seen, batch, *, k):
     )
     u, s, vt = jnp.linalg.svd(stacked, full_matrices=False)
     u, vt = svd_flip(u, vt, u_based_decision=False)
-    return vt[:k], s[:k], new_mean, new_var, n_total
+    sv = s[:k]
+    explained = sv**2 / (nt - 1.0)
+    total_var = jnp.sum(new_var) * nt / (nt - 1.0)
+    ratio = explained / total_var
+    # sklearn's noise floor: mean of the discarded eigenvalues; 0 when
+    # every component is kept (k >= min(n, d))
+    min_nd = jnp.minimum(nt, float(d))
+    noise = jnp.where(
+        k < min_nd,
+        (total_var - jnp.sum(explained))
+        / jnp.maximum(min_nd - k, 1.0),
+        0.0,
+    ).astype(batch.dtype)
+    return vt[:k], sv, new_mean, new_var, n_total, explained, ratio, noise
 
 
 class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
@@ -77,6 +107,23 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
         self._mean_sh_ = jnp.zeros((d,), dtype=dtype)
         self.var_ = jnp.zeros((d,), dtype=dtype)
         self.n_samples_seen_ = 0
+
+    # The running sample count lives ON DEVICE (`_n_seen_`): the update
+    # program consumes and produces it without a host round-trip per
+    # block.  `n_samples_seen_` stays the sklearn-exact Python int — the
+    # fetch happens when someone READS it, not once per streamed block
+    # (graftsan's steady-phase transfer guard holds partial_fit to
+    # zero implicit crossings).
+    @property
+    def n_samples_seen_(self):
+        ns = getattr(self, "_n_seen_", None)
+        return 0 if ns is None else int(ns)
+
+    @n_samples_seen_.setter
+    def n_samples_seen_(self, value):
+        # accepts ints (init, legacy checkpoints) and device scalars;
+        # int32 keeps the count exact (an f32 carry saturates at 2^24)
+        self._n_seen_ = jnp.asarray(value, dtype=jnp.int32)
 
     # -- staged streaming protocol (pipeline.stream_partial_fit) -----------
     def _pf_stage(self, X, y=None, check_input=True, **kwargs):
@@ -139,36 +186,33 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
             # raw scale (anchor 0) so the shifted state is well-defined
             self._anchor_ = jnp.zeros((d,), dtype=x.dtype)
             self._mean_sh_ = jnp.asarray(self.mean_)
-        (
-            self.components_,
-            self.singular_values_,
-            self._mean_sh_,
-            self.var_,
-            self.n_samples_seen_,
-        ) = _update(
-            self.components_,
-            self.singular_values_,
-            self._mean_sh_,
-            self.var_,
-            self.n_samples_seen_,
-            x - self._anchor_,
-            k=self.n_components_,
-        )
-        # the reported attribute is the TRUE mean (sklearn parity); one
-        # final add costs only the f32 representation round-off
-        self.mean_ = self._anchor_ + self._mean_sh_
-        self.n_samples_seen_ = int(self.n_samples_seen_)
-        n = self.n_samples_seen_
-        self.explained_variance_ = self.singular_values_ ** 2 / (n - 1)
-        total = jnp.sum(self.var_) * n / (n - 1)
-        self.explained_variance_ratio_ = self.explained_variance_ / total
-        self.n_features_in_ = d
-        if self.n_components_ < min(n, d):
-            self.noise_variance_ = (total - jnp.sum(self.explained_variance_)) / (
-                min(n, d) - self.n_components_
+        # ONE program, all-device operands (the running count included),
+        # derived reporting attrs computed in-program: the steady-state
+        # streaming step crosses the host boundary zero times, verified
+        # by graftsan's transfer guard when a sanitizer is active
+        with _san.region("ipca.partial_fit"), _san.step_guard():
+            (
+                self.components_,
+                self.singular_values_,
+                self._mean_sh_,
+                self.var_,
+                self._n_seen_,
+                self.explained_variance_,
+                self.explained_variance_ratio_,
+                self.noise_variance_,
+            ) = _update(
+                self.components_,
+                self.singular_values_,
+                self._mean_sh_,
+                self.var_,
+                self._n_seen_,
+                x - self._anchor_,
+                k=self.n_components_,
             )
-        else:
-            self.noise_variance_ = jnp.asarray(0.0, dtype=x.dtype)
+            # the reported attribute is the TRUE mean (sklearn parity);
+            # one final add costs only the f32 representation round-off
+            self.mean_ = self._anchor_ + self._mean_sh_
+        self.n_features_in_ = d
         return self
 
     def fit(self, X, y=None):
@@ -202,15 +246,25 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
                 break
             spans.append((start, stop))
 
+        from ..resilience.preemption import active_watcher
+
         def _boundary(j, _model):
             # consumer-thread hook between device steps: the snapshot
             # reflects exactly the first ``i`` batches; prefetched
             # in-flight batches never touched the state, so a resume
-            # re-slices and replays them identically
+            # re-slices and replays them identically.  Built ONLY when
+            # someone is listening (the _sgd boundary pattern): the
+            # state dict reads n_samples_seen_, whose getter is a
+            # device fetch since the count moved on-device — paying
+            # that per block on an uninstrumented fit would serialize
+            # the prefetch overlap this loop exists to provide
+            if ckpt is None and active_watcher() is None:
+                return
             i = done_batches + j
+            state = self._fit_state()
             if ckpt is not None and ckpt.due(i):
-                ckpt.save(self, self._fit_state(), i)
-            check_preemption(ckpt, self, self._fit_state(), i)
+                ckpt.save(self, state, i)
+            check_preemption(ckpt, self, state, i)
 
         from ..pipeline import stream_partial_fit
 

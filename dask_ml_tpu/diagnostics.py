@@ -43,8 +43,30 @@ __all__ = [
     "trace", "benchmark_step", "benchmark_slope", "_timer",
     "FaultStats", "fault_stats", "reset_fault_stats",
     "pipeline_report", "reset_pipeline_stats",
-    "lint_report",
+    "lint_report", "sanitize_report",
 ]
+
+
+def sanitize_report() -> dict | None:
+    """The graftsan runtime-sanitizer counters, next to
+    :func:`pipeline_report`'s stage split: per-region compile / dispatch
+    / d2h-sync counters, violations, allow-site passes, and the
+    dispatching thread set.
+
+    Returns the ACTIVE sanitizer's live report when one is open (inside
+    a ``sanitize.sanitize()`` scope or a ``DASK_ML_TPU_SANITIZE=1``
+    ambient stream), else the report of the most recently completed
+    scope, else None (no sanitizer has run in this process).  See
+    :mod:`dask_ml_tpu.sanitize` for the detector semantics and
+    ``tools/sanitize_baseline.json`` for the committed per-workload
+    contract these counters are ratcheted against.
+    """
+    from . import sanitize as _san
+
+    s = _san.active_sanitizer()
+    if s is not None:
+        return s.report()
+    return _san.last_report()
 
 
 def lint_report(paths=None, baseline="auto") -> dict:

@@ -27,6 +27,15 @@ import jax.numpy as jnp
 from ..base import ClassifierMixin, RegressorMixin, TPUEstimator, clone
 from ..core.sharded import ShardedRows, unshard
 from ..utils import check_max_iter
+from .. import sanitize as _san
+
+#: runtime-verified twin of the epoch-boundary host-sync-loop
+#: suppression in the packed ensemble epoch loop (see sanitize/sites.py)
+_ENSEMBLE_SYNC = _san.AllowSite(
+    "ensemble-epoch-sync", rule="host-sync-loop",
+    cites="de76260843a0de2f",
+    note="one mean-loss scalar per packed epoch, only when tol is set",
+)
 
 
 def _to_host_pair(X, y):
@@ -220,9 +229,10 @@ class _BlockwiseBase(TPUEstimator):
             )
             # the host sync happens only when a tol check is active —
             # tol=None epochs pipeline without a device round-trip
-            # graftlint: disable=host-sync-loop -- epoch-boundary tol check, and only when tol is set; tol=None epochs pipeline freely
-            if stop.active and stop.update(float(jnp.mean(losses))):
-                break
+            with _ENSEMBLE_SYNC.allow():
+                # graftlint: disable=host-sync-loop -- epoch-boundary tol check, and only when tol is set; tol=None epochs pipeline freely
+                if stop.active and stop.update(float(jnp.mean(losses))):
+                    break
         for i, m in enumerate(members):
             m._state = jax.tree.map(lambda v: v[i], states)
             m.n_iter_ = epoch + 1

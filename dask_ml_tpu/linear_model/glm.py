@@ -14,6 +14,7 @@ import numpy as np
 from ..base import ClassifierMixin, RegressorMixin, TPUEstimator
 from ..core.sharded import ShardedRows
 from ..preprocessing.data import _ingest_float
+from .. import sanitize as _san
 from ..solvers import (
     Logistic,
     Normal,
@@ -82,15 +83,19 @@ class _GLM(TPUEstimator):
 
     def _solve(self, X: ShardedRows, y, family=None, beta0=None):
         kwargs = self._solver_call_kwargs()  # validates self.solver
-        if getattr(self, "fit_checkpoint", None) is not None:
-            return self._solve_chunked(
-                X, y, family or self.family, beta0, kwargs,
-                self.fit_checkpoint,
+        # graftsan region: every GLM solver dispatch path funnels through
+        # here (plain, chunked, and the OvR/multinomial branches that
+        # call _solve per class), so compile attribution names the lane
+        with _san.region("glm.fit.solve"):
+            if getattr(self, "fit_checkpoint", None) is not None:
+                return self._solve_chunked(
+                    X, y, family or self.family, beta0, kwargs,
+                    self.fit_checkpoint,
+                )
+            return _SOLVERS[self.solver](
+                X, y, return_n_iter=True, family=family or self.family,
+                beta0=beta0, **kwargs
             )
-        return _SOLVERS[self.solver](
-            X, y, return_n_iter=True, family=family or self.family,
-            beta0=beta0, **kwargs
-        )
 
     def _solve_chunked(self, X, y, family, beta0, kwargs, ckpt):
         """Preemption-safe solve: the fused device solver runs in SEGMENTS

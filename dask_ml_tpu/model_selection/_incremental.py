@@ -29,6 +29,16 @@ from ..core.sharded import ShardedRows, unshard
 from ..metrics.scorer import check_scoring
 from ..utils import check_random_state
 from ._split import train_test_split
+from .. import sanitize as _san
+
+#: runtime-verified twin of the packed-scores host-sync-loop suppression
+#: in train_cohort (see sanitize/sites.py)
+_PACKED_SCORE_SYNC = _san.AllowSite(
+    "search-packed-scores", rule="host-sync-loop",
+    cites="8950af7eda0878b7",
+    note="packed_accuracy fetched the whole (M,) cohort score vector in "
+         "one round-trip; the per-model float() reads host numpy",
+)
 
 logger = logging.getLogger(__name__)
 
@@ -331,13 +341,14 @@ class BaseIncrementalSearchCV(TPUEstimator):
             if (n_calls > 1 and prefetch_depth > 0
                     and hasattr(model, "_pf_stage")):
                 t0 = time.time()
-                stream_partial_fit(
-                    model,
-                    (block_for(model, (calls0 + j) % n_blocks)
-                     for j in range(n_calls)),
-                    depth=prefetch_depth, fit_kwargs=fit_params,
-                    label="search_ingest",
-                )
+                with _san.region("search.train_one"):
+                    stream_partial_fit(
+                        model,
+                        (block_for(model, (calls0 + j) % n_blocks)
+                         for j in range(n_calls)),
+                        depth=prefetch_depth, fit_kwargs=fit_params,
+                        label="search_ingest",
+                    )
                 meta = dict(meta)
                 meta["partial_fit_calls"] += n_calls
                 # train_one semantics: partial_fit_time is ONE call's
@@ -397,8 +408,9 @@ class BaseIncrementalSearchCV(TPUEstimator):
                 meta["partial_fit_calls"] += n_calls
                 meta["partial_fit_time"] = pf_time
                 if packed_scores is not None:
-                    # graftlint: disable=host-sync-loop -- packed_scores is host numpy already: packed_accuracy fetched the whole (M,) vector in ONE round-trip
-                    meta["score"] = float(packed_scores[i])
+                    with _PACKED_SCORE_SYNC.allow():
+                        # graftlint: disable=host-sync-loop -- packed_scores is host numpy already: packed_accuracy fetched the whole (M,) vector in ONE round-trip
+                        meta["score"] = float(packed_scores[i])
                     meta["score_time"] = packed_score_time
                 else:
                     meta = _score((model, meta), X_test, y_test, scorer)

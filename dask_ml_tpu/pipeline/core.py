@@ -50,6 +50,7 @@ from .stats import PipelineStats
 
 __all__ = [
     "DEPTH_ENV",
+    "PREFETCH_THREAD_NAME",
     "resolve_depth",
     "prefetch_blocks",
     "stream_partial_fit",
@@ -58,6 +59,13 @@ __all__ = [
 #: policy knob: default prefetch depth for every streaming consumer.
 #: 0 = the seed's serial behavior; k >= 1 = k blocks staged ahead.
 DEPTH_ENV = "DASK_ML_TPU_PREFETCH_DEPTH"
+
+#: the staging worker's thread name — the identity the graftsan dispatch
+#: sanitizer watches: this thread stages transfers and must NEVER appear
+#: as a program-dispatching or compiling thread (design.md §8; the
+#: runtime check behind the pipeline/core.py thread-dispatch
+#: suppression below)
+PREFETCH_THREAD_NAME = "dask-ml-tpu-prefetch"
 
 _DEFAULT_DEPTH = 2
 
@@ -154,7 +162,7 @@ def _staged_iter(src, stage, depth: int, stats: PipelineStats):
     # interleave multi-device enqueue order
     # graftlint: disable=thread-dispatch -- host-only prefetch worker: parse + H2D staging puts, never device program dispatch (design.md input-pipeline contract)
     worker = threading.Thread(
-        target=_work, daemon=True, name="dask-ml-tpu-prefetch",
+        target=_work, daemon=True, name=PREFETCH_THREAD_NAME,
     )
     worker.start()
     try:
@@ -234,6 +242,22 @@ def stream_partial_fit(model, blocks, *, depth: int | None = None,
 
     Returns ``model``.  Records a :class:`PipelineStats` either way.
     """
+    from .. import sanitize as _san
+
+    if _san.enabled_by_env() and _san.active_sanitizer() is None:
+        # DASK_ML_TPU_SANITIZE=1: ambient observe-don't-crash sanitizer
+        # around this one stream — counters land in
+        # diagnostics.sanitize_report() with no code changes at the
+        # call site.  Entry is atomic-or-skip (sanitize.ambient): a
+        # concurrent stream that loses the race runs unobserved rather
+        # than crashing on the no-nesting rule, and fail_fast is off so
+        # an ambient run records violations instead of raising mid-fit.
+        with _san.ambient(f"ambient:{label}"):
+            return stream_partial_fit(
+                model, blocks, depth=depth, fit_kwargs=fit_kwargs,
+                on_block=on_block, label=label,
+            )
+
     kw = dict(fit_kwargs or {})
     depth = resolve_depth(depth)
     staged_proto = depth > 0 and _supports_staging(model)

@@ -1,0 +1,63 @@
+"""graftsan: the runtime SPMD sanitizer (compile / transfer / dispatch).
+
+The dynamic half of graftlint (``dask_ml_tpu/analysis/``): the static
+pass proves what the AST can see, this package observes real fits —
+
+* the **compile sanitizer** counts every XLA backend compile and
+  attributes it to a named :func:`region`, asserting steady-state fit
+  loops compile zero new programs after warmup;
+* the **transfer sanitizer** arms ``jax.transfer_guard`` around
+  steady-phase hot loops, with :class:`AllowSite` escapes that cite —
+  and runtime-verify — the graftlint ``host-sync-loop`` suppressions;
+* the **dispatch sanitizer** records the thread of every device
+  dispatch and fails fast on a second dispatching thread (the PR-1
+  deadlock class, caught at the violating enqueue).
+
+Results surface in ``diagnostics.sanitize_report()``; the committed
+``tools/sanitize_baseline.json`` ratchets per-workload counts in tier-1
+exactly like the lint baseline (``tools/lint.sh --sanitize`` /
+``--rebaseline``).  See :mod:`.core` for the detectors, :mod:`.smoke`
+for the gated workloads, :mod:`.baseline` for the ratchet semantics.
+
+CLI::
+
+    python -m dask_ml_tpu.sanitize --baseline tools/sanitize_baseline.json
+    python -m dask_ml_tpu.sanitize --write-baseline tools/sanitize_baseline.json
+"""
+
+from . import baseline  # noqa: F401
+from .core import (  # noqa: F401
+    BASELINE_ENV,
+    SANITIZE_ENV,
+    CompileViolation,
+    DispatchViolation,
+    Sanitizer,
+    active_sanitizer,
+    ambient,
+    enabled_by_env,
+    last_report,
+    record_d2h,
+    region,
+    sanitize,
+    step_guard,
+)
+from .sites import AllowSite, registered_sites  # noqa: F401
+
+__all__ = [
+    "AllowSite",
+    "BASELINE_ENV",
+    "SANITIZE_ENV",
+    "CompileViolation",
+    "DispatchViolation",
+    "Sanitizer",
+    "active_sanitizer",
+    "ambient",
+    "baseline",
+    "enabled_by_env",
+    "last_report",
+    "record_d2h",
+    "region",
+    "registered_sites",
+    "sanitize",
+    "step_guard",
+]

@@ -1,0 +1,167 @@
+"""Per-workload sanitizer baseline: the runtime twin of graftlint's
+ratchet (``analysis/baseline.py``), with the same CI semantics —
+
+* a workload in the run but not in the snapshot is **new** → fail
+  (every workload must be consciously baselined);
+* a snapshot entry not in the run is **stale** → fail, so the committed
+  ``tools/sanitize_baseline.json`` always matches the suite
+  (refresh with ``tools/lint.sh --rebaseline``);
+* measured compile / d2h-sync / allow-site counts above the snapshot are
+  **new compiles / new transfers** → fail (the ratchet);
+* the hard invariants are not ratcheted at all: steady-state compiles,
+  sanitizer violations, and transfer-guard errors must be **zero** in
+  both the snapshot and the run — a baseline cannot grandfather a
+  contract violation in.
+
+One deliberate asymmetry vs the lint ratchet: counts *below* the
+snapshot pass without being stale.  Compile counts are ceilings, not
+identities — inside a warm pytest process the jit cache already holds
+programs a cold ``python -m dask_ml_tpu.sanitize`` run would compile,
+so only the cold run (which is what ``--write-baseline`` uses) observes
+the full count.  Tighten the ceiling by rebaselining from a cold run."""
+
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = [
+    "compare",
+    "default_path",
+    "emit",
+    "load",
+    "write",
+    "HARD_INVARIANTS",
+    "RATCHETED_COUNTS",
+]
+
+_VERSION = 1
+
+#: per-workload metrics that must be exactly zero, snapshot and run both
+HARD_INVARIANTS = ("steady_compiles", "violations", "transfer_errors")
+
+#: per-workload metrics ratcheted as ceilings (run > snapshot fails)
+RATCHETED_COUNTS = ("warmup_compiles", "steady_d2h_syncs")
+
+
+def default_path() -> str | None:
+    """The committed snapshot: the ``DASK_ML_TPU_SANITIZE_BASELINE``
+    knob, else ``tools/sanitize_baseline.json`` next to a repo checkout
+    of this package, else None."""
+    from .core import BASELINE_ENV
+
+    env = os.environ.get(BASELINE_ENV, "").strip()
+    if env:
+        return env
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cand = os.path.join(os.path.dirname(pkg), "tools",
+                        "sanitize_baseline.json")
+    return cand if os.path.isfile(cand) else None
+
+
+def emit(results: dict) -> dict:
+    """Snapshot payload for a full smoke run: ``results`` maps workload
+    name -> metrics dict (see :func:`.smoke.run_workload`)."""
+    import jax
+
+    return {
+        "version": _VERSION,
+        "tool": "graftsan",
+        # recorded for the human diffing a rebaseline, NOT compared: a
+        # jax upgrade legitimately shifts compile counts and the ratchet
+        # (not a version gate) is what must catch that
+        "jax": jax.__version__,
+        "workloads": {
+            name: {k: metrics[k] for k in sorted(metrics)}
+            for name, metrics in sorted(results.items())
+        },
+    }
+
+
+def write(path: str, payload: dict) -> None:
+    from ..analysis.cache import atomic_write_json
+
+    atomic_write_json(path, payload, indent=2, sort_keys=True)
+
+
+def load(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        payload = json.load(fh)
+    if payload.get("version", 0) > _VERSION:
+        raise ValueError(
+            f"sanitize baseline {path} has version {payload['version']}, "
+            f"newer than this sanitizer understands ({_VERSION})")
+    if not isinstance(payload.get("workloads"), dict):
+        raise ValueError(
+            f"sanitize baseline {path} is malformed: no workloads table")
+    return payload
+
+
+def compare(snapshot: dict, results: dict, *, partial: bool = False) -> dict:
+    """The ratchet delta::
+
+        {"new":        [workload names in the run, absent from snapshot],
+         "stale":      [snapshot names absent from the run],
+         "regressions":[human-readable count-regression strings],
+         "violations": [hard-invariant failures, run AND snapshot]}
+
+    ``partial=True`` (an explicit ``--workloads`` subset) checks the
+    hard invariants ONLY: the stale check is meaningless for a subset,
+    and the compile ceilings are calibrated against the full suite's
+    execution order (a depth-2 stream workload legitimately compiles
+    nothing when its depth-0 twin ran first), so count comparisons on a
+    subset would false-fail.  The gate always runs the full suite."""
+    snap = snapshot["workloads"]
+    new = [] if partial else sorted(set(results) - set(snap))
+    stale = [] if partial else sorted(set(snap) - set(results))
+    regressions: list[str] = []
+    violations: list[str] = []
+
+    for name, m in sorted(results.items()):
+        err = m.get("error")
+        if err:
+            violations.append(f"{name}: workload errored: {err}")
+        for k in HARD_INVARIANTS:
+            if m.get(k, 0):
+                violations.append(
+                    f"{name}: hard invariant {k} = {m[k]} (must be 0)")
+        base = snap.get(name)
+        if base is None or partial:
+            continue
+        for k in RATCHETED_COUNTS:
+            if m.get(k, 0) > base.get(k, 0):
+                regressions.append(
+                    f"{name}: {k} {m.get(k, 0)} > baseline "
+                    f"{base.get(k, 0)} — a NEW "
+                    f"{'compile' if 'compile' in k else 'transfer'} "
+                    f"reached the steady path; fix it or rebaseline "
+                    f"deliberately (tools/lint.sh --rebaseline)")
+        run_sites = m.get("allow_sites", {})
+        base_sites = base.get("allow_sites", {})
+        for site, count in sorted(run_sites.items()):
+            if site not in base_sites:
+                regressions.append(
+                    f"{name}: allow-site {site!r} is not in the "
+                    f"baseline — a new boundary-sync escape must be "
+                    f"baselined deliberately")
+            elif count > base_sites[site]:
+                regressions.append(
+                    f"{name}: allow-site {site!r} passed {count}x > "
+                    f"baseline {base_sites[site]}x — more boundary "
+                    f"syncs per fit than the committed contract")
+
+    for name, m in sorted(snap.items()):
+        for k in HARD_INVARIANTS:
+            if m.get(k, 0):
+                violations.append(
+                    f"baseline entry {name} carries {k} = {m[k]}: a "
+                    f"snapshot cannot grandfather a contract violation "
+                    f"— fix the workload and rebaseline")
+
+    return {"new": new, "stale": stale, "regressions": regressions,
+            "violations": violations}
+
+
+def is_clean(delta: dict) -> bool:
+    return not any(delta[k] for k in ("new", "stale", "regressions",
+                                      "violations"))

@@ -1,0 +1,135 @@
+"""graftsan CLI: run the smoke suite, ratchet against the committed
+baseline.  Exit contract mirrors graftlint's (a crash can never read as
+a verdict):
+
+* 0 — suite ran, every invariant held, ratchet clean
+* 1 — violations / new compiles / new transfers / stale baseline
+* 2 — the sanitizer itself failed (bad args, unreadable baseline)
+
+Usage::
+
+    python -m dask_ml_tpu.sanitize                      # run + report
+    python -m dask_ml_tpu.sanitize --baseline tools/sanitize_baseline.json
+    python -m dask_ml_tpu.sanitize --write-baseline tools/sanitize_baseline.json
+    python -m dask_ml_tpu.sanitize --workloads sgd_stream_d0,sgd_stream_d2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import baseline as _baseline
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m dask_ml_tpu.sanitize",
+        description="runtime SPMD sanitizer smoke suite + ratchet",
+    )
+    p.add_argument("--workloads", default=None,
+                   help="comma-separated subset (default: all)")
+    p.add_argument("--baseline", metavar="PATH", default=None,
+                   help="ratchet against this committed snapshot "
+                        "(default: DASK_ML_TPU_SANITIZE_BASELINE, else "
+                        "tools/sanitize_baseline.json when present)")
+    p.add_argument("--write-baseline", metavar="PATH", default=None,
+                   help="snapshot this run's metrics (then ratchet "
+                        "against the fresh snapshot: bootstrap is clean "
+                        "by construction)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-workloads", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    try:
+        args = _parser().parse_args(argv)
+    except SystemExit as e:  # argparse's bad-args path
+        return 0 if (e.code in (0, None)) else 2
+
+    from .smoke import WORKLOADS, run_smoke
+
+    if args.list_workloads:
+        for name in sorted(WORKLOADS):
+            print(name)
+        return 0
+
+    names = None
+    if args.workloads:
+        names = [w.strip() for w in args.workloads.split(",") if w.strip()]
+    if args.write_baseline and names is not None:
+        # a subset snapshot would silently shadow the full-suite
+        # baseline (every unselected workload would read as new on the
+        # next gate, and surviving ceilings are calibrated against the
+        # full suite's execution order) — refuse as a usage error
+        print("error: --write-baseline requires the full suite "
+              "(drop --workloads): a partial snapshot cannot be "
+              "ratcheted against", file=sys.stderr)
+        return 2
+    try:
+        results = run_smoke(names)
+    except KeyError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    snap_path = args.write_baseline or args.baseline
+    if args.write_baseline:
+        # gate BEFORE writing: a snapshot may never carry a hard
+        # invariant violation, so a violating run must leave the
+        # committed file untouched (exit 1, nothing written) instead of
+        # replacing it and only then failing
+        probe = _baseline.compare({"workloads": dict(results)}, results)
+        if probe["violations"]:
+            for line in probe["violations"]:
+                print(f"VIOLATION: {line}", file=sys.stderr)
+            print("sanitize: refusing to write a violating baseline "
+                  f"to {args.write_baseline} (file untouched)",
+                  file=sys.stderr)
+            return 1
+        _baseline.write(args.write_baseline, _baseline.emit(results))
+    if snap_path is None:
+        snap_path = _baseline.default_path()
+
+    delta = None
+    if snap_path is not None:
+        try:
+            snap = _baseline.load(snap_path)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load baseline {snap_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        delta = _baseline.compare(snap, results, partial=names is not None)
+    else:
+        # no snapshot anywhere: hard invariants still gate
+        delta = _baseline.compare({"workloads": dict(results)}, results,
+                                  partial=names is not None)
+
+    clean = _baseline.is_clean(delta)
+    if args.format == "json":
+        print(json.dumps({"workloads": results, "delta": delta,
+                          "baseline": snap_path, "clean": clean},
+                         indent=2, sort_keys=True))
+    else:
+        for name, m in sorted(results.items()):
+            sites = ", ".join(f"{k}x{v}"
+                              for k, v in sorted(m["allow_sites"].items()))
+            print(f"{name}: warmup_compiles={m['warmup_compiles']} "
+                  f"steady_compiles={m['steady_compiles']} "
+                  f"steady_d2h={m['steady_d2h_syncs']} "
+                  f"violations={m['violations']} "
+                  f"threads={','.join(m['dispatch_threads'])}"
+                  + (f" allow=[{sites}]" if sites else "")
+                  + (f" ERROR={m['error']}" if m.get("error") else ""))
+        for key in ("violations", "regressions", "new", "stale"):
+            for line in delta[key]:
+                print(f"{key.upper()}: {line}")
+        print("sanitize: "
+              + ("clean" if clean else "FAILED")
+              + (f" (vs {snap_path})" if snap_path else " (no baseline)"))
+    return 0 if clean else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,532 @@
+"""graftsan core: the runtime SPMD sanitizer (compile / transfer / dispatch).
+
+graftlint (``dask_ml_tpu/analysis/``) proves the concurrency contract the
+AST can see; this module observes the half it cannot — the three runtime
+costs docs/design.md §7/§8 legislate against but only measurement can
+verify:
+
+* **compile** — every XLA backend compile is counted and attributed to
+  the innermost active :func:`region` on the compiling thread (via
+  ``jax.monitoring``'s ``/jax/core/compile/backend_compile_duration``
+  event, which jax 0.4.x emits once per backend compile and never on a
+  cache hit).  A steady-state fit loop must compile **zero** new
+  programs after warmup: recompilation is the hidden tax SURVEY §7
+  hard part (c) names, and the `[compile]` program-cache lane needs
+  measured, gated counts, not guesses.
+* **transfer** — ``jax.transfer_guard("disallow")`` is armed around
+  steady-phase hot loops (:meth:`Sanitizer.steady` /
+  :func:`step_guard`): any *implicit* host↔device transfer — a Python
+  scalar leaking into an eager op, a numpy array crossing at a jit
+  boundary — raises at the violating call.  The documented boundary
+  syncs (the graftlint ``host-sync-loop`` suppressions) become
+  runtime-verified :class:`~.sites.AllowSite` escapes that nest an
+  explicit ``allow`` and count each pass.  Explicit staging puts
+  (``jnp.asarray`` of host numpy, ``device_put``) stay legal — that is
+  precisely the §8 staging contract.  Scalar device→host syncs are
+  additionally counted via an ``ArrayImpl._value`` hook (the
+  ``float()``/``.item()`` class host-sync-loop flags statically;
+  CPU's zero-copy D2H never trips the XLA guard, so the sanitizer
+  carries its own counter).
+* **dispatch** — every compiled-program execution
+  (``pxla.ExecuteReplicated.__call__``) records its thread.  A second
+  dispatching thread is the PR-1 deadlock class (design.md §7 rule 1);
+  the sanitizer raises :class:`DispatchViolation` *at the violating
+  dispatch* — in the offending thread, before the enqueue interleave
+  can deadlock — unless the thread's name is in the blessed set
+  (``analysis.rules._spmd.BLESSED_COMPILE_THREADS``, shared with the
+  static stage-purity rule so the runtime and static allowlists cannot
+  drift).
+
+The hooks are installed lazily on the first :func:`sanitize` entry and
+stay installed as pass-throughs (a ``None`` active-sanitizer check per
+event); nothing is patched until a sanitizer is first used, and an
+inactive process pays nothing.
+
+Typical shape (the smoke suite in :mod:`.smoke` and the conftest
+``sanitizer`` fixture both follow it)::
+
+    from dask_ml_tpu import sanitize
+    with sanitize.sanitize(label="sgd_stream") as s:
+        fit_some_blocks(model)          # warmup: compiles counted
+        with s.steady():                # guard armed, phase = steady
+            fit_more_blocks(model)      # zero new compiles allowed
+    s.last_report()["totals"]["steady_compiles"]  # -> 0 or the gate fails
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from collections import defaultdict
+
+import jax
+
+__all__ = [
+    "SANITIZE_ENV",
+    "BASELINE_ENV",
+    "CompileViolation",
+    "DispatchViolation",
+    "Sanitizer",
+    "active_sanitizer",
+    "enabled_by_env",
+    "last_report",
+    "region",
+    "sanitize",
+    "step_guard",
+]
+
+#: policy knob: a truthy value arms an ambient (fail-soft) sanitizer
+#: around every ``pipeline.stream_partial_fit`` call, so any streamed
+#: fit in the process records compile/transfer/dispatch counters into
+#: ``diagnostics.sanitize_report()`` without code changes.
+SANITIZE_ENV = "DASK_ML_TPU_SANITIZE"
+
+#: policy knob: path of the committed per-workload sanitizer baseline
+#: (default ``tools/sanitize_baseline.json`` next to a repo checkout).
+BASELINE_ENV = "DASK_ML_TPU_SANITIZE_BASELINE"
+
+#: the jax.monitoring event emitted once per XLA backend compile (and
+#: never on a compile-cache hit) — the compile detector's signal.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+#: region label charged for events on a thread with no open region.
+UNATTRIBUTED = "<unattributed>"
+
+
+class DispatchViolation(RuntimeError):
+    """A device program was dispatched from a non-primary, non-blessed
+    thread while a sanitizer was active — the PR-1 deadlock class,
+    surfaced at the violating dispatch instead of as a post-hoc hang."""
+
+
+class CompileViolation(RuntimeError):
+    """Steady-state compile contract broken: a region compiled a new XLA
+    program after :meth:`Sanitizer.steady` marked warmup complete."""
+
+
+def enabled_by_env() -> bool:
+    """Strict parse of the ``DASK_ML_TPU_SANITIZE`` knob — an
+    unrecognized value is rejected loudly (the repo's env_choice
+    posture), never silently read as 'on': the ambient sanitizer
+    suppresses the pjit C++ fastpath, which no one should pay for a
+    typo'd ``false``."""
+    val = os.environ.get(SANITIZE_ENV, "").strip().lower()
+    if val in ("", "0", "off", "false", "no"):
+        return False
+    if val in ("1", "on", "true", "yes"):
+        return True
+    raise ValueError(
+        f"{SANITIZE_ENV} must be 0/off/false or 1/on/true; got {val!r}")
+
+
+# -- active-sanitizer state ----------------------------------------------
+_LOCK = threading.RLock()
+_ACTIVE: "Sanitizer | None" = None
+_LAST_REPORT: dict | None = None
+_TLS = threading.local()  # per-thread region stack
+
+
+def active_sanitizer() -> "Sanitizer | None":
+    return _ACTIVE
+
+
+def last_report() -> dict | None:
+    """The report of the most recently exited sanitizer (None when no
+    sanitizer has run in this process)."""
+    return _LAST_REPORT
+
+
+def _region_stack() -> list:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+def current_region() -> str:
+    st = getattr(_TLS, "stack", None)
+    if st:
+        return st[-1]
+    name = threading.current_thread().name
+    if name != "MainThread":
+        return f"<thread:{name}>"
+    return UNATTRIBUTED
+
+
+class _Region:
+    """Cheap named-region context: pushes onto the calling thread's
+    stack only while a sanitizer is active (estimator fit loops carry
+    these annotations permanently; an un-sanitized fit pays one
+    attribute check)."""
+
+    __slots__ = ("name", "_pushed")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._pushed = False
+
+    def __enter__(self):
+        if _ACTIVE is not None:
+            _region_stack().append(self.name)
+            self._pushed = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._pushed:
+            self._pushed = False
+            st = _region_stack()
+            if st and st[-1] == self.name:
+                st.pop()
+        return False
+
+
+def region(name: str) -> _Region:
+    """Attribute enclosed compile/transfer/dispatch events to ``name``.
+
+    Reentrant and nestable (innermost wins); a fresh context object per
+    call, so concurrent threads and recursive fits cannot share state.
+    """
+    return _Region(name)
+
+
+def step_guard():
+    """``jax.transfer_guard("disallow")`` when the active sanitizer is in
+    its steady phase WITH the guard armed (the effective per-``steady()``
+    choice, so ``steady(guard=False)`` really does disarm the per-step
+    guards too), else a no-op — the per-dispatch arming estimators wrap
+    around their jitted steps, so a steady-state step with a host
+    operand fails at the exact call that leaked it."""
+    s = _ACTIVE
+    if s is not None and s.phase == "steady" and s._steady_guard:
+        return jax.transfer_guard("disallow")
+    return contextlib.nullcontext()
+
+
+# -- lazily-installed process hooks --------------------------------------
+_HOOKS_INSTALLED = False
+
+
+def _install_hooks() -> None:
+    """Install the three detectors' process hooks exactly once.  All of
+    them are pass-throughs when no sanitizer is active."""
+    global _HOOKS_INSTALLED
+    with _LOCK:
+        if _HOOKS_INSTALLED:
+            return
+
+        # 1. compile: jax.monitoring duration listener (fires on the
+        # compiling thread, once per backend compile, never on cache hit)
+        import jax.monitoring as _mon
+
+        def _on_event_duration(event: str, duration: float, **_kw) -> None:
+            s = _ACTIVE
+            if s is not None and event == _COMPILE_EVENT:
+                s._record_compile(duration)
+
+        _mon.register_event_duration_secs_listener(_on_event_duration)
+
+        # 2. dispatch: wrap the compiled-program execution choke point.
+        # Every jitted (and eager-op) execution funnels through
+        # ExecuteReplicated.__call__ on the dispatching thread — but
+        # only on the PYTHON dispatch path: jax's C++ pjit fastpath
+        # executes warm programs without re-entering Python at all.  So
+        # while a sanitizer is active the fastpath is suppressed (no new
+        # fastpath entries are minted) and its caches are cleared at
+        # scope entry (pre-warmed entries are evicted), which routes
+        # every dispatch — warm or cold — through this hook.  The
+        # executable cache is untouched, so suppression costs Python
+        # dispatch overhead only, never a recompile; after the scope
+        # exits, fastpath entries re-mint organically on the next call.
+        from jax._src.interpreters import pxla as _pxla
+
+        orig_call = _pxla.ExecuteReplicated.__call__
+
+        def _dispatch_hook(er_self, *args):
+            s = _ACTIVE
+            if s is not None:
+                s._record_dispatch(getattr(er_self, "name", "<program>"))
+            return orig_call(er_self, *args)
+
+        _pxla.ExecuteReplicated.__call__ = _dispatch_hook
+
+        from jax._src import pjit as _pjit
+
+        orig_fastpath = _pjit._get_fastpath_data
+
+        def _fastpath_hook(*args, **kwargs):
+            if _ACTIVE is not None:
+                return None
+            return orig_fastpath(*args, **kwargs)
+
+        _pjit._get_fastpath_data = _fastpath_hook
+
+        # 3. d2h scalar syncs: ArrayImpl._value is the host
+        # materialization funnel behind float()/int()/.item()/__bool__
+        # (CPU's zero-copy D2H never trips the XLA transfer guard, so
+        # the sanitizer counts these itself).  numpy's buffer-protocol
+        # fast path can bypass it for bulk np.asarray — the counter is
+        # therefore a *scalar-sync* counter, which is exactly the
+        # host-sync-loop hazard class, not a byte meter.
+        try:
+            from jax._src import array as _jarray
+
+            orig_value = _jarray.ArrayImpl._value
+
+            def _value_hook(arr_self):
+                s = _ACTIVE
+                if s is not None:
+                    s._record_d2h()
+                return orig_value.fget(arr_self)
+
+            _jarray.ArrayImpl._value = property(_value_hook)
+        except (ImportError, AttributeError):  # pragma: no cover
+            pass  # detector degrades to guard-only transfer checking
+
+        # (the repo's own API-boundary fetch, core.sharded.unshard, is
+        # instrumented at its definition via record_d2h() — a patch here
+        # would miss every call site that bound the name at import time)
+
+        _HOOKS_INSTALLED = True
+
+
+def record_d2h() -> None:
+    """Count one device→host fetch against the active sanitizer (no-op
+    when none is active) — the hook point for this repo's own fetch
+    boundaries (``core.sharded.unshard``), whose bulk ``device_get``
+    rides numpy's buffer protocol and is invisible to the
+    ``ArrayImpl._value`` scalar hook."""
+    s = _ACTIVE
+    if s is not None:
+        s._record_d2h()
+
+
+def _new_counter() -> dict:
+    return {
+        "compiles": 0,
+        "steady_compiles": 0,
+        "compile_s": 0.0,
+        "dispatches": 0,
+        "steady_dispatches": 0,
+        "d2h_syncs": 0,
+        "steady_d2h_syncs": 0,
+    }
+
+
+class Sanitizer:
+    """One sanitization scope: counters, phase, and violation log.
+
+    Use via :func:`sanitize`; at most one sanitizer is active per
+    process at a time (nested entry raises — scoping must stay
+    unambiguous for attribution to mean anything).
+    """
+
+    def __init__(self, label: str = "sanitize", *, fail_fast: bool = True,
+                 guard_steady: bool = True, blessed_threads=None):
+        from ..analysis.rules._spmd import BLESSED_COMPILE_THREADS
+
+        self.label = label
+        self.fail_fast = fail_fast
+        self.guard_steady = guard_steady
+        self.blessed_threads = frozenset(
+            BLESSED_COMPILE_THREADS if blessed_threads is None
+            else blessed_threads)
+        self.phase = "warmup"
+        #: the EFFECTIVE guard choice of the innermost steady() block —
+        #: step_guard() consults this, so a steady(guard=False) caller
+        #: is not re-armed by estimator-internal step guards
+        self._steady_guard = False
+        self.regions: dict = defaultdict(_new_counter)
+        self.violations: list[dict] = []
+        self.allow_counts: dict = defaultdict(int)
+        self.dispatch_threads: set = set()
+        self._primary_ident: int | None = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------
+    def __enter__(self):
+        global _ACTIVE
+        _install_hooks()
+        with _LOCK:
+            if _ACTIVE is not None:
+                raise RuntimeError(
+                    f"a sanitizer ({_ACTIVE.label!r}) is already active: "
+                    f"sanitize() scopes must not nest — use region() for "
+                    f"finer attribution inside one scope"
+                )
+            self._primary_ident = threading.get_ident()
+            _ACTIVE = self
+        # evict pre-warmed C++ pjit fastpath entries so every dispatch in
+        # this scope re-enters Python where the dispatch hook can see it
+        # (the compiled-executable caches are separate and untouched — no
+        # recompiles are induced; see _install_hooks)
+        try:
+            from jax._src import pjit as _pjit
+
+            _pjit._cpp_pjit_cache_fun_only.clear()
+            _pjit._cpp_pjit_cache_explicit_attributes.clear()
+        except (ImportError, AttributeError):  # pragma: no cover
+            pass  # dispatch detector degrades to cold-dispatch-only
+        return self
+
+    def __exit__(self, *exc):
+        global _ACTIVE, _LAST_REPORT
+        with _LOCK:
+            if _ACTIVE is self:
+                _ACTIVE = None
+        _LAST_REPORT = self.report()
+        return False
+
+    @contextlib.contextmanager
+    def steady(self, guard: bool | None = None):
+        """Mark warmup complete for the enclosed block: compiles become
+        violations, and (``guard`` True, the default from the
+        constructor's ``guard_steady``) an implicit-transfer
+        ``jax.transfer_guard("disallow")`` is armed on this thread —
+        the :class:`~.sites.AllowSite` escapes re-allow the documented
+        boundary syncs."""
+        if guard is None:
+            guard = self.guard_steady
+        prev, prev_guard = self.phase, self._steady_guard
+        self.phase = "steady"
+        self._steady_guard = bool(guard)
+        try:
+            if guard:
+                with jax.transfer_guard("disallow"):
+                    yield self
+            else:
+                yield self
+        finally:
+            self.phase, self._steady_guard = prev, prev_guard
+
+    # -- recording (hook callbacks; any thread) --------------------------
+    def _record_compile(self, duration: float) -> None:
+        reg = current_region()
+        thread = threading.current_thread()
+        steady = self.phase == "steady"
+        with self._lock:
+            c = self.regions[reg]
+            c["compiles"] += 1
+            c["compile_s"] += float(duration)
+            if steady:
+                c["steady_compiles"] += 1
+        off_thread = (threading.get_ident() != self._primary_ident
+                      and thread.name not in self.blessed_threads)
+        if off_thread or steady:
+            kind = ("off-thread-compile" if off_thread
+                    else "steady-state-compile")
+            self._violation(kind, reg, thread.name,
+                            f"XLA backend compile in region {reg!r} "
+                            f"on thread {thread.name!r} "
+                            f"(phase={self.phase})")
+            if self.fail_fast and off_thread:
+                # raise in the offending thread: a prefetch/stage worker
+                # must never compile (design.md §8) — the pipeline
+                # propagates this to the consumer at the block position
+                raise CompileViolation(self.violations[-1]["detail"])
+
+    def _record_dispatch(self, program: str) -> None:
+        reg = current_region()
+        thread = threading.current_thread()
+        steady = self.phase == "steady"
+        with self._lock:
+            c = self.regions[reg]
+            c["dispatches"] += 1
+            if steady:
+                c["steady_dispatches"] += 1
+            self.dispatch_threads.add(thread.name)
+        if (threading.get_ident() != self._primary_ident
+                and thread.name not in self.blessed_threads):
+            self._violation(
+                "off-thread-dispatch", reg, thread.name,
+                f"device program {program!r} dispatched from second "
+                f"thread {thread.name!r} (region {reg!r}): two threads "
+                f"interleaving multi-device enqueues can deadlock the "
+                f"runtime (design.md §7 rule 1)")
+            if self.fail_fast:
+                raise DispatchViolation(self.violations[-1]["detail"])
+
+    def _record_d2h(self) -> None:
+        reg = current_region()
+        with self._lock:
+            c = self.regions[reg]
+            c["d2h_syncs"] += 1
+            if self.phase == "steady":
+                c["steady_d2h_syncs"] += 1
+
+    def _record_allow(self, site_id: str) -> None:
+        with self._lock:
+            self.allow_counts[site_id] += 1
+
+    def _violation(self, kind: str, reg: str, thread: str,
+                   detail: str) -> None:
+        with self._lock:
+            self.violations.append({
+                "kind": kind, "region": reg, "thread": thread,
+                "detail": detail,
+            })
+
+    # -- results ---------------------------------------------------------
+    def report(self) -> dict:
+        """Per-region counters + totals + violations, the
+        ``diagnostics.sanitize_report()`` payload."""
+        with self._lock:
+            regions = {k: dict(v) for k, v in sorted(self.regions.items())}
+            violations = list(self.violations)
+            allow = dict(sorted(self.allow_counts.items()))
+            threads = sorted(self.dispatch_threads)
+        totals = _new_counter()
+        for c in regions.values():
+            for k in totals:
+                totals[k] += c[k]
+        return {
+            "label": self.label,
+            "phase": self.phase,
+            "regions": regions,
+            "totals": totals,
+            "violations": violations,
+            "allow_sites": allow,
+            "dispatch_threads": threads,
+        }
+
+    def last_report(self) -> dict:
+        return self.report()
+
+    def assert_clean(self) -> None:
+        """Raise with full attribution if any contract was violated:
+        a steady-state compile, an off-thread compile or dispatch."""
+        rep = self.report()
+        if rep["violations"]:
+            lines = [v["detail"] for v in rep["violations"]]
+            raise CompileViolation(
+                f"{len(lines)} sanitizer violation(s) in "
+                f"{self.label!r}:\n  " + "\n  ".join(lines))
+
+
+def sanitize(label: str = "sanitize", *, fail_fast: bool = True,
+             guard_steady: bool = True, blessed_threads=None) -> Sanitizer:
+    """Context manager: observe every compile, transfer, and dispatch in
+    the enclosed block.  See the module docstring for the canonical
+    warmup/steady shape."""
+    return Sanitizer(label, fail_fast=fail_fast, guard_steady=guard_steady,
+                     blessed_threads=blessed_threads)
+
+
+@contextlib.contextmanager
+def ambient(label: str):
+    """Best-effort observe-only scope for the ``DASK_ML_TPU_SANITIZE=1``
+    ambient mode: yields an entered fail-soft Sanitizer, or ``None``
+    when another sanitizer is (or becomes) active — entry is
+    atomic-or-skip, so two concurrent streams racing for the ambient
+    slot both proceed and the loser simply goes unobserved, instead of
+    one of them crashing on the no-nesting rule mid-fit."""
+    s = Sanitizer(label, fail_fast=False)
+    try:
+        s.__enter__()
+    except RuntimeError:  # lost the race / explicitly-scoped sanitizer
+        yield None
+        return
+    try:
+        yield s
+    finally:
+        s.__exit__(None, None, None)

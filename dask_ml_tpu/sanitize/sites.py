@@ -1,0 +1,86 @@
+"""Runtime-verified transfer allow-sites.
+
+Every ``host-sync-loop`` suppression in the package is a *claim*: "this
+sync is an intentional boundary, not a leak".  graftlint checks the
+claim's justification exists; the sanitizer checks the claim itself at
+runtime.  An :class:`AllowSite` is the bridge:
+
+* it is declared module-level next to the code it covers, **citing the
+  graftlint suppression fingerprint** it runtime-verifies (the 16-hex
+  id from ``tools/sanitize_baseline.json``'s sibling,
+  ``tools/graftlint_baseline.json``) — tests/test_sanitize.py fails if
+  a citation does not resolve to a suppressed finding in the committed
+  baseline, so a dead suppression cannot keep a live runtime escape;
+* entering :meth:`AllowSite.allow` under an active sanitizer nests an
+  explicit ``jax.transfer_guard("allow")`` (the ONLY sanctioned escape
+  from the steady-phase ``disallow``) and counts the pass, so the
+  per-workload baseline ratchets boundary-sync *counts*, not just their
+  existence;
+* with no sanitizer active the context is a no-op — production fits pay
+  one attribute check.
+
+The three ``thread-dispatch`` suppressions have no allow-site: their
+runtime verification is the dispatch detector itself (the suppressed
+threads must simply never appear in ``dispatch_threads``)."""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from . import core as _core
+
+__all__ = ["AllowSite", "registered_sites"]
+
+_REGISTRY: dict = {}
+
+
+class AllowSite:
+    """One documented boundary-sync escape.
+
+    Args:
+      site_id: stable short name, unique per process
+        (``"kmeans-segment-sync"``).
+      rule: the graftlint rule the cited suppression belongs to
+        (``"host-sync-loop"``).
+      cites: the 16-hex baseline fingerprint(s) of that suppression
+        (``tools/graftlint_baseline.json`` ``findings[].fingerprint``) —
+        a string or tuple of strings when one statement carries several
+        findings.
+      note: one line of why the sync is a legitimate boundary.
+    """
+
+    __slots__ = ("site_id", "rule", "cites", "note")
+
+    def __init__(self, site_id: str, *, rule: str, cites, note: str):
+        self.site_id = site_id
+        self.rule = rule
+        self.cites = (cites,) if isinstance(cites, str) else tuple(cites)
+        self.note = note
+        if site_id in _REGISTRY and _REGISTRY[site_id] is not self:
+            raise ValueError(f"duplicate AllowSite id {site_id!r}")
+        _REGISTRY[site_id] = self
+
+    @contextlib.contextmanager
+    def allow(self):
+        """Explicitly-allowed transfer window: counts the pass and lifts
+        the steady-phase guard for exactly the enclosed statements."""
+        s = _core.active_sanitizer()
+        if s is None:
+            yield
+            return
+        s._record_allow(self.site_id)
+        with jax.transfer_guard("allow"):
+            yield
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"AllowSite({self.site_id!r}, rule={self.rule!r}, "
+                f"cites={self.cites!r})")
+
+
+def registered_sites() -> dict:
+    """All AllowSites constructed in this process, by id.  Estimator
+    modules declare their sites at import time, so importing the package
+    surface (``import dask_ml_tpu``) materializes the full registry."""
+    return dict(_REGISTRY)

@@ -1,0 +1,240 @@
+"""Sanitizer smoke workloads: the measured, gated compile/transfer
+contract of every streamed-fit hot path.
+
+Each workload is ONE sanitization scope with the canonical
+warmup→steady split: the warmup round pays state init + XLA compiles,
+the steady round streams the *same shapes into the same model* and must
+therefore compile zero new programs, dispatch from one thread, and
+perform no implicit transfers (the steady phase runs under
+``jax.transfer_guard("disallow")`` for the staged-protocol estimators;
+whole-array fits re-initialize state per ``fit`` call, which is
+legitimate warmup-class work, so they run ``guard=False`` and are held
+to the compile/dispatch contract only).
+
+The suite exists to be *committed*: ``tools/sanitize_baseline.json``
+snapshots each workload's metrics, ``tools/lint.sh --sanitize`` (and
+tests/test_sanitize.py in tier-1) re-runs the suite and ratchets
+against the snapshot — see :mod:`.baseline` for the failure semantics.
+Data shapes are deliberately tiny (the contract is about *counts*, not
+throughput) and fixed-seed (the compile set must be deterministic)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .core import sanitize
+
+__all__ = ["WORKLOADS", "run_workload", "run_smoke", "metrics_from"]
+
+_SEED = 7
+_BLOCKS = 4  # per round (warmup round, then steady round)
+
+
+def _class_blocks(n=32, d=4, blocks=_BLOCKS, offset=0):
+    rng = np.random.RandomState(_SEED + offset)
+    out = []
+    for _ in range(blocks):
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (X[:, 0] + 0.1 * rng.normal(size=n) > 0).astype(np.int32)
+        out.append((X, y))
+    return out
+
+
+def _row_blocks(n=16, d=4, blocks=_BLOCKS, offset=0):
+    rng = np.random.RandomState(_SEED + offset)
+    return [rng.normal(size=(n, d)).astype(np.float32)
+            for _ in range(blocks)]
+
+
+def metrics_from(s, error: str | None = None,
+                 transfer_error: bool = False) -> dict:
+    """Reduce a Sanitizer report to the committed per-workload metrics."""
+    rep = s.report()
+    t = rep["totals"]
+    return {
+        "warmup_compiles": t["compiles"] - t["steady_compiles"],
+        "steady_compiles": t["steady_compiles"],
+        "steady_d2h_syncs": t["steady_d2h_syncs"],
+        "violations": len(rep["violations"]),
+        "transfer_errors": 1 if transfer_error else 0,
+        "allow_sites": dict(rep["allow_sites"]),
+        "dispatch_threads": rep["dispatch_threads"],
+        **({"error": error} if error else {}),
+    }
+
+
+def _run_streamed(label, make_model, blocks_fn, depth, *, fit_kwargs=None,
+                  paired=True):
+    """warmup round then guarded steady round of ``stream_partial_fit``
+    over fresh same-shaped blocks into the SAME model."""
+    from ..pipeline import stream_partial_fit
+
+    model = make_model()
+    with sanitize(label=label) as s:
+        stream_partial_fit(
+            model,
+            blocks_fn(offset=0) if paired
+            else [(b, None) for b in blocks_fn(offset=0)],
+            depth=depth, fit_kwargs=fit_kwargs, label=label,
+        )
+        with s.steady():
+            stream_partial_fit(
+                model,
+                blocks_fn(offset=1) if paired
+                else [(b, None) for b in blocks_fn(offset=1)],
+                depth=depth, fit_kwargs=fit_kwargs, label=label,
+            )
+    return s
+
+
+def _wl_sgd_stream(depth):
+    from ..linear_model import SGDClassifier
+
+    return _run_streamed(
+        f"sgd_stream_d{depth}",
+        lambda: SGDClassifier(random_state=0),
+        _class_blocks, depth,
+        fit_kwargs={"classes": np.array([0, 1])},
+    )
+
+
+def _wl_mbk_stream(depth):
+    from ..cluster import MiniBatchKMeans
+
+    return _run_streamed(
+        f"mbk_stream_d{depth}",
+        lambda: MiniBatchKMeans(n_clusters=3, random_state=0),
+        _row_blocks, depth, paired=False,
+    )
+
+
+def _wl_ipca_stream(depth):
+    from ..decomposition import IncrementalPCA
+
+    return _run_streamed(
+        f"ipca_stream_d{depth}",
+        lambda: IncrementalPCA(n_components=2),
+        _row_blocks, depth, paired=False,
+    )
+
+
+def _wl_kmeans_fit():
+    from ..cluster import KMeans
+
+    rng = np.random.RandomState(_SEED)
+    X = rng.normal(size=(64, 4)).astype(np.float32)
+    with sanitize(label="kmeans_fit") as s:
+        KMeans(n_clusters=3, max_iter=5, random_state=0).fit(X)
+        # whole-array fit: each fit() re-inits device state (warmup-class
+        # work), so the steady contract here is compile/dispatch only
+        with s.steady(guard=False):
+            KMeans(n_clusters=3, max_iter=5, random_state=0).fit(X)
+    return s
+
+
+def _wl_kmeans_fit_ckpt():
+    """The SEGMENTED Lloyd path (fit_checkpoint set): every segment
+    boundary passes through the ``kmeans-segment-sync`` AllowSite, so
+    the committed baseline ratchets a NONZERO boundary-sync count — a
+    regression that syncs per iteration instead of per segment fails
+    the allow-site ceiling, not just a docstring."""
+    import shutil
+    import tempfile
+
+    from ..cluster import KMeans
+    from ..resilience import FitCheckpoint
+
+    rng = np.random.RandomState(_SEED)
+    X = rng.normal(size=(96, 4)).astype(np.float32)
+    d = tempfile.mkdtemp(prefix="graftsan-ckpt-")
+    try:
+        def _fit():
+            KMeans(
+                n_clusters=3, max_iter=64, tol=0.0, random_state=0,
+                fit_checkpoint=FitCheckpoint(
+                    os.path.join(d, "ck"), every_n_iters=32),
+            ).fit(X)
+
+        with sanitize(label="kmeans_fit_ckpt") as s:
+            _fit()
+            with s.steady(guard=False):
+                _fit()
+        return s
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _wl_mbk_fit():
+    """MiniBatchKMeans whole-array fit: the epoch loop passes the
+    ``mbk-epoch-sync`` AllowSite once per epoch — ratcheted nonzero in
+    the baseline for the same reason as the kmeans ckpt workload."""
+    from ..cluster import MiniBatchKMeans
+
+    rng = np.random.RandomState(_SEED)
+    X = rng.normal(size=(96, 4)).astype(np.float32)
+    with sanitize(label="mbk_fit") as s:
+        MiniBatchKMeans(n_clusters=3, max_iter=4, random_state=0).fit(X)
+        with s.steady(guard=False):
+            MiniBatchKMeans(n_clusters=3, max_iter=4, random_state=0).fit(X)
+    return s
+
+
+def _wl_glm_fit():
+    from ..linear_model import LogisticRegression
+
+    rng = np.random.RandomState(_SEED)
+    X = rng.normal(size=(64, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int32)
+    with sanitize(label="glm_fit") as s:
+        LogisticRegression(max_iter=8).fit(X, y)
+        with s.steady(guard=False):
+            LogisticRegression(max_iter=8).fit(X, y)
+    return s
+
+
+WORKLOADS = {
+    "sgd_stream_d0": lambda: _wl_sgd_stream(0),
+    "sgd_stream_d2": lambda: _wl_sgd_stream(2),
+    "mbk_stream_d0": lambda: _wl_mbk_stream(0),
+    "mbk_stream_d2": lambda: _wl_mbk_stream(2),
+    "ipca_stream_d0": lambda: _wl_ipca_stream(0),
+    "ipca_stream_d2": lambda: _wl_ipca_stream(2),
+    "kmeans_fit": _wl_kmeans_fit,
+    "kmeans_fit_ckpt": _wl_kmeans_fit_ckpt,
+    "mbk_fit": _wl_mbk_fit,
+    "glm_fit": _wl_glm_fit,
+}
+
+
+def run_workload(name: str) -> dict:
+    """Run one workload; a sanitizer/guard raise becomes an ``error``
+    metric (and a hard failure in the ratchet), never a crash of the
+    suite."""
+    from .core import CompileViolation, DispatchViolation
+
+    fn = WORKLOADS[name]
+    try:
+        s = fn()
+    except (CompileViolation, DispatchViolation) as e:
+        return {"warmup_compiles": 0, "steady_compiles": 0,
+                "steady_d2h_syncs": 0, "violations": 1,
+                "transfer_errors": 0, "allow_sites": {},
+                "dispatch_threads": [], "error": f"{type(e).__name__}: {e}"}
+    except Exception as e:  # transfer-guard XlaRuntimeError et al.
+        transfer = "Disallowed" in str(e) and "transfer" in str(e)
+        return {"warmup_compiles": 0, "steady_compiles": 0,
+                "steady_d2h_syncs": 0, "violations": 0 if transfer else 1,
+                "transfer_errors": 1 if transfer else 0, "allow_sites": {},
+                "dispatch_threads": [], "error": f"{type(e).__name__}: {e}"}
+    return metrics_from(s)
+
+
+def run_smoke(names=None) -> dict:
+    """Run the (selected) workloads and return {name: metrics}."""
+    names = list(WORKLOADS) if names is None else list(names)
+    unknown = [n for n in names if n not in WORKLOADS]
+    if unknown:
+        raise KeyError(f"unknown workload(s): {', '.join(unknown)}")
+    return {name: run_workload(name) for name in names}

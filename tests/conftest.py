@@ -152,6 +152,20 @@ def retry_flaky(attempts=2, match=None):
 
 
 @pytest.fixture
+def sanitizer():
+    """A scoped graftsan runtime sanitizer (dask_ml_tpu/sanitize/):
+    compile/transfer/dispatch detectors armed for exactly this test.
+    Fail-fast: an off-thread dispatch or compile raises at the violating
+    call; use ``with sanitizer.steady():`` around the post-warmup phase
+    to arm the implicit-transfer guard and make new compiles
+    violations."""
+    from dask_ml_tpu import sanitize
+
+    with sanitize.sanitize(label="pytest") as s:
+        yield s
+
+
+@pytest.fixture
 def rng():
     return np.random.RandomState(42)
 

@@ -666,6 +666,202 @@ class TestUnboundedRetry:
         assert not active(findings)
 
 
+class TestBlessedCompileThread:
+    """PR-6 stage-purity extension: a Thread constructed with a literal
+    name in ``_spmd.BLESSED_COMPILE_THREADS`` may COMPILE off the main
+    thread (the ROADMAP [compile] compile-ahead worker); it still may
+    not fetch, rendezvous, or run a dispatch surface — and ``_pf_stage``
+    workers stay forbidden from compiling entirely."""
+
+    def test_blessed_thread_compiling_is_clean(self):
+        findings = lint("""
+            import threading
+            import jax
+
+            def _warm_cache():
+                jax.jit(lambda v: v).lower(1.0).compile()
+
+            t = threading.Thread(
+                target=_warm_cache, name="dask-ml-tpu-compile-ahead")
+        """)
+        assert not active(findings), rule_ids(active(findings))
+
+    def test_blessed_thread_fetch_is_flagged(self):
+        findings = lint("""
+            import threading
+            from dask_ml_tpu.core.sharded import unshard
+
+            def _leak(x):
+                return unshard(x)
+
+            t = threading.Thread(
+                target=_leak, name="dask-ml-tpu-compile-ahead")
+        """)
+        fs = [f for f in active(findings) if f.rule == "stage-purity"]
+        assert fs and "blessed" in fs[0].message
+
+    def test_blessed_thread_collective_is_flagged(self):
+        findings = lint("""
+            import threading
+            import jax
+
+            def _run():
+                jax.lax.psum(1, "i")
+
+            t = threading.Thread(
+                target=_run, name="dask-ml-tpu-compile-ahead")
+        """)
+        assert "stage-purity" in rule_ids(active(findings))
+
+    def test_unblessed_name_still_flags_thread_dispatch(self):
+        findings = lint("""
+            import threading
+            import jax
+
+            def _warm_cache():
+                jax.jit(lambda v: v)(1.0)
+
+            t = threading.Thread(
+                target=_warm_cache, name="some-random-worker")
+        """)
+        assert rule_ids(active(findings)) == ["thread-dispatch"]
+
+    def test_computed_name_is_not_blessed(self):
+        # only a string LITERAL blesses: a computed name is unprovable
+        findings = lint("""
+            import threading
+            import jax
+
+            NAME = "dask-ml-tpu-compile-ahead"
+
+            def _warm_cache():
+                jax.jit(lambda v: v)(1.0)
+
+            t = threading.Thread(target=_warm_cache, name=NAME)
+        """)
+        assert "thread-dispatch" in rule_ids(active(findings))
+
+    def test_pf_stage_still_forbidden_from_compiling(self):
+        # the blessing must NOT leak to staging workers: a _pf_stage
+        # that compiles keeps flagging regardless of thread names
+        findings = lint("""
+            import jax
+
+            class Est:
+                def _pf_stage(self, X, y=None, **kwargs):
+                    return jax.jit(lambda v: v)(X)
+        """)
+        assert "stage-purity" in rule_ids(active(findings))
+
+
+class TestRecompileRisk:
+    """PR-6: the static twin of graftsan's compile sanitizer."""
+
+    def test_flags_traced_param_in_reshape(self):
+        findings = lint("""
+            import jax
+
+            @jax.jit
+            def f(x, n):
+                return x.reshape(n, -1)
+        """)
+        fs = [f for f in active(findings) if f.rule == "recompile-risk"]
+        assert fs and "n" in fs[0].message and "static_argnames" in \
+            fs[0].message
+
+    def test_flags_partial_applied_idiom_with_propagation(self):
+        # this repo's module-level wrap: partial(jax.jit, ...)(fn), and
+        # the taint flows through a local arithmetic assignment
+        findings = lint("""
+            import jax
+            import jax.numpy as jnp
+            from functools import partial
+
+            def step(state, n):
+                m = n * 2
+                return state + jnp.zeros(m)
+
+            _jitted = partial(jax.jit, donate_argnames=("state",))(step)
+        """)
+        assert "recompile-risk" in rule_ids(active(findings))
+
+    def test_flags_jit_call_form(self):
+        findings = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def g(x, k):
+                return jnp.arange(k) + x
+
+            wrapped = jax.jit(g)
+        """)
+        assert "recompile-risk" in rule_ids(active(findings))
+
+    def test_static_argnames_is_clean(self):
+        findings = lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("n",))
+            def f(x, n):
+                return x.reshape(n, -1)
+        """)
+        assert not active(findings)
+
+    def test_shape_touch_is_shielded(self):
+        findings = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                b = x.shape[0]
+                return jnp.zeros(b) + x.reshape(x.shape[0], -1)
+        """)
+        assert not active(findings)
+
+    def test_helper_call_result_does_not_taint(self):
+        # a call's result is unknowable (usually a static shape helper):
+        # treating it as tainted would flag every _pdim-style helper
+        findings = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def _pdim(x):
+                return x.shape[1]
+
+            @jax.jit
+            def f(x):
+                d = _pdim(x)
+                return jnp.zeros(d)
+        """)
+        assert not active(findings)
+
+    def test_data_arg_of_reshape_function_form_is_not_shape(self):
+        findings = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                return jnp.reshape(x, (2, -1))
+        """)
+        assert not active(findings)
+
+    def test_nonstandard_module_alias_resolves_as_function_form(self):
+        # import-table resolution, not a hardcoded alias list: `jn` must
+        # read as jax.numpy, so arg 0 is the DATA, not a shape position
+        findings = lint("""
+            import jax
+            import jax.numpy as jn
+
+            @jax.jit
+            def f(x):
+                return jn.reshape(x, (2, -1))
+        """)
+        assert not active(findings)
+
+
 class TestCheckpointSchemaDrift:
     def test_flags_consumed_key_never_written(self):
         findings = lint("""
@@ -1360,6 +1556,8 @@ class TestFramework:
             # v2: project-wide contracts
             "stage-purity", "unbounded-retry", "checkpoint-schema-drift",
             "undocumented-knob",
+            # PR 6: the static twin of graftsan's compile sanitizer
+            "recompile-risk",
         }
 
     def test_select_unknown_rule_raises(self):

@@ -10,22 +10,35 @@
 # the analyzer itself failed (bad args / crash), which must never be
 # confused with a clean run.
 #
+# --sanitize additionally runs graftsan, the RUNTIME half (compile /
+# transfer / dispatch sanitizer smoke suite, dask_ml_tpu/sanitize/),
+# ratcheted against tools/sanitize_baseline.json with the same new/stale
+# semantics.  Slower (~1 min: it executes real fits on the virtual
+# mesh), so it is opt-in here while tier-1 runs it via
+# tests/test_sanitize.py.
+#
 # Usage:
-#   tools/lint.sh                 # ratchet gate (text output)
+#   tools/lint.sh                 # static ratchet gate (text output)
 #   tools/lint.sh --json          # same, JSON output (CI trending)
-#   tools/lint.sh --rebaseline    # refresh the committed baseline after
-#                                 # intentional changes, then re-gate
+#   tools/lint.sh --sanitize      # static gate + runtime sanitizer gate
+#   tools/lint.sh --rebaseline    # refresh BOTH committed baselines after
+#                                 # intentional changes (the sanitize write
+#                                 # self-gates its hard invariants; the
+#                                 # graftlint ratchet re-runs below)
 #   tools/lint.sh [extra graftlint args]   # passed through
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 BASELINE=tools/graftlint_baseline.json
+SAN_BASELINE=tools/sanitize_baseline.json
 MODE=gate
+SANITIZE=0
 EXTRA=()
 for a in "$@"; do
   case "$a" in
     --json) EXTRA+=(--format json) ;;
     --rebaseline) MODE=rebaseline ;;
+    --sanitize) SANITIZE=1 ;;
     *) EXTRA+=("$a") ;;
   esac
 done
@@ -34,11 +47,27 @@ if [[ "$MODE" == rebaseline ]]; then
   echo "== graftlint (rebaseline) =="
   JAX_PLATFORMS=cpu python -m dask_ml_tpu.analysis dask_ml_tpu \
     --write-baseline "$BASELINE"
+  echo "== graftsan (rebaseline: full smoke suite, cold counts) =="
+  # both snapshots refresh in one invocation or the script fails before
+  # the gate below — a half-updated pair cannot be committed green.
+  # Same 8-virtual-device mesh as the tier-1 harness: ceilings must be
+  # calibrated on the topology the gate measures against.
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m dask_ml_tpu.sanitize --write-baseline "$SAN_BASELINE"
 fi
 
 echo "== graftlint (ratchet vs $BASELINE) =="
 JAX_PLATFORMS=cpu python -m dask_ml_tpu.analysis dask_ml_tpu \
   --baseline "$BASELINE" ${EXTRA[@]+"${EXTRA[@]}"}
+
+# (in --rebaseline mode the --write-baseline run above already
+# self-gated the fresh snapshot's hard invariants; --sanitize is the
+# standalone gate against the committed one)
+if [[ "$SANITIZE" == 1 ]]; then
+  echo "== graftsan (runtime sanitizer smoke suite vs $SAN_BASELINE) =="
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m dask_ml_tpu.sanitize --baseline "$SAN_BASELINE"
+fi
 
 echo "== compileall =="
 python -m compileall -q dask_ml_tpu
