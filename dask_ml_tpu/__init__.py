@@ -44,6 +44,7 @@ from . import _partial  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import resilience  # noqa: F401
 from . import sanitize  # noqa: F401
+from . import obs  # noqa: F401
 from . import diagnostics  # noqa: F401
 from . import model_selection  # noqa: F401
 
@@ -68,6 +69,7 @@ __all__ = [
     "resilience",
     "compose",
     "diagnostics",
+    "obs",
     "sanitize",
     "wrappers",
     "model_selection",

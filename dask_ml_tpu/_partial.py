@@ -22,6 +22,7 @@ import logging
 
 import numpy as np
 
+from . import obs
 from .core.sharded import ShardedRows, unshard
 from .utils import check_chunks, check_random_state
 
@@ -78,10 +79,12 @@ def fit(model, x, y=None, *, chunk_size: int | None = None, shuffle_blocks=False
                 "shuffle_blocks ignored for an iterator source: a "
                 "one-shot stream has no random access to permute"
             )
-        return stream_partial_fit(
-            model, _iter_block_pairs(x), depth=prefetch_depth,
-            fit_kwargs=kwargs,
-        )
+        with obs.span("fit", estimator=type(model).__name__,
+                      source="iterator"):
+            return stream_partial_fit(
+                model, _iter_block_pairs(x), depth=prefetch_depth,
+                fit_kwargs=kwargs,
+            )
 
     xv = unshard(x) if isinstance(x, ShardedRows) else np.asarray(x)
     if chunk_size is None:
@@ -110,9 +113,11 @@ def fit(model, x, y=None, *, chunk_size: int | None = None, shuffle_blocks=False
             logger.debug("partial_fit chunk %d/%d", i + 1, len(spans))
             yield xv[lo:hi], (None if yv is None else yv[lo:hi])
 
-    return stream_partial_fit(
-        model, _blocks(), depth=prefetch_depth, fit_kwargs=kwargs,
-    )
+    with obs.span("fit", estimator=type(model).__name__,
+                  blocks=len(spans)):
+        return stream_partial_fit(
+            model, _blocks(), depth=prefetch_depth, fit_kwargs=kwargs,
+        )
 
 
 def predict(model, x, *, chunk_size: int = 100_000,
@@ -130,9 +135,10 @@ def predict(model, x, *, chunk_size: int = 100_000,
     else:
         xv = unshard(x) if isinstance(x, ShardedRows) else np.asarray(x)
         blocks = (xv[lo:hi] for lo, hi in _row_chunks(xv.shape[0], chunk_size))
-    outs = [
-        np.asarray(model.predict(xb))
-        for xb in prefetch_blocks(blocks, depth=prefetch_depth,
-                                  label="partial_predict")
-    ]
+    with obs.span("predict", estimator=type(model).__name__):
+        outs = [
+            np.asarray(model.predict(xb))
+            for xb in prefetch_blocks(blocks, depth=prefetch_depth,
+                                      label="partial_predict")
+        ]
     return np.concatenate(outs)

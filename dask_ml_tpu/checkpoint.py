@@ -35,8 +35,18 @@ import numpy as np
 import jax
 
 from .core.sharded import ShardedRows, unshard
+from .obs import event as _obs_event
+from .obs.metrics import registry as _obs_registry
 
 __all__ = ["save_estimator", "load_estimator", "SearchCheckpoint"]
+
+
+def _note_save(kind: str, path: str, **attrs) -> None:
+    """Checkpoint observability (design.md §11): one ``checkpoint.save``
+    counter tagged by kind, plus a span-tree/flight event — a resumed
+    post-mortem shows WHICH snapshots the dying fit managed to write."""
+    _obs_registry().counter("checkpoint.save", kind).inc()
+    _obs_event("checkpoint.save", kind=kind, path=path, **attrs)
 
 _FORMAT_VERSION = 1
 
@@ -137,6 +147,7 @@ def save_estimator(estimator, path: str) -> None:
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
     _atomic_pickle(state, os.path.join(path, "state.pkl"))
+    _note_save("estimator", path, cls=cls.__qualname__)
 
 
 def load_estimator(path: str):
@@ -197,6 +208,7 @@ class SearchCheckpoint:
             },
             self.path,
         )
+        _note_save("search", self.path, models=len(models))
 
     def load_if_matches(self):
         """One read: the snapshot tuple, or None if absent / written by a

@@ -39,12 +39,70 @@ from .pipeline import (  # noqa: F401
     reset_pipeline_stats,
 )
 
+# grafttrace (re-export): the unified span/metrics/flight spine the
+# reporters above publish through (dask_ml_tpu/obs/, design.md §11) —
+# run_report() below is its merged per-fit view
+from . import obs  # noqa: F401
+from .obs import (  # noqa: F401
+    event,
+    export_perfetto,
+    flight_dump,
+    metrics_snapshot,
+    span,
+)
+
 __all__ = [
     "trace", "benchmark_step", "benchmark_slope", "_timer",
     "FaultStats", "fault_stats", "reset_fault_stats",
     "pipeline_report", "reset_pipeline_stats",
     "lint_report", "sanitize_report",
+    "obs", "span", "event", "metrics_snapshot", "export_perfetto",
+    "flight_dump", "run_report", "reset",
 ]
+
+
+def run_report() -> dict:
+    """The merged "what happened, in order, during THAT fit" view.
+
+    One dict over the whole observability spine:
+
+    * ``span_tree`` — the most recent ROOT span (the last whole
+      fit/stream/search) assembled as a nested tree: pipeline stage
+      children (parse/stage/compute, prefetch-worker spans stitched
+      in), search rounds/units, with retry/checkpoint/violation events
+      attached to the spans they occurred under.  ``None`` when tracing
+      is disabled or nothing has completed.
+    * ``metrics`` — the registry snapshot: counters, gauges, and
+      histograms with p50/p95/p99 (``pipeline.block_s``,
+      ``compile.duration_s``, ...).
+    * ``pipeline`` / ``faults`` / ``sanitize`` — the pre-existing
+      reporters, unchanged shapes (views over the same registry).
+
+    Call :func:`reset` first to scope the report to one fit; export the
+    same fit with :func:`export_perfetto` to render it next to an XProf
+    device trace.
+    """
+    return {
+        "schema": obs.SCHEMA_VERSION,
+        "span_tree": obs.span_tree(),
+        "metrics": obs.metrics_snapshot(),
+        "pipeline": pipeline_report(),
+        "faults": fault_stats().snapshot(),
+        "sanitize": sanitize_report(),
+    }
+
+
+def reset() -> None:
+    """One-call observability reset: fault stats, pipeline stats, the
+    metrics registry, the span rings, and the flight recorder — the
+    test/bench isolation idiom (replaces hand-chained
+    ``reset_fault_stats()`` + ``reset_pipeline_stats()`` calls)."""
+    obs.reset_all()
+    # the legacy reporters' registry families are already gone; these
+    # clear their residual module state (the last-stream slot; private
+    # books if the global stats object was ever swapped out)
+    reset_fault_stats()
+    reset_pipeline_stats()
 
 
 def sanitize_report() -> dict | None:
@@ -151,12 +209,20 @@ def trace(log_dir: str):
     The TPU analogue of watching the distributed dashboard's task stream:
     ``with diagnostics.trace('/tmp/prof'): est.fit(X)`` then point
     TensorBoard (or xprof) at the directory.
+
+    Exception-safe: ``start_trace`` itself can raise (unwritable
+    directory, a trace already active) — the stop only runs if the
+    start succeeded, so the REAL error propagates instead of being
+    masked by ``stop_trace`` complaining about a never-started trace.
     """
-    jax.profiler.start_trace(log_dir)
+    started = False
     try:
+        jax.profiler.start_trace(log_dir)
+        started = True
         yield
     finally:
-        jax.profiler.stop_trace()
+        if started:
+            jax.profiler.stop_trace()
 
 
 def _sync(out):

@@ -28,6 +28,7 @@ import math
 
 import numpy as np
 
+from .. import obs as _obs
 from ._incremental import BaseIncrementalSearchCV
 from ._successive_halving import SuccessiveHalvingSearchCV
 
@@ -163,8 +164,22 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
         X_train, X_test, y_train, y_test = self._split(X, y)
         brackets = self._make_brackets()
 
-        def bracket_fit(sha):
-            return sha._fit(X_train, y_train, X_test, y_test, **fit_params)
+        # span tree (design.md §11): one regular root span for the whole
+        # Hyperband fit; each bracket is a DETACHED child (brackets
+        # interleave as coroutines on this thread, so stack parentage
+        # would cross-link them), and each bracket hands its span id to
+        # its SHA so that SHA's round/unit spans nest under the bracket
+        hb_span = _obs.span("search.fit",
+                            search=type(self).__qualname__,
+                            brackets=len(brackets))
+
+        async def bracket_fit(s, sha):
+            with _obs.span("search.bracket", parent=hb_span.span_id,
+                           detached=True, bracket=s) as bs:
+                sha._obs_parent = bs.span_id or hb_span.span_id
+                return await sha._fit(
+                    X_train, y_train, X_test, y_test, **fit_params
+                )
 
         async def run_all():
             if self.sequential_brackets:
@@ -172,12 +187,13 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
                 # failing bracket leaves no never-awaited coroutines);
                 # with run_round's lockstep dispatch each bracket issues
                 # identical collectives on every process
-                return [await bracket_fit(sha) for _, sha in brackets]
+                return [await bracket_fit(s, sha) for s, sha in brackets]
             return await asyncio.gather(
-                *[bracket_fit(sha) for _, sha in brackets]
+                *[bracket_fit(s, sha) for s, sha in brackets]
             )
 
-        results = asyncio.run(run_all())
+        with hb_span:
+            results = asyncio.run(run_all())
 
         # merge results across brackets with globally unique model ids
         all_models, all_info = {}, {}

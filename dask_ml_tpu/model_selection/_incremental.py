@@ -24,6 +24,7 @@ from collections import defaultdict
 
 import numpy as np
 
+from .. import obs as _obs
 from ..base import TPUEstimator, clone
 from ..core.sharded import ShardedRows, unshard
 from ..metrics.scorer import check_scoring
@@ -266,6 +267,16 @@ class BaseIncrementalSearchCV(TPUEstimator):
         self._reset_policy()
         self._fit_failures = 0
         self._fit_failures_lock = threading.Lock()
+        # span parentage (design.md §11): async scopes use DETACHED
+        # spans with an explicit parent — concurrent brackets interleave
+        # coroutines on one loop thread, so stack parentage would
+        # cross-link them.  A Hyperband bracket hands its bracket-span
+        # id in via _obs_parent; a direct fit() parents under the
+        # search.fit span fit() opened on this (the calling) thread.
+        fit_parent = getattr(self, "_obs_parent", None)
+        if fit_parent is None:
+            fit_parent = _obs.current_span_id()
+        round_span = {"id": fit_parent}  # units parent here per round
         scorer = check_scoring(self.estimator, self.scoring)
         params = self._get_params()
         rng = check_random_state(self.random_state)
@@ -501,12 +512,17 @@ class BaseIncrementalSearchCV(TPUEstimator):
                     models[i] = snapshot[i]
                     del info[i][info_snapshot[i]:]
 
-            return _retry(
-                fn, first_arg, n_calls,
-                retries=0 if lockstep else 1,
-                backoff=0.0, jitter=0.0,
-                tag="search-unit", on_error=rollback,
-            )
+            # a regular (stack) span: run_unit executes synchronously on
+            # its thread (pool worker or, serialized, the loop thread),
+            # so nested pipeline.stream spans parent here naturally
+            with _obs.span("search.unit", parent=round_span["id"],
+                           models=len(unit_ids), n_calls=n_calls):
+                return _retry(
+                    fn, first_arg, n_calls,
+                    retries=0 if lockstep else 1,
+                    backoff=0.0, jitter=0.0,
+                    tag="search-unit", on_error=rollback,
+                )
 
         async def run_round(instructions):
             """Fan this round's training units over the shared thread pool
@@ -567,7 +583,11 @@ class BaseIncrementalSearchCV(TPUEstimator):
         # initial round: one call each (skipped when resuming — the
         # snapshot already contains at least the initial round)
         if not resumed:
-            await run_round({ident: 1 for ident in models})
+            with _obs.span("search.round", parent=fit_parent,
+                           detached=True, round=0,
+                           models=len(models)) as rs:
+                round_span["id"] = rs.span_id or fit_parent
+                await run_round({ident: 1 for ident in models})
             if ckpt is not None:
                 ckpt.save(models, info, self._capture_policy_state(),
                           elapsed=time.time() - start_time)
@@ -595,7 +615,12 @@ class BaseIncrementalSearchCV(TPUEstimator):
             if not instructions:
                 break
             round_no += 1
-            await run_round(instructions)
+            with _obs.span("search.round", parent=fit_parent,
+                           detached=True, round=round_no,
+                           models=sum(1 for v in instructions.values()
+                                      if v > 0)) as rs:
+                round_span["id"] = rs.span_id or fit_parent
+                await run_round(instructions)
             if ckpt is not None:
                 ckpt.save(models, info, self._capture_policy_state(),
                           elapsed=time.time() - start_time)
@@ -644,9 +669,13 @@ class BaseIncrementalSearchCV(TPUEstimator):
 
     def fit(self, X, y=None, **fit_params):
         X_train, X_test, y_train, y_test = self._split(X, y)
-        models, info = asyncio.run(
-            self._fit(X_train, y_train, X_test, y_test, **fit_params)
-        )
+        # asyncio.run blocks this thread, so a regular stack span is the
+        # whole-search root; the coroutine's detached round spans parent
+        # under it via fit_parent (see _fit)
+        with _obs.span("search.fit", search=type(self).__qualname__):
+            models, info = asyncio.run(
+                self._fit(X_train, y_train, X_test, y_test, **fit_params)
+            )
         return self._process_results(models, info)
 
     def _split(self, X, y):

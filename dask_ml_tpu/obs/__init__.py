@@ -1,0 +1,102 @@
+"""grafttrace: unified structured tracing, metrics, and flight recorder.
+
+The one event spine every runtime layer reports through (docs/design.md
+§11).  Four pieces:
+
+* :mod:`.spans` — the span tree (``obs.span("fit")`` → rounds → blocks
+  → parse/stage/compute children) in lock-free per-thread rings, with
+  worker-thread stitching (``adopt``) and async-safe detached spans;
+* :mod:`.metrics` — the counters/gauges/HDR-histogram registry
+  (``pipeline.stall_s``, ``resilience.retry``, ``compile.count``) that
+  ``PipelineStats``, ``FaultStats``, and graftsan publish into — the
+  old reporters keep their shapes as views;
+* :mod:`.export` — schema-versioned JSONL streaming
+  (``DASK_ML_TPU_TRACE=path``) and Chrome/Perfetto ``trace_event``
+  export, so a streamed fit's host-side overlap renders next to an
+  XProf device trace;
+* :mod:`.flight` — the always-on last-N-events post-mortem ring dumped
+  by the conftest watchdog and the preemption/fault paths.
+
+Everything importable from here is pure-stdlib host code (no jax) —
+safe in any thread including the prefetch worker; the jax compile
+listener lives in :mod:`.jaxhooks` and is installed lazily by
+:func:`enable` / :func:`install_jax_hooks`.
+"""
+
+from __future__ import annotations
+
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    metrics_snapshot,
+    registry,
+    reset_metrics,
+)
+from .spans import (  # noqa: F401
+    RING_ENV,
+    SCHEMA_VERSION,
+    TRACE_ENV,
+    Span,
+    SpanRecord,
+    adopt,
+    clear_spans,
+    current_span_id,
+    disable,
+    enable,
+    enabled,
+    event,
+    fmt_exc,
+    last_root,
+    open_span_paths,
+    span,
+    span_records,
+    span_tree,
+)
+from .export import (  # noqa: F401
+    export_perfetto,
+    perfetto_trace,
+    read_jsonl,
+)
+from . import flight  # noqa: F401
+from .flight import (  # noqa: F401
+    dump as flight_dump,
+    post_mortem as flight_post_mortem,
+    tail as flight_tail,
+)
+
+__all__ = [
+    # spans
+    "SCHEMA_VERSION", "TRACE_ENV", "RING_ENV",
+    "span", "event", "fmt_exc", "adopt", "current_span_id",
+    "enable", "disable", "enabled",
+    "open_span_paths", "last_root", "span_records", "span_tree",
+    "clear_spans", "Span", "SpanRecord",
+    # metrics
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "registry", "metrics_snapshot", "reset_metrics",
+    # export
+    "export_perfetto", "perfetto_trace", "read_jsonl",
+    # flight
+    "flight", "flight_dump", "flight_post_mortem", "flight_tail",
+    # lifecycle
+    "install_jax_hooks", "reset_all",
+]
+
+
+def install_jax_hooks() -> None:
+    """Arm the compile-event registry listener without enabling span
+    recording (bench processes that only want counters)."""
+    from . import jaxhooks
+
+    jaxhooks.install()
+
+
+def reset_all() -> None:
+    """Zero the whole spine: metrics registry, span rings + last root,
+    and the flight recorder.  ``diagnostics.reset()`` is the public
+    one-call form (it also clears the legacy reporters' residue)."""
+    reset_metrics()
+    clear_spans()
+    flight.clear()

@@ -1,0 +1,190 @@
+"""Exporters: schema-versioned JSONL event log + Chrome/Perfetto JSON.
+
+**JSONL** (``DASK_ML_TPU_TRACE=path`` or ``obs.enable(jsonl_path=...)``)
+streams every completed span/event as one JSON line the moment it
+completes, so a crashed process keeps everything up to the crash.  The
+first line is a header ``{"schema": "grafttrace", "version": 1, ...}``;
+:func:`read_jsonl` validates it on read-back and refuses a NEWER major
+version (an older one is fine — the schema only grows).
+
+**Perfetto** (:func:`perfetto_trace` / :func:`export_perfetto`) emits
+the Chrome ``trace_event`` format (``{"traceEvents": [...]}``, complete
+``"X"`` slices in microseconds, one ``tid`` lane per recorded thread
+with ``"M"`` thread-name metadata).  Load it in ui.perfetto.dev or
+``chrome://tracing`` NEXT TO an XProf device trace of the same fit: the
+host-side parse/stage/compute overlap renders against the device
+timeline, which is the whole point of stitching the prefetch worker's
+spans into the fit tree.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from . import spans as _spans
+
+__all__ = [
+    "JsonlSink",
+    "read_jsonl",
+    "perfetto_trace",
+    "export_perfetto",
+]
+
+
+class JsonlSink:
+    """Append-one-line-per-record writer (thread-safe: the prefetch
+    worker completes spans too).  Each line is flushed so a kill -9
+    loses at most the record being written."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._lock = threading.Lock()
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(self.path, "a", encoding="utf-8")
+        self._write_obj({
+            "schema": "grafttrace",
+            "version": _spans.SCHEMA_VERSION,
+            "pid": os.getpid(),
+            "unix_time": round(time.time(), 3),
+            # perf_counter epoch at header time: lets a reader map the
+            # records' monotonic stamps onto wall clock
+            "perf_counter": round(time.perf_counter(), 9),
+        })
+
+    def _write_obj(self, obj: dict) -> None:
+        line = json.dumps(obj, separators=(",", ":"), default=repr)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def write(self, rec) -> None:
+        try:
+            self._write_obj(rec.as_dict())
+        except ValueError:  # closed file on shutdown: quiet drop
+            pass
+        except OSError:
+            # disk full / filesystem gone read-only: the TRACED FIT
+            # must not die for its trace.  Warn once, drop the sink
+            # (ring + flight recording continue), keep training.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "grafttrace: JSONL sink %s failed; disabling file "
+                "streaming for this process", self.path, exc_info=True,
+            )
+            self.close()
+            from . import spans as _sp
+
+            if _sp._STATE.sink is self:
+                _sp._STATE.sink = None
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except Exception:  # pragma: no cover
+                pass
+
+
+def read_jsonl(path: str) -> tuple[dict, list[dict]]:
+    """``(first_header, records)`` from a grafttrace JSONL file; raises
+    ``ValueError`` on a malformed header or a newer schema version.
+
+    The sink appends, so a file may hold SEVERAL sessions (the
+    documented multi-process ``DASK_ML_TPU_TRACE=path`` usage), each
+    opening with its own header line.  Every header is validated and
+    excluded from ``records``; note each session's ``t0``/``t1`` stamps
+    are that process's monotonic clock — map them to wall time via its
+    own header's ``perf_counter``/``unix_time`` pair before comparing
+    across sessions.
+    """
+    with open(path, encoding="utf-8") as f:
+        lines = [ln for ln in (ln.strip() for ln in f) if ln]
+    if not lines:
+        raise ValueError(f"{path}: empty trace file")
+    first = json.loads(lines[0])
+    if first.get("schema") != "grafttrace":
+        raise ValueError(f"{path}: not a grafttrace JSONL (header {first!r})")
+    records = []
+    for i, ln in enumerate(lines):
+        try:
+            obj = json.loads(ln)
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                # a torn FINAL line is the expected kill-9/OOM artifact
+                # ("a crashed process keeps everything up to the
+                # crash"): drop it, keep the intact records
+                break
+            raise ValueError(
+                f"{path}: malformed record at line {i + 1}"
+            ) from None
+        if obj.get("schema") == "grafttrace":  # a session header
+            if int(obj.get("version", 0)) > _spans.SCHEMA_VERSION:
+                raise ValueError(
+                    f"{path}: schema version {obj['version']} is newer "
+                    f"than this reader ({_spans.SCHEMA_VERSION})"
+                )
+            continue
+        records.append(obj)
+    return first, records
+
+
+def _json_attrs(attrs: dict) -> dict:
+    return {k: (v if isinstance(v, (str, int, float, bool, type(None)))
+                else repr(v))
+            for k, v in attrs.items()}
+
+
+def perfetto_trace(records=None) -> dict:
+    """Build a Chrome ``trace_event`` dict from grafttrace records
+    (default: everything retained in the span rings).
+
+    Accepts either :class:`~.spans.SpanRecord` objects or the dict form
+    (a JSONL read-back), so a trace can be re-rendered offline from the
+    event log alone.
+    """
+    if records is None:
+        records = _spans.span_records()
+    dicts = [r if isinstance(r, dict) else r.as_dict() for r in records]
+    if not dicts:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    epoch = min(d["t0"] for d in dicts)
+    pid = os.getpid()
+    tids: dict[str, int] = {}
+    events = []
+    for d in dicts:
+        tid = tids.setdefault(d["thread"], len(tids) + 1)
+        args = _json_attrs(d.get("attrs", {}))
+        if d.get("error"):
+            args["error"] = d["error"]
+        common = {
+            "name": d["name"], "pid": pid, "tid": tid,
+            "ts": round((d["t0"] - epoch) * 1e6, 3), "args": args,
+        }
+        if d["kind"] == "event":
+            events.append({**common, "ph": "i", "s": "t"})
+        else:
+            events.append({
+                **common, "ph": "X",
+                "dur": round((d["t1"] - d["t0"]) * 1e6, 3),
+            })
+    meta = [
+        {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+         "args": {"name": thread}}
+        for thread, tid in tids.items()
+    ]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def export_perfetto(path: str | None = None, records=None) -> dict:
+    """:func:`perfetto_trace`, optionally written to ``path`` as JSON.
+    Returns the trace dict either way."""
+    trace = perfetto_trace(records)
+    if path:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(trace, f, separators=(",", ":"))
+    return trace

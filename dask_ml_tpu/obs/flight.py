@@ -1,0 +1,106 @@
+"""Flight recorder: the always-on post-mortem ring.
+
+A hung, preempted, or crashed fit used to leave a bare stack dump; this
+process-global bounded ring retains the last N **significant** events —
+faults, retries, checkpoint saves, preemption notices, sanitizer
+violations, stream boundaries — regardless of whether tracing is
+enabled, so the conftest watchdog, :func:`~dask_ml_tpu.resilience.
+preemption.check_preemption`, and any unhandled-fault handler can dump
+"what was happening, in order, just before this" instead of frames
+alone.
+
+Appends are one ``deque.append`` of a small tuple (thread-safe in
+CPython, no lock); the dump path takes its snapshot via ``list(ring)``.
+Wall-clock timestamps (``time.time``) are recorded alongside the
+monotonic ones so a post-mortem correlates with external logs.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+__all__ = [
+    "FLIGHT_SIZE",
+    "record",
+    "tail",
+    "clear",
+    "post_mortem",
+    "dump",
+]
+
+#: retained event count — sized for "the last few rounds of a fit", not
+#: a full trace (that is what the span rings / JSONL sink are for)
+FLIGHT_SIZE = 256
+
+_RING: collections.deque = collections.deque(maxlen=FLIGHT_SIZE)
+
+
+def record(kind: str, name: str, attrs: dict | None = None) -> None:
+    """Append one event.  Cheap enough for fault paths inside retry
+    loops; NOT meant for per-row hot loops."""
+    _RING.append((
+        time.time(), time.perf_counter(), kind, name,
+        threading.current_thread().name, dict(attrs) if attrs else {},
+    ))
+
+
+def tail(n: int | None = None) -> list[dict]:
+    """The most recent ``n`` events (default: all retained), oldest
+    first, as dicts."""
+    items = list(_RING)
+    if n is not None:
+        items = items[-n:]
+    return [
+        {"time": ts, "t": tp, "kind": kind, "name": name,
+         "thread": thread, "attrs": attrs}
+        for ts, tp, kind, name, thread, attrs in items
+    ]
+
+
+def clear() -> None:
+    _RING.clear()
+
+
+def post_mortem(reason: str = "", n: int = FLIGHT_SIZE) -> str:
+    """Formatted dump text: the flight tail plus every thread's
+    currently-open span path (which block/round was in flight), ready
+    for stderr or a logger."""
+    from . import spans as _spans
+
+    lines = [f"=== grafttrace flight recorder"
+             + (f" ({reason})" if reason else "") + " ==="]
+    open_paths = _spans.open_span_paths()
+    if open_paths:
+        lines.append("open spans:")
+        for thread, path in sorted(open_paths.items()):
+            lines.append(f"  {thread}: {path}")
+    else:
+        lines.append("open spans: (none)")
+    events = tail(n)
+    lines.append(f"last {len(events)} events:")
+    for e in events:
+        stamp = time.strftime("%H:%M:%S", time.localtime(e["time"]))
+        attrs = (" " + " ".join(f"{k}={v!r}" for k, v in
+                                sorted(e["attrs"].items()))
+                 if e["attrs"] else "")
+        lines.append(
+            f"  {stamp} [{e['thread']}] {e['kind']}:{e['name']}{attrs}"
+        )
+    if not events:
+        lines.append("  (empty)")
+    return "\n".join(lines)
+
+
+def dump(reason: str = "", file=None, n: int = 64) -> None:
+    """Print :func:`post_mortem` (default: stderr).  Never raises — this
+    runs on watchdog/preemption/fault paths where a secondary failure
+    must not mask the primary one."""
+    import sys
+
+    try:
+        print(post_mortem(reason, n=n),
+              file=file if file is not None else sys.stderr, flush=True)
+    except Exception:  # pragma: no cover - forensic path must not throw
+        pass

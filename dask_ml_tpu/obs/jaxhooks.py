@@ -1,0 +1,50 @@
+"""The one obs module that touches jax: compile-event publication.
+
+``jax.monitoring`` emits ``/jax/core/compile/backend_compile_duration``
+once per XLA backend compile (never on a cache hit) — the same signal
+graftsan's compile detector attributes per-region.  This listener is the
+UNGATED twin: it publishes ``compile.count`` / ``compile.duration_s``
+into the metrics registry on every compile, sanitizer or not, so
+``diagnostics.run_report()`` and the bench per-workload ``obs`` blocks
+can trend compilation alongside throughput in any process.
+
+Kept out of ``obs/__init__`` imports deliberately: the rest of the obs
+package is pure stdlib (provably host-only for graftlint's
+thread-dispatch/stage-purity reachability), and this module is imported
+lazily by :func:`~.spans.enable` and by graftsan's hook installer.
+``install()`` is idempotent and is the SINGLE registry publisher for
+compile events — graftsan's own listener only does per-region
+attribution, so double-installation can never double-count.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import metrics as _metrics
+
+__all__ = ["install", "COMPILE_EVENT"]
+
+#: jax.monitoring event key: one firing per XLA backend compile
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_LOCK = threading.Lock()
+_INSTALLED = False
+
+
+def install() -> None:
+    """Register the compile-event listener exactly once per process."""
+    global _INSTALLED
+    with _LOCK:
+        if _INSTALLED:
+            return
+        import jax.monitoring as _mon
+
+        def _on_event_duration(event: str, duration: float, **_kw) -> None:
+            if event == COMPILE_EVENT:
+                reg = _metrics.registry()
+                reg.counter("compile.count").inc()
+                reg.histogram("compile.duration_s").record(float(duration))
+
+        _mon.register_event_duration_secs_listener(_on_event_duration)
+        _INSTALLED = True

@@ -46,6 +46,7 @@ import queue
 import threading
 import time
 
+from .. import obs
 from .stats import PipelineStats
 
 __all__ = [
@@ -99,6 +100,26 @@ def resolve_depth(depth: int | None = None) -> int:
     return depth
 
 
+def _parse_and_stage(src, stage, stats: PipelineStats, blk: int):
+    """One pipeline step, identical on BOTH paths (inline depth-0 loop
+    and the worker thread): timed+spanned parse of the next item, then
+    timed+spanned staging.  Returns the staged item, or ``_DONE`` on
+    source exhaustion."""
+    t0 = time.perf_counter()
+    try:
+        with obs.span("pipeline.parse", block=blk):
+            item = next(src)
+    except StopIteration:
+        return _DONE
+    finally:
+        stats.parse_s += time.perf_counter() - t0
+    t0 = time.perf_counter()
+    with obs.span("pipeline.stage", block=blk):
+        staged = stage(item)
+    stats.transfer_s += time.perf_counter() - t0
+    return staged
+
+
 def _staged_iter(src, stage, depth: int, stats: PipelineStats):
     """Yield ``stage(item)`` for each item of ``src``, staged up to
     ``depth`` blocks ahead on a host worker thread.
@@ -109,22 +130,23 @@ def _staged_iter(src, stage, depth: int, stats: PipelineStats):
     worker promptly even when it is blocked on a full queue.
     """
     if depth <= 0:
+        blk = 0
         while True:
-            t0 = time.perf_counter()
-            try:
-                item = next(src)
-            except StopIteration:
+            staged = _parse_and_stage(src, stage, stats, blk)
+            if staged is _DONE:
                 return
-            finally:
-                stats.parse_s += time.perf_counter() - t0
-            t0 = time.perf_counter()
-            staged = stage(item)
-            stats.transfer_s += time.perf_counter() - t0
+            blk += 1
             yield staged
 
     # depth >= 1: bounded queue + one host-only staging worker
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
+    # thread stitching (design.md §11): the worker's parse/stage spans
+    # attach under the consumer's innermost open span (the
+    # pipeline.stream span) instead of becoming orphan roots — this
+    # generator body runs on the consumer thread at first next(), so
+    # the capture happens in the right place
+    trace_parent = obs.current_span_id()
 
     def _put(msg) -> bool:
         """Queue-put that stays responsive to consumer shutdown."""
@@ -138,20 +160,16 @@ def _staged_iter(src, stage, depth: int, stats: PipelineStats):
 
     def _work():
         try:
-            while not stop.is_set():
-                t0 = time.perf_counter()
-                try:
-                    item = next(src)
-                except StopIteration:
-                    _put(_DONE)
-                    return
-                finally:
-                    stats.parse_s += time.perf_counter() - t0
-                t0 = time.perf_counter()
-                staged = stage(item)
-                stats.transfer_s += time.perf_counter() - t0
-                if not _put(staged):
-                    return
+            with obs.adopt(trace_parent):
+                blk = 0
+                while not stop.is_set():
+                    staged = _parse_and_stage(src, stage, stats, blk)
+                    if staged is _DONE:
+                        _put(_DONE)
+                        return
+                    blk += 1
+                    if not _put(staged):
+                        return
         except BaseException as exc:  # propagate to the consumer
             _put(_WorkerError(exc))
 
@@ -200,16 +218,21 @@ def prefetch_blocks(blocks, *, depth: int | None = None,
     depth = resolve_depth(depth)
     stage = stage or _identity
     stats = PipelineStats(label=label, depth=depth, staged=stage is not _identity)
-    feed = _staged_iter(iter(blocks), stage, depth, stats)
-    try:
-        for staged in feed:
-            t0 = time.perf_counter()
-            yield staged
-            stats.compute_s += time.perf_counter() - t0
-            stats.blocks += 1
-    finally:
-        feed.close()  # stop the worker promptly on early exit
-        stats.finish()
+    # the stream span opens at first next() and closes when the
+    # generator finishes/closes — both on the consumer thread, so stack
+    # discipline holds; the worker's parse/stage spans stitch under it
+    with obs.span("pipeline.stream", label=label, depth=depth):
+        feed = _staged_iter(iter(blocks), stage, depth, stats)
+        try:
+            for staged in feed:
+                t0 = time.perf_counter()
+                with obs.span("pipeline.compute", block=stats.blocks):
+                    yield staged
+                stats.compute_s += time.perf_counter() - t0
+                stats.blocks += 1
+        finally:
+            feed.close()  # stop the worker promptly on early exit
+            stats.finish()
 
 
 def _supports_staging(model) -> bool:
@@ -291,19 +314,36 @@ def stream_partial_fit(model, blocks, *, depth: int | None = None,
 
         _consume = _raw_consume
 
-    feed = _staged_iter(iter(blocks), _stage, depth, stats)
-    done = 0
-    try:
-        for item in feed:
-            t0 = time.perf_counter()
-            _consume(item)
-            stats.compute_s += time.perf_counter() - t0
-            stats.blocks += 1
-            done += 1
-            del item  # release the staged buffers: bounded HBM = depth+1 blocks
-            if on_block is not None:
-                on_block(done, model)
-        return model
-    finally:
-        feed.close()
-        stats.finish()
+    # per-block device-step latency feeds the registry histogram the
+    # serving lane will ratchet SLOs on; re-fetched per block (the
+    # registry contract: a cached handle would silently record into an
+    # orphan after a concurrent diagnostics.reset())
+    with obs.span("pipeline.stream", label=label, depth=depth,
+                  staged=staged_proto,
+                  estimator=type(model).__name__):
+        feed = _staged_iter(iter(blocks), _stage, depth, stats)
+        done = 0
+        try:
+            for item in feed:
+                t0 = time.perf_counter()
+                with obs.span("pipeline.compute", block=done):
+                    _consume(item)
+                dt = time.perf_counter() - t0
+                stats.compute_s += dt
+                obs.registry().histogram("pipeline.block_s").record(dt)
+                stats.blocks += 1
+                done += 1
+                del item  # release the staged buffers: bounded HBM = depth+1 blocks
+                if on_block is not None:
+                    on_block(done, model)
+            return model
+        except BaseException as exc:
+            # flight-recorder breadcrumb at the failed position: a
+            # post-mortem of a dead stream shows WHICH block was in
+            # flight, not just the traceback
+            obs.event("pipeline.fault", label=label, block=done,
+                      error=obs.fmt_exc(exc))
+            raise
+        finally:
+            feed.close()
+            stats.finish()

@@ -10,18 +10,25 @@ disk→device bottleneck was asserted, never measured; this split is the
 measurement, surfaced through :func:`dask_ml_tpu.diagnostics.
 pipeline_report` and the ``streamed_loader_overlap`` bench workload.
 
-Books are process-global (like ``resilience.retry.FaultStats``): the
-LAST completed stream is kept whole for "what did that fit do", and a
-cumulative tally trends across a session.  Writers touch disjoint
-fields from at most two threads (the prefetch worker owns parse/
-transfer, the consumer owns compute/stall), so per-field accumulation
-needs no lock; the registry swap does take one.
+Books are process-global: the LAST completed stream is kept whole for
+"what did that fit do", and the session-cumulative tally lives in the
+grafttrace metrics registry (``pipeline.*`` histograms + counters,
+docs/design.md §11) — :func:`pipeline_report` is a VIEW over that
+registry, so the same numbers feed ``diagnostics.run_report()``, the
+bench per-workload ``obs`` blocks, and this report without double
+bookkeeping.  Writers touch disjoint fields from at most two threads
+(the prefetch worker owns parse/transfer, the consumer owns
+compute/stall), so per-field accumulation needs no lock; the
+per-stream registry publication at ``finish()`` does take the
+instruments' locks once.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+
+from ..obs.metrics import registry as _registry
 
 __all__ = [
     "PipelineStats",
@@ -78,48 +85,67 @@ class PipelineStats:
 
 _LOCK = threading.Lock()
 _LAST: PipelineStats | None = None
-_CUM = {
-    "streams": 0, "blocks": 0, "parse_s": 0.0, "transfer_s": 0.0,
-    "compute_s": 0.0, "stall_s": 0.0, "wall_s": 0.0,
-}
+
+_STAGES = ("parse_s", "transfer_s", "compute_s", "stall_s", "wall_s")
 
 
 def _record(stats: PipelineStats) -> None:
+    """Keep the last whole stream and publish it into the metrics
+    registry: one histogram observation per stage (so the registry
+    carries p50/p99 over streams, not just sums) plus stream/block
+    counters.  The slot swap AND the publication happen under one
+    _LOCK acquisition so a concurrent report can never pair stream N's
+    last-slot with stream N-1's cumulative books (the atomicity the
+    old single-store _CUM code had; instrument locks nest inside,
+    never the other way around)."""
     global _LAST
+    reg = _registry()
     with _LOCK:
         _LAST = stats
-        _CUM["streams"] += 1
-        _CUM["blocks"] += stats.blocks
-        for k in ("parse_s", "transfer_s", "compute_s", "stall_s", "wall_s"):
-            _CUM[k] += getattr(stats, k)
+        reg.counter("pipeline.streams").inc()
+        reg.counter("pipeline.blocks").inc(stats.blocks)
+        for k in _STAGES:
+            reg.histogram(f"pipeline.{k}").record(getattr(stats, k))
+        reg.histogram("pipeline.hidden_s").record(
+            stats.as_dict()["hidden_s"])
 
 
 def pipeline_report() -> dict:
     """Parse / transfer / compute split of the LAST streamed fit, plus
-    the session-cumulative tally.
+    the session-cumulative tally (a view over the metrics registry's
+    ``pipeline.*`` instruments).
 
     Returns ``{"streams": 0}`` when nothing has streamed yet; otherwise
     the last stream's :meth:`PipelineStats.as_dict` fields at the top
     level plus ``{"streams": n, "cumulative": {...}}``.
     """
-    with _LOCK:
-        if _LAST is None:
+    reg = _registry()
+    with _LOCK:  # one acquisition: slot + books read as _record wrote them
+        last = _LAST
+        # family() never CREATES instruments — a report on an empty
+        # process must not seed the registry with zero-valued counters
+        streams = reg.family("pipeline.streams").get("", 0)
+        if last is None or streams == 0:
+            # streams == 0 with a retained last stream means the
+            # registry was reset out from under us (obs.reset_all()):
+            # report empty rather than a phantom stream
             return {"streams": 0}
-        out = _LAST.as_dict()
-        out["streams"] = _CUM["streams"]
-        out["cumulative"] = {
-            k: (round(v, 6) if isinstance(v, float) else v)
-            for k, v in _CUM.items()
+        out = last.as_dict()
+        out["streams"] = streams
+        cum = {
+            "streams": streams,
+            "blocks": reg.counter("pipeline.blocks").value,
         }
-        return out
+        for k in _STAGES:
+            cum[k] = round(reg.histogram(f"pipeline.{k}").sum, 6)
+    out["cumulative"] = cum
+    return out
 
 
 def reset_pipeline_stats() -> None:
-    """Zero the books (bench / test isolation)."""
+    """Zero the books (bench / test isolation): the last-stream slot
+    and the registry's ``pipeline.*`` family."""
     global _LAST
     with _LOCK:
         _LAST = None
-        _CUM.update(
-            streams=0, blocks=0, parse_s=0.0, transfer_s=0.0,
-            compute_s=0.0, stall_s=0.0, wall_s=0.0,
-        )
+    _registry().reset(prefix="pipeline.")

@@ -142,6 +142,10 @@ class FitCheckpoint:
         )
         self._last_save_t = time.monotonic()
         self._last_save_iter = int(iteration)
+        from ..checkpoint import _note_save
+
+        _note_save("fit", self.path, iteration=int(iteration),
+                   cls=type(estimator).__name__)
 
     def load_if_matches(self, estimator):
         """``(iteration, state)`` from the snapshot, or ``None`` if absent

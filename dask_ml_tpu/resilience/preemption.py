@@ -122,6 +122,16 @@ class PreemptionWatcher:
             signal.raise_signal(signum)
             return
         self._requested.set()
+        # flight-recorder breadcrumb, recorded DIRECTLY (one lock-free
+        # deque append): obs.event() would also write the span ring and
+        # the JSONL sink, whose non-reentrant locks this thread may
+        # already hold mid-record when the signal lands — a handler
+        # blocking on its own thread's lock would deadlock the very
+        # checkpoint-and-stop this watcher exists to perform
+        from ..obs import flight as _obs_flight
+
+        _obs_flight.record("event", "preemption.signal",
+                           {"signum": int(signum)})
         logger.warning(
             "received signal %d: will checkpoint and stop at the next "
             "iteration boundary", signum,
@@ -183,4 +193,14 @@ def check_preemption(ckpt, estimator, state: dict, iteration: int) -> None:
         if getattr(ckpt, "_last_save_iter", None) != int(iteration):
             ckpt.save(estimator, state, iteration)
         path = ckpt.path
+    # a preempted fit leaves a post-mortem: the boundary event plus the
+    # flight-recorder tail (what was in flight when the signal landed)
+    from ..obs import event as _obs_event, flight as _obs_flight
+
+    _obs_event("preemption.stop", iteration=int(iteration),
+               checkpoint=path)
+    logger.warning(
+        "preemption stop at iteration %d\n%s", iteration,
+        _obs_flight.post_mortem("preemption", n=16),
+    )
     raise TrainingPreempted(iteration, path)

@@ -17,6 +17,18 @@ inline per subsystem:
 * :class:`FaultStats` — thread-safe counters (faults seen, retries
   scheduled, failures propagated) keyed by tag, surfaced through
   ``dask_ml_tpu.diagnostics`` so recovery is observable, never silent.
+
+Observability spine (docs/design.md §11): the process-global stats are
+BACKED BY the grafttrace metrics registry (``resilience.fault`` /
+``resilience.retry`` / ``resilience.failure`` counters, tagged) —
+``fault_stats()`` keeps its shape as a view over those counters, so
+retries trend in ``diagnostics.run_report()`` and the bench ``obs``
+blocks from the same store.  Every scheduled retry and every propagated
+failure additionally emits an ``obs.event`` (onto the owning span when
+tracing is on, and into the always-on flight recorder regardless), and
+a propagated failure logs the flight-recorder tail — an unhandled fault
+leaves a post-mortem, not a bare traceback.  Caller-private
+``FaultStats()`` books stay private (no registry traffic).
 """
 
 from __future__ import annotations
@@ -26,6 +38,11 @@ import random
 import threading
 import time
 from collections import Counter
+
+from ..obs import event as _obs_event
+from ..obs import flight as _obs_flight
+from ..obs import fmt_exc as _fmt_exc
+from ..obs.metrics import registry as _obs_registry
 
 logger = logging.getLogger(__name__)
 
@@ -86,44 +103,79 @@ class FaultStats:
 
     ``faults == retries + failures`` holds per tag for :func:`retry`
     traffic, which is the invariant tests assert against.
+
+    With ``registry=`` (how the process-global instance is built) the
+    counters live in the grafttrace metrics registry under
+    ``resilience.<kind>`` tagged names and the ``faults``/``retries``/
+    ``failures`` attributes are read-only views; without it (the
+    default) the books are private in-object Counters, exactly the old
+    behavior for callers keeping separate books.
     """
 
-    def __init__(self):
+    _NAMES = {"faults": "resilience.fault", "retries": "resilience.retry",
+              "failures": "resilience.failure"}
+
+    def __init__(self, registry=None):
         self._lock = threading.Lock()
-        self.faults: Counter = Counter()
-        self.retries: Counter = Counter()
-        self.failures: Counter = Counter()
+        self._reg = registry
+        self._faults: Counter = Counter()
+        self._retries: Counter = Counter()
+        self._failures: Counter = Counter()
+
+    def _counter_view(self, kind: str) -> Counter:
+        if self._reg is not None:
+            return Counter(self._reg.family(self._NAMES[kind]))
+        with self._lock:
+            return Counter(getattr(self, f"_{kind}"))
+
+    @property
+    def faults(self) -> Counter:
+        return self._counter_view("faults")
+
+    @property
+    def retries(self) -> Counter:
+        return self._counter_view("retries")
+
+    @property
+    def failures(self) -> Counter:
+        return self._counter_view("failures")
+
+    def _record(self, kind: str, tag: str) -> None:
+        if self._reg is not None:
+            self._reg.counter(self._NAMES[kind], tag).inc()
+            return
+        with self._lock:
+            getattr(self, f"_{kind}")[tag] += 1
 
     def record_fault(self, tag: str) -> None:
-        with self._lock:
-            self.faults[tag] += 1
+        self._record("faults", tag)
 
     def record_retry(self, tag: str) -> None:
-        with self._lock:
-            self.retries[tag] += 1
+        self._record("retries", tag)
 
     def record_failure(self, tag: str) -> None:
-        with self._lock:
-            self.failures[tag] += 1
+        self._record("failures", tag)
 
     def snapshot(self) -> dict:
         """Plain-dict copy (stable for logging / assertions)."""
-        with self._lock:
-            return {
-                "faults": dict(self.faults),
-                "retries": dict(self.retries),
-                "failures": dict(self.failures),
-            }
+        return {
+            "faults": dict(self.faults),
+            "retries": dict(self.retries),
+            "failures": dict(self.failures),
+        }
 
     def total(self, kind: str = "faults") -> int:
-        with self._lock:
-            return sum(getattr(self, kind).values())
+        return sum(self._counter_view(kind).values())
 
     def reset(self) -> None:
+        if self._reg is not None:
+            for name in self._NAMES.values():
+                self._reg.reset(prefix=name)
+            return
         with self._lock:
-            self.faults.clear()
-            self.retries.clear()
-            self.failures.clear()
+            self._faults.clear()
+            self._retries.clear()
+            self._failures.clear()
 
     def __repr__(self):
         s = self.snapshot()
@@ -133,7 +185,9 @@ class FaultStats:
 
 # The process-global stats object: every in-repo retry site records here
 # (callers may pass their own FaultStats to keep private books instead).
-_GLOBAL_STATS = FaultStats()
+# Registry-backed: the counters ARE the metrics-registry resilience.*
+# family, so fault_stats() and run_report() can never disagree.
+_GLOBAL_STATS = FaultStats(registry=_obs_registry())
 
 
 def fault_stats() -> FaultStats:
@@ -144,6 +198,19 @@ def fault_stats() -> FaultStats:
 
 def reset_fault_stats() -> None:
     _GLOBAL_STATS.reset()
+
+
+def _note_failure(tag: str, attempt: int, exc: BaseException) -> None:
+    """A fault is propagating (budget exhausted / deadline dead /
+    persistent): record the event and log the flight-recorder tail so
+    the unhandled-fault path leaves an in-order post-mortem, not just a
+    traceback."""
+    _obs_event("resilience.failure", tag=tag, attempt=attempt,
+               error=_fmt_exc(exc))
+    logger.warning(
+        "%s: fault is propagating after %d attempt(s)\n%s",
+        tag, attempt + 1, _obs_flight.post_mortem(f"failure: {tag}", n=8),
+    )
 
 
 def retry(fn, *args, retries: int = 3, backoff: float = 0.1,
@@ -192,13 +259,14 @@ def retry(fn, *args, retries: int = 3, backoff: float = 0.1,
             deadline.check(tag)
         try:
             return fn(*args, **kwargs)
-        except DeadlineExceeded:
+        except DeadlineExceeded as exc:
             # a deadline blown INSIDE fn is a budget exhaustion, not a
             # transient fault — never absorbed, even with Exception in
             # retryable.  Still counted as a fault so the books keep
             # faults == retries + failures.
             stats.record_fault(tag)
             stats.record_failure(tag)
+            _note_failure(tag, attempt, exc)
             raise
         except retryable as exc:
             stats.record_fault(tag)
@@ -209,6 +277,7 @@ def retry(fn, *args, retries: int = 3, backoff: float = 0.1,
             )
             if out_of_budget:
                 stats.record_failure(tag)
+                _note_failure(tag, attempt, exc)
                 raise
             delay = min(backoff * (factor ** attempt), max_backoff)
             delay *= 1.0 + jitter * random.random()
@@ -218,8 +287,11 @@ def retry(fn, *args, retries: int = 3, backoff: float = 0.1,
                 # dead budget (and keep the books exact: every fault is
                 # either a retry or a failure, never both, never neither)
                 stats.record_failure(tag)
+                _note_failure(tag, attempt, exc)
                 raise
             stats.record_retry(tag)
+            _obs_event("resilience.retry", tag=tag, attempt=attempt,
+                       error=_fmt_exc(exc))
             logger.warning(
                 "%s: attempt %d/%d failed (%s: %s); retrying in %.3gs",
                 tag, attempt + 1, retries + 1, type(exc).__name__, exc,

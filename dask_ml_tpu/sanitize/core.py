@@ -62,6 +62,9 @@ from collections import defaultdict
 
 import jax
 
+from ..obs import event as _obs_event
+from ..obs.metrics import registry as _metrics_registry
+
 __all__ = [
     "SANITIZE_ENV",
     "BASELINE_ENV",
@@ -214,6 +217,16 @@ def _install_hooks() -> None:
     with _LOCK:
         if _HOOKS_INSTALLED:
             return
+
+        # grafttrace: the UNGATED compile counters (registry
+        # compile.count / compile.duration_s) ride the obs.jaxhooks
+        # listener — installed here too so any sanitized process trends
+        # compiles even if tracing was never enabled.  That listener is
+        # the single registry publisher; the one below only does
+        # per-region sanitizer attribution, so there is no double count.
+        from ..obs import jaxhooks as _jaxhooks
+
+        _jaxhooks.install()
 
         # 1. compile: jax.monitoring duration listener (fires on the
         # compiling thread, once per backend compile, never on cache hit)
@@ -435,6 +448,7 @@ class Sanitizer:
             if steady:
                 c["steady_dispatches"] += 1
             self.dispatch_threads.add(thread.name)
+        _metrics_registry().counter("dispatch.count").inc()
         if (threading.get_ident() != self._primary_ident
                 and thread.name not in self.blessed_threads):
             self._violation(
@@ -453,6 +467,7 @@ class Sanitizer:
             c["d2h_syncs"] += 1
             if self.phase == "steady":
                 c["steady_d2h_syncs"] += 1
+        _metrics_registry().counter("d2h.count").inc()
 
     def _record_allow(self, site_id: str) -> None:
         with self._lock:
@@ -465,6 +480,12 @@ class Sanitizer:
                 "kind": kind, "region": reg, "thread": thread,
                 "detail": detail,
             })
+        # span-tree + flight-recorder breadcrumb: a violation shows up
+        # in the post-mortem ordered against the blocks/retries around
+        # it, not just in the end-of-scope report
+        _metrics_registry().counter("sanitize.violation", kind).inc()
+        _obs_event("sanitize.violation", kind=kind, region=reg,
+                   thread=thread)
 
     # -- results ---------------------------------------------------------
     def report(self) -> dict:

@@ -64,16 +64,45 @@ faulthandler.enable()
 
 _TEST_TIMEOUT_S = float(os.environ.get("DASK_ML_TPU_TEST_TIMEOUT_S", "300"))
 
+# grafttrace armed for the whole suite: span rings + flight recorder
+# cost is within the tier-1 noise floor (the obs overhead A/B test
+# gates it at <=3% on the streamed path), and it buys the watchdog dump
+# below the "which block/round was in flight" context — faulthandler
+# alone shows frames, not fit structure.
+from dask_ml_tpu import obs as _obs  # noqa: E402
+
+_obs.enable()
+
+
+def _watchdog_dump(nodeid: str) -> None:
+    """Flight-recorder half of the hang dump (runs on a plain timer
+    thread: faulthandler's C-level dumper cannot run Python, so the
+    span-path/flight context needs its own timer)."""
+    _obs.flight_dump(
+        reason=f"test watchdog: {nodeid} exceeded {_TEST_TIMEOUT_S:g}s",
+        n=32,
+    )
+
 
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_protocol(item):
+    import threading as _threading
+
+    timer = None
     if _TEST_TIMEOUT_S > 0:
         faulthandler.dump_traceback_later(_TEST_TIMEOUT_S, exit=False)
+        timer = _threading.Timer(
+            _TEST_TIMEOUT_S, _watchdog_dump, args=(item.nodeid,)
+        )
+        timer.daemon = True
+        timer.start()
     try:
         yield
     finally:
         if _TEST_TIMEOUT_S > 0:
             faulthandler.cancel_dump_traceback_later()
+        if timer is not None:
+            timer.cancel()
 
 
 @pytest.fixture(scope="session")

@@ -23,7 +23,7 @@ from sklearn.base import BaseEstimator
 
 from dask_ml_tpu.model_selection import GridSearchCV, IncrementalSearchCV
 from dask_ml_tpu.resilience import FaultInjected, FaultPlan, fault_plan, maybe_fault
-from dask_ml_tpu.resilience.retry import fault_stats, reset_fault_stats
+from dask_ml_tpu.resilience.retry import fault_stats
 
 pytestmark = pytest.mark.faults
 
@@ -74,9 +74,13 @@ def xy(rng):
 
 @pytest.fixture(autouse=True)
 def _clean_fault_stats():
-    reset_fault_stats()
+    # diagnostics.reset() is the one-call isolation idiom: fault stats,
+    # pipeline stats, metrics registry, span rings, flight recorder
+    from dask_ml_tpu import diagnostics
+
+    diagnostics.reset()
     yield
-    reset_fault_stats()
+    diagnostics.reset()
 
 
 class TestIncrementalFaultRecovery:

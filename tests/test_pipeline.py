@@ -316,7 +316,7 @@ class TestStageSplit:
         from dask_ml_tpu.linear_model import SGDClassifier
 
         X, y = xy_blocks
-        diagnostics.reset_pipeline_stats()
+        diagnostics.reset()  # one-call isolation (pipeline + registry)
         clf = SGDClassifier(random_state=0)
         _partial.fit(clf, X, y, chunk_size=256, prefetch_depth=2,
                      classes=[0, 1])

@@ -27,7 +27,6 @@ from dask_ml_tpu.resilience.retry import (
     DeadlineExceeded,
     FaultStats,
     fault_stats,
-    reset_fault_stats,
     retry,
 )
 
@@ -36,9 +35,13 @@ pytestmark = pytest.mark.faults
 
 @pytest.fixture(autouse=True)
 def _clean_fault_stats():
-    reset_fault_stats()
+    # diagnostics.reset() is the one-call isolation idiom: fault stats,
+    # pipeline stats, metrics registry, span rings, flight recorder
+    from dask_ml_tpu import diagnostics
+
+    diagnostics.reset()
     yield
-    reset_fault_stats()
+    diagnostics.reset()
 
 
 @pytest.fixture

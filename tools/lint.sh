@@ -67,6 +67,11 @@ if [[ "$SANITIZE" == 1 ]]; then
   echo "== graftsan (runtime sanitizer smoke suite vs $SAN_BASELINE) =="
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m dask_ml_tpu.sanitize --baseline "$SAN_BASELINE"
+  echo "== grafttrace (obs smoke: tests/test_obs.py) =="
+  # the observability spine's own suite rides the runtime smoke path:
+  # span stitching, exporters, the overhead ratchet (<=3% traced wall)
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_obs.py -q -p no:cacheprovider
 fi
 
 echo "== compileall =="
