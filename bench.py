@@ -51,7 +51,7 @@ _RESULT = {
 # so a crashed/wedged run's numbers survive into the next run's JSON.
 _KNOWN_SECTIONS = {
     "lloyd", "admm", "tsqr", "scatter", "pairwise", "streamed", "packed",
-    "csv",
+    "csv", "recompile",
 }
 ONLY_SECTIONS = {
     s.strip()
@@ -1573,6 +1573,104 @@ def main():
         pass
     except Exception:
         extra["streamed_error"] = traceback.format_exc(limit=3)
+
+    # --- recompile_tax: heterogeneous-shape stream, bucketing off vs on
+    # (the programs/ cache A/B, design.md §12).  A ragged block-length
+    # sequence streams through SGD partial_fit twice: DASK_ML_TPU_BUCKET
+    # =off mints one XLA program per distinct length; =auto resolves
+    # every block to a few warm bucketed programs (+ compile-ahead on
+    # the blessed thread).  Verdict currency: compile.count registry
+    # delta and wall, with the trained coefficients REQUIRED identical
+    # (mask-weighted padding is exact) — fewer compiles with a different
+    # model would be a correctness bug, not a win. ---
+    try:
+        if _want("recompile") and time.time() - _START_TS < _BUDGET_S * 0.93:
+            from dask_ml_tpu import programs as _programs
+            from dask_ml_tpu.linear_model import SGDClassifier as _RTClf
+            from dask_ml_tpu.pipeline import (
+                stream_partial_fit as _rt_stream,
+            )
+
+            nRT, dRT = (8192, 32) if on_tpu else (1536, 12)
+            # ragged, all-distinct lengths, none equal to a bucket rung
+            # (so the off arm cannot accidentally pre-warm the on arm)
+            sizes = sorted({
+                max(3, nRT - 13), nRT // 2 + 7, nRT // 3 + 11,
+                nRT // 4 + 3, nRT // 5 + 17, nRT // 6 + 5,
+                nRT // 7 + 9, nRT // 8 + 1,
+            })
+
+            def _rt_blocks():
+                r = np.random.RandomState(11)
+                for n in sizes:
+                    X = r.normal(size=(n, dRT)).astype(np.float32)
+                    yield X, (X[:, 0] > 0).astype(np.float32)
+
+            _rt_env = os.environ.get("DASK_ML_TPU_BUCKET")
+
+            def _rt_run(policy):
+                os.environ["DASK_ML_TPU_BUCKET"] = policy
+                try:
+                    _programs.reset_counters()
+                    reg = _obs.registry()
+                    c0 = reg.counter("compile.count").value
+                    s0 = reg.histogram("compile.duration_s").sum
+                    clf = _RTClf(random_state=0)
+                    t0 = time.perf_counter()
+                    _rt_stream(clf, _rt_blocks(),
+                               fit_kwargs={"classes": [0.0, 1.0]},
+                               label=f"recompile_tax_{policy}")
+                    float(clf._loss_)  # sync the donated chain
+                    _programs.drain_ahead()
+                    wall = time.perf_counter() - t0
+                    tot = _programs.report()["totals"]
+                    return {
+                        "wall_s": round(wall, 3),
+                        "compiles": reg.counter("compile.count").value - c0,
+                        "compile_s": round(
+                            reg.histogram("compile.duration_s").sum - s0,
+                            3),
+                        "warm_hit_rate": round(
+                            tot["hits"]
+                            / max(tot["hits"] + tot["misses"], 1), 3),
+                        "ahead_hits": tot["ahead_hits"],
+                        "compile_s_hidden": tot["saved_s"],
+                    }, np.asarray(clf.coef_)
+                finally:
+                    if _rt_env is None:
+                        os.environ.pop("DASK_ML_TPU_BUCKET", None)
+                    else:
+                        os.environ["DASK_ML_TPU_BUCKET"] = _rt_env
+
+            off, coef_off = _rt_run("off")
+            on, coef_on = _rt_run("auto")
+            # model-equality contract: padding rows are exact zeros in
+            # every masked reduction, but a different padded SHAPE can
+            # re-tile XLA's reduction tree (SIMD lanes vs remainder
+            # loop), regrouping the SAME real addends — so the bound is
+            # reassociation noise (measured ~5e-9 relative on this
+            # image, < 1 ulp at coefficient scale), not bitwise
+            # equality across shapes.  Same-shape streams stay
+            # bit-exact (tests/test_programs.py pins both halves).
+            scale = float(max(np.abs(coef_off).max(), 1e-30))
+            max_rel = float(np.abs(coef_off - coef_on).max() / scale)
+            _record({
+                "workload": f"recompile_tax_{len(sizes)}blk_x{dRT}",
+                "blocks": len(sizes),
+                "off": off,
+                "on": on,
+                "speedup": round(
+                    off["wall_s"] / max(on["wall_s"], 1e-9), 3),
+                "compiles_saved": off["compiles"] - on["compiles"],
+                # the acceptance contract: strictly fewer compiles AND
+                # the same model, or the bucketing default is wrong
+                "fewer_compiles": on["compiles"] < off["compiles"],
+                "bit_identical": bool(np.array_equal(coef_off, coef_on)),
+                "max_rel_diff": max_rel,
+                "results_match": bool(max_rel < 1e-6),
+            })
+    except Exception:
+        extra["recompile_tax_error"] = traceback.format_exc(limit=3)
 
     # --- packed OvR vs sequential: K one-vs-rest solves as ONE vmapped
     # program (the round-3 dispatch win on the GLM flagship) ---

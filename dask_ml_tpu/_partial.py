@@ -127,7 +127,19 @@ def predict(model, x, *, chunk_size: int = 100_000,
     ``x`` may be an iterator of blocks (out-of-core inference); array
     input is sliced as before.  The prefetch thread pulls/parses block
     k+1 while the model predicts block k.
+
+    Device-native models get the shape-bucketing policy on the way in
+    (``DASK_ML_TPU_BUCKET``, design.md §12): ragged tail blocks pad up
+    to a bucket on the prefetch worker and the padded predictions are
+    sliced back, so a variable-chunk inference stream resolves to the
+    same few compiled shapes a training stream does.  Row-wise
+    inference makes the pad exact — padding rows never influence real
+    rows' outputs.
     """
+    import jax.numpy as jnp
+
+    from . import programs
+    from .base import TPUEstimator
     from .pipeline import prefetch_blocks
 
     if hasattr(x, "__next__"):
@@ -135,10 +147,26 @@ def predict(model, x, *, chunk_size: int = 100_000,
     else:
         xv = unshard(x) if isinstance(x, ShardedRows) else np.asarray(x)
         blocks = (xv[lo:hi] for lo, hi in _row_chunks(xv.shape[0], chunk_size))
+
+    policy = programs.resolve_policy()
+    bucketed = policy.kind != "off" and isinstance(model, TPUEstimator)
+
+    def _stage(xb):
+        """Host-side bucket pad (prefetch worker): returns (block, n)
+        where n is the real row count to slice back, or (block, None)
+        for blocks the pad must not touch (device-resident input)."""
+        if not bucketed or isinstance(xb, (ShardedRows, jnp.ndarray)):
+            return xb, None
+        xa = np.asarray(xb)
+        if xa.ndim != 2:
+            return xb, None
+        padded, _, _ = programs.pad_block(xa, policy=policy)
+        return padded, (None if padded is xa else xa.shape[0])
+
     with obs.span("predict", estimator=type(model).__name__):
-        outs = [
-            np.asarray(model.predict(xb))
-            for xb in prefetch_blocks(blocks, depth=prefetch_depth,
-                                      label="partial_predict")
-        ]
+        outs = []
+        for xb, n in prefetch_blocks(blocks, depth=prefetch_depth,
+                                     stage=_stage, label="partial_predict"):
+            p = np.asarray(model.predict(xb))
+            outs.append(p if n is None else p[:n])
     return np.concatenate(outs)

@@ -10,6 +10,7 @@ and ``analysis/dataflow.py``.
 from . import checkpoints  # noqa: F401
 from . import collectives  # noqa: F401
 from . import host_sync  # noqa: F401
+from . import jit_bypass  # noqa: F401
 from . import jit_hazards  # noqa: F401
 from . import knobs  # noqa: F401
 from . import prng  # noqa: F401

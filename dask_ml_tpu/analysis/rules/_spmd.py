@@ -105,9 +105,12 @@ DISPATCH_METHOD_SUFFIXES = frozenset({
 # puts and host-side metadata queries, NOT programs.  Everything else
 # under jax is treated as compiling/dispatching (design.md §8: "staging
 # is transfers only — jnp.asarray of host numpy is a put, not a
-# program").
+# program").  ShapeDtypeStruct/canonicalize_dtype are pure-metadata
+# constructors the compile-ahead warm hooks build their abstract
+# signatures with (programs/cache.py) — no device interaction at all.
 TRANSFER_SAFE_JAX_SUFFIXES = frozenset({
     "asarray", "device_put", "issubdtype", "result_type", "dtype",
+    "ShapeDtypeStruct", "canonicalize_dtype",
 })
 
 # callables that FETCH device values to host (a sync, and on a worker

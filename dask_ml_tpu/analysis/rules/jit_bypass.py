@@ -1,0 +1,160 @@
+"""jit-outside-cache: streamed-step ``jax.jit`` wraps bypass the cache.
+
+The ROADMAP ``[compile]`` lane built ONE central compiled-program cache
+(``dask_ml_tpu/programs/``): a step program routed through
+``programs.cached_program`` gets shape-bucket warm hits, compile-ahead
+on the blessed thread, hit/miss books in
+``diagnostics.program_report()``, and the persistent XLA cache.  A bare
+``jax.jit`` wrap gets none of that — its compiles are invisible to the
+books and stall whichever thread trips them.
+
+Scope: the STREAMING fit/predict surfaces, where ragged block shapes
+recur and the recompile tax actually accrues — any jit-wrapped function
+reachable (same module, through helpers and ``self.`` methods) from a
+``partial_fit`` / ``_pf_stage`` / ``_pf_consume`` / ``_step_block``
+method.  Whole-array ``fit`` solvers compile once per dataset shape and
+sit outside this rule (``recompile-risk`` still covers their retrace
+hazards); migrate them opportunistically.  The one sanctioned
+suppression is the cache's own internal wrap in ``programs/cache.py`` —
+the single place a raw ``jax.jit`` must exist.
+
+Recognized wrap forms (the package's idioms): ``@jax.jit`` /
+``@partial(jax.jit, ...)`` decorators and the
+``name = partial(jax.jit, ...)(fn)`` / ``name = jax.jit(fn, ...)``
+assignment, with ``jax.jit`` resolved through the module import table
+when the whole-program index is available (``from jax import jit``
+included; a foreign ``jit`` — numba's, say — never matches).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule, dotted_name, register
+
+#: the streaming-protocol roots: methods whose transitive (same-module)
+#: callees must route device step programs through the cache.
+STREAM_ROOTS = frozenset({
+    "partial_fit", "_pf_stage", "_pf_consume", "_step_block",
+})
+
+
+def _is_jax_jit(ctx: Context, node: ast.AST) -> bool:
+    name = dotted_name(node)
+    if not name or name.rsplit(".", 1)[-1] != "jit":
+        return False
+    if ctx.project is not None:
+        name = ctx.project.module_for(ctx).expand_alias(name)
+    return name == "jax.jit"
+
+
+def _jit_wraps(ctx: Context):
+    """Yield ``(wrapped_name, report_node)`` for every jit wrap in the
+    module: decorated defs (reported at the decorator) and
+    wrap-at-assignment names (reported at the wrapping call)."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if _is_jax_jit(ctx, target):
+                    yield node.name, dec
+                elif isinstance(dec, ast.Call) and any(
+                        _is_jax_jit(ctx, a) for a in dec.args):
+                    yield node.name, dec  # @partial(jax.jit, ...)
+        elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                        ast.Call):
+            call = node.value
+            wraps = _is_jax_jit(ctx, call.func)
+            if not wraps and isinstance(call.func, ast.Call):
+                # partial(jax.jit, ...)(fn)
+                wraps = any(_is_jax_jit(ctx, a) for a in call.func.args)
+            if not wraps:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    yield t.id, call
+                elif isinstance(t, ast.Attribute):
+                    # self._jitted = jax.jit(...) — the cache's own
+                    # internal idiom; matched by attr name so the
+                    # in-programs scope (and any self.<attr>() caller
+                    # in a stream closure) sees it
+                    yield t.attr, call
+
+
+def _called_names(fn: ast.AST):
+    """Bare names and ``self.<attr>`` methods invoked in ``fn``'s body."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name):
+            yield func.id
+        elif isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                yield func.attr
+
+
+def _stream_closure(ctx: Context) -> set:
+    """Names transitively callable from any STREAM_ROOTS method in this
+    module (same-module resolution: module defs by name, class methods
+    via ``self.``)."""
+    defs: dict = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    work = [n for n in defs if n in STREAM_ROOTS]
+    seen: set = set()
+    while work:
+        name = work.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for fn in defs.get(name, ()):
+            for callee in _called_names(fn):
+                if callee not in seen:
+                    work.append(callee)
+    return seen
+
+
+@register
+class JitOutsideCacheRule(Rule):
+    id = "jit-outside-cache"
+    summary = (
+        "direct jax.jit wrap on a streamed fit/predict step bypasses "
+        "the central program cache (dask_ml_tpu/programs/): no "
+        "shape-bucket warm hits, no compile-ahead, invisible to "
+        "diagnostics.program_report()"
+    )
+
+    def run(self, ctx: Context):
+        wraps = list(_jit_wraps(ctx))
+        if not wraps:
+            return
+        # inside the cache package itself EVERY raw jit is a bypass by
+        # definition (the cache must eat its own dogfood) — that is the
+        # scope where the one sanctioned suppression lives
+        path = ctx.path.replace("\\", "/")
+        in_programs = "/programs/" in path or \
+            path.startswith("programs/")
+        closure = None if in_programs else _stream_closure(ctx)
+        if not in_programs and not closure:
+            return
+        seen: set = set()
+        for name, node in wraps:
+            if not in_programs and name not in closure:
+                continue
+            key = (node.lineno, node.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield ctx.finding(
+                self.id, node,
+                f"{name}() is jit-wrapped directly but runs on a "
+                f"streaming fit path (reachable from "
+                f"partial_fit/_pf_consume/_step_block): route it "
+                f"through dask_ml_tpu.programs.cached_program(name=...) "
+                f"so shape bucketing, the compile-ahead worker, and the "
+                f"program_report() hit/miss books see it (the cache's "
+                f"internal wrap in programs/cache.py is the one "
+                f"sanctioned direct use)",
+            )

@@ -51,8 +51,7 @@ _EPOCH_SYNC = _san.AllowSite(
 )
 
 
-@jax.jit
-def _mbk_step(centers, counts, xb, mask):
+def _mbk_step_fn(centers, counts, xb, mask):
     """One Sculley update on one batch: returns (centers, counts, inertia).
 
     Per-center learning rate 1/n_c (cumulative weight mass), applied as
@@ -100,6 +99,16 @@ def _mbk_step(centers, counts, xb, mask):
     bmass_d = bmass.astype(xb.dtype)
     new_centers = centers + (bsum - bmass_d[:, None] * centers) * inv[:, None]
     return new_centers, jnp.stack([hi, lo]), inertia
+
+
+# Streamed-step entry through the central program cache (design.md §12):
+# ragged stream blocks bucket to warm executables and `_pf_stage` can
+# compile the next bucket ahead.  Inside `_mbk_epoch`'s scan body the
+# tracer operands route through the cache's jitted twin (inlined), so
+# the fused epoch program is unchanged.
+from .. import programs as _programs  # noqa: E402
+
+_mbk_step = _programs.cached_program(_mbk_step_fn, name="minibatch_kmeans.step")
 
 
 from functools import partial as _fpartial  # noqa: E402
@@ -270,9 +279,39 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
         Xh = np.asarray(X, dtype=np.float32)
         n = Xh.shape[0]
         Xh, _, mask = _bucket_pad(Xh)
+        self._warm_step(Xh.shape)
         return ShardedRows(
             data=jnp.asarray(Xh), mask=jnp.asarray(mask), n_samples=n
         )
+
+    def _warm_step(self, xshape) -> bool:
+        """Compile-ahead hook (programs.ahead): pre-build the Sculley
+        step for a bucketed block of ``xshape`` on the blessed compile
+        thread.  Host-only (shape structs + a queue put) — safe from
+        the prefetch worker."""
+        if not _programs.compile_ahead_enabled():
+            return False
+        b, d = int(xshape[0]), int(xshape[1])
+        k = int(self.n_clusters)
+        # per-block memo, same rationale as _BaseSGD._warm_step
+        key = (b, d, k)
+        if getattr(self, "_warm_memo", None) == key:
+            return False
+        self._warm_memo = key
+        f32 = jnp.float32
+        sds = jax.ShapeDtypeStruct
+        return _mbk_step.warm(
+            (sds((k, d), f32), sds((2, k), f32), sds((b, d), f32),
+             sds((b,), f32)))
+
+    def _pf_warm(self, shape, classes=None) -> bool:
+        """Shape-based warm twin (the adaptive search calls this before
+        a unit's partial_fit burst)."""
+        if len(shape) != 2:
+            return False
+        from ..programs import bucket_rows
+
+        return self._warm_step((bucket_rows(int(shape[0])), int(shape[1])))
 
     def _pf_consume(self, staged):
         """One fused Sculley update on a pre-staged block (consumer

@@ -23,8 +23,7 @@ from ..utils import check_array, svd_flip
 from .. import sanitize as _san
 
 
-@partial(jax.jit, static_argnames=("k",))
-def _update(components, singular_values, mean, var, n_seen, batch, *, k):
+def _update_fn(components, singular_values, mean, var, n_seen, batch, *, k):
     """One incremental rank-update (Ross et al. 2008, as in sklearn).
 
     ``n_seen`` is a DEVICE scalar and every derived reporting attribute
@@ -79,6 +78,19 @@ def _update(components, singular_values, mean, var, n_seen, batch, *, k):
         0.0,
     ).astype(batch.dtype)
     return vt[:k], sv, new_mean, new_var, n_total, explained, ratio, noise
+
+
+# the rank-update through the central program cache (design.md §12):
+# `_pf_stage` pre-compiles a ragged tail batch's program on the blessed
+# compile-ahead thread while the previous batch's SVD executes.  IPCA
+# batches are deliberately NOT bucket-padded — `_update` has no row
+# mask, so padding rows would enter the moments; only the compile
+# overlap applies here.
+from .. import programs as _programs  # noqa: E402
+
+_update = _programs.cached_program(
+    _update_fn, name="ipca.update", static_argnames=("k",),
+)
 
 
 class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
@@ -141,7 +153,40 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
             # cast on HOST: a device astype is a program, which the
             # worker thread must never dispatch
             xh = xh.astype(np.float32)
+        self._warm_update(xh.shape, xh.dtype)
         return jnp.asarray(xh)
+
+    def _warm_update(self, xshape, dtype) -> bool:
+        """Compile-ahead hook: pre-build the rank-update for a batch of
+        ``xshape`` on the blessed compile thread (host-only work here —
+        shape structs + a queue put).  Only possible once the state
+        shapes exist, i.e. after the first consumed batch — which is
+        exactly when a ragged TAIL batch's fresh program would
+        otherwise stall the consumer."""
+        from .. import programs
+
+        if not programs.compile_ahead_enabled():
+            return False
+        # n_components_ is assigned AFTER _init_state in _pf_consume; the
+        # prefetch worker can stage the next block between the two, so
+        # gate on the attribute the shapes actually need (a declined
+        # warm just means this block compiles on demand — warmup class)
+        k = getattr(self, "n_components_", None)
+        if k is None or not hasattr(self, "components_") \
+                or len(xshape) != 2:
+            return False
+        k = int(k)
+        d = int(xshape[1])
+        # the device dtype the staged jnp.asarray will produce (host
+        # f64 lands as f32 unless x64 is enabled) — pure metadata math
+        dtype = jax.dtypes.canonicalize_dtype(dtype)
+        sds = jax.ShapeDtypeStruct
+        return _update.warm(
+            (sds((k, d), dtype), sds((k,), dtype), sds((d,), dtype),
+             sds((d,), dtype), sds((), jnp.int32),
+             sds((int(xshape[0]), d), dtype)),
+            k=k,
+        )
 
     def partial_fit(self, X, y=None, check_input=True):
         # composed from the staged hooks so serial and prefetched paths

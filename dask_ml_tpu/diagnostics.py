@@ -55,10 +55,34 @@ __all__ = [
     "trace", "benchmark_step", "benchmark_slope", "_timer",
     "FaultStats", "fault_stats", "reset_fault_stats",
     "pipeline_report", "reset_pipeline_stats",
-    "lint_report", "sanitize_report",
+    "lint_report", "sanitize_report", "program_report",
     "obs", "span", "event", "metrics_snapshot", "export_perfetto",
     "flight_dump", "run_report", "reset",
 ]
+
+
+def program_report() -> dict:
+    """The central compiled-program cache's books, next to
+    :func:`pipeline_report` (design.md §12)::
+
+        {"programs": {name: {hits, misses, ahead_hits, ahead_submitted,
+                             bypass, fallback, compile_s,
+                             ahead_compile_s, saved_s, wait_s,
+                             programs, inflight}},
+         "totals": {...same keys summed...},
+         "bucket": {blocks, padded_blocks, pad_rows},
+         "persistent_cache": dir_or_None}
+
+    ``saved_s`` is the compile wall time the blessed compile-ahead
+    thread hid from consumers (ahead-compiled programs that were
+    subsequently hit); ``bucket`` is the shape-bucketing pad split
+    (``padded_blocks == 0`` means every reader emitted bucket-sized
+    chunks — the no-op fast path).  Reset with
+    :func:`dask_ml_tpu.programs.reset_counters` (compiled executables
+    are kept)."""
+    from . import programs
+
+    return programs.report()
 
 
 def run_report() -> dict:

@@ -48,14 +48,20 @@ import jax.numpy as jnp
 from ..base import ClassifierMixin, RegressorMixin, TPUEstimator
 from ..core.sharded import ShardedRows
 from ..utils import safe_denominator
+from .. import programs as _programs
 from .. import sanitize as _san
 
 __all__ = ["SGDClassifier", "SGDRegressor"]
 
 # Streamed blocks are padded up to one of these row counts (then to the next
 # multiple of the largest) so a stream of ragged chunk sizes compiles at most
-# len(_BUCKETS)+ programs per (d, k) shape.
-_BUCKETS = (256, 1024, 4096, 16384, 65536)
+# len(_BUCKETS)+ programs per (d, k) shape.  The policy now lives in
+# dask_ml_tpu/programs/bucket.py behind the DASK_ML_TPU_BUCKET knob
+# (off / pow2 / explicit ladders); these names stay as the historical
+# entry points every caller and test binds.
+_BUCKETS = _programs.DEFAULT_BUCKETS
+_bucket_rows = _programs.bucket_rows
+_bucket_pad = _programs.pad_block
 
 #: Default streaming block size: a bucket entry, so default-chunk streams
 #: pad zero extra rows per partial_fit (wrappers.Incremental, _partial.fit)
@@ -66,33 +72,10 @@ _REG_LOSSES = ("squared_error", "huber")
 _PENALTIES = ("l2", "l1", "elasticnet", None)
 _SCHEDULES = ("constant", "optimal", "invscaling", "adaptive")
 
-
-def _bucket_rows(n: int) -> int:
-    for b in _BUCKETS:
-        if n <= b:
-            return b
-    top = _BUCKETS[-1]
-    return ((n + top - 1) // top) * top
-
-
-def _bucket_pad(X: np.ndarray, targets: np.ndarray | None = None):
-    """Zero-pad host block rows to the bucket size, with a validity mask.
-
-    Shared by the SGD `_prep_block` host branch and MiniBatchKMeans'
-    streaming ingest, so the bucketing discipline cannot drift between
-    the two.  Returns ``(X_padded, targets_padded_or_None, mask)``.
-    """
-    n = X.shape[0]
-    b = _bucket_rows(n)
-    mask = np.zeros(b, dtype=np.float32)
-    mask[:n] = 1.0
-    if b != n:
-        X = np.concatenate([X, np.zeros((b - n, X.shape[1]), X.dtype)])
-        if targets is not None:
-            targets = np.concatenate(
-                [targets, np.zeros((b - n, targets.shape[1]), targets.dtype)]
-            )
-    return X, targets, mask
+#: the traced-scalar hyperparameter keys every step signature carries
+#: (order-free: dicts key the program-cache signature sorted)
+_HYPER_KEYS = ("alpha", "eta0", "power_t", "t0", "l1_ratio", "epsilon",
+               "eta_scale")
 
 
 def _margin_losses(loss: str, margins, ysigned):
@@ -206,12 +189,15 @@ def sgd_step(state, xb, yb, mask, hyper, *, loss, penalty, schedule,
 
 
 # One compiled program per (loss, penalty, schedule, fit_intercept, shapes);
-# state donated so the update happens in place in HBM.
-_jitted_step = partial(
-    jax.jit,
+# state donated so the update happens in place in HBM.  Routed through the
+# central program cache (design.md §12): shape-bucketed streams resolve to
+# already-compiled executables and the compile-ahead worker can pre-build
+# the next bucket's program while the current block computes.
+_jitted_step = _programs.cached_program(
+    sgd_step, name="sgd.step",
     static_argnames=("loss", "penalty", "schedule", "fit_intercept"),
     donate_argnames=("state",),
-)(sgd_step)
+)
 
 
 def sgd_epoch(state, xs, ys, ms, hyper, *, loss, penalty, schedule,
@@ -245,15 +231,14 @@ def sgd_epoch(state, xs, ys, ms, hyper, *, loss, penalty, schedule,
     return state, jnp.sum(losses * counts) / total
 
 
-_jitted_epoch = partial(
-    jax.jit,
+_jitted_epoch = _programs.cached_program(
+    sgd_epoch, name="sgd.epoch",
     static_argnames=("loss", "penalty", "schedule", "fit_intercept"),
     donate_argnames=("state",),
-)(sgd_epoch)
+)
 
 
-@partial(jax.jit, static_argnames=("loss",))
-def _eval_loss(state, xb, yb, mask, hyper, *, loss):
+def _eval_loss_fn(state, xb, yb, mask, hyper, *, loss):
     """Masked mean loss of the CURRENT state over ``mask`` rows — the
     per-epoch validation metric for ``early_stopping``.  This is an EXTRA
     forward pass over all rows each epoch (~+50% epoch FLOPs on the
@@ -267,6 +252,11 @@ def _eval_loss(state, xb, yb, mask, hyper, *, loss):
         ell, _ = _regression_losses(loss, margins, yb, hyper["epsilon"])
     m = mask[:, None].astype(margins.dtype)
     return jnp.sum(ell * m) / safe_denominator(jnp.sum(mask))
+
+
+_eval_loss = _programs.cached_program(
+    _eval_loss_fn, name="sgd.eval_loss", static_argnames=("loss",),
+)
 
 
 def _row_shard_count(arr) -> int:
@@ -642,6 +632,51 @@ class _BaseSGD(TPUEstimator):
             or isinstance(y, (ShardedRows, jnp.ndarray))
         )
 
+    # -- compile-ahead (programs.ahead; design.md §12) --------------------
+    def _warm_step(self, xshape, k) -> bool:
+        """Enqueue an ahead-of-time compile of the streamed step program
+        for a staged block of shape ``xshape`` (already bucketed) and
+        ``k`` output columns, on the blessed compile-ahead thread.  Pure
+        host work (shape structs + a queue put) — safe from the prefetch
+        worker, where ``_pf_stage`` calls it per block (a known
+        signature short-circuits in microseconds)."""
+        if not _programs.compile_ahead_enabled():
+            return False
+        b, d = int(xshape[0]), int(xshape[1])
+        k = int(k)
+        # steady streams hit the same (b, d, k, statics) every block:
+        # one tuple compare instead of rebuilding the shape structs and
+        # re-walking the cache's signature table per staged block
+        key = (b, d, k, self.loss, self.penalty, self.learning_rate,
+               self.fit_intercept)
+        if getattr(self, "_warm_memo", None) == key:
+            return False
+        self._warm_memo = key
+        f32 = jnp.float32
+        sds = jax.ShapeDtypeStruct
+        state = {"coef": sds((d, k), f32), "intercept": sds((k,), f32),
+                 "t": sds((), f32)}
+        hyper = {name: sds((), f32) for name in _HYPER_KEYS}
+        return _jitted_step.warm(
+            (state, sds((b, d), f32), sds((b, k), f32), sds((b,), f32),
+             hyper),
+            loss=self.loss, penalty=self.penalty,
+            schedule=self.learning_rate, fit_intercept=self.fit_intercept,
+        )
+
+    def _pf_warm(self, shape, classes=None) -> bool:
+        """Shape-based twin of the ``_pf_stage`` warm hook for callers
+        that know an upcoming block's (n, d) before staging it (the
+        adaptive search warms each unit's program before its burst).
+        Returns False when the output width cannot be derived yet."""
+        if len(shape) != 2:
+            return False
+        k = self._warm_k(classes)
+        if k is None:
+            return False
+        return self._warm_step(
+            (_bucket_rows(int(shape[0])), int(shape[1])), k)
+
     # device state lives in a non-underscore-suffixed private attr; tell
     # checkpoint.save_estimator to persist it with the fitted attrs
     _checkpoint_private_attrs = ("_state",)
@@ -816,7 +851,19 @@ class SGDClassifier(ClassifierMixin, _BaseSGD):
         # host tail directly: _pf_stage_ok declined device-resident X, so
         # _prep_block's ShardedRows branch (a device cast program) must
         # stay structurally unreachable from the worker thread
-        return self._prep_block_host(X, self._encode_targets(np.asarray(y)))
+        staged = self._prep_block_host(X, self._encode_targets(np.asarray(y)))
+        # compile-ahead: if this block's bucket is a new shape, its step
+        # program builds on the blessed compile thread while the PREVIOUS
+        # block's device step runs — the consumer lands on a warm program
+        self._warm_step(staged[0].shape, staged[1].shape[1])
+        return staged
+
+    def _warm_k(self, classes=None):
+        classes = self.classes_ if classes is None and \
+            hasattr(self, "classes_") else classes
+        if classes is None:
+            return None
+        return 1 if len(classes) == 2 else len(classes)
 
     def partial_fit(self, X, y, classes=None, sample_weight=None, **kwargs):
         self._validate()
@@ -1038,7 +1085,12 @@ class SGDRegressor(RegressorMixin, _BaseSGD):
         if not self._pf_stage_ok(X, y, sample_weight, kwargs):
             return None
         self._validate()
-        return self._prep_block_host(X, self._targets_host(y))
+        staged = self._prep_block_host(X, self._targets_host(y))
+        self._warm_step(staged[0].shape, 1)
+        return staged
+
+    def _warm_k(self, classes=None):
+        return 1
 
     def partial_fit(self, X, y, sample_weight=None, **kwargs):
         self._validate()

@@ -349,6 +349,31 @@ class BaseIncrementalSearchCV(TPUEstimator):
         def train_one(ident, n_calls):
             model, meta = models[ident]
             calls0 = meta["partial_fit_calls"]
+            # compile-ahead (programs/, design.md §12): heterogeneous
+            # configs whose static hyperparams differ each need their own
+            # step program — pre-build this unit's from the next block's
+            # shape on the blessed compile thread, so the burst below
+            # starts on a warm executable instead of stalling on XLA
+            warm = getattr(model, "_pf_warm", None)
+            if warm is not None and n_calls > 0:
+                from .. import programs as _programs
+
+                Xw, _yw = blocks[calls0 % n_blocks]
+                # knob check OUTSIDE the best-effort net: a typo'd
+                # DASK_ML_TPU_COMPILE_AHEAD must raise loudly (the
+                # strict-parse contract), not read as a shapeless block.
+                # Host blocks only: device-resident blocks take the
+                # unbucketed ShardedRows step, whose signature the
+                # shape-based warm cannot predict
+                if _programs.compile_ahead_enabled() and \
+                        not isinstance(Xw, ShardedRows) and \
+                        isinstance(getattr(Xw, "shape", None), tuple) and \
+                        not hasattr(Xw, "aval"):
+                    try:
+                        warm(Xw.shape,
+                             classes=(fit_params or {}).get("classes"))
+                    except (TypeError, ValueError):
+                        pass  # shapeless/1-D blocks: warm is best-effort
             if (n_calls > 1 and prefetch_depth > 0
                     and hasattr(model, "_pf_stage")):
                 t0 = time.time()

@@ -9,13 +9,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import programs as _programs
 from .base import ClassifierMixin, TPUEstimator
 from .core.sharded import ShardedRows, unshard
 from .preprocessing.data import _ingest_float, _masked_or_plain
 
 
-@jax.jit
-def _class_moments(x, mask, onehot):
+def _class_moments_fn(x, mask, onehot):
     w = onehot * mask[:, None]  # (n, k); mask may carry sample WEIGHTS
     counts = jnp.sum(w, axis=0)  # (k,) weight mass per class
     from .utils import safe_denominator
@@ -31,6 +31,14 @@ def _class_moments(x, mask, onehot):
     dev = x - onehot @ means
     var = (w.T @ (dev ** 2)) / safe[:, None]
     return counts, means, var
+
+
+# streamed per-block moments through the central program cache
+# (design.md §12): GaussianNB rides Incremental/partial_fit streams, so
+# its step program gets the hit/miss books like the SGD family's
+_class_moments = _programs.cached_program(
+    _class_moments_fn, name="naive_bayes.class_moments",
+)
 
 
 class GaussianNB(ClassifierMixin, TPUEstimator):

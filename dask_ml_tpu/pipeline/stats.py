@@ -138,6 +138,13 @@ def pipeline_report() -> dict:
         }
         for k in _STAGES:
             cum[k] = round(reg.histogram(f"pipeline.{k}").sum, 6)
+        # bucket-pad split of the transfer stage (programs/bucket.py):
+        # a reader that already emits bucket-sized chunks must show
+        # padded_blocks == 0 — the pad is a no-op fast path, and this
+        # is where that is observable (and asserted, test_programs.py)
+        from ..programs.bucket import counters_snapshot
+
+        cum["bucket"] = counters_snapshot()
     out["cumulative"] = cum
     return out
 
@@ -149,3 +156,6 @@ def reset_pipeline_stats() -> None:
     with _LOCK:
         _LAST = None
     _registry().reset(prefix="pipeline.")
+    # the report's cumulative carries the bucket-pad split; keep the two
+    # in one reset scope so a fresh stream reads fresh pad counters
+    _registry().reset(prefix="bucket.")

@@ -1,0 +1,125 @@
+"""The blessed compile-ahead worker thread.
+
+One background thread — named exactly ``dask-ml-tpu-compile-ahead``,
+the single name graftlint's stage-purity/thread-dispatch rules and
+graftsan's runtime detectors bless
+(``analysis.rules._spmd.BLESSED_COMPILE_THREADS``) — drains a queue of
+ahead-of-time compile requests from :class:`~.cache.CachedProgram`:
+while block *k* computes on the consumer thread, block *k+1*'s (or the
+next bucket's) program lowers and compiles here, so a bucket crossing
+in a steady stream never stalls the device behind XLA.
+
+Contract (design.md §12): this thread may COMPILE — trace + lower +
+backend-compile, which under omnistaging never executes a device
+program — and nothing else.  It never dispatches an estimator surface,
+never fetches device values, never joins a collective; graftsan
+attributes its compiles separately (``ahead_compiles`` in the
+sanitizer baseline) instead of suppressing them, and any other thread
+compiling in a steady phase remains a hard-zero violation.  It is
+DISTINCT from the input pipeline's ``dask-ml-tpu-prefetch`` staging
+worker, which stays fully compile-forbidden.
+
+``DASK_ML_TPU_COMPILE_AHEAD`` (default ``on``) turns the worker off
+entirely; with it off every ``warm()`` is a no-op and all compiles
+happen on the calling thread, exactly as before this module existed.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+
+__all__ = [
+    "AHEAD_ENV",
+    "AHEAD_THREAD_NAME",
+    "enabled",
+    "submit",
+    "drain",
+]
+
+logger = logging.getLogger(__name__)
+
+#: policy knob: arm/disarm the compile-ahead worker (strict parse; an
+#: unrecognized value raises — the repo's env_choice posture).
+AHEAD_ENV = "DASK_ML_TPU_COMPILE_AHEAD"
+
+#: the ONE blessed compile thread name; must stay equal to the entry in
+#: ``analysis.rules._spmd.BLESSED_COMPILE_THREADS`` (asserted in
+#: tests/test_programs.py) so the static and runtime allowlists cannot
+#: drift.
+AHEAD_THREAD_NAME = "dask-ml-tpu-compile-ahead"
+
+_LOCK = threading.Lock()
+_QUEUE: queue.Queue | None = None
+_THREAD: threading.Thread | None = None
+
+
+def enabled() -> bool:
+    """Strict parse of ``DASK_ML_TPU_COMPILE_AHEAD`` (default on)."""
+    val = os.environ.get(AHEAD_ENV, "").strip().lower()
+    if val in ("", "1", "on", "true", "yes"):
+        return True
+    if val in ("0", "off", "false", "no"):
+        return False
+    raise ValueError(
+        f"{AHEAD_ENV} must be 0/off/false or 1/on/true; got {val!r}")
+
+
+def _loop(q: queue.Queue) -> None:
+    while True:
+        prog, sig, args, static = q.get()
+        try:
+            prog._compile_entry(sig, args, static, source="ahead")
+        except BaseException:  # the worker must outlive any one build
+            logger.exception("compile-ahead task for %r failed",
+                             getattr(prog, "name", prog))
+        finally:
+            q.task_done()
+
+
+def _ensure_worker() -> queue.Queue:
+    global _QUEUE, _THREAD
+    with _LOCK:
+        if _THREAD is None or not _THREAD.is_alive():
+            _QUEUE = queue.Queue(maxsize=256)
+            # the ONE thread allowed to compile off the main thread: the
+            # literal name is what blesses it for graftlint's
+            # stage-purity/thread-dispatch rules AND graftsan's runtime
+            # compile/dispatch attribution (shared source:
+            # analysis.rules._spmd.BLESSED_COMPILE_THREADS)
+            _THREAD = threading.Thread(
+                target=_loop, args=(_QUEUE,), daemon=True,
+                name="dask-ml-tpu-compile-ahead",
+            )
+            _THREAD.start()
+        return _QUEUE
+
+
+def submit(prog, sig, args, static) -> bool:
+    """Enqueue one ahead compile; False when the worker is off or the
+    queue is full (the caller then keeps its in-flight marker clear and
+    the consumer compiles on demand, exactly the pre-ahead behavior)."""
+    if not enabled():
+        return False
+    try:
+        _ensure_worker().put_nowait((prog, sig, args, static))
+    except queue.Full:
+        return False
+    return True
+
+
+def drain(timeout: float = 30.0) -> bool:
+    """Wait until every submitted compile has finished (tests/bench
+    determinism).  Returns False on timeout."""
+    q = _QUEUE
+    if q is None:
+        return True
+    deadline = time.monotonic() + timeout
+    while q.unfinished_tasks:
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.005)
+    return True

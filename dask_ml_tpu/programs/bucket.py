@@ -1,0 +1,188 @@
+"""Shape-bucketing policy: pad ragged row blocks to a small shape set.
+
+Every streamed block that reaches a jitted step with a fresh row count
+mints a fresh XLA program — the recompile tax SURVEY §7 hard part (c)
+names: ragged CSV tails, heterogeneous search configs, and variable
+serving request shapes all retrigger compiles.  The fix this repo has
+always used (``linear_model._sgd._BUCKETS``) is to pad the batch axis up
+to one of a few bucket sizes and let the row-validity mask carry
+correctness (padding rows weigh 0.0 in every masked reduction, and
+adding exact zeros never changes an IEEE sum).
+
+This module is that discipline centralized behind ONE policy knob,
+``DASK_ML_TPU_BUCKET``:
+
+* ``auto`` (default, and the empty string): the committed
+  :data:`DEFAULT_BUCKETS` ladder — blocks pad to the next rung, blocks
+  beyond the top rung round up to a multiple of it.  Identical to the
+  historical ``_sgd`` behavior.
+* ``off``: no bucketing — every distinct block length is its own
+  program shape (the A/B control arm of the ``recompile_tax`` bench).
+* ``pow2``: pad to the next power of two (unbounded ladder; useful when
+  block sizes vary over orders of magnitude).
+* ``"256,4096,65536"``: an explicit ascending ladder (same semantics
+  as ``auto`` with those rungs).
+
+The knob is read at *call* time (the repo's policy-knob contract), and
+an unparseable value raises loudly — a typo'd policy must never
+silently disable bucketing.
+
+:func:`pad_block` is the shared pad+mask entry every staged estimator
+path uses (SGD ``_prep_block_host``, MiniBatchKMeans ``_pf_stage``);
+it runs on the prefetch worker thread, so it is pure numpy + metric
+counters — no jax.  The counters (``bucket.blocks`` /
+``bucket.padded_blocks`` / ``bucket.pad_rows``) surface through
+``diagnostics.pipeline_report()``'s cumulative block and
+``diagnostics.program_report()``: a reader that already emits
+bucket-sized chunks must show ``padded_blocks == 0`` (the pad is a
+no-op fast path, asserted in tests/test_programs.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..obs.metrics import registry as _registry
+
+__all__ = [
+    "BUCKET_ENV",
+    "DEFAULT_BUCKETS",
+    "BucketPolicy",
+    "resolve_policy",
+    "bucket_rows",
+    "counters_snapshot",
+    "pad_block",
+]
+
+
+def counters_snapshot() -> dict:
+    """The pad split as both reports surface it
+    (``pipeline_report().cumulative.bucket`` and
+    ``program_report().bucket``) — one reader next to the one writer in
+    :func:`pad_block`, so the counter names cannot drift between them."""
+    reg = _registry()
+    return {
+        "blocks": reg.family("bucket.blocks").get("", 0),
+        "padded_blocks": reg.family("bucket.padded_blocks").get("", 0),
+        "pad_rows": reg.family("bucket.pad_rows").get("", 0),
+    }
+
+#: policy knob: how streamed block row counts map to compiled shapes.
+BUCKET_ENV = "DASK_ML_TPU_BUCKET"
+
+#: the committed default ladder (the historical ``_sgd._BUCKETS``): a
+#: stream of ragged chunk sizes compiles at most len()+tail programs
+#: per (d, k) shape.
+DEFAULT_BUCKETS = (256, 1024, 4096, 16384, 65536)
+
+
+class BucketPolicy:
+    """One resolved bucketing policy: ``kind`` ∈ off / pow2 / sizes."""
+
+    __slots__ = ("kind", "sizes")
+
+    def __init__(self, kind: str, sizes: tuple | None = None):
+        self.kind = kind
+        self.sizes = sizes
+
+    def bucket(self, n: int) -> int:
+        """The padded row count for a block of ``n`` real rows."""
+        n = int(n)
+        # empty blocks stay empty under EVERY policy: padding 0 real
+        # rows up to a nonempty shape would run a pure-padding device
+        # step for nothing
+        if n <= 0:
+            return 0
+        if self.kind == "off":
+            return n
+        if self.kind == "pow2":
+            return 1 << (n - 1).bit_length()
+        for b in self.sizes:
+            if n <= b:
+                return b
+        top = self.sizes[-1]
+        return ((n + top - 1) // top) * top
+
+    def __eq__(self, other):
+        return (isinstance(other, BucketPolicy)
+                and self.kind == other.kind and self.sizes == other.sizes)
+
+    def __repr__(self):
+        if self.kind == "sizes":
+            return f"BucketPolicy(sizes={self.sizes})"
+        return f"BucketPolicy({self.kind!r})"
+
+
+_AUTO = BucketPolicy("sizes", DEFAULT_BUCKETS)
+_OFF = BucketPolicy("off")
+_POW2 = BucketPolicy("pow2")
+
+
+def resolve_policy(policy: str | BucketPolicy | None = None) -> BucketPolicy:
+    """Resolve a bucketing policy: explicit argument, else the
+    ``DASK_ML_TPU_BUCKET`` env knob, else ``auto`` (the default ladder).
+
+    Accepts ``off`` / ``pow2`` / ``auto`` / a comma-separated ascending
+    list of positive ints; anything else raises (the repo's strict
+    env-parse posture — a typo must not silently change the compile
+    set)."""
+    if isinstance(policy, BucketPolicy):
+        return policy
+    raw = policy if policy is not None else os.environ.get(BUCKET_ENV, "")
+    raw = raw.strip().lower()
+    if raw in ("", "auto", "default"):
+        return _AUTO
+    if raw == "off":
+        return _OFF
+    if raw == "pow2":
+        return _POW2
+    try:
+        sizes = tuple(int(s) for s in raw.split(",") if s.strip())
+    except ValueError:
+        sizes = ()
+    if not sizes or any(b <= 0 for b in sizes) or \
+            list(sizes) != sorted(set(sizes)):
+        raise ValueError(
+            f"{BUCKET_ENV} must be 'off', 'pow2', 'auto', or a "
+            f"strictly-ascending comma-separated list of positive ints; "
+            f"got {raw!r}")
+    return BucketPolicy("sizes", sizes)
+
+
+def bucket_rows(n: int, policy: str | BucketPolicy | None = None) -> int:
+    """The bucketed row count for ``n`` real rows under ``policy``
+    (default: the ``DASK_ML_TPU_BUCKET`` knob)."""
+    return resolve_policy(policy).bucket(n)
+
+
+def pad_block(X: np.ndarray, targets: np.ndarray | None = None,
+              policy: str | BucketPolicy | None = None):
+    """Zero-pad host block rows to the policy's bucket, with a validity
+    mask.  Returns ``(X_padded, targets_padded_or_None, mask)``.
+
+    The ONE pad entry the staged estimator paths share (SGD,
+    MiniBatchKMeans), so the bucketing discipline cannot drift between
+    them.  Safe on the prefetch worker thread: numpy + counters only.
+    A block that already arrives bucket-sized takes the no-op fast
+    path — no copy, no concatenate, just a ones mask — and counts as
+    unpadded in the ``bucket.*`` metrics (how the pipeline report and
+    tests assert the reader/bucket agreement)."""
+    n = X.shape[0]
+    b = resolve_policy(policy).bucket(n)
+    reg = _registry()
+    reg.counter("bucket.blocks").inc()
+    if b == n:
+        # no-op fast path: the reader already emits bucket-sized chunks
+        return X, targets, np.ones(n, dtype=np.float32)
+    reg.counter("bucket.padded_blocks").inc()
+    reg.counter("bucket.pad_rows").inc(b - n)
+    mask = np.zeros(b, dtype=np.float32)
+    mask[:n] = 1.0
+    X = np.concatenate([X, np.zeros((b - n,) + X.shape[1:], X.dtype)])
+    if targets is not None:
+        targets = np.concatenate(
+            [targets, np.zeros((b - n,) + targets.shape[1:], targets.dtype)]
+        )
+    return X, targets, mask

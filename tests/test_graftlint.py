@@ -754,6 +754,134 @@ class TestBlessedCompileThread:
         assert "stage-purity" in rule_ids(active(findings))
 
 
+class TestJitOutsideCache:
+    """PR-8: streamed-step jax.jit wraps must route through programs/
+    (scope: reachable from partial_fit/_pf_stage/_pf_consume/
+    _step_block; whole-array fit solvers are out of scope)."""
+
+    def test_flags_decorated_step_on_stream_path(self):
+        findings = lint("""
+            import jax
+
+            @jax.jit
+            def _step(x):
+                return x + 1
+
+            class Est:
+                def partial_fit(self, X):
+                    return _step(X)
+        """)
+        fs = [f for f in active(findings) if f.rule == "jit-outside-cache"]
+        assert fs and "cached_program" in fs[0].message
+
+    def test_flags_wrap_at_assignment_through_helper_chain(self):
+        # this repo's idiom: partial(jax.jit, ...)(fn), reached via
+        # _pf_consume -> self._step_block -> the wrapped name
+        findings = lint("""
+            import jax
+            from functools import partial
+
+            def step(state, x):
+                return state
+
+            _jitted_step = partial(jax.jit, donate_argnames=("state",))(step)
+
+            class Est:
+                def _pf_consume(self, staged):
+                    return self._step_block(staged)
+
+                def _step_block(self, staged):
+                    return _jitted_step(self._state, staged)
+        """)
+        assert "jit-outside-cache" in rule_ids(active(findings))
+
+    def test_flags_bare_jit_import(self):
+        findings = lint("""
+            from jax import jit
+
+            @jit
+            def _moments(x):
+                return x
+
+            class Est:
+                def partial_fit(self, X):
+                    return _moments(X)
+        """)
+        assert "jit-outside-cache" in rule_ids(active(findings))
+
+    def test_foreign_jit_clean(self):
+        findings = lint("""
+            from numba import jit
+
+            @jit
+            def _step(x):
+                return x
+
+            class Est:
+                def partial_fit(self, X):
+                    return _step(X)
+        """)
+        assert "jit-outside-cache" not in rule_ids(active(findings))
+
+    def test_fit_only_solver_out_of_scope(self):
+        # whole-array fit programs compile once per dataset shape — the
+        # streaming recompile tax does not apply, so no finding
+        findings = lint("""
+            import jax
+
+            @jax.jit
+            def _solve(x):
+                return x
+
+            class Est:
+                def fit(self, X):
+                    return _solve(X)
+        """)
+        assert "jit-outside-cache" not in rule_ids(active(findings))
+
+    def test_jit_not_on_stream_path_clean(self):
+        findings = lint("""
+            import jax
+
+            @jax.jit
+            def _other(x):
+                return x
+
+            class Est:
+                def partial_fit(self, X):
+                    return X
+        """)
+        assert "jit-outside-cache" not in rule_ids(active(findings))
+
+    def test_cached_program_idiom_clean(self):
+        findings = lint("""
+            from dask_ml_tpu import programs
+
+            def step(x):
+                return x * 2
+
+            _step = programs.cached_program(step, name="m.step")
+
+            class Est:
+                def partial_fit(self, X):
+                    return _step(X)
+        """)
+        assert "jit-outside-cache" not in rule_ids(active(findings))
+
+    def test_suppression_lives_only_in_cache_internals(self):
+        """The one sanctioned suppression is programs/cache.py's own
+        wrap; it must exist (and match, or it becomes an active
+        unused-suppression finding)."""
+        path = os.path.join(PKG, "programs", "cache.py")
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        assert "disable=jit-outside-cache" in src
+        findings = lint_source(src, path=path)
+        sup = [f for f in findings if f.rule == "jit-outside-cache"]
+        assert sup and all(f.suppressed for f in sup)
+        assert "unused-suppression" not in rule_ids(active(findings))
+
+
 class TestRecompileRisk:
     """PR-6: the static twin of graftsan's compile sanitizer."""
 
@@ -1558,6 +1686,8 @@ class TestFramework:
             "undocumented-knob",
             # PR 6: the static twin of graftsan's compile sanitizer
             "recompile-risk",
+            # PR 8: streamed-step jits must route through programs/
+            "jit-outside-cache",
         }
 
     def test_select_unknown_rule_raises(self):

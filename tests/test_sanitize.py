@@ -153,6 +153,30 @@ class TestDispatchDetector:
         assert ok == [True]
         assert not s.report()["violations"]
         assert "dask-ml-tpu-compile-ahead" in s.report()["dispatch_threads"]
+        # PR-8 attribution: the blessed thread's compile lands in the
+        # separately-ratcheted ahead counters, not in "compiles"
+        totals = s.report()["totals"]
+        assert totals["ahead_compiles"] >= 1
+        assert totals["compiles"] == 0
+
+    def test_blessed_steady_compile_attributed_not_violating(self):
+        """A steady-phase compile on the blessed compile-ahead thread is
+        that thread's job: counted in steady_ahead_compiles (a ratchet
+        ceiling), never a steady-state-compile violation — while the
+        same compile on the main thread (sibling test below) stays a
+        hard zero."""
+        f = _fresh_jit()
+        x = jnp.ones(6)
+        with sanitize.sanitize(label="t") as s:
+            with s.steady(guard=False):
+                t = threading.Thread(
+                    target=lambda: f(x), name="dask-ml-tpu-compile-ahead")
+                t.start()
+                t.join()
+        rep = s.report()
+        assert not rep["violations"]
+        assert rep["totals"]["steady_compiles"] == 0
+        assert rep["totals"]["steady_ahead_compiles"] >= 1
 
     def test_prefetch_worker_name_is_not_blessed(self):
         """The §8 contract at runtime: the staging worker's thread name
@@ -496,7 +520,10 @@ class TestAllowSiteCitations:
         """The PR-6 triage target: ≤ 11 inline suppression comments
         (from 12).  The runtime sanitizer proved the truncated_svd
         streaming path host-only, so its four suppressions became a
-        named host tail — the count is now 8."""
+        named host tail (count 8); PR-8 added exactly ONE — the
+        ``jit-outside-cache`` rule's sanctioned escape at the program
+        cache's own internal ``jax.jit`` wrap (programs/cache.py), the
+        single place a raw jit must exist — so the count is now 9."""
         import subprocess
 
         out = subprocess.run(
@@ -507,7 +534,7 @@ class TestAllowSiteCitations:
                     for line in out.stdout.splitlines() if ":" in line)
         # analysis/core.py's docstring EXAMPLE is not a live suppression
         assert total - 1 <= 11
-        assert total - 1 == 8, (
+        assert total - 1 == 9, (
             "suppression count moved — update this test AND re-audit "
             "the AllowSite citations")
 
