@@ -1,0 +1,121 @@
+"""Swallowed faults: every fault at a registered injection point must
+stay observable.
+
+The chaos drill suite (``resilience/drills.py``, design.md §13) proves
+at runtime that every ``FaultPlan`` injection point has a recovery path
+whose faults land in ``FaultStats``/obs; this rule is its static twin
+for the code the drills cannot execute: a ``try/except`` wrapped around
+a fault-registered call site (anything that transitively reaches
+``resilience.testing.maybe_fault`` — the io readers, the checkpoint
+writer, the sharding boundary, the pipeline staging path) whose handler
+neither re-raises nor DOES anything at all silently erases a fault the
+whole resilience layer exists to account for.
+
+Deliberately narrow (precision over recall): a handler is flagged only
+when its body contains NO ``raise`` and NO call expression whatsoever —
+the bare ``except: pass`` / ``except: continue`` / ``except: return
+None`` shapes.  A handler that raises, logs, records through
+``FaultStats``/``obs.event``/the flight recorder, or even constructs a
+degraded result is doing *something* observable-ish and is left to the
+runtime drills to judge; the pure silent swallow is indefensible at a
+fault point and is the exact inverse of the "recovery is loud, never
+silent" contract (resilience/retry.py)."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule, dotted_name, register
+
+
+def _calls_maybe_fault(node: ast.AST) -> bool:
+    for call in ast.walk(node):
+        if not isinstance(call, ast.Call):
+            continue
+        name = dotted_name(call.func)
+        if name and name.rsplit(".", 1)[-1] == "maybe_fault":
+            return True
+    return False
+
+
+def _reaches_fault_point(project, info, memo: dict) -> bool:
+    """Does ``info`` (or anything resolvably called from it) fire a
+    ``maybe_fault`` injection point?  Memoized per function node."""
+    key = id(info.node)
+    if key in memo:
+        return memo[key]
+    memo[key] = False  # cycle guard
+    hit = False
+    for fn, _chain in project.reachable(info):
+        if _calls_maybe_fault(fn.node):
+            hit = True
+            break
+    memo[key] = hit
+    return hit
+
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body is a pure silent swallow: no raise,
+    no call of any kind."""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Call)):
+            return False
+    return True
+
+
+@register
+class SwallowedFaultRule(Rule):
+    id = "swallowed-fault"
+    project_wide = True
+    summary = (
+        "try/except around a FaultPlan-registered call site whose "
+        "handler neither re-raises nor records anything — the fault "
+        "vanishes from FaultStats/obs, inverting the 'recovery is "
+        "loud, never silent' contract (design.md §13)"
+    )
+
+    def _fault_call_in_try(self, project, mod, try_node: ast.Try):
+        """The first call in the TRY body (handlers excluded) that is —
+        or transitively reaches — a maybe_fault injection site."""
+        memo = getattr(project, "_swallowed_fault_memo", None)
+        if memo is None:
+            memo = project._swallowed_fault_memo = {}
+        for stmt in try_node.body:
+            for call in ast.walk(stmt):
+                if not isinstance(call, ast.Call):
+                    continue
+                name = dotted_name(call.func)
+                if name and name.rsplit(".", 1)[-1] == "maybe_fault":
+                    return call, "maybe_fault"
+                res = project.resolve_call(mod, call)
+                if res.kind == "function" and _reaches_fault_point(
+                        project, res.target, memo):
+                    return call, res.target.qualname
+                if res.kind == "class" and res.target is not None:
+                    init = res.target.methods.get("__init__")
+                    if init is not None and _reaches_fault_point(
+                            project, init, memo):
+                        return call, res.target.name
+        return None
+
+    def run_project(self, project):
+        for mod in project.modules:
+            for node in ast.walk(mod.ctx.tree):
+                if not isinstance(node, ast.Try):
+                    continue
+                site = self._fault_call_in_try(project, mod, node)
+                if site is None:
+                    continue
+                _call, via = site
+                for handler in node.handlers:
+                    if not _handler_swallows(handler):
+                        continue
+                    yield mod.ctx.finding(
+                        self.id, handler,
+                        f"except block silently swallows faults from a "
+                        f"FaultPlan-registered site (via {via}): the "
+                        f"handler has no raise and no call — record "
+                        f"through FaultStats/obs.event/flight, log, or "
+                        f"re-raise so the fault stays observable "
+                        f"(design.md §13)",
+                    )

@@ -7,9 +7,12 @@ call site that forwards a caller-supplied budget (``retries=int(n)``,
 proves the loop ever gives up, and a persistent fault behind such a site
 retries silently for as long as the caller's arithmetic says — the
 fault-observability contract (resilience/retry.py: recovery must be
-loud, never silent) inverted.  The fix is either a literal re-attempt
-budget or a :class:`~dask_ml_tpu.resilience.Deadline` that converts
-"still failing at T" into an exception."""
+loud, never silent) inverted.  The fix is a literal re-attempt budget,
+a :class:`~dask_ml_tpu.resilience.Deadline` that converts "still
+failing at T" into an exception, or a shared
+:class:`~dask_ml_tpu.resilience.FaultBudget` (``budget=``, design.md
+§13) whose per-fit ceiling bounds the loop no matter what the
+caller-supplied arithmetic says."""
 
 from __future__ import annotations
 
@@ -68,12 +71,17 @@ class UnboundedRetryRule(Rule):
             if not self._is_retry_call(ctx, node):
                 continue
             kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
-            deadline = kwargs.get("deadline")
-            has_deadline = deadline is not None and not (
-                isinstance(deadline, ast.Constant)
-                and deadline.value is None
-            )
-            if has_deadline:
+
+            def _bounding(value: ast.AST | None) -> bool:
+                return value is not None and not (
+                    isinstance(value, ast.Constant) and value.value is None
+                )
+
+            # a Deadline wall-bounds the loop; a shared FaultBudget
+            # (design.md §13) attempt-bounds it fit-wide — either proves
+            # the loop gives up
+            if _bounding(kwargs.get("deadline")) \
+                    or _bounding(kwargs.get("budget")):
                 continue
             retries = kwargs.get("retries")
             if retries is None:
@@ -84,8 +92,9 @@ class UnboundedRetryRule(Rule):
             yield ctx.finding(
                 self.id, node,
                 f"retry(...) with retries={ast.unparse(retries)} and no "
-                f"deadline: the re-attempt budget is not a compile-time "
-                f"bound, so nothing proves this loop gives up under a "
-                f"persistent fault — pass deadline=Deadline(...)/seconds, "
+                f"deadline or shared budget: the re-attempt budget is "
+                f"not a compile-time bound, so nothing proves this loop "
+                f"gives up under a persistent fault — pass "
+                f"deadline=Deadline(...)/seconds or budget=FaultBudget, "
                 f"or make the budget a literal",
             )

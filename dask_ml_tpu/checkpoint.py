@@ -100,7 +100,7 @@ def _from_host(value):
     return value
 
 
-def _atomic_pickle(obj, path: str):
+def _atomic_pickle_once(obj, path: str):
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
@@ -118,6 +118,28 @@ def _atomic_pickle(obj, path: str):
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+
+
+def _atomic_pickle(obj, path: str):
+    """Atomic snapshot write with transient-fault recovery.
+
+    Every checkpoint write in the repo (``FitCheckpoint`` /
+    ``SearchCheckpoint`` / ``save_estimator``) funnels through here, so
+    this is the one choke point for the checkpoint-write fault domain
+    (design.md §13): a transient ``OSError`` (ENOSPC race, flaky
+    network filesystem) is retried — each attempt rewrites the tmp file
+    whole, and the rename stays atomic, so a retry can never tear a
+    snapshot.  Anything else (a pickling ``TypeError``, an injected
+    :class:`~dask_ml_tpu.resilience.FaultInjected` crash drill)
+    propagates unretried: a crash-mid-write drill must observe exactly
+    one attempt.  Counted under the ``"checkpoint-write"`` tag in
+    :func:`~dask_ml_tpu.diagnostics.fault_stats`.
+    """
+    from .resilience.retry import retry as _retry
+
+    _retry(_atomic_pickle_once, obj, path, retries=2, backoff=0.05,
+           max_backoff=1.0, retryable=(OSError,), deadline=30.0,
+           tag="checkpoint-write")
 
 
 def save_estimator(estimator, path: str) -> None:

@@ -53,12 +53,48 @@ from .obs import (  # noqa: F401
 
 __all__ = [
     "trace", "benchmark_step", "benchmark_slope", "_timer",
-    "FaultStats", "fault_stats", "reset_fault_stats",
+    "FaultStats", "fault_stats", "reset_fault_stats", "fault_report",
     "pipeline_report", "reset_pipeline_stats",
     "lint_report", "sanitize_report", "program_report",
     "obs", "span", "event", "metrics_snapshot", "export_perfetto",
     "flight_dump", "run_report", "reset",
 ]
+
+
+def fault_report() -> dict:
+    """The elastic fault-domain runtime's books (design.md §13), next to
+    :func:`fault_stats`'s raw counters::
+
+        {"faults":    {faults, retries, failures}   # fault_stats view
+         "budgets":   {name: {spent, denied, remaining}},
+         "backoff_s": {tag: total_sleep_seconds},
+         "degraded_skips": {stream_label: n},
+         "supervisor": {domain: {units, late, dead, beats,
+                                 deaths, restarts}}}
+
+    Everything is registry-backed (``resilience.budget_*``,
+    ``resilience.backoff_s``, ``resilience.degraded_skip``,
+    ``supervisor.*``) so the same numbers appear in
+    :func:`run_report`'s metrics snapshot and survive the owning
+    objects — a finished fit's budget consumption stays reportable.
+    """
+    from .resilience import supervisor as _supervisor
+    from .resilience.elastic import budget_report
+
+    reg = obs.registry()
+    snap = reg.snapshot()
+    backoff = {}
+    for key, h in snap.get("histograms", {}).items():
+        if key.startswith("resilience.backoff_s"):
+            tag = key[len("resilience.backoff_s"):].strip("{}")
+            backoff[tag or ""] = h.get("sum", 0.0)
+    return {
+        "faults": fault_stats().snapshot(),
+        "budgets": budget_report(),
+        "backoff_s": backoff,
+        "degraded_skips": reg.family("resilience.degraded_skip"),
+        "supervisor": _supervisor.report(),
+    }
 
 
 def program_report() -> dict:
@@ -106,12 +142,16 @@ def run_report() -> dict:
     same fit with :func:`export_perfetto` to render it next to an XProf
     device trace.
     """
+    resilience = fault_report()
     return {
         "schema": obs.SCHEMA_VERSION,
         "span_tree": obs.span_tree(),
         "metrics": obs.metrics_snapshot(),
         "pipeline": pipeline_report(),
-        "faults": fault_stats().snapshot(),
+        # the legacy top-level key IS the resilience view's snapshot —
+        # one read, so the two can never disagree mid-call
+        "faults": resilience["faults"],
+        "resilience": resilience,
         "sanitize": sanitize_report(),
     }
 
@@ -124,9 +164,13 @@ def reset() -> None:
     obs.reset_all()
     # the legacy reporters' registry families are already gone; these
     # clear their residual module state (the last-stream slot; private
-    # books if the global stats object was ever swapped out)
+    # books if the global stats object was ever swapped out; the
+    # supervisor's registered-unit table)
     reset_fault_stats()
     reset_pipeline_stats()
+    from .resilience import supervisor as _supervisor
+
+    _supervisor.reset()
 
 
 def sanitize_report() -> dict | None:

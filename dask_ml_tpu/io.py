@@ -107,7 +107,8 @@ def csv_dims(path: str, *, has_header: bool = False) -> tuple[int, int]:
 def read_csv(path: str, *, has_header: bool = False,
              n_threads: int | None = None, retries: int = 0,
              retry_backoff: float = 0.1,
-             retry_deadline_s: float | None = 120.0) -> np.ndarray:
+             retry_deadline_s: float | None = 120.0,
+             retry_budget=None) -> np.ndarray:
     """Parse a numeric CSV into a float32 (rows, cols) array, one parser
     thread per row range.
 
@@ -122,6 +123,10 @@ def read_csv(path: str, *, has_header: bool = False,
     ``unbounded-retry`` contract): a persistently failing mount raises
     :class:`~dask_ml_tpu.resilience.DeadlineExceeded` loudly instead of
     backing off for as long as the budget arithmetic allows.
+    ``retry_budget`` optionally shares a per-fit
+    :class:`~dask_ml_tpu.resilience.FaultBudget` with the other fault
+    points of the calling fit (design.md §13) — cascading ingest faults
+    then stop at the fit-wide ceiling, not this site's alone.
     """
     from .resilience.retry import retry as _retry
     from .resilience.testing import maybe_fault
@@ -140,7 +145,8 @@ def read_csv(path: str, *, has_header: bool = False,
         return out
 
     return _retry(_parse, retries=int(retries), backoff=retry_backoff,
-                  deadline=retry_deadline_s, tag="ingest")
+                  deadline=retry_deadline_s, budget=retry_budget,
+                  tag="ingest")
 
 
 def read_binary(path: str, shape: tuple[int, ...], *,
@@ -159,7 +165,8 @@ def read_binary(path: str, shape: tuple[int, ...], *,
 def stream_csv_blocks(path: str, block_rows: int, *, has_header: bool = False,
                       n_threads: int | None = None, prefetch: int = 2,
                       retries: int = 0, retry_backoff: float = 0.1,
-                      retry_deadline_s: float | None = 120.0):
+                      retry_deadline_s: float | None = 120.0,
+                      retry_budget=None):
     """Yield float32 row blocks of (at most) ``block_rows`` — the
     out-of-core ingest feeding ``wrappers.Incremental`` (the reference's
     sequential block streaming, SURVEY.md §2.2).
@@ -213,7 +220,8 @@ def stream_csv_blocks(path: str, block_rows: int, *, has_header: bool = False,
         while True:
             buf = _retry(_next_block, retries=int(retries),
                          backoff=retry_backoff,
-                         deadline=retry_deadline_s, tag="ingest")
+                         deadline=retry_deadline_s, budget=retry_budget,
+                         tag="ingest")
             if got.value == 0:
                 break
             yield buf[: got.value]
@@ -224,7 +232,8 @@ def stream_csv_blocks(path: str, block_rows: int, *, has_header: bool = False,
 def stream_binary_blocks(path: str, block_rows: int, n_features: int, *,
                          n_rows: int | None = None, offset_bytes: int = 0,
                          retries: int = 0, retry_backoff: float = 0.1,
-                         retry_deadline_s: float | None = 120.0):
+                         retry_deadline_s: float | None = 120.0,
+                         retry_budget=None):
     """Yield float32 row blocks of (at most) ``block_rows`` from a raw
     little-endian float32 file — the binary twin of
     :func:`stream_csv_blocks`, for out-of-core streams whose parse cost
@@ -270,7 +279,7 @@ def stream_binary_blocks(path: str, block_rows: int, n_features: int, *,
         rows = min(int(block_rows), n_rows - lo)
         yield _retry(_read_block, lo, rows, retries=int(retries),
                      backoff=retry_backoff, deadline=retry_deadline_s,
-                     tag="ingest")
+                     budget=retry_budget, tag="ingest")
 
 
 def stream_text_lines(path: str, block_lines: int = 10_000):

@@ -267,6 +267,15 @@ class BaseIncrementalSearchCV(TPUEstimator):
         self._reset_policy()
         self._fit_failures = 0
         self._fit_failures_lock = threading.Lock()
+        # per-fit shared fault budget (design.md §13): every unit's
+        # requeue retry AND every streamed burst's elastic recovery
+        # draw from this ONE pool, so cascading faults across many
+        # concurrent units stop at the fit-wide ceiling instead of
+        # multiplying per-site budgets
+        from ..resilience.elastic import FaultBudget
+
+        self._fault_budget = FaultBudget.from_env(
+            name=f"search:{type(self).__name__}")
         # span parentage (design.md §11): async scopes use DETACHED
         # spans with an explicit parent — concurrent brackets interleave
         # coroutines on one loop thread, so stack parentage would
@@ -376,6 +385,8 @@ class BaseIncrementalSearchCV(TPUEstimator):
                         pass  # shapeless/1-D blocks: warm is best-effort
             if (n_calls > 1 and prefetch_depth > 0
                     and hasattr(model, "_pf_stage")):
+                from ..resilience.elastic import ElasticPolicy
+
                 t0 = time.time()
                 with _san.region("search.train_one"):
                     stream_partial_fit(
@@ -384,6 +395,10 @@ class BaseIncrementalSearchCV(TPUEstimator):
                          for j in range(n_calls)),
                         depth=prefetch_depth, fit_kwargs=fit_params,
                         label="search_ingest",
+                        # burst recovery draws from the fit-wide budget
+                        elastic=ElasticPolicy(
+                            budget=self._fault_budget,
+                            label="search_ingest"),
                     )
                 meta = dict(meta)
                 meta["partial_fit_calls"] += n_calls
@@ -519,9 +534,19 @@ class BaseIncrementalSearchCV(TPUEstimator):
             the fleet's collective streams diverge and deadlock.  State is
             rolled back and the fault propagates so every process stops
             loudly.
+
+            Elastic additions (design.md §13): the unit registers a
+            supervisor heartbeat (one beat per unit run — the search
+            domain's liveness books), and the retry draws from the
+            FIT-WIDE shared :class:`~dask_ml_tpu.resilience.FaultBudget`
+            — one flaky unit still gets its single requeue, but a
+            CASCADE of failing units (a sick device, a poisoned split)
+            exhausts the shared budget and propagates loudly instead of
+            retrying once per unit forever.
             """
             import copy
 
+            from ..resilience import supervisor as _supervisor
             from ..resilience.retry import retry as _retry
 
             snapshot = {i: copy.deepcopy(models[i]) for i in unit_ids}
@@ -540,14 +565,21 @@ class BaseIncrementalSearchCV(TPUEstimator):
             # a regular (stack) span: run_unit executes synchronously on
             # its thread (pool worker or, serialized, the loop thread),
             # so nested pipeline.stream spans parent here naturally
-            with _obs.span("search.unit", parent=round_span["id"],
-                           models=len(unit_ids), n_calls=n_calls):
-                return _retry(
-                    fn, first_arg, n_calls,
-                    retries=0 if lockstep else 1,
-                    backoff=0.0, jitter=0.0,
-                    tag="search-unit", on_error=rollback,
-                )
+            hb = _supervisor.register(
+                f"search-unit:{'-'.join(map(str, unit_ids))}", "search")
+            try:
+                with _obs.span("search.unit", parent=round_span["id"],
+                               models=len(unit_ids), n_calls=n_calls):
+                    hb.beat()
+                    return _retry(
+                        fn, first_arg, n_calls,
+                        retries=0 if lockstep else 1,
+                        backoff=0.0, jitter=0.0,
+                        budget=self._fault_budget,
+                        tag="search-unit", on_error=rollback,
+                    )
+            finally:
+                hb.retire()
 
         async def run_round(instructions):
             """Fan this round's training units over the shared thread pool

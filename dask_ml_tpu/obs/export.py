@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
 
@@ -56,6 +57,17 @@ class JsonlSink:
         })
 
     def _write_obj(self, obj: dict) -> None:
+        # drill point (resilience.testing): an injected
+        # OSError(ENOSPC) here exercises the disk-full degradation
+        # below — drop the sink, keep training.  Looked up through
+        # sys.modules, NOT imported: obs is imported BY resilience, and
+        # a DASK_ML_TPU_TRACE sink writes its header DURING obs's own
+        # import, where importing resilience back would be a cycle.  If
+        # the module is absent no plan can be active (plans live in it),
+        # so skipping the fire is exact, not a best-effort.
+        testing = sys.modules.get("dask_ml_tpu.resilience.testing")
+        if testing is not None:
+            testing.maybe_fault("exporter-write")
         line = json.dumps(obj, separators=(",", ":"), default=repr)
         with self._lock:
             self._f.write(line + "\n")
@@ -64,6 +76,7 @@ class JsonlSink:
     def write(self, rec) -> None:
         try:
             self._write_obj(rec.as_dict())
+        # graftlint: disable=swallowed-fault -- write-after-close during interpreter/sink shutdown: the sink was already dropped WITH its one warning (OSError branch below); a second message per straggling span would be noise, not observability
         except ValueError:  # closed file on shutdown: quiet drop
             pass
         except OSError:

@@ -31,12 +31,24 @@ depth, and staging is the same pure host→device conversion the serial
 path performs — so results are bit-identical to ``depth=0`` by
 construction (asserted across estimators in tests/test_pipeline.py).
 
-Resilience: the io readers' per-block ``retry`` runs INSIDE the worker
-(a transient read fault is absorbed without stalling the device longer
-than the backoff); a propagated failure surfaces on the consumer thread
-at the failed block's position.  Prefetched-but-unconsumed blocks are
-dropped on close and never reach the model, so a ``FitCheckpoint``
-resume replays exactly the blocks after the last consumed one.
+Resilience (docs/design.md §13): the io readers' per-block ``retry``
+runs INSIDE the worker (a transient read fault is absorbed without
+stalling the device longer than the backoff).  Above that, the stream
+runs under an ELASTIC restart driver (``resilience.elastic``): the
+worker registers a supervisor heartbeat, and a worker fault — or a
+silent thread death (the dead-thread verdict) — triggers domain-scoped
+recovery within the stream's shared :class:`~dask_ml_tpu.resilience.
+FaultBudget`: a fresh worker is started and the in-flight block is
+REPLAYED exactly (the raw parsed item is held until its staged form is
+delivered, so a crash between parse and enqueue loses nothing).  A
+staging-poisoned block past its per-block retries can — policy knob
+``DASK_ML_TPU_DEGRADED_BLOCKS``, default off — be skipped with an
+exact flight-recorder record instead of killing the fit.  A propagated
+failure surfaces on the consumer thread carrying the failed block's
+position and phase (``pipeline.fault`` flight event).  Prefetched-but-
+unconsumed blocks are dropped on close and never reach the model, so a
+``FitCheckpoint`` resume replays exactly the blocks after the last
+consumed one.
 """
 
 from __future__ import annotations
@@ -47,6 +59,10 @@ import threading
 import time
 
 from .. import obs
+from ..resilience import supervisor as _supervisor
+from ..resilience.elastic import ElasticPolicy, WorkerLost
+from ..resilience.testing import ThreadCrash as _ThreadCrash
+from ..resilience.testing import maybe_fault as _maybe_fault
 from .stats import PipelineStats
 
 __all__ = [
@@ -72,12 +88,26 @@ _DEFAULT_DEPTH = 2
 
 _DONE = object()  # worker sentinel: source exhausted
 
+#: consumer-side poll interval: how long a q.get waits before checking
+#: the worker's liveness (the dead-thread verdict's detection latency)
+_POLL_S = 0.05
 
-class _WorkerError:
-    __slots__ = ("exc",)
 
-    def __init__(self, exc: BaseException):
+class _BlockFault(Exception):
+    """Internal: one block's pipeline failure with position + phase
+    (``parse`` / ``stage`` / ``crash`` / ``worker``) attribution.  For
+    staging faults ``item`` holds the already-parsed raw block so a
+    retry re-stages it instead of losing it."""
+
+    __slots__ = ("blk", "phase", "exc", "item")
+
+    def __init__(self, blk: int, phase: str, exc: BaseException,
+                 item=None):
+        super().__init__(f"block {blk} {phase} fault: {exc!r}")
+        self.blk = int(blk)
+        self.phase = phase
         self.exc = exc
+        self.item = item
 
 
 def resolve_depth(depth: int | None = None) -> int:
@@ -100,47 +130,95 @@ def resolve_depth(depth: int | None = None) -> int:
     return depth
 
 
-def _parse_and_stage(src, stage, stats: PipelineStats, blk: int):
+def _parse_and_stage(src, stage, stats: PipelineStats, blk: int,
+                     item=None):
     """One pipeline step, identical on BOTH paths (inline depth-0 loop
     and the worker thread): timed+spanned parse of the next item, then
     timed+spanned staging.  Returns the staged item, or ``_DONE`` on
-    source exhaustion."""
+    source exhaustion; failures raise :class:`_BlockFault` with the
+    position, phase, and (for staging faults) the raw item so the
+    elastic driver can replay exactly.  ``item`` replays a held raw
+    block (skipping the parse leg) after a worker restart."""
+    if item is None:
+        t0 = time.perf_counter()
+        try:
+            with obs.span("pipeline.parse", block=blk):
+                item = next(src)
+        except StopIteration:
+            return _DONE
+        except BaseException as exc:
+            raise _BlockFault(blk, "parse", exc) from exc
+        finally:
+            stats.parse_s += time.perf_counter() - t0
     t0 = time.perf_counter()
     try:
-        with obs.span("pipeline.parse", block=blk):
-            item = next(src)
-    except StopIteration:
-        return _DONE
+        with obs.span("pipeline.stage", block=blk):
+            _maybe_fault("stage")
+            staged = stage(item)
+    except BaseException as exc:
+        raise _BlockFault(blk, "stage", exc, item=item) from exc
     finally:
-        stats.parse_s += time.perf_counter() - t0
-    t0 = time.perf_counter()
-    with obs.span("pipeline.stage", block=blk):
-        staged = stage(item)
-    stats.transfer_s += time.perf_counter() - t0
+        stats.transfer_s += time.perf_counter() - t0
     return staged
 
 
-def _staged_iter(src, stage, depth: int, stats: PipelineStats):
+def _staged_iter(src, stage, depth: int, stats: PipelineStats,
+                 policy: ElasticPolicy):
     """Yield ``stage(item)`` for each item of ``src``, staged up to
-    ``depth`` blocks ahead on a host worker thread.
+    ``depth`` blocks ahead on a host worker thread, under the elastic
+    restart driver.
 
-    ``depth <= 0`` degrades to the inline serial loop (same timings
-    recorded, no thread).  Worker faults re-raise on the consumer thread
-    at the failed block's position; closing the generator stops the
-    worker promptly even when it is blocked on a full queue.
+    ``depth <= 0`` degrades to the inline serial loop (same timings and
+    fault policy, no thread).  Worker faults consult ``policy``: retry
+    (restart the worker, replay the held raw item), degraded-mode skip,
+    or re-raise on the consumer thread at the failed block's position.
+    Closing the generator stops the worker promptly even when it is
+    blocked on a full queue.
     """
+    restartable = bool(getattr(src, "restartable_source", False))
+    # shared driver state: ONE worker exists at a time (start happens
+    # only after the previous join), so these see no concurrent writers
+    state = {"blk": 0, "pending": None}
+
+    def _handle(fault: _BlockFault) -> str:
+        verdict = policy.on_block_fault(fault.blk, fault.phase, fault.exc,
+                                        restartable=restartable)
+        if verdict == "raise":
+            exc = fault.exc
+            try:
+                # position + phase attribution for the pipeline.fault
+                # flight event (stream_partial_fit's handler) — staging
+                # faults carry their true block index even when the
+                # consumer is blocks behind the worker
+                exc.__dmlt_block__ = fault.blk
+                exc.__dmlt_phase__ = fault.phase
+            except Exception:  # pragma: no cover - exotic exception types
+                pass
+            raise exc
+        if verdict == "skip":
+            # degraded mode: drop the poisoned block exactly (recorded
+            # by the policy) and continue at the next position
+            state["pending"] = None
+            state["blk"] += 1
+        return verdict
+
     if depth <= 0:
-        blk = 0
         while True:
-            staged = _parse_and_stage(src, stage, stats, blk)
+            item, state["pending"] = state["pending"], None
+            try:
+                staged = _parse_and_stage(src, stage, stats, state["blk"],
+                                          item=item)
+            except _BlockFault as fault:
+                if _handle(fault) == "retry":
+                    state["pending"] = fault.item
+                continue
             if staged is _DONE:
                 return
-            blk += 1
+            state["blk"] += 1
             yield staged
 
-    # depth >= 1: bounded queue + one host-only staging worker
-    q: queue.Queue = queue.Queue(maxsize=depth)
-    stop = threading.Event()
+    # depth >= 1: bounded queue + one host-only staging worker per
+    # (re)start — the driver below restarts it on recoverable faults
     # thread stitching (design.md §11): the worker's parse/stage spans
     # attach under the consumer's innermost open span (the
     # pipeline.stream span) instead of becoming orphan roots — this
@@ -148,58 +226,115 @@ def _staged_iter(src, stage, depth: int, stats: PipelineStats):
     # the capture happens in the right place
     trace_parent = obs.current_span_id()
 
-    def _put(msg) -> bool:
-        """Queue-put that stays responsive to consumer shutdown."""
-        while not stop.is_set():
+    while True:
+        q: queue.Queue = queue.Queue(maxsize=depth)
+        stop = threading.Event()
+        hb_box: list = [None]
+
+        def _put(msg, q=q, stop=stop) -> bool:
+            """Queue-put that stays responsive to consumer shutdown."""
+            while not stop.is_set():
+                try:
+                    q.put(msg, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def _work(stop=stop, put=_put):
             try:
-                q.put(msg, timeout=0.05)
-                return True
-            except queue.Full:
-                continue
-        return False
+                with obs.adopt(trace_parent):
+                    while not stop.is_set():
+                        # drill point: a ThreadCrash here simulates the
+                        # worker dying WITHOUT reporting — the silent
+                        # failure mode the liveness poll below catches
+                        _maybe_fault("prefetch-worker")
+                        hb = hb_box[0]
+                        if hb is not None:
+                            hb.beat()
+                        item, state["pending"] = state["pending"], None
+                        try:
+                            staged = _parse_and_stage(
+                                src, stage, stats, state["blk"], item=item)
+                        except _BlockFault as fault:
+                            state["pending"] = fault.item
+                            put(("fault", fault))
+                            return
+                        if staged is _DONE:
+                            put(("done",))
+                            return
+                        blk = state["blk"]
+                        if not put(("blk", blk, staged)):
+                            return  # consumer shut the stream down
+                        state["blk"] = blk + 1
+            except _ThreadCrash:
+                return  # simulated hard death: vanish without reporting
+            except BaseException as exc:  # driver bug: surface, don't hang
+                put(("fault", _BlockFault(state["blk"], "worker", exc)))
 
-    def _work():
+        # host-only staging worker: parses blocks and issues host->device
+        # transfers; it never dispatches a device program (the jitted step
+        # and any device-resident cast/reshard stay on the consumer thread
+        # -- module docstring / design.md "input pipeline"), so it cannot
+        # interleave multi-device enqueue order
+        # graftlint: disable=thread-dispatch -- host-only prefetch worker: parse + H2D staging puts, never device program dispatch (design.md input-pipeline contract)
+        worker = threading.Thread(
+            target=_work, daemon=True, name=PREFETCH_THREAD_NAME,
+        )
+        hb = _supervisor.register(
+            f"prefetch:{stats.label}", "pipeline", thread=worker)
+        hb_box[0] = hb
+        worker.start()
+        fault: _BlockFault | None = None
         try:
-            with obs.adopt(trace_parent):
-                blk = 0
-                while not stop.is_set():
-                    staged = _parse_and_stage(src, stage, stats, blk)
-                    if staged is _DONE:
-                        _put(_DONE)
-                        return
-                    blk += 1
-                    if not _put(staged):
-                        return
-        except BaseException as exc:  # propagate to the consumer
-            _put(_WorkerError(exc))
-
-    # host-only staging worker: parses blocks and issues host->device
-    # transfers; it never dispatches a device program (the jitted step
-    # and any device-resident cast/reshard stay on the consumer thread
-    # -- module docstring / design.md "input pipeline"), so it cannot
-    # interleave multi-device enqueue order
-    # graftlint: disable=thread-dispatch -- host-only prefetch worker: parse + H2D staging puts, never device program dispatch (design.md input-pipeline contract)
-    worker = threading.Thread(
-        target=_work, daemon=True, name=PREFETCH_THREAD_NAME,
-    )
-    worker.start()
-    try:
-        while True:
-            t0 = time.perf_counter()
-            msg = q.get()
-            stats.stall_s += time.perf_counter() - t0
-            if msg is _DONE:
-                return
-            if isinstance(msg, _WorkerError):
-                raise msg.exc
-            yield msg
-    finally:
-        stop.set()
-        try:  # unblock a worker stuck in q.put full-wait
-            q.get_nowait()
-        except queue.Empty:
-            pass
-        worker.join(timeout=5.0)
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    msg = q.get(timeout=_POLL_S)
+                except queue.Empty:
+                    stats.stall_s += time.perf_counter() - t0
+                    if worker.is_alive():
+                        continue
+                    # dead without reporting — but a message may have
+                    # landed between our Empty and the liveness check
+                    # (the worker puts, THEN dies): drain before the
+                    # crash verdict, or that staged block is silently
+                    # lost.  is_alive() False means every put the
+                    # worker ever made has completed, so one final
+                    # Empty here is definitive.
+                    try:
+                        msg = q.get_nowait()
+                    except queue.Empty:
+                        break  # crash verdict below
+                else:
+                    stats.stall_s += time.perf_counter() - t0
+                if msg[0] == "done":
+                    return
+                if msg[0] == "fault":
+                    fault = msg[1]
+                    break
+                yield msg[2]
+        finally:
+            stop.set()
+            try:  # unblock a worker stuck in q.put full-wait
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            worker.join(timeout=5.0)
+            hb.retire()
+        # reached only via break: a reported fault or a silent death.
+        # (A reported stage fault already parked its raw item in
+        # state["pending"] from the worker before it exited.)
+        if fault is None:
+            _supervisor.note_death(
+                "pipeline", hb.name,
+                error="prefetch worker died without reporting")
+            fault = _BlockFault(
+                state["blk"], "crash",
+                WorkerLost("prefetch worker died without reporting"))
+        _handle(fault)  # raises on "raise"; advances past block on "skip"
+        _supervisor.note_restart("pipeline", hb.name)
+        # loop: a fresh worker resumes from state (held raw item first)
 
 
 def _identity(x):
@@ -207,22 +342,26 @@ def _identity(x):
 
 
 def prefetch_blocks(blocks, *, depth: int | None = None,
-                    stage=None, label: str = "stream"):
+                    stage=None, label: str = "stream", elastic=None):
     """Generator over ``blocks`` with bounded host-thread prefetch.
 
     The building block the consumers share: ``stage`` (default identity)
     runs on the worker thread — host parse is timed around the source
-    pull, staging around ``stage``.  Records a :class:`PipelineStats`
-    when the stream completes or closes.
+    pull, staging around ``stage``.  ``elastic`` (an
+    :class:`~dask_ml_tpu.resilience.ElasticPolicy`) governs worker
+    restarts / degraded-mode skips; default: a fresh policy from the
+    env knobs.  Records a :class:`PipelineStats` when the stream
+    completes or closes.
     """
     depth = resolve_depth(depth)
     stage = stage or _identity
+    policy = elastic if elastic is not None else ElasticPolicy(label=label)
     stats = PipelineStats(label=label, depth=depth, staged=stage is not _identity)
     # the stream span opens at first next() and closes when the
     # generator finishes/closes — both on the consumer thread, so stack
     # discipline holds; the worker's parse/stage spans stitch under it
     with obs.span("pipeline.stream", label=label, depth=depth):
-        feed = _staged_iter(iter(blocks), stage, depth, stats)
+        feed = _staged_iter(iter(blocks), stage, depth, stats, policy)
         try:
             for staged in feed:
                 t0 = time.perf_counter()
@@ -241,7 +380,7 @@ def _supports_staging(model) -> bool:
 
 def stream_partial_fit(model, blocks, *, depth: int | None = None,
                        fit_kwargs: dict | None = None, on_block=None,
-                       label: str = "partial_fit_stream"):
+                       label: str = "partial_fit_stream", elastic=None):
     """Drive ``model.partial_fit`` over an iterator of ``(X, y)`` block
     pairs with prefetch + early H2D staging.
 
@@ -263,6 +402,14 @@ def stream_partial_fit(model, blocks, *, depth: int | None = None,
     a ``TrainingPreempted`` raise sees a model state that reflects
     exactly the first ``i`` blocks, never an in-flight prefetched one.
 
+    ``elastic`` is the stream's recovery policy (an
+    :class:`~dask_ml_tpu.resilience.ElasticPolicy`; default: one built
+    from the ``DASK_ML_TPU_FAULT_BUDGET`` / ``DASK_ML_TPU_DEGRADED_BLOCKS``
+    knobs): it bounds worker restarts and staging replays under the
+    per-fit shared budget, enables degraded-mode block skips, and —
+    opt-in via ``step_retries`` — retries a failed device step on the
+    same staged block.
+
     Returns ``model``.  Records a :class:`PipelineStats` either way.
     """
     from .. import sanitize as _san
@@ -278,11 +425,12 @@ def stream_partial_fit(model, blocks, *, depth: int | None = None,
         with _san.ambient(f"ambient:{label}"):
             return stream_partial_fit(
                 model, blocks, depth=depth, fit_kwargs=fit_kwargs,
-                on_block=on_block, label=label,
+                on_block=on_block, label=label, elastic=elastic,
             )
 
     kw = dict(fit_kwargs or {})
     depth = resolve_depth(depth)
+    policy = elastic if elastic is not None else ElasticPolicy(label=label)
     staged_proto = depth > 0 and _supports_staging(model)
     stats = PipelineStats(label=label, depth=depth, staged=staged_proto)
 
@@ -314,6 +462,22 @@ def stream_partial_fit(model, blocks, *, depth: int | None = None,
 
         _consume = _raw_consume
 
+    def _consume_elastic(item, blk):
+        """Step-fault recovery (opt-in, ``policy.step_retries``): retry
+        the SAME staged block — exact-once only for steps that either
+        complete or leave state untouched, which holds for the device-
+        native functional steps (state reassigned after the program
+        returns), hence the opt-in."""
+        while True:
+            try:
+                _consume(item)
+                return
+            except Exception as exc:
+                if policy.step_retries <= 0:
+                    raise
+                if policy.on_block_fault(blk, "step", exc) != "retry":
+                    raise
+
     # per-block device-step latency feeds the registry histogram the
     # serving lane will ratchet SLOs on; re-fetched per block (the
     # registry contract: a cached handle would silently record into an
@@ -321,13 +485,13 @@ def stream_partial_fit(model, blocks, *, depth: int | None = None,
     with obs.span("pipeline.stream", label=label, depth=depth,
                   staged=staged_proto,
                   estimator=type(model).__name__):
-        feed = _staged_iter(iter(blocks), _stage, depth, stats)
+        feed = _staged_iter(iter(blocks), _stage, depth, stats, policy)
         done = 0
         try:
             for item in feed:
                 t0 = time.perf_counter()
                 with obs.span("pipeline.compute", block=done):
-                    _consume(item)
+                    _consume_elastic(item, done)
                 dt = time.perf_counter() - t0
                 stats.compute_s += dt
                 obs.registry().histogram("pipeline.block_s").record(dt)
@@ -340,8 +504,11 @@ def stream_partial_fit(model, blocks, *, depth: int | None = None,
         except BaseException as exc:
             # flight-recorder breadcrumb at the failed position: a
             # post-mortem of a dead stream shows WHICH block was in
-            # flight, not just the traceback
-            obs.event("pipeline.fault", label=label, block=done,
+            # flight — staging faults carry their true (worker-side)
+            # position and phase even when the consumer is behind
+            obs.event("pipeline.fault", label=label,
+                      block=getattr(exc, "__dmlt_block__", done),
+                      phase=getattr(exc, "__dmlt_phase__", "consume"),
                       error=obs.fmt_exc(exc))
             raise
         finally:

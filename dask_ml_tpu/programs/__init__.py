@@ -28,6 +28,7 @@ from .ahead import (  # noqa: F401
     drain as drain_ahead,
     enabled as compile_ahead_enabled,
     submit,
+    worker_alive as ahead_worker_alive,
 )
 from .bucket import (  # noqa: F401
     BUCKET_ENV,
@@ -49,6 +50,7 @@ from .cache import (  # noqa: F401
 __all__ = [
     "AHEAD_ENV",
     "AHEAD_THREAD_NAME",
+    "ahead_worker_alive",
     "BUCKET_ENV",
     "CACHE_DIR_ENV",
     "DEFAULT_BUCKETS",

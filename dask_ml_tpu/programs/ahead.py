@@ -19,6 +19,18 @@ compiling in a steady phase remains a hard-zero violation.  It is
 DISTINCT from the input pipeline's ``dask-ml-tpu-prefetch`` staging
 worker, which stays fully compile-forbidden.
 
+Fault domain (design.md §13): the worker registers a supervisor
+heartbeat and its death can NEVER strand a consumer — every exit path
+(a build raising, an injected :class:`~dask_ml_tpu.resilience.
+ThreadCrash`, interpreter teardown) fails the in-flight markers of
+every task it held, with the error attached, so a consumer waiting on
+an in-flight event falls through to the synchronous compile path
+immediately instead of sleeping out the 120 s safety valve.  The next
+``submit`` restarts the worker (counted as a supervisor restart), up
+to :data:`_MAX_RESTARTS` deaths per process — past that the module
+degrades LOUDLY to synchronous compiles (one warning; ``warm()``
+returns False), exactly the pre-ahead behavior.
+
 ``DASK_ML_TPU_COMPILE_AHEAD`` (default ``on``) turns the worker off
 entirely; with it off every ``warm()`` is a no-op and all compiles
 happen on the calling thread, exactly as before this module existed.
@@ -38,6 +50,7 @@ __all__ = [
     "enabled",
     "submit",
     "drain",
+    "worker_alive",
 ]
 
 logger = logging.getLogger(__name__)
@@ -52,9 +65,15 @@ AHEAD_ENV = "DASK_ML_TPU_COMPILE_AHEAD"
 #: drift.
 AHEAD_THREAD_NAME = "dask-ml-tpu-compile-ahead"
 
+#: how many worker deaths this process tolerates before degrading to
+#: synchronous compiles for good (a crash-looping builder must not spin)
+_MAX_RESTARTS = 3
+
 _LOCK = threading.Lock()
 _QUEUE: queue.Queue | None = None
 _THREAD: threading.Thread | None = None
+_DEATHS = 0
+_DEGRADED_WARNED = False
 
 
 def enabled() -> bool:
@@ -68,44 +87,135 @@ def enabled() -> bool:
         f"{AHEAD_ENV} must be 0/off/false or 1/on/true; got {val!r}")
 
 
-def _loop(q: queue.Queue) -> None:
+def worker_alive() -> bool:
+    """Is the blessed thread currently running?  (Consumers waiting on
+    an in-flight ahead build poll this: a dead builder means no one
+    will ever set their event — fall through to a demand compile.)"""
+    t = _THREAD
+    return t is not None and t.is_alive()
+
+
+def _fail_task(task, exc: BaseException) -> None:
+    """Fail one queued build's in-flight marker (error attached) so any
+    consumer waiting on it falls through to the synchronous path."""
+    prog, sig = task[0], task[1]
+    try:
+        prog._ahead_failed(sig, exc)
+    except Exception:  # pragma: no cover - forensic path must not throw
+        logger.exception("failing ahead task for %r raised",
+                         getattr(prog, "name", prog))
+
+
+def _drain_failed(q: queue.Queue | None, exc: BaseException) -> None:
+    """Fail every task still queued (the worker is dead: no one will
+    build them)."""
+    if q is None:
+        return
     while True:
-        prog, sig, args, static = q.get()
         try:
-            prog._compile_entry(sig, args, static, source="ahead")
-        except BaseException:  # the worker must outlive any one build
-            logger.exception("compile-ahead task for %r failed",
-                             getattr(prog, "name", prog))
-        finally:
-            q.task_done()
+            task = q.get_nowait()
+        except queue.Empty:
+            return
+        _fail_task(task, exc)
+        q.task_done()
 
 
-def _ensure_worker() -> queue.Queue:
-    global _QUEUE, _THREAD
+def _loop(q: queue.Queue) -> None:
+    from ..resilience import supervisor as _supervisor
+    from ..resilience.testing import ThreadCrash as _ThreadCrash
+    from ..resilience.testing import maybe_fault as _maybe_fault
+
+    hb = _supervisor.register(AHEAD_THREAD_NAME, "compile",
+                              thread=threading.current_thread())
+    try:
+        while True:
+            task = q.get()
+            hb.beat()
+            prog, sig, args, static = task
+            try:
+                # drill point: a ThreadCrash here simulates the builder
+                # dying mid-build — the set-on-failure contract below is
+                # what keeps its consumer from hanging
+                _maybe_fault("compile-ahead")
+                prog._compile_entry(sig, args, static, source="ahead")
+            except _ThreadCrash as exc:
+                _fail_task(task, exc)
+                q.task_done()
+                raise  # hard death: the finally fails the rest
+            except BaseException as exc:
+                # the worker must outlive any one build; _compile_entry
+                # handles Exception itself (source="ahead" swallows), so
+                # only escapes land here — fail the marker with the
+                # error attached and keep draining
+                logger.exception("compile-ahead task for %r failed",
+                                 getattr(prog, "name", prog))
+                _fail_task(task, exc)
+                q.task_done()
+            else:
+                q.task_done()
+    except BaseException as exc:
+        # the worker is dying (injected crash, interpreter teardown, a
+        # queue failure): no queued build may strand its waiter
+        _supervisor.note_death("compile", AHEAD_THREAD_NAME,
+                               error=f"{type(exc).__name__}: {exc}")
+        _drain_failed(q, exc)
+        if not isinstance(exc, _ThreadCrash):
+            raise
+
+
+def _ensure_worker() -> queue.Queue | None:
+    """The live worker's queue, (re)starting the thread as needed;
+    ``None`` once the restart budget is spent (degraded: synchronous
+    compiles only)."""
+    global _QUEUE, _THREAD, _DEATHS, _DEGRADED_WARNED
+    from ..resilience import supervisor as _supervisor
+
     with _LOCK:
-        if _THREAD is None or not _THREAD.is_alive():
-            _QUEUE = queue.Queue(maxsize=256)
-            # the ONE thread allowed to compile off the main thread: the
-            # literal name is what blesses it for graftlint's
-            # stage-purity/thread-dispatch rules AND graftsan's runtime
-            # compile/dispatch attribution (shared source:
-            # analysis.rules._spmd.BLESSED_COMPILE_THREADS)
-            _THREAD = threading.Thread(
-                target=_loop, args=(_QUEUE,), daemon=True,
-                name="dask-ml-tpu-compile-ahead",
-            )
-            _THREAD.start()
+        if _THREAD is not None and _THREAD.is_alive():
+            return _QUEUE
+        if _THREAD is not None:
+            # a previous worker died; its dying drain already failed its
+            # tasks, but a submit racing the death can strand one — fail
+            # leftovers before dropping the queue
+            _DEATHS += 1
+            _drain_failed(
+                _QUEUE, RuntimeError("compile-ahead worker died"))
+            if _DEATHS > _MAX_RESTARTS:
+                if not _DEGRADED_WARNED:
+                    _DEGRADED_WARNED = True
+                    logger.warning(
+                        "compile-ahead worker died %d times; degrading "
+                        "to synchronous compiles for the rest of this "
+                        "process", _DEATHS)
+                return None
+            _supervisor.note_restart("compile", AHEAD_THREAD_NAME)
+        _QUEUE = queue.Queue(maxsize=256)
+        # the ONE thread allowed to compile off the main thread: the
+        # literal name is what blesses it for graftlint's
+        # stage-purity/thread-dispatch rules AND graftsan's runtime
+        # compile/dispatch attribution (shared source:
+        # analysis.rules._spmd.BLESSED_COMPILE_THREADS)
+        # graftlint: disable=thread-dispatch -- blessed compile-ahead worker: compiles + host-only supervisor/flight bookkeeping, never dispatches (runtime-verified by graftsan's dispatch detector and the ahead-crash drill)
+        _THREAD = threading.Thread(
+            target=_loop, args=(_QUEUE,), daemon=True,
+            name="dask-ml-tpu-compile-ahead",
+        )
+        _THREAD.start()
         return _QUEUE
 
 
 def submit(prog, sig, args, static) -> bool:
-    """Enqueue one ahead compile; False when the worker is off or the
-    queue is full (the caller then keeps its in-flight marker clear and
-    the consumer compiles on demand, exactly the pre-ahead behavior)."""
+    """Enqueue one ahead compile; False when the worker is off, dead
+    past its restart budget, or the queue is full (the caller then
+    keeps its in-flight marker clear and the consumer compiles on
+    demand, exactly the pre-ahead behavior)."""
     if not enabled():
         return False
+    q = _ensure_worker()
+    if q is None:
+        return False
     try:
-        _ensure_worker().put_nowait((prog, sig, args, static))
+        q.put_nowait((prog, sig, args, static))
     except queue.Full:
         return False
     return True
@@ -113,13 +223,26 @@ def submit(prog, sig, args, static) -> bool:
 
 def drain(timeout: float = 30.0) -> bool:
     """Wait until every submitted compile has finished (tests/bench
-    determinism).  Returns False on timeout."""
+    determinism).  Returns False on timeout; a dead worker's leftover
+    tasks are failed (set-on-failure) rather than waited out."""
     q = _QUEUE
     if q is None:
         return True
     deadline = time.monotonic() + timeout
     while q.unfinished_tasks:
+        if not worker_alive():
+            _drain_failed(q, RuntimeError("compile-ahead worker died"))
+            return q.unfinished_tasks == 0
         if time.monotonic() > deadline:
             return False
         time.sleep(0.005)
     return True
+
+
+def _reset_restarts_for_tests() -> None:
+    """Re-arm the restart budget (drills/tests inject deliberate worker
+    deaths and must not consume the process's real budget)."""
+    global _DEATHS, _DEGRADED_WARNED
+    with _LOCK:
+        _DEATHS = 0
+        _DEGRADED_WARNED = False

@@ -305,23 +305,69 @@ class CachedProgram:
                     self._inflight[sig] = threading.Event()
                     break  # we are the builder
             t0 = time.perf_counter()
-            done = ev.wait(_AHEAD_WAIT_S)
+            done = self._wait_inflight(ev)
             with self._lock:
                 self.counters["wait_s"] += time.perf_counter() - t0
                 e = self._entries.get(sig)
             if e is not None:
                 return e, "hit"
             if not done:
-                # builder wedged past the deadline: safety-valve compile
+                # builder wedged past the deadline — or the blessed
+                # ahead thread died with this build still queued (the
+                # liveness poll in _wait_inflight): safety-valve compile
                 # on this thread (its eventual finish pops the marker
-                # benignly)
+                # benignly; _lookup's own marker registration below is
+                # what makes the duplicate at worst one extra compile)
+                with self._lock:
+                    if self._inflight.get(sig) is ev:
+                        self._inflight.pop(sig, None)
                 break
-            # builder finished with no entry (its build failed): loop —
-            # the marker is gone, so we register and build ourselves,
+            # builder finished with no entry (its build failed — the
+            # event carries the error when the ahead worker died): loop
+            # — the marker is gone, so we register and build ourselves,
             # surfacing the real error on this thread
         self._count("misses")
         return self._compile_entry(sig, args, static, source="demand"), \
             "miss"
+
+    @staticmethod
+    def _wait_inflight(ev) -> bool:
+        """Wait on another builder's in-flight event, with a liveness
+        poll when the builder is the blessed ahead thread: a dead
+        builder will never set its event (its dying drain fails queued
+        markers, but a submit racing the death can strand one), so a
+        dead-thread verdict converts the 120 s safety valve into an
+        immediate fall-through to the synchronous compile path."""
+        from . import ahead as _ahead
+
+        if not getattr(ev, "ahead", False):
+            return ev.wait(_AHEAD_WAIT_S)
+        deadline = time.perf_counter() + _AHEAD_WAIT_S
+        while True:
+            if ev.wait(0.2):
+                return True
+            if not _ahead.worker_alive():
+                return ev.wait(0.05)  # one last look: it may have just set
+            if time.perf_counter() >= deadline:
+                return False
+
+    def _ahead_failed(self, sig, exc: BaseException) -> None:
+        """The blessed compile-ahead worker could not build ``sig`` (the
+        build raised past its own net, or the worker died with the task
+        queued/in hand): pop the in-flight marker and SET the event with
+        the error attached, so a consumer waiting on it falls through to
+        the synchronous compile path immediately — a dead builder must
+        never read as an in-flight one (design.md §13)."""
+        with self._lock:
+            ev = self._inflight.pop(sig, None)
+        self._count("ahead_errors")
+        if ev is not None:
+            ev.error = exc
+            ev.set()
+        logger.warning(
+            "compile-ahead build of %s failed (%s: %s); consumers fall "
+            "back to the synchronous compile path",
+            self.name, type(exc).__name__, exc)
 
     # -- compilation (consumer thread on miss; blessed thread on warm) ---
     def _compile_entry(self, sig, args, static, source: str):
@@ -385,6 +431,7 @@ class CachedProgram:
         if sig is None:
             return False
         ev = threading.Event()
+        ev.ahead = True  # waiters poll the blessed thread's liveness
         with self._lock:
             if sig in self._entries or sig in self._inflight:
                 return False

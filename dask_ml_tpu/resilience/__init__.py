@@ -16,7 +16,19 @@ story lives here as first-class layers:
   (:class:`PreemptionWatcher`, :class:`TrainingPreempted`).
 * :mod:`.testing` — the pluggable fault-injection harness
   (:class:`FaultPlan`, :func:`maybe_fault`) wired through ingest, step,
-  checkpoint-write, and collective layers.
+  checkpoint-write, collective, staging, prefetch-worker, compile-ahead,
+  and exporter layers.
+* :mod:`.elastic` — the elastic fault-domain runtime: per-fit shared
+  :class:`FaultBudget`, degraded-mode block skipping
+  (:class:`ElasticPolicy`), and slice loss as a resume
+  (:func:`run_with_slice_recovery`).
+* :mod:`.supervisor` — heartbeat registration + dead-thread verdicts
+  for the background units (prefetch worker, compile-ahead thread,
+  search-pool units), with per-domain death/restart books.
+* :mod:`.drills` — the ratcheted chaos drill suite: every registered
+  injection point is walked against real streamed fits at prefetch
+  depth 0 and 2, asserting recovery + model equality vs the unfaulted
+  twin, gated by the committed ``tools/drill_baseline.json``.
 
 NOTE on import order: the injection sites inside ``checkpoint`` and
 ``core.sharded`` import :mod:`.testing` lazily (function level) — an
@@ -35,10 +47,20 @@ from .preemption import (
 from .testing import (
     FaultInjected,
     FaultPlan,
+    ThreadCrash,
     active_plan,
     fault_plan,
     maybe_fault,
 )
+from .elastic import (
+    BudgetExhausted,
+    ElasticPolicy,
+    FaultBudget,
+    SliceLost,
+    WorkerLost,
+    run_with_slice_recovery,
+)
+from . import supervisor  # noqa: F401
 
 # last, so the package attribute `retry` is the FUNCTION, not the module
 from .retry import (  # noqa: E402
@@ -51,14 +73,20 @@ from .retry import (  # noqa: E402
 )
 
 __all__ = [
+    "BudgetExhausted",
     "Deadline",
     "DeadlineExceeded",
+    "ElasticPolicy",
+    "FaultBudget",
     "FaultInjected",
     "FaultPlan",
     "FaultStats",
     "FitCheckpoint",
     "PreemptionWatcher",
+    "SliceLost",
+    "ThreadCrash",
     "TrainingPreempted",
+    "WorkerLost",
     "active_plan",
     "active_watcher",
     "check_preemption",
@@ -69,4 +97,6 @@ __all__ = [
     "preemption_requested",
     "reset_fault_stats",
     "retry",
+    "run_with_slice_recovery",
+    "supervisor",
 ]

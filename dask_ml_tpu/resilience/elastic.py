@@ -1,0 +1,395 @@
+"""Elastic fault-domain runtime: budgets, degraded mode, slice recovery.
+
+PR 1's primitives (``retry`` / ``Deadline`` / ``FitCheckpoint`` /
+``PreemptionWatcher``) made individual fault points recoverable; this
+module makes a whole FIT self-healing by giving its fault points a
+SHARED contract:
+
+* :class:`FaultBudget` — one per-fit budget of total re-attempts and
+  recovery (backoff) wall seconds across ALL fault points (ingest retries, staging
+  replays, prefetch-worker restarts, search-unit requeues, checkpoint
+  rewrites).  Per-site retry budgets multiply under cascading faults —
+  five sites with three retries each is a silent 3^5 storm; one shared
+  budget degrades loudly instead.  Registry-backed
+  (``resilience.budget_spent{name}`` / ``budget_denied{name}`` and a
+  ``resilience.budget_remaining{name}`` gauge), so consumption shows in
+  ``diagnostics.fault_report()`` and ``run_report()``.
+* :class:`ElasticPolicy` — the per-stream recovery policy the input
+  pipeline's restart driver consults on every block fault: budgeted
+  retry of the failed block (re-stage the held raw item, re-pull a
+  restartable source, restart a dead prefetch worker), then — policy
+  knob ``DASK_ML_TPU_DEGRADED_BLOCKS``, default OFF — a degraded-mode
+  **skip** of a poisoned block, with an exact record (flight event
+  ``pipeline.degraded_skip`` + ``resilience.degraded_skip{label}``
+  counter + the policy's ``skips`` list), never a silent drop.
+* :class:`SliceLost` + :func:`run_with_slice_recovery` — device-slice
+  loss as a RESUME instead of a failure: re-enter the fit on each
+  surviving submesh in turn; an estimator carrying a ``FitCheckpoint``
+  resumes from its last snapshot (the resume-across-mesh-shapes path
+  from PR 1), so the work done before the loss is kept.
+
+Knobs (documented in docs/api.md):
+
+* ``DASK_ML_TPU_FAULT_BUDGET`` — ``"attempts[,wall_seconds]"``
+  (default ``8,600``): the per-fit budget constructed when a caller
+  does not pass one.  Strict parse — a typo raises.
+* ``DASK_ML_TPU_DEGRADED_BLOCKS`` — int ≥ 0 (default 0 = off): max
+  poisoned blocks a stream may skip after its per-block retries are
+  exhausted.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..obs import event as _obs_event
+from ..obs import fmt_exc as _fmt_exc
+from ..obs.metrics import registry as _registry
+
+__all__ = [
+    "FAULT_BUDGET_ENV",
+    "DEGRADED_ENV",
+    "BudgetExhausted",
+    "FaultBudget",
+    "ElasticPolicy",
+    "SliceLost",
+    "WorkerLost",
+    "resolve_degraded_blocks",
+    "run_with_slice_recovery",
+    "budget_report",
+]
+
+#: policy knob: the default per-fit fault budget, "attempts[,wall_s]".
+FAULT_BUDGET_ENV = "DASK_ML_TPU_FAULT_BUDGET"
+
+#: policy knob: degraded-mode poisoned-block skips per stream (0 = off).
+DEGRADED_ENV = "DASK_ML_TPU_DEGRADED_BLOCKS"
+
+_DEFAULT_ATTEMPTS = 8
+_DEFAULT_WALL_S = 600.0
+
+
+class BudgetExhausted(RuntimeError):
+    """A shared :class:`FaultBudget` ran out: cascading faults crossed
+    the per-fit ceiling and recovery must stop retrying LOUDLY."""
+
+
+class WorkerLost(RuntimeError):
+    """A supervised background worker (prefetch staging thread) died
+    without reporting — the dead-thread verdict's exception form."""
+
+
+class SliceLost(RuntimeError):
+    """A device slice / fault domain dropped out of the mesh.  Raised by
+    callers' health probes (an ICI timeout, a coordinator eviction, a
+    dead host in the fleet) and consumed by
+    :func:`run_with_slice_recovery`."""
+
+
+def _parse_budget_env(raw: str) -> tuple[int, float]:
+    parts = [p.strip() for p in raw.split(",") if p.strip()]
+    if not 1 <= len(parts) <= 2:
+        raise ValueError(
+            f"{FAULT_BUDGET_ENV} must be 'attempts[,wall_seconds]', "
+            f"got {raw!r}")
+    try:
+        attempts = int(parts[0])
+        wall_s = float(parts[1]) if len(parts) == 2 else _DEFAULT_WALL_S
+    except ValueError:
+        raise ValueError(
+            f"{FAULT_BUDGET_ENV} must be 'attempts[,wall_seconds]', "
+            f"got {raw!r}") from None
+    if attempts < 0 or not wall_s > 0:
+        raise ValueError(
+            f"{FAULT_BUDGET_ENV} needs attempts >= 0 and wall > 0, "
+            f"got {raw!r}")
+    return attempts, wall_s
+
+
+class FaultBudget:
+    """Shared re-attempt + wall-clock budget for one fit's fault points.
+
+    ``acquire(tag)`` is the one gate: every recovery action (a retry
+    sleep, a worker restart, a unit requeue) asks the budget first and
+    takes a denial as "stop retrying, degrade loudly".  Thread-safe —
+    search-pool units and the pipeline driver share one instance.
+
+    ``wall_s`` bounds the wall clock spent ON RECOVERY (the backoff
+    sleeps charged through :meth:`charge_backoff`), NOT the fit's age:
+    a healthy fit may run for hours and keep its full retry capability
+    — what the wall budget caps is how long a fit may sit in backoff
+    before degradation is the honest answer.
+    """
+
+    def __init__(self, attempts: int = _DEFAULT_ATTEMPTS,
+                 wall_s: float = _DEFAULT_WALL_S, *, name: str = "fit"):
+        if int(attempts) < 0:
+            raise ValueError(f"attempts must be >= 0, got {attempts}")
+        if not float(wall_s) > 0:
+            raise ValueError(f"wall_s must be > 0, got {wall_s}")
+        self.attempts = int(attempts)
+        self.wall_s = float(wall_s)
+        self.name = str(name)
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+        self.spent = 0
+        self.denied = 0
+        self.backoff_s = 0.0
+
+    @classmethod
+    def from_env(cls, name: str = "fit") -> "FaultBudget":
+        raw = os.environ.get(FAULT_BUDGET_ENV, "").strip()
+        if not raw:
+            return cls(name=name)
+        attempts, wall_s = _parse_budget_env(raw)
+        return cls(attempts, wall_s, name=name)
+
+    # -- clock ---------------------------------------------------------
+    def elapsed_s(self) -> float:
+        """The owning fit's age (informational; never gates)."""
+        return time.monotonic() - self._t0
+
+    def remaining_s(self) -> float:
+        """Recovery wall seconds left before the budget denies."""
+        with self._lock:
+            return self.wall_s - self.backoff_s
+
+    def remaining_attempts(self) -> int:
+        with self._lock:
+            return max(self.attempts - self.spent, 0)
+
+    def expired(self) -> bool:
+        return self.remaining_s() <= 0.0
+
+    # -- the gate ------------------------------------------------------
+    def acquire(self, tag: str, n: int = 1) -> bool:
+        """Take ``n`` re-attempts from the budget; False when attempts
+        or recovery wall seconds are exhausted (the caller must then
+        degrade — propagate, skip, or fall back — instead of
+        retrying)."""
+        with self._lock:
+            ok = (self.spent + n <= self.attempts
+                  and self.backoff_s < self.wall_s)
+            if ok:
+                self.spent += n
+            else:
+                self.denied += n
+        reg = _registry()
+        if ok:
+            reg.counter("resilience.budget_spent", self.name).inc(n)
+        else:
+            reg.counter("resilience.budget_denied", self.name).inc(n)
+        reg.gauge("resilience.budget_remaining", self.name).set(
+            self.remaining_attempts())
+        return ok
+
+    def check(self, tag: str) -> None:
+        """``acquire`` or raise :class:`BudgetExhausted` — the loud
+        form for call sites with no degraded fallback."""
+        if not self.acquire(tag):
+            raise BudgetExhausted(
+                f"fault budget {self.name!r} exhausted at {tag!r}: "
+                f"{self.spent}/{self.attempts} attempts used, "
+                f"{self.remaining_s():.3g}s of {self.wall_s:g}s left")
+
+    def charge_backoff(self, tag: str, seconds: float) -> None:
+        """Account backoff sleep against the budget's wall books (the
+        registry-backed total ``diagnostics.fault_report()`` shows)."""
+        with self._lock:
+            self.backoff_s += float(seconds)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "attempts": self.attempts,
+                "spent": self.spent,
+                "denied": self.denied,
+                "wall_s": self.wall_s,
+                "elapsed_s": round(self.elapsed_s(), 6),
+                "backoff_s": round(self.backoff_s, 6),
+            }
+
+    def __repr__(self):
+        s = self.snapshot()
+        return (f"FaultBudget({s['name']!r}, {s['spent']}/{s['attempts']} "
+                f"attempts, {s['elapsed_s']:.3g}/{s['wall_s']:g}s)")
+
+
+def budget_report() -> dict:
+    """Registry view of every budget's consumption: the per-name
+    ``resilience.budget_*`` families (spent/denied counters + remaining
+    gauge).  Survives the budget objects themselves — this is what
+    ``diagnostics.fault_report()`` publishes."""
+    reg = _registry()
+    out: dict = {}
+    for fam, key in (("resilience.budget_spent", "spent"),
+                     ("resilience.budget_denied", "denied"),
+                     ("resilience.budget_remaining", "remaining")):
+        for name, value in reg.family(fam).items():
+            out.setdefault(name, {})[key] = value
+    return out
+
+
+def resolve_degraded_blocks(value: int | None = None) -> int:
+    """Resolve the degraded-mode skip allowance: explicit argument, else
+    the ``DASK_ML_TPU_DEGRADED_BLOCKS`` knob, else 0 (off).  Strict
+    parse — a typo'd knob raises rather than silently disarming."""
+    if value is None:
+        raw = os.environ.get(DEGRADED_ENV, "").strip()
+        if not raw:
+            return 0
+        try:
+            value = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"{DEGRADED_ENV} must be an integer >= 0, got {raw!r}"
+            ) from None
+    value = int(value)
+    if value < 0:
+        raise ValueError(
+            f"degraded-mode skip allowance must be >= 0, got {value}")
+    return value
+
+
+class ElasticPolicy:
+    """Per-stream recovery policy the pipeline's restart driver consults.
+
+    One instance per stream (or shared across a search's bursts via an
+    explicit ``budget``).  Decisions, per block fault:
+
+    * **retry** — re-attempt the SAME block (re-stage the held raw item
+      for a staging fault, re-pull a restartable source for a parse
+      fault, restart a dead worker for a crash), at most
+      ``block_retries`` times per block and within the shared budget;
+    * **skip** — degraded mode (``degraded_blocks`` > 0): a staging-
+      poisoned block past its retries is dropped with an exact record
+      (counter + flight event + ``skips``) and the stream continues;
+    * **raise** — everything else: the fault propagates with its block
+      position attached, exactly the pre-elastic behavior.
+
+    Parse faults on plain generator sources are NEVER retried: a
+    generator that raised is finished, so a re-pull would read as a
+    silent END of the stream (data loss).  Sources that can re-serve
+    the failed block opt in with a truthy ``restartable_source``
+    attribute (the io layer's native streams keep their position
+    internally and retry per block themselves).
+
+    Step (consume-side) faults are retried only when ``step_retries``
+    > 0 — opt-in, because a retry is exact-once only for steps that
+    either complete or leave state untouched (true for the device-
+    native functional steps, not guaranteed for arbitrary host
+    ``partial_fit`` implementations).
+    """
+
+    def __init__(self, *, budget: FaultBudget | None = None,
+                 degraded_blocks: int | None = None,
+                 block_retries: int = 2, step_retries: int = 0,
+                 label: str = "stream"):
+        self.budget = budget if budget is not None \
+            else FaultBudget.from_env(name=label)
+        self.degraded_blocks = resolve_degraded_blocks(degraded_blocks)
+        self.block_retries = int(block_retries)
+        self.step_retries = int(step_retries)
+        self.label = str(label)
+        self.skips: list[dict] = []
+        self._last_key: tuple | None = None
+        self._attempts = 0
+
+    # -- bookkeeping ---------------------------------------------------
+    def _stats(self):
+        from .retry import fault_stats
+
+        return fault_stats()
+
+    def note_skip(self, blk: int, phase: str, exc: BaseException) -> None:
+        rec = {"block": int(blk), "phase": phase, "error": _fmt_exc(exc)}
+        self.skips.append(rec)
+        _registry().counter("resilience.degraded_skip", self.label).inc()
+        _obs_event("pipeline.degraded_skip", label=self.label, **rec)
+
+    # -- the decision --------------------------------------------------
+    def on_block_fault(self, blk: int, phase: str, exc: BaseException,
+                       *, restartable: bool = False) -> str:
+        """Returns ``"retry"`` / ``"skip"`` / ``"raise"``.  Keeps the
+        fault books exact: every arrival is a fault; a retry verdict is
+        a retry; skip and raise are terminal failures for that block."""
+        tag = "prefetch-worker" if phase in ("crash", "worker") \
+            else f"pipeline-{phase}"
+        stats = self._stats()
+        stats.record_fault(tag)
+        key = (blk, phase)
+        if key != self._last_key:
+            self._last_key, self._attempts = key, 0
+        self._attempts += 1
+        can_retry = (
+            phase in ("stage", "crash", "worker", "step")
+            or (phase == "parse" and restartable)
+        )
+        if phase == "step":
+            within = self._attempts <= self.step_retries
+        else:
+            within = self._attempts <= self.block_retries
+        if can_retry and within and self.budget.acquire(tag):
+            stats.record_retry(tag)
+            _obs_event("resilience.retry", tag=tag, attempt=self._attempts,
+                       block=int(blk), error=_fmt_exc(exc))
+            return "retry"
+        if phase == "stage" and len(self.skips) < self.degraded_blocks:
+            stats.record_failure(tag)
+            self.note_skip(blk, phase, exc)
+            return "skip"
+        stats.record_failure(tag)
+        return "raise"
+
+
+def run_with_slice_recovery(fit, meshes, *,
+                            budget: FaultBudget | None = None,
+                            retryable=(SliceLost,)):
+    """Run ``fit(mesh)`` under each mesh in turn, treating a slice-loss
+    class fault as "resume on the surviving submesh".
+
+    ``meshes`` is the degradation ladder — the full mesh first, then
+    each surviving submesh (largest first).  On a ``retryable`` fault
+    the next mesh is entered within the shared ``budget``; anything
+    else propagates immediately.  An estimator carrying a
+    ``FitCheckpoint`` makes each re-entry a RESUME from its last
+    snapshot (checkpoints restore across mesh shapes — fit_checkpoint
+    module docstring), so completed iterations are kept, not redone.
+
+    Returns ``fit``'s result; raises the last slice loss when every
+    mesh (or the budget) is exhausted.
+    """
+    from ..core.mesh import use_mesh
+    from .retry import fault_stats
+
+    meshes = list(meshes)
+    if not meshes:
+        raise ValueError("run_with_slice_recovery needs at least one mesh")
+    if budget is None:
+        budget = FaultBudget.from_env(name="slice-recovery")
+    stats = fault_stats()
+    last: BaseException | None = None
+    for i, mesh in enumerate(meshes):
+        if last is not None:
+            # this entry is a RE-entry: it consumes budget
+            if not budget.acquire("slice-loss"):
+                stats.record_failure("slice-loss")
+                raise BudgetExhausted(
+                    f"slice-recovery budget exhausted after "
+                    f"{i} mesh(es)") from last
+            stats.record_retry("slice-loss")
+            _obs_event("resilience.slice_resume", mesh_index=i,
+                       error=_fmt_exc(last))
+        try:
+            if mesh is None:
+                return fit(None)
+            with use_mesh(mesh):
+                return fit(mesh)
+        except retryable as exc:
+            stats.record_fault("slice-loss")
+            last = exc
+    stats.record_failure("slice-loss")
+    raise last

@@ -215,7 +215,8 @@ def _note_failure(tag: str, attempt: int, exc: BaseException) -> None:
 
 def retry(fn, *args, retries: int = 3, backoff: float = 0.1,
           factor: float = 2.0, max_backoff: float = 30.0,
-          jitter: float = 0.1, retryable=(Exception,), deadline=None,
+          jitter: float = 0.1, full_jitter: bool = False,
+          retryable=(Exception,), deadline=None, budget=None,
           stats: FaultStats | None = None, tag: str = "retry",
           on_error=None, sleep=time.sleep, **kwargs):
     """Call ``fn(*args, **kwargs)``, retrying transient faults.
@@ -224,7 +225,12 @@ def retry(fn, *args, retries: int = 3, backoff: float = 0.1,
     ``min(backoff * factor**k, max_backoff) * (1 + jitter * U[0,1))`` —
     exponential with multiplicative jitter so a fleet of callers hitting
     the same flaky dependency doesn't resynchronize into a thundering
-    herd.
+    herd.  With ``full_jitter=True`` the delay is instead drawn uniform
+    from ``[0, min(backoff * factor**k, max_backoff))`` — the AWS
+    "full jitter" schedule, which decorrelates a large fleet harder at
+    the cost of occasionally near-zero sleeps; prefer it wherever MANY
+    units share one flaky dependency (the search pool, the drill
+    suite's cascades).
 
     Args:
       retries: maximum number of RE-attempts (0 = single attempt; the
@@ -236,6 +242,12 @@ def retry(fn, *args, retries: int = 3, backoff: float = 0.1,
         loop: an expired deadline stops retrying even with budget left,
         and a backoff that would outlive the deadline propagates the
         fault immediately instead of sleeping into a dead budget.
+      budget: optional shared :class:`~.elastic.FaultBudget`: every
+        re-attempt also acquires from it, so cascading faults across
+        MANY sites of one fit stop at the fit-wide ceiling instead of
+        multiplying per-site budgets.  A denial is a budget exhaustion:
+        the fault propagates (counted as a failure), exactly like
+        running out of ``retries``.
       stats: a :class:`FaultStats` to record into (defaults to the global
         one via :func:`fault_stats`); pass ``tag`` to separate books.
       on_error: ``on_error(exc, attempt)`` called on every caught
@@ -279,13 +291,22 @@ def retry(fn, *args, retries: int = 3, backoff: float = 0.1,
                 stats.record_failure(tag)
                 _note_failure(tag, attempt, exc)
                 raise
-            delay = min(backoff * (factor ** attempt), max_backoff)
-            delay *= 1.0 + jitter * random.random()
+            cap = min(backoff * (factor ** attempt), max_backoff)
+            if full_jitter:
+                delay = cap * random.random()
+            else:
+                delay = cap * (1.0 + jitter * random.random())
             if deadline is not None and delay >= deadline.remaining():
                 # the deadline dies before the retry could run: this fault
                 # is terminal — propagate NOW instead of sleeping into a
                 # dead budget (and keep the books exact: every fault is
                 # either a retry or a failure, never both, never neither)
+                stats.record_failure(tag)
+                _note_failure(tag, attempt, exc)
+                raise
+            if budget is not None and not budget.acquire(tag):
+                # the fit-wide shared budget said no: cascading faults
+                # crossed the per-fit ceiling — degrade loudly now
                 stats.record_failure(tag)
                 _note_failure(tag, attempt, exc)
                 raise
@@ -298,5 +319,11 @@ def retry(fn, *args, retries: int = 3, backoff: float = 0.1,
                 delay,
             )
             if delay > 0:
+                # backoff totals are registry-backed (fault_report):
+                # the histogram's sum is the wall this tag slept
+                _obs_registry().histogram(
+                    "resilience.backoff_s", tag).record(delay)
+                if budget is not None:
+                    budget.charge_backoff(tag, delay)
                 sleep(delay)
             attempt += 1

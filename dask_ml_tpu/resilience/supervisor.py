@@ -1,0 +1,196 @@
+"""Health supervision: heartbeat registry + fault-domain verdicts.
+
+The runtime grew several long-lived background units — the input
+pipeline's prefetch worker, the blessed compile-ahead thread, the
+adaptive search's training-pool units — and until now their health was
+implicit: a dead prefetch worker meant a consumer blocked on an empty
+queue forever, a dead compile-ahead thread meant consumers waiting out
+a 120 s safety valve, a wedged search unit meant a silent stall.  This
+module makes liveness EXPLICIT and cheap:
+
+* a unit **registers** a :class:`Heartbeat` under a fault *domain*
+  (``"pipeline"``, ``"compile"``, ``"search"``) and **beats** it at its
+  natural cadence (per staged block, per ahead build, per unit);
+* anyone holding the handle (or the name) can ask for a **verdict** —
+  ``healthy`` / ``late`` (no beat within the declared interval) /
+  ``dead`` (the registered thread is no longer alive) / ``retired``;
+* domain owners record **deaths** and **restarts** through
+  :func:`note_death` / :func:`note_restart`, which land in the metrics
+  registry (``supervisor.death{domain}`` / ``supervisor.restart{domain}``)
+  and the flight recorder — so ``diagnostics.fault_report()`` and
+  ``run_report()`` show exactly how many times each domain's recovery
+  path fired.
+
+Everything here is pure host stdlib plus the obs metrics registry — no
+jax, no numpy — so beats are legal from ANY thread, including the
+stage-purity-constrained prefetch worker (same posture as
+``obs.metrics``).  A beat is one attribute store plus one counter
+increment.
+
+The supervisor never *acts*: recovery is domain-scoped and lives with
+the domain owner (:mod:`dask_ml_tpu.pipeline` restarts its worker,
+:mod:`dask_ml_tpu.programs.ahead` restarts the blessed thread, the
+search requeues its unit) — this module is the shared verdict + books
+those drivers report through, so one report covers every domain.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..obs import event as _obs_event
+from ..obs.metrics import registry as _registry
+
+__all__ = [
+    "Heartbeat",
+    "register",
+    "lookup",
+    "verdicts",
+    "note_death",
+    "note_restart",
+    "report",
+    "reset",
+]
+
+
+class Heartbeat:
+    """One supervised unit's liveness handle.
+
+    ``beat()`` is the only hot-path call: a monotonic store and a
+    counter increment.  ``verdict()`` is pull-based — the supervisor
+    never polls on its own thread; domain owners (and the drill suite)
+    ask at their recovery decision points.
+    """
+
+    __slots__ = ("name", "domain", "interval_s", "_last", "_thread",
+                 "_retired", "beats")
+
+    def __init__(self, name: str, domain: str, *, thread=None,
+                 interval_s: float | None = None):
+        self.name = str(name)
+        self.domain = str(domain)
+        self.interval_s = None if interval_s is None else float(interval_s)
+        self._thread = thread
+        self._retired = False
+        self.beats = 0
+        self._last = time.monotonic()
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+        self.beats += 1
+        _registry().counter("supervisor.beat", self.domain).inc()
+
+    def retire(self) -> None:
+        """The unit finished cleanly; it is no longer supervised.  Also
+        drops the registry entry (long-lived processes register a unit
+        per stream/search-unit — retired handles must not accumulate),
+        unless a restarted unit already re-registered under the name."""
+        self._retired = True
+        with _LOCK:
+            if _UNITS.get(self.name) is self:
+                del _UNITS[self.name]
+
+    def age_s(self) -> float:
+        return time.monotonic() - self._last
+
+    def verdict(self) -> str:
+        if self._retired:
+            return "retired"
+        t = self._thread
+        if t is not None and not t.is_alive():
+            return "dead"
+        if self.interval_s is not None and self.age_s() > self.interval_s:
+            return "late"
+        return "healthy"
+
+    def __repr__(self):
+        return (f"Heartbeat({self.name!r}, domain={self.domain!r}, "
+                f"verdict={self.verdict()!r}, beats={self.beats})")
+
+
+_LOCK = threading.Lock()
+_UNITS: dict[str, Heartbeat] = {}
+
+
+def register(name: str, domain: str, *, thread=None,
+             interval_s: float | None = None) -> Heartbeat:
+    """Register (or replace — a restarted unit re-registers under its
+    name) a supervised unit and return its :class:`Heartbeat`."""
+    hb = Heartbeat(name, domain, thread=thread, interval_s=interval_s)
+    with _LOCK:
+        _UNITS[name] = hb
+    return hb
+
+
+def lookup(name: str) -> Heartbeat | None:
+    with _LOCK:
+        return _UNITS.get(name)
+
+
+def verdicts() -> dict:
+    """``{name: verdict}`` for every registered unit."""
+    with _LOCK:
+        units = list(_UNITS.values())
+    return {hb.name: hb.verdict() for hb in units}
+
+
+def note_death(domain: str, name: str, error: str | None = None) -> None:
+    """A supervised unit was found dead (missed-heartbeat or dead-thread
+    verdict).  Counted per domain and flight-recorded — a death is a
+    fault, and faults are loud."""
+    _registry().counter("supervisor.death", domain).inc()
+    _obs_event("supervisor.death", domain=domain, unit=name,
+               **({"error": error} if error else {}))
+
+
+def note_restart(domain: str, name: str) -> None:
+    """Domain-scoped recovery restarted a unit (the verdict's other
+    half: every death should pair with a restart or a loud failure)."""
+    _registry().counter("supervisor.restart", domain).inc()
+    _obs_event("supervisor.restart", domain=domain, unit=name)
+
+
+def report() -> dict:
+    """Per-domain supervision books (registry-backed: deaths/restarts
+    read the ``supervisor.*`` counter families, so they survive unit
+    retirement and appear in ``run_report()``'s metrics snapshot)::
+
+        {domain: {"units": n, "late": [...], "dead": [...],
+                  "beats": n, "deaths": n, "restarts": n}}
+    """
+    reg = _registry()
+    with _LOCK:
+        units = list(_UNITS.values())
+    domains: dict[str, dict] = {}
+    for hb in units:
+        d = domains.setdefault(hb.domain, {"units": 0, "late": [],
+                                           "dead": []})
+        if hb.verdict() == "retired":
+            continue
+        d["units"] += 1
+        v = hb.verdict()
+        if v == "late":
+            d["late"].append(hb.name)
+        elif v == "dead":
+            d["dead"].append(hb.name)
+    for fam, key in (("supervisor.beat", "beats"),
+                     ("supervisor.death", "deaths"),
+                     ("supervisor.restart", "restarts")):
+        for domain, count in reg.family(fam).items():
+            d = domains.setdefault(domain, {"units": 0, "late": [],
+                                            "dead": []})
+            d[key] = count
+    for d in domains.values():
+        d.setdefault("beats", 0)
+        d.setdefault("deaths", 0)
+        d.setdefault("restarts", 0)
+    return domains
+
+
+def reset() -> None:
+    """Drop every registered unit and the ``supervisor.*`` registry
+    family (test isolation)."""
+    with _LOCK:
+        _UNITS.clear()
+    _registry().reset(prefix="supervisor.")

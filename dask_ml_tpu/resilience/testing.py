@@ -17,7 +17,20 @@ pattern).  This module replaces that with a declarative registry: a
   tmp file is written but BEFORE the atomic rename, i.e. exactly the
   crash-mid-write window the atomicity contract protects against;
 * ``"collective"`` — the sharding boundary (``core.sharded.shard_rows`` /
-  ``unshard``), the in-process stand-in for an ICI/DCN transport fault.
+  ``unshard``), the in-process stand-in for an ICI/DCN transport fault;
+* ``"stage"`` — the input pipeline's staging leg (``pipeline/core.py``
+  ``_parse_and_stage``), i.e. a post-parse H2D fault on the prefetch
+  worker thread — the poisoned-block case degraded-mode training skips;
+* ``"prefetch-worker"`` — the top of the prefetch worker's loop; inject
+  :class:`ThreadCrash` here to simulate the worker thread dying WITHOUT
+  reporting (the dead-thread verdict the supervisor must catch);
+* ``"compile-ahead"`` — the blessed compile-ahead thread's build loop
+  (``programs/ahead.py``); a :class:`ThreadCrash` here simulates the
+  builder dying mid-build (consumers must fall through to synchronous
+  compiles, never hang on the in-flight event);
+* ``"exporter-write"`` — the grafttrace JSONL sink's write path
+  (``obs/export.py``); inject ``OSError(errno.ENOSPC, ...)`` to drill
+  the disk-full degradation (drop the sink, keep training).
 
 Hot paths pay one global ``is None`` check when no plan is active.
 """
@@ -32,18 +45,34 @@ from dataclasses import dataclass, field
 __all__ = [
     "FaultInjected",
     "FaultPlan",
+    "ThreadCrash",
     "active_plan",
     "fault_plan",
     "maybe_fault",
 ]
 
 #: The canonical injection points wired through the runtime (plans may
-#: use additional caller-private point names freely).
-INJECTION_POINTS = ("ingest", "step", "checkpoint-write", "collective")
+#: use additional caller-private point names freely).  EVERY entry here
+#: must have a drill in ``resilience.drills`` — the chaos suite's
+#: coverage invariant fails a new point with no recovery drill.
+INJECTION_POINTS = (
+    "ingest", "step", "checkpoint-write", "collective",
+    "stage", "prefetch-worker", "compile-ahead", "exporter-write",
+)
 
 
 class FaultInjected(RuntimeError):
     """The default exception raised at a scheduled injection."""
+
+
+class ThreadCrash(BaseException):
+    """Simulated hard death of a background thread (drills only).
+
+    Deliberately a ``BaseException``: it must sail past every
+    ``except Exception`` recovery net so the thread dies exactly as if
+    the runtime killed it — the worker loops catch it EXPLICITLY and
+    vanish without reporting, which is the failure mode the supervisor's
+    dead-thread verdict exists to detect."""
 
 
 @dataclass

@@ -1,0 +1,646 @@
+"""Elastic fault-domain runtime: budgets, supervision, restart driver,
+degraded mode, slice recovery (docs/design.md §13).
+
+Covers the PR-9 satellites too: the compile-ahead set-on-failure
+contract (an injected builder crash must not strand a consumer on the
+in-flight event), staging faults carrying their block position into
+``pipeline.fault`` flight events, the checkpoint-write transient-OSError
+retry, and checkpoint resume across a ``DASK_ML_TPU_BUCKET`` policy
+change.
+"""
+
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import diagnostics, obs
+from dask_ml_tpu.pipeline import prefetch_blocks, stream_partial_fit
+from dask_ml_tpu.resilience import (
+    BudgetExhausted,
+    ElasticPolicy,
+    FaultBudget,
+    FaultInjected,
+    FaultPlan,
+    SliceLost,
+    ThreadCrash,
+    fault_plan,
+    fault_stats,
+    retry,
+    run_with_slice_recovery,
+    supervisor,
+)
+from dask_ml_tpu.resilience import elastic as elastic_mod
+
+
+def _blocks(n=6, rows=4, cols=2):
+    return [np.full((rows, cols), i, np.float32) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# FaultBudget
+# ---------------------------------------------------------------------------
+
+class TestFaultBudget:
+    def test_acquire_until_exhausted_then_denied(self):
+        b = FaultBudget(attempts=2, wall_s=60, name="t-budget")
+        assert b.acquire("a") and b.acquire("b")
+        assert not b.acquire("c")
+        assert b.spent == 2 and b.denied == 1
+        assert b.remaining_attempts() == 0
+
+    def test_recovery_wall_exhaustion_denies_with_attempts_left(self):
+        b = FaultBudget(attempts=100, wall_s=0.01, name="t-wall")
+        b.charge_backoff("x", 0.02)
+        assert b.expired()
+        assert not b.acquire("late")
+
+    def test_fit_age_never_gates_a_healthy_fit(self):
+        """The wall budget caps RECOVERY wall, not fit duration: a
+        long-running fit with no backoff spend keeps full retry
+        capability (pre-fix, any fit older than wall_s lost it all)."""
+        b = FaultBudget(attempts=2, wall_s=0.01, name="t-age")
+        time.sleep(0.03)  # fit "runs" far past wall_s, zero recovery
+        assert not b.expired()
+        assert b.acquire("late-but-healthy")
+
+    def test_check_raises_loudly(self):
+        b = FaultBudget(attempts=0, name="t-check")
+        with pytest.raises(BudgetExhausted, match="t-check"):
+            b.check("site")
+
+    def test_registry_backed_books(self):
+        b = FaultBudget(attempts=1, name="t-registry")
+        b.acquire("x")
+        b.acquire("y")
+        rep = elastic_mod.budget_report()
+        assert rep["t-registry"]["spent"] >= 1
+        assert rep["t-registry"]["denied"] >= 1
+
+    @pytest.mark.parametrize("raw,attempts,wall", [
+        ("5", 5, 600.0), ("4,30", 4, 30.0), (" 7 , 2.5 ", 7, 2.5),
+    ])
+    def test_env_parse(self, monkeypatch, raw, attempts, wall):
+        monkeypatch.setenv(elastic_mod.FAULT_BUDGET_ENV, raw)
+        b = FaultBudget.from_env("t-env")
+        assert (b.attempts, b.wall_s) == (attempts, wall)
+
+    @pytest.mark.parametrize("raw", ["nope", "3,x", "1,2,3", "-1", "2,0"])
+    def test_env_parse_strict(self, monkeypatch, raw):
+        monkeypatch.setenv(elastic_mod.FAULT_BUDGET_ENV, raw)
+        with pytest.raises(ValueError):
+            FaultBudget.from_env("t-env")
+
+    def test_degraded_knob_strict(self, monkeypatch):
+        monkeypatch.setenv(elastic_mod.DEGRADED_ENV, "soon")
+        with pytest.raises(ValueError):
+            elastic_mod.resolve_degraded_blocks()
+        monkeypatch.setenv(elastic_mod.DEGRADED_ENV, "3")
+        assert elastic_mod.resolve_degraded_blocks() == 3
+
+
+# ---------------------------------------------------------------------------
+# retry: budget + full jitter
+# ---------------------------------------------------------------------------
+
+class TestRetryBudget:
+    def test_budget_denial_propagates_and_counts_failure(self):
+        budget = FaultBudget(attempts=1, name="t-retry-budget")
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise OSError("down")
+
+        before = fault_stats().snapshot()
+        with pytest.raises(OSError):
+            retry(flaky, retries=10, backoff=0.0, jitter=0.0,
+                  budget=budget, tag="t-retry-budget")
+        after = fault_stats().snapshot()
+        # attempt 1 + the single budgeted re-attempt: the shared budget
+        # cut a retries=10 loop to 2 calls
+        assert len(calls) == 2
+        delta_f = (after["failures"].get("t-retry-budget", 0)
+                   - before["failures"].get("t-retry-budget", 0))
+        assert delta_f == 1
+
+    def test_full_jitter_delay_below_cap(self):
+        sleeps = []
+
+        def flaky():
+            if len(sleeps) < 3:
+                raise OSError("down")
+            return "ok"
+
+        out = retry(flaky, retries=5, backoff=0.1, factor=1.0,
+                    full_jitter=True, sleep=sleeps.append,
+                    tag="t-full-jitter")
+        assert out == "ok"
+        assert len(sleeps) == 3
+        assert all(0.0 <= s < 0.1 for s in sleeps)
+
+    def test_backoff_totals_registry_backed(self):
+        sleeps = []
+
+        def flaky():
+            if not sleeps:
+                raise OSError("down")
+            return "ok"
+
+        retry(flaky, retries=2, backoff=0.05, jitter=0.0,
+              sleep=sleeps.append, tag="t-backoff-books")
+        rep = diagnostics.fault_report()
+        assert rep["backoff_s"].get("t-backoff-books", 0) >= 0.05
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+class TestSupervisor:
+    def test_verdicts(self):
+        hb = supervisor.register("t-unit", "t-domain", interval_s=0.02)
+        assert hb.verdict() == "healthy"
+        time.sleep(0.05)
+        assert hb.verdict() == "late"
+        hb.beat()
+        assert hb.verdict() == "healthy"
+        hb.retire()
+        assert hb.verdict() == "retired"
+
+    def test_dead_thread_verdict(self):
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+        hb = supervisor.register("t-dead", "t-domain", thread=t)
+        assert hb.verdict() == "dead"
+
+    def test_retire_drops_registry_entry(self):
+        """Long-lived processes register a unit per stream / search
+        unit: retirement must drop the table entry, not just flag it,
+        or _UNITS grows without bound."""
+        hb = supervisor.register("t-retire", "t-domain")
+        assert supervisor.lookup("t-retire") is hb
+        hb.retire()
+        assert supervisor.lookup("t-retire") is None
+        assert hb.verdict() == "retired"  # the handle still answers
+
+    def test_report_counts_deaths_and_restarts(self):
+        supervisor.note_death("t-dom2", "u", error="boom")
+        supervisor.note_restart("t-dom2", "u")
+        rep = supervisor.report()
+        assert rep["t-dom2"]["deaths"] >= 1
+        assert rep["t-dom2"]["restarts"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# the elastic pipeline driver
+# ---------------------------------------------------------------------------
+
+class _Restartable:
+    restartable_source = True
+
+    def __init__(self, blocks, fire=None):
+        self._blocks = list(blocks)
+        self._fire = fire
+        self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._i >= len(self._blocks):
+            raise StopIteration
+        if self._fire:
+            from dask_ml_tpu.resilience.testing import maybe_fault
+
+            maybe_fault(self._fire)
+        b = self._blocks[self._i]
+        self._i += 1
+        return b
+
+
+class TestElasticPipeline:
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_worker_crash_restarts_and_replays_exactly(self, depth):
+        blocks = _blocks()
+        plan = FaultPlan().inject("prefetch-worker", at_call=3, times=1,
+                                  exc=ThreadCrash("test"))
+        with fault_plan(plan):
+            out = list(prefetch_blocks(blocks, depth=depth,
+                                       label="t-crash"))
+        # depth 0 has no worker: the point never fires; depth >= 1
+        # restarts and replays with no loss, no duplication, in order
+        assert len(out) == len(blocks)
+        assert all(np.array_equal(a, b) for a, b in zip(out, blocks))
+        if depth:
+            assert plan.fired["prefetch-worker"] == 1
+
+    def test_transient_stage_fault_retried_same_block(self):
+        blocks = _blocks()
+        plan = FaultPlan().inject("stage", at_call=2, times=1)
+        with fault_plan(plan):
+            out = list(prefetch_blocks(blocks, depth=2, label="t-stage"))
+        assert len(out) == len(blocks)
+        assert all(np.array_equal(a, b) for a, b in zip(out, blocks))
+
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_poisoned_block_skipped_under_degraded_knob(self, depth):
+        blocks = _blocks()
+        policy = ElasticPolicy(degraded_blocks=1, block_retries=1,
+                               label="t-skip")
+        plan = FaultPlan().inject("stage", at_call=(3, 4), times=2)
+        with fault_plan(plan):
+            out = list(prefetch_blocks(blocks, depth=depth,
+                                       elastic=policy))
+        assert len(out) == len(blocks) - 1
+        assert np.array_equal(out[2], blocks[3])  # block 2 is gone
+        assert policy.skips == [{
+            "block": 2, "phase": "stage",
+            "error": "FaultInjected: injected fault at 'stage'"}]
+        rep = diagnostics.fault_report()
+        assert rep["degraded_skips"].get("t-skip", 0) >= 1
+
+    def test_degraded_off_by_default_raises_with_position(self):
+        blocks = _blocks()
+        plan = FaultPlan().inject("stage", at_call=(4, 5, 6), times=3)
+        with pytest.raises(FaultInjected) as ei:
+            with fault_plan(plan):
+                list(prefetch_blocks(blocks, depth=2,
+                                     elastic=ElasticPolicy(
+                                         block_retries=2, label="t-pos")))
+        assert ei.value.__dmlt_block__ == 3
+        assert ei.value.__dmlt_phase__ == "stage"
+
+    def test_parse_fault_on_generator_source_propagates(self):
+        """A generator that raised is FINISHED: retrying it would read
+        as a silent end-of-stream (data loss), so plain generator
+        sources never retry parse faults."""
+        def gen():
+            yield np.zeros((2, 2), np.float32)
+            raise OSError("reader died")
+
+        with pytest.raises(OSError):
+            list(prefetch_blocks(gen(), depth=2, label="t-gen"))
+
+    def test_parse_fault_on_restartable_source_retried(self):
+        blocks = _blocks()
+        plan = FaultPlan().inject("ingest", at_call=3, times=1)
+        src = _Restartable(blocks, fire="ingest")
+        with fault_plan(plan):
+            out = list(prefetch_blocks(src, depth=2, label="t-restart"))
+        assert len(out) == len(blocks)
+        assert all(np.array_equal(a, b) for a, b in zip(out, blocks))
+
+    def test_budget_exhaustion_stops_restarting(self):
+        blocks = _blocks()
+        policy = ElasticPolicy(
+            budget=FaultBudget(attempts=1, name="t-exhaust"),
+            block_retries=10, label="t-exhaust")
+        plan = FaultPlan().persistent("stage")
+        with pytest.raises(FaultInjected):
+            with fault_plan(plan):
+                list(prefetch_blocks(blocks, depth=2, elastic=policy))
+        # original attempt + exactly ONE budgeted retry, despite
+        # block_retries=10
+        assert plan.calls["stage"] == 2
+
+    def test_crash_death_and_restart_are_supervised(self):
+        before = obs.registry().family("supervisor.death").get(
+            "pipeline", 0)
+        plan = FaultPlan().inject("prefetch-worker", at_call=2, times=1,
+                                  exc=ThreadCrash("test"))
+        with fault_plan(plan):
+            list(prefetch_blocks(_blocks(), depth=2, label="t-sup"))
+        fam = obs.registry().family("supervisor.death")
+        assert fam.get("pipeline", 0) == before + 1
+
+
+class _StepModel:
+    """Host-only partial_fit model whose step can fault BEFORE mutating
+    state (the retry-safety contract step_retries documents)."""
+
+    def __init__(self, fail_on_call=None):
+        self.seen = []
+        self.calls = 0
+        self.fail_on_call = fail_on_call
+
+    def partial_fit(self, X, y=None):
+        self.calls += 1
+        if self.calls == self.fail_on_call:
+            raise RuntimeError("transient step fault")
+        self.seen.append(float(X[0, 0]))
+        return self
+
+
+class TestStepRetry:
+    def test_step_retry_opt_in_consumes_block_exactly_once(self):
+        model = _StepModel(fail_on_call=3)
+        blocks = [(b, None) for b in _blocks()]
+        stream_partial_fit(
+            model, blocks, depth=2,
+            elastic=ElasticPolicy(step_retries=1, label="t-step"))
+        assert model.seen == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+    def test_step_fault_propagates_by_default(self):
+        model = _StepModel(fail_on_call=3)
+        blocks = [(b, None) for b in _blocks()]
+        with pytest.raises(RuntimeError, match="transient step fault"):
+            stream_partial_fit(model, blocks, depth=2, label="t-step-off")
+
+
+class TestFaultEventPosition:
+    def test_stage_fault_event_carries_worker_side_position(self):
+        """PR-9 satellite: a staging (post-parse H2D) fault's
+        ``pipeline.fault`` flight event must carry the FAILING block's
+        position and phase — even when the prefetch worker is blocks
+        ahead of the consumer."""
+        class _Slow(_StepModel):
+            def partial_fit(self, X, y=None):
+                time.sleep(0.05)  # let the worker run ahead
+                return super().partial_fit(X, y)
+
+        blocks = [(b, None) for b in _blocks(n=8)]
+        plan = FaultPlan().inject("stage", at_call=(5, 6, 7), times=3)
+        obs.flight.clear()
+        with pytest.raises(FaultInjected):
+            with fault_plan(plan):
+                stream_partial_fit(
+                    _Slow(), blocks, depth=3,
+                    elastic=ElasticPolicy(block_retries=2,
+                                          label="t-event"))
+        events = [e for e in obs.flight_tail()
+                  if e["name"] == "pipeline.fault"]
+        assert events, "no pipeline.fault flight event recorded"
+        evt = events[-1]
+        assert evt["attrs"]["block"] == 4
+        assert evt["attrs"]["phase"] == "stage"
+
+    def test_consume_fault_event_keeps_consumer_position(self):
+        model = _StepModel(fail_on_call=2)
+        blocks = [(b, None) for b in _blocks()]
+        obs.flight.clear()
+        with pytest.raises(RuntimeError):
+            stream_partial_fit(model, blocks, depth=0, label="t-consume")
+        evt = [e for e in obs.flight_tail()
+               if e["name"] == "pipeline.fault"][-1]
+        assert evt["attrs"]["block"] == 1
+        assert evt["attrs"]["phase"] == "consume"
+
+
+# ---------------------------------------------------------------------------
+# compile-ahead: set-on-failure (PR-9 satellite regression)
+# ---------------------------------------------------------------------------
+
+class TestAheadCrash:
+    def test_builder_crash_never_strands_the_consumer(self):
+        import jax
+        import jax.numpy as jnp
+        from dask_ml_tpu.programs import ahead, cache
+
+        ahead._reset_restarts_for_tests()
+        prog = cache.cached_program(lambda x: x * 3.0,
+                                    name="t_elastic_ahead_crash")
+        x = jnp.ones((4, 3), jnp.float32)
+        sds = jax.ShapeDtypeStruct((4, 3), jnp.float32)
+        plan = FaultPlan().inject("compile-ahead", at_call=1, times=1,
+                                  exc=ThreadCrash("test"))
+        with fault_plan(plan):
+            assert prog.warm((sds,)) is True
+            t0 = time.perf_counter()
+            out = prog(x)  # pre-fix: hung for the 120 s safety valve
+            waited = time.perf_counter() - t0
+        assert np.allclose(np.asarray(out), 3.0)
+        assert waited < 30.0
+        assert prog.report()["ahead_errors"] >= 1
+        # the dying worker failed the in-flight marker; nothing leaks
+        assert prog.report()["inflight"] == 0
+
+    def test_worker_restarts_after_death(self):
+        import jax
+        import jax.numpy as jnp
+        from dask_ml_tpu.programs import ahead, cache
+
+        ahead._reset_restarts_for_tests()
+        prog = cache.cached_program(lambda x: x - 1.0,
+                                    name="t_elastic_ahead_restart")
+        sds = jax.ShapeDtypeStruct((3, 3), jnp.float32)
+        assert prog.warm((sds,)) is True
+        assert ahead.drain()
+        assert ahead.worker_alive()
+        out = prog(jnp.ones((3, 3), jnp.float32))
+        assert np.allclose(np.asarray(out), 0.0)
+        assert prog.report()["ahead_hits"] == 1
+
+    def test_queued_builds_fail_when_worker_dies(self):
+        """A task still queued when the builder dies must have its
+        in-flight marker failed by the dying drain — not wait for a
+        future submit."""
+        from dask_ml_tpu.programs import ahead as ahead_mod
+
+        class _Prog:
+            name = "t_fake"
+
+            def __init__(self):
+                self.failed = []
+
+            def _ahead_failed(self, sig, exc):
+                self.failed.append((sig, exc))
+
+        p = _Prog()
+        q = queue.Queue()
+        q.put((p, "sig1", (), {}))
+        q.put((p, "sig2", (), {}))
+        ahead_mod._drain_failed(q, RuntimeError("dead"))
+        assert [s for s, _ in p.failed] == ["sig1", "sig2"]
+        assert q.unfinished_tasks == 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-write retry (PR-9: the one choke point recovers transients)
+# ---------------------------------------------------------------------------
+
+class TestCheckpointWriteRetry:
+    def test_transient_oserror_absorbed(self, tmp_path):
+        from dask_ml_tpu.checkpoint import _atomic_pickle
+
+        path = str(tmp_path / "snap.pkl")
+        plan = FaultPlan().inject("checkpoint-write", at_call=1, times=1,
+                                  exc=OSError(28, "no space"))
+        with fault_plan(plan):
+            _atomic_pickle({"v": 1}, path)
+        import pickle
+
+        with open(path, "rb") as f:
+            assert pickle.load(f) == {"v": 1}
+        assert plan.calls["checkpoint-write"] == 2  # fault + clean retry
+
+    def test_injected_crash_still_propagates_unretried(self, tmp_path):
+        """The crash-mid-write drill contract: a FaultInjected is a
+        simulated CRASH, not a transient — exactly one attempt, the
+        previous snapshot untouched."""
+        from dask_ml_tpu.checkpoint import _atomic_pickle
+
+        path = str(tmp_path / "snap.pkl")
+        _atomic_pickle({"v": 1}, path)
+        plan = FaultPlan().inject("checkpoint-write", at_call=1, times=1)
+        with pytest.raises(FaultInjected):
+            with fault_plan(plan):
+                _atomic_pickle({"v": 2}, path)
+        assert plan.calls["checkpoint-write"] == 1
+        import pickle
+
+        with open(path, "rb") as f:
+            assert pickle.load(f) == {"v": 1}
+
+
+# ---------------------------------------------------------------------------
+# slice loss as a resume (submesh recovery)
+# ---------------------------------------------------------------------------
+
+class TestSliceRecovery:
+    def test_reentry_on_next_mesh_within_budget(self):
+        calls = []
+
+        def fit(mesh):
+            calls.append(mesh)
+            if len(calls) == 1:
+                raise SliceLost("slice 1 gone")
+            return "fitted"
+
+        out = run_with_slice_recovery(
+            fit, [None, None],
+            budget=FaultBudget(attempts=4, name="t-slice"))
+        assert out == "fitted" and len(calls) == 2
+
+    def test_budget_denial_raises_budget_exhausted(self):
+        def fit(mesh):
+            raise SliceLost("gone")
+
+        with pytest.raises(BudgetExhausted):
+            run_with_slice_recovery(
+                fit, [None, None, None],
+                budget=FaultBudget(attempts=0, name="t-slice0"))
+
+    def test_non_slice_fault_propagates_immediately(self):
+        calls = []
+
+        def fit(mesh):
+            calls.append(1)
+            raise ValueError("real bug")
+
+        with pytest.raises(ValueError):
+            run_with_slice_recovery(
+                fit, [None, None],
+                budget=FaultBudget(attempts=4, name="t-slice2"))
+        assert len(calls) == 1
+
+    def test_kmeans_resumes_on_surviving_submesh(self, tmp_path,
+                                                 n_devices):
+        """The real thing: a KMeans fit loses its slice mid-fit (an
+        injected SliceLost at a segment boundary), and the re-entry on
+        the 4-device submesh RESUMES from the FitCheckpoint — the final
+        centers match the uninterrupted full-mesh fit."""
+        if n_devices < 8:
+            pytest.skip("needs the 8-virtual-device mesh")
+        from dask_ml_tpu.cluster import KMeans
+        from dask_ml_tpu.core.mesh import device_mesh
+        from dask_ml_tpu.resilience import FitCheckpoint
+
+        rng = np.random.RandomState(3)
+        X = rng.normal(size=(64, 4)).astype(np.float32)
+
+        def make(ck=None):
+            return KMeans(n_clusters=3, max_iter=12, tol=0.0,
+                          random_state=0, fit_checkpoint=ck)
+
+        ref = make().fit(X)
+        path = str(tmp_path / "ck.pkl")
+        attempt = []
+
+        def fit(mesh):
+            est = make(FitCheckpoint(path, every_n_iters=4))
+            if not attempt:
+                attempt.append(1)
+                plan = FaultPlan().inject(
+                    "step", at_call=2, times=1,
+                    exc=SliceLost("slice down"))
+                with fault_plan(plan):
+                    return est.fit(X)
+            return est.fit(X)
+
+        model = run_with_slice_recovery(
+            fit, [device_mesh(8), device_mesh(4)],
+            budget=FaultBudget(attempts=2, name="t-slice-km"))
+        np.testing.assert_allclose(
+            np.asarray(model.cluster_centers_),
+            np.asarray(ref.cluster_centers_), rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint resume across a bucket-policy change (PR-9 satellite)
+# ---------------------------------------------------------------------------
+
+class TestBucketPolicyResume:
+    @pytest.mark.parametrize("resume_policy", ["off", "64,512,2048"])
+    def test_sgd_resume_across_bucket_change(self, tmp_path,
+                                             monkeypatch,
+                                             resume_policy):
+        """Save mid-fit under ``DASK_ML_TPU_BUCKET=auto``, resume under
+        ``off`` / an explicit ladder: the padded program SHAPES differ
+        (program warmth may differ), but the model must match the
+        uninterrupted fit to the documented reassociation bound."""
+        from dask_ml_tpu.linear_model import SGDClassifier
+        from dask_ml_tpu.resilience import FitCheckpoint
+
+        rng = np.random.RandomState(5)
+        X = rng.normal(size=(300, 6)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int32)
+
+        def make(ck=None):
+            return SGDClassifier(random_state=0, max_iter=10, tol=None,
+                                 fit_checkpoint=ck)
+
+        monkeypatch.setenv("DASK_ML_TPU_BUCKET", "auto")
+        ref = make().fit(X, y)
+        path = str(tmp_path / "sgd.pkl")
+        plan = FaultPlan().inject("step", at_call=7, times=1)
+        with pytest.raises(FaultInjected):
+            with fault_plan(plan):
+                make(FitCheckpoint(path, every_n_iters=2)).fit(X, y)
+        assert os.path.exists(path)
+
+        monkeypatch.setenv("DASK_ML_TPU_BUCKET", resume_policy)
+        resumed = make(FitCheckpoint(path, every_n_iters=2,
+                                     keep_on_complete=True)).fit(X, y)
+        np.testing.assert_allclose(
+            np.asarray(resumed.coef_), np.asarray(ref.coef_),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(resumed.intercept_), np.asarray(ref.intercept_),
+            rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fault_report / run_report integration
+# ---------------------------------------------------------------------------
+
+class TestFaultReport:
+    def test_shape_and_registry_backing(self):
+        b = FaultBudget(attempts=3, name="t-report")
+        b.acquire("x")
+        rep = diagnostics.fault_report()
+        for key in ("faults", "budgets", "backoff_s", "degraded_skips",
+                    "supervisor"):
+            assert key in rep
+        assert rep["budgets"]["t-report"]["spent"] >= 1
+
+    def test_run_report_carries_resilience_view(self):
+        rep = diagnostics.run_report()
+        assert "resilience" in rep
+        assert set(rep["resilience"]) == {
+            "faults", "budgets", "backoff_s", "degraded_skips",
+            "supervisor"}

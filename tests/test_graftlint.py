@@ -665,6 +665,111 @@ class TestUnboundedRetry:
         """)
         assert not active(findings)
 
+    def test_shared_fault_budget_bounds_it(self):
+        """PR 9: a non-None budget= (the per-fit shared FaultBudget,
+        design.md §13) attempt-bounds the loop like a Deadline does."""
+        findings = lint("""
+            from dask_ml_tpu.resilience.retry import retry
+
+            def pull(fetch, retries, budget):
+                return retry(fetch, retries=int(retries), budget=budget)
+        """)
+        assert not active(findings)
+
+    def test_budget_none_does_not_count(self):
+        findings = lint("""
+            from dask_ml_tpu.resilience.retry import retry
+
+            def pull(fetch, n):
+                return retry(fetch, retries=n, budget=None)
+        """)
+        assert rule_ids(active(findings)) == ["unbounded-retry"]
+
+
+class TestSwallowedFault:
+    """PR 9 satellite: the static twin of the chaos drill suite's
+    'every fault is observable' contract — a try/except around a
+    FaultPlan-registered call site whose handler neither raises nor
+    calls anything erases a fault from the books."""
+
+    def _pkg(self, tmp_path, handler_body):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "sites.py").write_text(textwrap.dedent("""
+            def maybe_fault(point):
+                pass
+
+            def read_block(path):
+                maybe_fault("ingest")
+                return path
+        """))
+        (pkg / "caller.py").write_text(textwrap.dedent(f"""
+            from .sites import read_block
+
+            def pull(path):
+                try:
+                    return read_block(path)
+                except Exception:
+                    {handler_body}
+        """))
+        return str(pkg)
+
+    def test_silent_swallow_around_fault_site_flagged(self, tmp_path):
+        findings, errors = lint_paths(
+            [self._pkg(tmp_path, "return None")])
+        assert not errors
+        assert "swallowed-fault" in rule_ids(active(findings))
+
+    def test_transitive_reach_through_helper_flagged(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "deep.py").write_text(textwrap.dedent("""
+            def maybe_fault(point):
+                pass
+
+            def inner():
+                maybe_fault("collective")
+
+            def outer():
+                return inner()
+
+            def pull():
+                try:
+                    outer()
+                except Exception:
+                    pass
+        """))
+        findings, _ = lint_paths([str(pkg)])
+        assert "swallowed-fault" in rule_ids(active(findings))
+
+    def test_logging_handler_is_clean(self, tmp_path):
+        findings, _ = lint_paths(
+            [self._pkg(tmp_path, "logger.warning('fault dropped')")])
+        assert "swallowed-fault" not in rule_ids(active(findings))
+
+    def test_reraise_handler_is_clean(self, tmp_path):
+        findings, _ = lint_paths([self._pkg(tmp_path, "raise")])
+        assert "swallowed-fault" not in rule_ids(active(findings))
+
+    def test_swallow_around_plain_call_is_clean(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "plain.py").write_text(textwrap.dedent("""
+            def host_only(x):
+                return x + 1
+
+            def pull(x):
+                try:
+                    return host_only(x)
+                except Exception:
+                    return None
+        """))
+        findings, _ = lint_paths([str(pkg)])
+        assert "swallowed-fault" not in rule_ids(active(findings))
+
 
 class TestBlessedCompileThread:
     """PR-6 stage-purity extension: a Thread constructed with a literal
@@ -1688,6 +1793,8 @@ class TestFramework:
             "recompile-risk",
             # PR 8: streamed-step jits must route through programs/
             "jit-outside-cache",
+            # PR 9: the static twin of the chaos drill suite
+            "swallowed-fault",
         }
 
     def test_select_unknown_rule_raises(self):

@@ -17,28 +17,40 @@
 # mesh), so it is opt-in here while tier-1 runs it via
 # tests/test_sanitize.py.
 #
+# --drills runs the chaos drill suite (resilience/drills.py): every
+# registered FaultPlan injection point against streamed fits at prefetch
+# depth 0 and 2, ratcheted against tools/drill_baseline.json (recovery,
+# model-equality-vs-unfaulted-twin, and retry-ceiling invariants).
+# Tier-1 runs the same gate via tests/test_drills.py.
+#
 # Usage:
 #   tools/lint.sh                 # static ratchet gate (text output)
 #   tools/lint.sh --json          # same, JSON output (CI trending)
 #   tools/lint.sh --sanitize      # static gate + runtime sanitizer gate
-#   tools/lint.sh --rebaseline    # refresh BOTH committed baselines after
-#                                 # intentional changes (the sanitize write
-#                                 # self-gates its hard invariants; the
-#                                 # graftlint ratchet re-runs below)
+#   tools/lint.sh --drills       # static gate + chaos drill gate
+#   tools/lint.sh --rebaseline    # refresh ALL THREE committed baselines
+#                                 # (lint, sanitize, drills) after
+#                                 # intentional changes — each write
+#                                 # self-gates its hard invariants; a
+#                                 # half-updated set cannot be committed
+#                                 # green
 #   tools/lint.sh [extra graftlint args]   # passed through
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 BASELINE=tools/graftlint_baseline.json
 SAN_BASELINE=tools/sanitize_baseline.json
+DRILL_BASELINE=tools/drill_baseline.json
 MODE=gate
 SANITIZE=0
+DRILLS=0
 EXTRA=()
 for a in "$@"; do
   case "$a" in
     --json) EXTRA+=(--format json) ;;
     --rebaseline) MODE=rebaseline ;;
     --sanitize) SANITIZE=1 ;;
+    --drills) DRILLS=1 ;;
     *) EXTRA+=("$a") ;;
   esac
 done
@@ -48,21 +60,24 @@ if [[ "$MODE" == rebaseline ]]; then
   JAX_PLATFORMS=cpu python -m dask_ml_tpu.analysis dask_ml_tpu \
     --write-baseline "$BASELINE"
   echo "== graftsan (rebaseline: full smoke suite, cold counts) =="
-  # both snapshots refresh in one invocation or the script fails before
-  # the gate below — a half-updated pair cannot be committed green.
-  # Same 8-virtual-device mesh as the tier-1 harness: ceilings must be
-  # calibrated on the topology the gate measures against.
+  # all three snapshots refresh in one invocation or the script fails
+  # before the gate below — a half-updated set cannot be committed
+  # green.  Same 8-virtual-device mesh as the tier-1 harness: ceilings
+  # must be calibrated on the topology the gate measures against.
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m dask_ml_tpu.sanitize --write-baseline "$SAN_BASELINE"
+  echo "== graftdrill (rebaseline: full chaos drill suite) =="
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m dask_ml_tpu.resilience.drills --write-baseline "$DRILL_BASELINE"
 fi
 
 echo "== graftlint (ratchet vs $BASELINE) =="
 JAX_PLATFORMS=cpu python -m dask_ml_tpu.analysis dask_ml_tpu \
   --baseline "$BASELINE" ${EXTRA[@]+"${EXTRA[@]}"}
 
-# (in --rebaseline mode the --write-baseline run above already
-# self-gated the fresh snapshot's hard invariants; --sanitize is the
-# standalone gate against the committed one)
+# (in --rebaseline mode the --write-baseline runs above already
+# self-gated each fresh snapshot's hard invariants; --sanitize/--drills
+# are the standalone gates against the committed ones)
 if [[ "$SANITIZE" == 1 ]]; then
   echo "== graftsan (runtime sanitizer smoke suite vs $SAN_BASELINE) =="
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
@@ -72,6 +87,12 @@ if [[ "$SANITIZE" == 1 ]]; then
   # span stitching, exporters, the overhead ratchet (<=3% traced wall)
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_obs.py -q -p no:cacheprovider
+fi
+
+if [[ "$DRILLS" == 1 ]]; then
+  echo "== graftdrill (chaos drill suite vs $DRILL_BASELINE) =="
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m dask_ml_tpu.resilience.drills --baseline "$DRILL_BASELINE"
 fi
 
 echo "== compileall =="
