@@ -367,6 +367,12 @@ def _compact_line(result):
             ent["h"] = w["vs_history"]
         if "decision" in w:
             ent["d"] = w["decision"]
+        # graftscope occupancy: the bench trajectory's device-idle
+        # currency, one utilization + idle-seconds pair per workload
+        w_obs = w.get("obs") or {}
+        if "device_util" in w_obs:
+            ent["util"] = w_obs["device_util"]
+            ent["idle_s"] = w_obs["device_idle_s"]
         if w.get("from_partial"):
             ent["carried"] = True
         ws.append(ent)
@@ -638,11 +644,16 @@ def main():
 
     _obs.install_jax_hooks()
     _obs_prev = {}
+    _scope_cursor = {"pos": 0}
 
     def _obs_read():
         """Current registry scalars — the ONE key list both the
         per-workload deltas and the end-of-run obs_totals use."""
         reg = _obs.registry()
+        # graftscope device seconds: sum over the per-program busy
+        # histogram family (tags = program names)
+        dev_busy = sum(inst.sum for name, _tag, inst in reg.export_items()
+                       if name == "device.busy_s")
         return {
             "compiles": reg.counter("compile.count").value,
             "compile_s": round(
@@ -651,6 +662,9 @@ def main():
                 reg.histogram("pipeline.stall_s").sum, 3),
             "pipeline_hidden_s": round(
                 reg.histogram("pipeline.hidden_s").sum, 3),
+            "device_busy_s": round(dev_busy, 3),
+            "device_dispatches": sum(
+                reg.family("device.dispatches").values()),
             "retries": sum(reg.family("resilience.retry").values()),
             "faults": sum(reg.family("resilience.fault").values()),
         }
@@ -666,9 +680,23 @@ def main():
                 d = v
             delta[k] = round(d, 3)
         _obs_prev.update(cur)
-        return {k: (int(v) if k in ("compiles", "retries", "faults")
-                    else v)
-                for k, v in delta.items() if v}
+        out = {k: (int(v) if k in ("compiles", "retries", "faults",
+                                   "device_dispatches")
+                   else v)
+               for k, v in delta.items() if v}
+        # per-workload occupancy over THIS record's dispatch window
+        # (graftscope cursor delta): utilization + idle seconds — the
+        # device-idle budget currency, per workload, in the trajectory
+        try:
+            dev = _obs.scope.device_report(since=_scope_cursor["pos"],
+                                           settle_s=1.0)
+            _scope_cursor["pos"] = _obs.scope.cursor()
+            if dev["dispatches"]:
+                out["device_util"] = dev["utilization"]
+                out["device_idle_s"] = dev["idle_s"]
+        except Exception:  # observability must never sink a bench
+            pass
+        return out
 
     def _record(entry):
         """Append a measured workload AND persist it immediately, stamped

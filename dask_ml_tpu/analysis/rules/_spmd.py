@@ -73,21 +73,48 @@ def divergent_source(test: ast.AST) -> str | None:
 # dispatch surface.
 BLESSED_COMPILE_THREADS = frozenset({"dask-ml-tpu-compile-ahead"})
 
+# Thread names declared HOST-ONLY by contract — the graftscope readiness
+# sampler and the live metrics endpoint (obs/scope.py, obs/serve.py):
+# they read registry books, poll `is_ready()` futures, and serve HTTP;
+# they must never compile OR dispatch a device program.  The static
+# rules use the declaration ONLY to accept a target they cannot resolve
+# (the stdlib `serve_forever` loop) — a target that provably reaches
+# device work still flags, declaration or not.  The runtime half is
+# graftsan: these names are deliberately NOT in BLESSED_COMPILE_THREADS,
+# so the dispatch detector raises IN one of these threads at the
+# violating enqueue and a steady compile attributed to one is a hard
+# violation (tests/test_graftscope.py holds both ends together).
+HOST_ONLY_THREAD_NAMES = frozenset({
+    "dask-ml-tpu-scope",
+    "dask-ml-tpu-metrics",
+})
 
-def blessed_thread_name(ctor: ast.Call) -> str | None:
+
+def _thread_literal_name(ctor: ast.Call, names: frozenset) -> str | None:
     """The literal ``name=`` of a ``threading.Thread(...)`` construction
-    when it is in :data:`BLESSED_COMPILE_THREADS`, else None.  Only a
-    string LITERAL blesses — a computed name is unprovable and stays
-    under the ordinary rules."""
+    when it is in ``names``, else None.  Only a string LITERAL counts —
+    a computed name is unprovable and stays under the ordinary rules."""
     name = dotted_name(ctor.func)
     if not name or name.rsplit(".", 1)[-1] != "Thread":
         return None
     for kw in ctor.keywords:
         if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
                 and isinstance(kw.value.value, str) \
-                and kw.value.value in BLESSED_COMPILE_THREADS:
+                and kw.value.value in names:
             return kw.value.value
     return None
+
+
+def blessed_thread_name(ctor: ast.Call) -> str | None:
+    """The literal ``name=`` of a Thread construction when it is in
+    :data:`BLESSED_COMPILE_THREADS`, else None."""
+    return _thread_literal_name(ctor, BLESSED_COMPILE_THREADS)
+
+
+def host_only_thread_name(ctor: ast.Call) -> str | None:
+    """The literal ``name=`` of a Thread construction when it is in
+    :data:`HOST_ONLY_THREAD_NAMES`, else None."""
+    return _thread_literal_name(ctor, HOST_ONLY_THREAD_NAMES)
 
 
 # -- device work markers (interprocedural rules) --------------------------

@@ -23,7 +23,8 @@ from __future__ import annotations
 import ast
 
 from ..core import Context, Rule, dotted_name, register
-from ._spmd import blessed_thread_name, device_work_in
+from ._spmd import blessed_thread_name, device_work_in, \
+    host_only_thread_name
 
 _CTOR_SUFFIXES = frozenset({"ThreadPoolExecutor", "Thread"})
 _GUARD_NAME = "_uses_device_estimator"
@@ -176,6 +177,16 @@ class ThreadDispatchRule(Rule):
                 if all_evidence:
                     why = "; ".join(all_evidence[:3])
                 elif unresolved:
+                    # a declared host-only thread (a LITERAL name in
+                    # _spmd.HOST_ONLY_THREAD_NAMES — graftscope's
+                    # sampler/endpoint) may hand off a target the index
+                    # cannot see (the stdlib serve_forever loop): the
+                    # declaration is runtime-verified by graftsan's
+                    # dispatch detector, which raises IN that thread at
+                    # a violating enqueue.  Provable device work above
+                    # still flags regardless of the name.
+                    if host_only_thread_name(node) is not None:
+                        continue
                     why = "submitted callable could not be resolved"
             else:
                 why = "no submitted work visible from the construction site"

@@ -135,18 +135,25 @@ def run_report() -> dict:
     * ``metrics`` — the registry snapshot: counters, gauges, and
       histograms with p50/p95/p99 (``pipeline.block_s``,
       ``compile.duration_s``, ...).
+    * ``device`` — graftscope's occupancy view (design.md §14):
+      per-program dispatches + busy seconds, utilization over the
+      device window, idle seconds, and the top-3 idle gaps — the
+      device-side half of the host stage split next to it.  The read
+      settles briefly (≤1 s) so a just-finished fit's last in-flight
+      program closes its interval.
     * ``pipeline`` / ``faults`` / ``sanitize`` — the pre-existing
       reporters, unchanged shapes (views over the same registry).
 
     Call :func:`reset` first to scope the report to one fit; export the
-    same fit with :func:`export_perfetto` to render it next to an XProf
-    device trace.
+    same fit with :func:`export_perfetto` to render its host lanes AND
+    its measured device lane in one trace.
     """
     resilience = fault_report()
     return {
         "schema": obs.SCHEMA_VERSION,
         "span_tree": obs.span_tree(),
         "metrics": obs.metrics_snapshot(),
+        "device": obs.scope.device_report(settle_s=1.0),
         "pipeline": pipeline_report(),
         # the legacy top-level key IS the resilience view's snapshot —
         # one read, so the two can never disagree mid-call
@@ -158,9 +165,13 @@ def run_report() -> dict:
 
 def reset() -> None:
     """One-call observability reset: fault stats, pipeline stats, the
-    metrics registry, the span rings, and the flight recorder — the
-    test/bench isolation idiom (replaces hand-chained
-    ``reset_fault_stats()`` + ``reset_pipeline_stats()`` calls)."""
+    metrics registry, the span rings, the flight recorder, and the
+    graftscope device timeline — the test/bench isolation idiom
+    (replaces hand-chained ``reset_fault_stats()`` +
+    ``reset_pipeline_stats()`` calls).  The live metrics endpoint and
+    the graftscope sampler survive a reset: their books zero, and
+    their supervisor heartbeats re-register immediately below (the
+    unit-table wipe must not orphan a unit that is still serving)."""
     obs.reset_all()
     # the legacy reporters' registry families are already gone; these
     # clear their residual module state (the last-stream slot; private
@@ -171,6 +182,8 @@ def reset() -> None:
     from .resilience import supervisor as _supervisor
 
     _supervisor.reset()
+    obs.serve.rearm()
+    obs.scope.rearm()
 
 
 def sanitize_report() -> dict | None:

@@ -15,7 +15,14 @@ The one event spine every runtime layer reports through (docs/design.md
   export, so a streamed fit's host-side overlap renders next to an
   XProf device trace;
 * :mod:`.flight` — the always-on last-N-events post-mortem ring dumped
-  by the conftest watchdog and the preemption/fault paths.
+  by the conftest watchdog and the preemption/fault paths;
+* :mod:`.scope` — graftscope device-time accounting: per-program
+  in-flight intervals from the dispatch choke points, the
+  utilization/idle-gap report (``run_report()["device"]``), and the
+  Perfetto device lane;
+* :mod:`.serve` — the live Prometheus ``/metrics`` + ``/healthz``
+  endpoint (``DASK_ML_TPU_METRICS_PORT``), supervised like the
+  compile-ahead thread.
 
 Everything importable from here is pure-stdlib host code (no jax) —
 safe in any thread including the prefetch worker; the jax compile
@@ -65,6 +72,10 @@ from .flight import (  # noqa: F401
     post_mortem as flight_post_mortem,
     tail as flight_tail,
 )
+from . import scope  # noqa: F401
+from .scope import device_report  # noqa: F401
+from . import serve  # noqa: F401
+from .serve import prometheus_text  # noqa: F401
 
 __all__ = [
     # spans
@@ -80,6 +91,8 @@ __all__ = [
     "export_perfetto", "perfetto_trace", "read_jsonl",
     # flight
     "flight", "flight_dump", "flight_post_mortem", "flight_tail",
+    # graftscope: device-time accounting + scrape endpoint
+    "scope", "device_report", "serve", "prometheus_text",
     # lifecycle
     "install_jax_hooks", "reset_all",
 ]
@@ -95,8 +108,18 @@ def install_jax_hooks() -> None:
 
 def reset_all() -> None:
     """Zero the whole spine: metrics registry, span rings + last root,
-    and the flight recorder.  ``diagnostics.reset()`` is the public
-    one-call form (it also clears the legacy reporters' residue)."""
+    the flight recorder, and the graftscope device timeline.
+    ``diagnostics.reset()`` is the public one-call form (it also
+    clears the legacy reporters' residue and re-registers the live
+    metrics-endpoint/sampler heartbeats)."""
     reset_metrics()
     clear_spans()
     flight.clear()
+    scope.reset()
+
+
+# graftscope endpoint env arming (DASK_ML_TPU_METRICS_PORT): a set port
+# starts the scrape surface at import, same posture as DASK_ML_TPU_TRACE
+# above — strict knob parse (a typo raises), fail-soft bind (a taken
+# port warns; the fit matters more than its scrape).
+serve.start_from_env()

@@ -10,11 +10,13 @@ version (an older one is fine — the schema only grows).
 **Perfetto** (:func:`perfetto_trace` / :func:`export_perfetto`) emits
 the Chrome ``trace_event`` format (``{"traceEvents": [...]}``, complete
 ``"X"`` slices in microseconds, one ``tid`` lane per recorded thread
-with ``"M"`` thread-name metadata).  Load it in ui.perfetto.dev or
-``chrome://tracing`` NEXT TO an XProf device trace of the same fit: the
-host-side parse/stage/compute overlap renders against the device
-timeline, which is the whole point of stitching the prefetch worker's
-spans into the fit tree.
+with ``"M"`` thread-name metadata) plus a dedicated **device lane**
+(tid 0) built from graftscope's per-program in-flight intervals
+(:mod:`.scope`).  Load it in ui.perfetto.dev or ``chrome://tracing``:
+the host-side parse/stage/compute overlap renders directly against
+measured device occupancy — idle gaps are the white space in the
+device lane — and the whole thing still sits happily next to an XProf
+device trace of the same fit.
 """
 
 from __future__ import annotations
@@ -152,23 +154,41 @@ def _json_attrs(attrs: dict) -> dict:
             for k, v in attrs.items()}
 
 
-def perfetto_trace(records=None) -> dict:
+def perfetto_trace(records=None, device=None) -> dict:
     """Build a Chrome ``trace_event`` dict from grafttrace records
-    (default: everything retained in the span rings).
+    (default: everything retained in the span rings) plus a dedicated
+    **device lane** (``tid 0``, thread-name ``"device"``): one ``X``
+    slice per graftscope in-flight interval (default: the retained
+    :func:`~.scope.timeline`; pass ``device=[]`` to omit), so host
+    parse/stage overlap and device occupancy read in ONE trace — idle
+    gaps are literally the white space in that lane.
 
     Accepts either :class:`~.spans.SpanRecord` objects or the dict form
     (a JSONL read-back), so a trace can be re-rendered offline from the
-    event log alone.
+    event log alone (the device lane is in-process state: an offline
+    re-render passes its own interval dicts or ``[]``).
     """
     if records is None:
         records = _spans.span_records()
+    if device is None:
+        from . import scope as _scope
+
+        device = _scope.timeline()
     dicts = [r if isinstance(r, dict) else r.as_dict() for r in records]
-    if not dicts:
+    if not dicts and not device:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
-    epoch = min(d["t0"] for d in dicts)
+    epoch = min([d["t0"] for d in dicts] + [iv["t0"] for iv in device])
     pid = os.getpid()
     tids: dict[str, int] = {}
     events = []
+    for iv in device:
+        events.append({
+            "name": iv["program"], "pid": pid, "tid": 0,
+            "ts": round((iv["t0"] - epoch) * 1e6, 3),
+            "dur": round((iv["t1"] - iv["t0"]) * 1e6, 3),
+            "ph": "X",
+            "args": ({"open": True} if iv.get("open") else {}),
+        })
     for d in dicts:
         tid = tids.setdefault(d["thread"], len(tids) + 1)
         args = _json_attrs(d.get("attrs", {}))
@@ -190,13 +210,17 @@ def perfetto_trace(records=None) -> dict:
          "args": {"name": thread}}
         for thread, tid in tids.items()
     ]
+    if device:
+        meta.insert(0, {"ph": "M", "pid": pid, "tid": 0,
+                        "name": "thread_name", "args": {"name": "device"}})
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
-def export_perfetto(path: str | None = None, records=None) -> dict:
+def export_perfetto(path: str | None = None, records=None,
+                    device=None) -> dict:
     """:func:`perfetto_trace`, optionally written to ``path`` as JSON.
     Returns the trace dict either way."""
-    trace = perfetto_trace(records)
+    trace = perfetto_trace(records, device=device)
     if path:
         with open(path, "w", encoding="utf-8") as f:
             json.dump(trace, f, separators=(",", ":"))

@@ -219,6 +219,16 @@ class MetricsRegistry:
         return {tag: inst.value for tag, inst in items
                 if isinstance(inst, (Counter, Gauge))}
 
+    def export_items(self) -> list:
+        """``[(name, tag, instrument)]`` sorted by (name, tag) — the
+        structured walk the Prometheus exporter (:mod:`.serve`) formats
+        from.  Unlike :meth:`snapshot`'s ``name{tag}`` composite keys,
+        tags stay separate so label values can be escaped correctly
+        (a tag may itself contain braces, quotes, or newlines)."""
+        with self._lock:
+            return [(name, tag, inst) for (name, tag), inst
+                    in sorted(self._instruments.items())]
+
     def snapshot(self) -> dict:
         """``{"counters": {key: n}, "gauges": {...}, "histograms":
         {key: {count, sum, min, max, p50, p95, p99}}}`` where ``key`` is

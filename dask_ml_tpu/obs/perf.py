@@ -1,0 +1,457 @@
+"""graftscope perf ratchet: the committed performance baseline.
+
+The repo already ratchets *static findings* (graftlint), *runtime SPMD
+counts* (graftsan), and *recovery behavior* (graftdrill).  This module
+is the fourth committed baseline — **performance itself**: a small
+suite of streamed-fit workloads whose per-block latency quantiles,
+device utilization, and stall fraction are snapshotted into
+``tools/perf_baseline.json`` and re-measured by ``tools/lint.sh
+--perf`` (and tier-1 via tests/test_graftscope.py) with the same
+new/stale/regression semantics as the other three:
+
+* a workload in the run but not in the snapshot is **new** → fail;
+* a snapshot entry not in the run is **stale** → fail (the committed
+  file always matches the suite; refresh with ``tools/lint.sh
+  --rebaseline``, which rewrites all FOUR baselines in one invocation);
+* a measured metric outside its **tolerance band** of the snapshot is
+  a regression → fail.  Bands, not exact times — the tier-1 box is a
+  loaded 2-core sandbox and wall clocks flap; what the ratchet must
+  catch is the *order-of-magnitude* class (a sleep smuggled into a
+  step program, a pipeline that stopped overlapping, a device left
+  idle), not scheduler jitter:
+
+  - ``p50_block_s`` ceiling: ``base * 5 + 10 ms`` (the median is the
+    robust one; an injected per-step sleep lands far above it);
+  - ``p99_block_s`` ceiling: ``base * 8 + 50 ms`` (the tail IS noisy
+    on a starved box — the wide band still catches real slowdowns);
+  - ``utilization`` floor: ``base * 0.5`` (checked only when the
+    committed value is ≥ 0.1 — a workload that never fed the device
+    cannot floor anything);
+  - ``stall_fraction`` ceiling: ``base * 3 + 0.20``.
+
+* a workload that ERRORS (or whose block count drifted from the
+  snapshot — the shapes are the calibration) is a hard failure.
+
+Workloads are deliberately tiny-but-not-trivial: block shapes chosen
+so the device step costs milliseconds (a measurable busy interval on
+this image) and bucket-aligned (16384 = the ``auto`` ladder's 16k rung,
+so the pad path is a no-op and the numbers measure the pipeline, not
+padding).  Fixed seeds; warmup round first so the measured round is
+compile-free.
+
+CLI (exit contract mirrors graftlint/graftsan: 0 clean, 1 ratchet
+failure, 2 crash/bad-args)::
+
+    python -m dask_ml_tpu.obs.perf                      # run + ratchet
+    python -m dask_ml_tpu.obs.perf --write-baseline tools/perf_baseline.json
+    python -m dask_ml_tpu.obs.perf --workloads sgd_stream_d2
+    python -m dask_ml_tpu.obs.perf --inject-slowdown 0.25   # must FAIL
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+__all__ = [
+    "PERF_BASELINE_ENV",
+    "WORKLOADS",
+    "run_workload",
+    "run_suite",
+    "compare",
+    "is_clean",
+    "default_path",
+    "emit",
+    "load",
+    "write",
+    "main",
+]
+
+#: policy knob: path of the committed perf snapshot (default:
+#: ``tools/perf_baseline.json`` next to a repo checkout).
+PERF_BASELINE_ENV = "DASK_ML_TPU_PERF_BASELINE"
+
+_VERSION = 1
+_SEED = 11
+_BLOCKS = 10
+_ROWS, _DIM = 16384, 32  # 16k = an `auto` bucket rung: no pad, no drift
+_PARSE_S = 0.001
+
+#: tolerance bands (factor, absolute-slack) / floors — see module
+#: docstring for why each is shaped the way it is.
+P50_BAND = (5.0, 0.010)
+P99_BAND = (8.0, 0.050)
+UTIL_FLOOR_FACTOR = 0.5
+UTIL_MIN_BASE = 0.10
+STALL_BAND = (3.0, 0.20)
+
+
+# -- workloads -----------------------------------------------------------
+
+def _class_blocks(offset: int, parse_s: float = _PARSE_S):
+    import numpy as np
+
+    rng = np.random.RandomState(_SEED + offset)
+    X = rng.normal(size=(_ROWS, _DIM)).astype(np.float32)
+    w = rng.normal(size=_DIM)
+    y = (X @ w > 0).astype(np.int32)
+    for _ in range(_BLOCKS):
+        if parse_s:
+            time.sleep(parse_s)
+        yield X, y
+
+
+def _row_blocks(offset: int, parse_s: float = _PARSE_S):
+    import numpy as np
+
+    rng = np.random.RandomState(_SEED + offset)
+    X = rng.normal(size=(_ROWS, _DIM)).astype(np.float32)
+    for _ in range(_BLOCKS):
+        if parse_s:
+            time.sleep(parse_s)
+        yield X, None
+
+
+def _inject(model, sleep_s: float):
+    """Testing hook: a per-step sleep smuggled into the model's device
+    step — the injected slowdown the acceptance criterion requires the
+    ratchet to fail on.  Wraps BOTH dispatch surfaces (the staged
+    ``_pf_consume`` and plain ``partial_fit``) so depth-0 and depth-2
+    workloads slow identically."""
+    if not sleep_s:
+        return model
+    if hasattr(model, "_pf_consume"):
+        orig_consume = model._pf_consume
+
+        def _slow_consume(staged):
+            time.sleep(sleep_s)
+            return orig_consume(staged)
+
+        model._pf_consume = _slow_consume
+    orig_pf = model.partial_fit
+
+    def _slow_pf(*args, **kwargs):
+        time.sleep(sleep_s)
+        return orig_pf(*args, **kwargs)
+
+    model.partial_fit = _slow_pf
+    return model
+
+
+def _run_streamed(make_model, blocks_fn, depth, *, fit_kwargs=None,
+                  inject_s: float = 0.0) -> dict:
+    """Warmup round (compiles) then a measured round of the SAME model
+    over fresh same-shaped blocks; returns the committed metrics."""
+    from .. import diagnostics
+    from ..pipeline import stream_partial_fit
+    from . import scope as _scope
+    from .metrics import registry as _registry
+
+    model = _inject(make_model(), inject_s)
+    stream_partial_fit(model, blocks_fn(offset=0), depth=depth,
+                       fit_kwargs=fit_kwargs, label="perf_warmup")
+    # scope the measured round: fresh pipeline/device books (the
+    # suite owns its process the way the sanitize smoke suite does)
+    diagnostics.reset_pipeline_stats()
+    cur = _scope.cursor()
+    stream_partial_fit(model, blocks_fn(offset=1), depth=depth,
+                       fit_kwargs=fit_kwargs, label="perf_measured")
+    hist = _registry().histogram("pipeline.block_s")
+    rep = diagnostics.pipeline_report()
+    dev = _scope.device_report(since=cur, settle_s=5.0)
+    wall = float(rep.get("wall_s", 0.0)) or 1e-9
+    return {
+        "blocks": int(rep.get("blocks", 0)),
+        "p50_block_s": round(float(hist.quantile(0.50)), 6),
+        "p99_block_s": round(float(hist.quantile(0.99)), 6),
+        "utilization": float(dev["utilization"]),
+        "stall_fraction": round(
+            min(float(rep.get("stall_s", 0.0)) / wall, 1.0), 4),
+        "wall_s": round(wall, 6),
+        "device_busy_s": dev["busy_s"],
+    }
+
+
+def _wl_sgd(depth, inject_s=0.0):
+    import numpy as np
+
+    from ..linear_model import SGDClassifier
+
+    return _run_streamed(
+        lambda: SGDClassifier(random_state=0), _class_blocks, depth,
+        fit_kwargs={"classes": np.array([0, 1])}, inject_s=inject_s)
+
+
+def _wl_mbk(depth, inject_s=0.0):
+    from ..cluster import MiniBatchKMeans
+
+    return _run_streamed(
+        lambda: MiniBatchKMeans(n_clusters=8, random_state=0),
+        _row_blocks, depth, inject_s=inject_s)
+
+
+WORKLOADS = {
+    "sgd_stream_d0": lambda inject_s=0.0: _wl_sgd(0, inject_s),
+    "sgd_stream_d2": lambda inject_s=0.0: _wl_sgd(2, inject_s),
+    "mbk_stream_d2": lambda inject_s=0.0: _wl_mbk(2, inject_s),
+}
+
+
+def run_workload(name: str, inject_s: float = 0.0) -> dict:
+    """Run one workload; an exception becomes an ``error`` metric (a
+    hard ratchet failure), never a crash of the suite."""
+    try:
+        return WORKLOADS[name](inject_s=inject_s)
+    except KeyError:
+        raise
+    except Exception as e:
+        return {"blocks": 0, "p50_block_s": 0.0, "p99_block_s": 0.0,
+                "utilization": 0.0, "stall_fraction": 0.0, "wall_s": 0.0,
+                "device_busy_s": 0.0,
+                "error": f"{type(e).__name__}: {e}"}
+
+
+def run_suite(names=None, inject_s: float = 0.0) -> dict:
+    names = list(WORKLOADS) if names is None else list(names)
+    unknown = [n for n in names if n not in WORKLOADS]
+    if unknown:
+        raise KeyError(f"unknown workload(s): {', '.join(unknown)}")
+    return {name: run_workload(name, inject_s=inject_s) for name in names}
+
+
+# -- baseline ------------------------------------------------------------
+
+def default_path() -> str | None:
+    env = os.environ.get(PERF_BASELINE_ENV, "").strip()
+    if env:
+        return env
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cand = os.path.join(os.path.dirname(pkg), "tools",
+                        "perf_baseline.json")
+    return cand if os.path.isfile(cand) else None
+
+
+def emit(results: dict) -> dict:
+    import jax
+
+    return {
+        "version": _VERSION,
+        "tool": "graftscope-perf",
+        # recorded for the human diffing a rebaseline, NOT compared:
+        # the bands (not a version/topology gate) catch real drift
+        "jax": jax.__version__,
+        "platform": jax.devices()[0].platform,
+        "n_devices": len(jax.devices()),
+        "workloads": {
+            name: {k: metrics[k] for k in sorted(metrics)}
+            for name, metrics in sorted(results.items())
+        },
+    }
+
+
+def write(path: str, payload: dict) -> None:
+    from ..analysis.cache import atomic_write_json
+
+    atomic_write_json(path, payload, indent=2, sort_keys=True)
+
+
+def load(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        payload = json.load(fh)
+    if payload.get("version", 0) > _VERSION:
+        raise ValueError(
+            f"perf baseline {path} has version {payload['version']}, "
+            f"newer than this ratchet understands ({_VERSION})")
+    if not isinstance(payload.get("workloads"), dict):
+        raise ValueError(
+            f"perf baseline {path} is malformed: no workloads table")
+    return payload
+
+
+def _ceiling(base: float, band) -> float:
+    return base * band[0] + band[1]
+
+
+def compare(snapshot: dict, results: dict, *, partial: bool = False) -> dict:
+    """The ratchet delta (same shape as the graftsan one)::
+
+        {"new": [...], "stale": [...], "regressions": [...],
+         "violations": [...]}
+
+    ``partial=True`` (an explicit ``--workloads`` subset) checks errors
+    only: stale is meaningless for a subset, and the bands are
+    calibrated against the full suite's execution order (warm caches)."""
+    snap = snapshot["workloads"]
+    new = [] if partial else sorted(set(results) - set(snap))
+    stale = [] if partial else sorted(set(snap) - set(results))
+    regressions: list[str] = []
+    violations: list[str] = []
+
+    for name, m in sorted(results.items()):
+        if m.get("error"):
+            violations.append(f"{name}: workload errored: {m['error']}")
+            continue
+        base = snap.get(name)
+        if base is None or partial:
+            continue
+        if base.get("error"):
+            violations.append(
+                f"baseline entry {name} carries an error — a snapshot "
+                f"cannot grandfather a broken workload; fix and "
+                f"rebaseline")
+            continue
+        if m.get("blocks") != base.get("blocks"):
+            regressions.append(
+                f"{name}: measured {m.get('blocks')} blocks vs baseline "
+                f"{base.get('blocks')} — the workload definition "
+                f"drifted; rebaseline deliberately "
+                f"(tools/lint.sh --rebaseline)")
+            continue
+        for key, band in (("p50_block_s", P50_BAND),
+                          ("p99_block_s", P99_BAND)):
+            ceil = _ceiling(base.get(key, 0.0), band)
+            if m.get(key, 0.0) > ceil:
+                regressions.append(
+                    f"{name}: {key} {m[key]:.4f}s > ceiling {ceil:.4f}s "
+                    f"(baseline {base.get(key, 0.0):.4f}s × {band[0]} + "
+                    f"{band[1]}s) — the step path got slower; fix it or "
+                    f"rebaseline deliberately")
+        b_util = base.get("utilization", 0.0)
+        if b_util >= UTIL_MIN_BASE and \
+                m.get("utilization", 0.0) < b_util * UTIL_FLOOR_FACTOR:
+            regressions.append(
+                f"{name}: utilization {m.get('utilization', 0.0):.3f} < "
+                f"floor {b_util * UTIL_FLOOR_FACTOR:.3f} (baseline "
+                f"{b_util:.3f} × {UTIL_FLOOR_FACTOR}) — the device is "
+                f"idling where the committed run kept it fed")
+        s_ceil = _ceiling(base.get("stall_fraction", 0.0), STALL_BAND)
+        if m.get("stall_fraction", 0.0) > s_ceil:
+            regressions.append(
+                f"{name}: stall_fraction {m['stall_fraction']:.3f} > "
+                f"ceiling {s_ceil:.3f} — the consumer is starving "
+                f"where the committed run overlapped")
+
+    return {"new": new, "stale": stale, "regressions": regressions,
+            "violations": violations}
+
+
+def is_clean(delta: dict) -> bool:
+    return not any(delta[k] for k in ("new", "stale", "regressions",
+                                      "violations"))
+
+
+# -- CLI -----------------------------------------------------------------
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m dask_ml_tpu.obs.perf",
+        description="graftscope perf smoke suite + committed ratchet",
+    )
+    p.add_argument("--workloads", default=None,
+                   help="comma-separated subset (default: all)")
+    p.add_argument("--baseline", metavar="PATH", default=None,
+                   help="ratchet against this committed snapshot "
+                        "(default: DASK_ML_TPU_PERF_BASELINE, else "
+                        "tools/perf_baseline.json when present)")
+    p.add_argument("--write-baseline", metavar="PATH", default=None,
+                   help="snapshot this run's metrics (then ratchet "
+                        "against the fresh snapshot)")
+    p.add_argument("--inject-slowdown", type=float, default=0.0,
+                   metavar="S",
+                   help="testing: sleep S seconds inside every step "
+                        "program — the ratchet MUST fail")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-workloads", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    try:
+        args = _parser().parse_args(argv)
+    except SystemExit as e:  # argparse's bad-args path
+        return 0 if (e.code in (0, None)) else 2
+
+    if args.list_workloads:
+        for name in sorted(WORKLOADS):
+            print(name)
+        return 0
+
+    names = None
+    if args.workloads:
+        names = [w.strip() for w in args.workloads.split(",") if w.strip()]
+    if args.write_baseline and names is not None:
+        print("error: --write-baseline requires the full suite "
+              "(drop --workloads): a partial snapshot cannot be "
+              "ratcheted against", file=sys.stderr)
+        return 2
+    if args.inject_slowdown and names is not None:
+        # a --workloads subset runs in errors-only (partial) mode, so
+        # the injected slowdown would read as green — the exact
+        # opposite of the flag's "MUST fail" contract
+        print("error: --inject-slowdown requires the full suite "
+              "(drop --workloads): partial runs skip the tolerance "
+              "bands the injection must trip", file=sys.stderr)
+        return 2
+    if args.write_baseline and args.inject_slowdown:
+        print("error: refusing to baseline an injected slowdown",
+              file=sys.stderr)
+        return 2
+    try:
+        results = run_suite(names, inject_s=args.inject_slowdown)
+    except KeyError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    snap_path = args.write_baseline or args.baseline
+    if args.write_baseline:
+        errs = [f"{n}: {m['error']}" for n, m in sorted(results.items())
+                if m.get("error")]
+        if errs:
+            for line in errs:
+                print(f"ERROR: {line}", file=sys.stderr)
+            print("perf: refusing to write an erroring baseline to "
+                  f"{args.write_baseline} (file untouched)",
+                  file=sys.stderr)
+            return 1
+        write(args.write_baseline, emit(results))
+    if snap_path is None:
+        snap_path = default_path()
+
+    if snap_path is not None:
+        try:
+            snap = load(snap_path)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load baseline {snap_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        delta = compare(snap, results, partial=names is not None)
+    else:
+        delta = compare({"workloads": dict(results)}, results,
+                        partial=names is not None)
+
+    clean = is_clean(delta)
+    if args.format == "json":
+        print(json.dumps({"workloads": results, "delta": delta,
+                          "baseline": snap_path, "clean": clean},
+                         indent=2, sort_keys=True))
+    else:
+        for name, m in sorted(results.items()):
+            print(f"{name}: p50={m['p50_block_s'] * 1e3:.2f}ms "
+                  f"p99={m['p99_block_s'] * 1e3:.2f}ms "
+                  f"util={m['utilization']:.3f} "
+                  f"stall={m['stall_fraction']:.3f} "
+                  f"wall={m['wall_s']:.3f}s"
+                  + (f" ERROR={m['error']}" if m.get("error") else ""))
+        for key in ("violations", "regressions", "new", "stale"):
+            for line in delta[key]:
+                print(f"{key.upper()}: {line}")
+        print("perf: " + ("clean" if clean else "FAILED")
+              + (f" (vs {snap_path})" if snap_path else " (no baseline)"))
+    return 0 if clean else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,396 @@
+"""graftscope: device-time accounting — the device-side half of grafttrace.
+
+grafttrace (design.md §11) records host wall spans; this module makes
+**device occupancy** a first-class observable.  Every dispatched program
+is tracked at the two choke points the repo already owns — the central
+program cache's dispatch (:mod:`dask_ml_tpu.programs.cache`) and the
+graftsan ``ExecuteReplicated`` hook — as an **in-flight interval**:
+
+* ``t0`` — the moment the program was enqueued on the dispatching
+  thread (jax dispatch is asynchronous on every backend this repo
+  runs, measured on this image: a 270 ms program returns from its
+  dispatch call in 3 ms);
+* ``t1`` — the moment its outputs were observed ready.  Readiness is
+  detected by duck-typed ``leaf.is_ready()`` polling (a ~0.3 µs
+  host-only future check): at every subsequent tracked dispatch, and —
+  so the end of a busy period is found even when the host goes quiet —
+  on a dedicated **sampler thread** (:data:`SCOPE_THREAD_NAME`,
+  supervised under the ``"obs"`` domain) that polls every
+  :data:`_SAMPLE_S` seconds while work is in flight and parks on a
+  condition variable otherwise.
+
+The union of in-flight intervals is the "device busy-or-fed" timeline:
+its complement inside the observation window is **device idle time** —
+the budget currency the ROADMAP's [search-scale] lane names, and the
+occupancy number the [serving] lane's SLOs sit next to.  Per-program
+seconds land in the metrics registry (``device.busy_s{program}``
+histograms, ``device.dispatches{program}`` counters — scraped by
+:mod:`.serve`), closed intervals in a bounded ring consumed by
+:func:`device_report` (``diagnostics.run_report()["device"]``) and
+:func:`~.export.perfetto_trace`'s dedicated device lane.
+
+Honesty contract: ``t1`` carries a detection slack of at most one
+sampler period (~2 ms) — fine for the ms-scale block programs this
+repo streams, and the committed perf ratchet (:mod:`.perf`) is
+calibrated under the same cadence.  An interval covers enqueue→ready,
+i.e. queue wait counts as *fed*, not idle — exactly the currency a
+scheduler that wants to keep the device fed should budget.  On a
+relayed backend (the axon TPU tunnel) readiness can report early
+(BENCH_LOCAL.md); there the XProf device trace stays the authority and
+this lane is a lower bound on idle.  The jitted-twin fallback path may
+fold its own cold trace/compile into one interval (the AOT cache path
+never does) — warm rounds, which is what the ratchet measures, are
+unaffected.
+
+Everything here is pure host stdlib — no jax import (the obs package's
+host-only posture): callers hand in output leaves and this module only
+ever calls ``is_ready()`` on them.  A leaf whose ``is_ready`` raises
+(a buffer donated into the next step) counts as ready — the consuming
+program's own interval is already open, so the lane stays continuous.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .metrics import registry as _registry
+
+__all__ = [
+    "SCOPE_THREAD_NAME",
+    "track",
+    "absorb",
+    "absorbed",
+    "sweep",
+    "settle",
+    "cursor",
+    "timeline",
+    "device_report",
+    "pending_count",
+    "rearm",
+    "reset",
+]
+
+#: the sampler thread's literal name.  It is HOST-ONLY: it polls
+#: ``is_ready()`` futures, beats a supervisor heartbeat, and records
+#: into the metrics registry — it must never compile or dispatch
+#: (``analysis.rules._spmd.HOST_ONLY_THREAD_NAMES``; the graftsan
+#: dispatch detector holds it to that at runtime, same as the prefetch
+#: worker).
+SCOPE_THREAD_NAME = "dask-ml-tpu-scope"
+
+#: sampler poll period while work is in flight: the end-detection slack
+#: of every interval is at most this (plus scheduler jitter).
+_SAMPLE_S = 0.002
+
+#: how many closed intervals the timeline ring retains (registry totals
+#: survive eviction; the ring bounds what device_report / the perfetto
+#: device lane can SEE, same posture as the span rings).
+_RING_CAP = 8192
+
+#: sampler deaths tolerated before degrading to sweep-on-dispatch only
+#: (detection slack grows to the inter-dispatch gap; totals stay exact).
+_MAX_RESTARTS = 5
+
+#: supervisor-beat decimation: one beat per this many sampler sweeps
+#: (a 500 Hz poller must not turn the beat counter into noise).
+_BEATS_EVERY = 50
+
+
+class _Pending:
+    __slots__ = ("program", "t0", "leaves", "seq")
+
+    def __init__(self, program, t0, leaves, seq):
+        self.program = program
+        self.t0 = t0
+        self.leaves = leaves
+        self.seq = seq
+
+
+_LOCK = threading.Lock()
+_COND = threading.Condition(_LOCK)
+_PENDING: list[_Pending] = []
+_CLOSED: list[dict] = []  # ring: trimmed to _RING_CAP on append
+_SEQ = 0
+_SAMPLER: threading.Thread | None = None
+_SAMPLER_DEATHS = 0
+_TLS = threading.local()
+
+
+def _leaf_ready(leaf) -> bool:
+    try:
+        return bool(leaf.is_ready())
+    except Exception:
+        # a buffer donated into the next program (or an exotic array
+        # type): its producing program is chained into the consumer's
+        # already-open interval — treat as ready, the lane stays whole
+        return True
+
+
+# -- recording (choke-point callbacks; any dispatching thread) -----------
+
+def track(program: str, t0: float, leaves) -> bool:
+    """Open an in-flight interval for one dispatched program.
+
+    ``leaves`` are the dispatch's output leaves; only leaves exposing
+    ``is_ready()`` participate (tracer outputs — a program inlining
+    into an outer trace — have none, and are deliberately not counted
+    as dispatches).  Returns True when an interval was opened.
+    Host-only: a time read, a lock, a list append, a registry
+    increment."""
+    live = [x for x in leaves if hasattr(x, "is_ready")]
+    if not live:
+        return False
+    now = time.perf_counter()
+    global _SEQ
+    with _COND:
+        _sweep_locked(now)
+        seq = _SEQ
+        _SEQ += 1
+        _PENDING.append(_Pending(str(program), float(t0), live, seq))
+        _ensure_sampler_locked()
+        _COND.notify()
+    _registry().counter("device.dispatches", str(program)).inc()
+    return True
+
+
+class absorb:
+    """Suppress inner-choke-point tracking on this thread: the program
+    cache wraps its dispatch call in one of these so the graftsan
+    ``ExecuteReplicated`` hook (which the same call funnels through
+    while a sanitizer is active) does not open a duplicate interval
+    for the identical execution."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        _TLS.absorb = getattr(_TLS, "absorb", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.absorb -= 1
+        return False
+
+
+def absorbed() -> bool:
+    return getattr(_TLS, "absorb", 0) > 0
+
+
+# -- interval closing ----------------------------------------------------
+
+def _close_locked(p: _Pending, t1: float) -> None:
+    iv = {
+        "program": p.program,
+        "t0": p.t0,
+        "t1": max(float(t1), p.t0),
+        "seq": p.seq,
+    }
+    _CLOSED.append(iv)
+    if len(_CLOSED) > _RING_CAP:
+        del _CLOSED[: len(_CLOSED) - _RING_CAP]
+
+
+def _sweep_locked(now: float) -> list[dict]:
+    done = [p for p in _PENDING if all(_leaf_ready(x) for x in p.leaves)]
+    if not done:
+        return []
+    closed = []
+    for p in done:
+        _PENDING.remove(p)
+        _close_locked(p, now)
+        closed.append((p.program, max(now - p.t0, 0.0)))
+    # registry publication outside the hot predicate but still under
+    # _LOCK: instrument locks nest inside, never the other way around
+    reg = _registry()
+    for program, dur in closed:
+        reg.histogram("device.busy_s", program).record(dur)
+    return closed
+
+
+def sweep() -> None:
+    """Close every pending interval whose outputs are ready (called by
+    the sampler; safe from any thread — host-only)."""
+    with _COND:
+        _sweep_locked(time.perf_counter())
+
+
+def settle(timeout_s: float = 5.0) -> bool:
+    """Poll until no tracked dispatch is in flight (a report/bench
+    boundary, never the hot path).  Returns False on timeout — a
+    wedged program must not wedge its report."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        with _COND:
+            _sweep_locked(time.perf_counter())
+            if not _PENDING:
+                return True
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(_SAMPLE_S)
+
+
+# -- the sampler thread --------------------------------------------------
+
+def _sampler_loop() -> None:
+    from ..resilience import supervisor as _supervisor
+
+    hb = _supervisor.register(SCOPE_THREAD_NAME, "obs",
+                              thread=threading.current_thread())
+    beats = 0
+    while True:
+        with _COND:
+            while not _PENDING:
+                _COND.wait()
+            _sweep_locked(time.perf_counter())
+        beats += 1
+        if beats % _BEATS_EVERY == 0:
+            # diagnostics.reset() wipes the supervisor table; a live
+            # sampler re-registers itself so the endpoint's /healthz
+            # keeps seeing it (rearm() covers the no-pending case)
+            if _supervisor.lookup(SCOPE_THREAD_NAME) is not hb:
+                hb = _supervisor.register(
+                    SCOPE_THREAD_NAME, "obs",
+                    thread=threading.current_thread())
+            hb.beat()
+        time.sleep(_SAMPLE_S)
+
+
+def _ensure_sampler_locked() -> None:
+    global _SAMPLER, _SAMPLER_DEATHS
+    t = _SAMPLER
+    if t is not None and t.is_alive():
+        return
+    if t is not None:
+        _SAMPLER_DEATHS += 1
+        if _SAMPLER_DEATHS > _MAX_RESTARTS:
+            return  # degraded: sweep-on-dispatch + settle() only
+        from ..resilience import supervisor as _supervisor
+
+        _supervisor.note_death("obs", SCOPE_THREAD_NAME)
+        _supervisor.note_restart("obs", SCOPE_THREAD_NAME)
+    # host-only sampler: is_ready futures + heartbeat + registry — never
+    # compiles, never dispatches (runtime-checked by graftsan, which
+    # does NOT bless this name)
+    _SAMPLER = threading.Thread(
+        target=_sampler_loop, daemon=True, name=SCOPE_THREAD_NAME,
+    )
+    _SAMPLER.start()
+
+
+def rearm() -> None:
+    """Re-register a live sampler's supervisor heartbeat (called by
+    ``diagnostics.reset()`` right after it wipes the unit table)."""
+    from ..resilience import supervisor as _supervisor
+
+    t = _SAMPLER
+    if t is not None and t.is_alive() \
+            and _supervisor.lookup(SCOPE_THREAD_NAME) is None:
+        _supervisor.register(SCOPE_THREAD_NAME, "obs", thread=t)
+
+
+# -- reading -------------------------------------------------------------
+
+def cursor() -> int:
+    """An opaque position in the interval sequence: pass to
+    :func:`timeline` / :func:`device_report` as ``since`` to scope a
+    read to dispatches tracked after this call (the bench per-workload
+    delta idiom)."""
+    with _LOCK:
+        return _SEQ
+
+
+def pending_count() -> int:
+    with _LOCK:
+        return len(_PENDING)
+
+
+def timeline(since: int | None = None, open_until: float | None = None):
+    """Retained intervals (oldest first): closed ones from the ring
+    plus — so a live scrape mid-fit sees the current busy period —
+    every still-pending dispatch as ``[t0, open_until]`` (default: now)
+    with ``"open": True``."""
+    now = time.perf_counter() if open_until is None else float(open_until)
+    with _COND:
+        _sweep_locked(time.perf_counter())
+        out = [dict(iv) for iv in _CLOSED
+               if since is None or iv["seq"] >= since]
+        for p in _PENDING:
+            if since is None or p.seq >= since:
+                out.append({"program": p.program, "t0": p.t0,
+                            "t1": max(now, p.t0), "seq": p.seq,
+                            "open": True})
+    out.sort(key=lambda iv: (iv["t0"], iv["seq"]))
+    return out
+
+
+def _merge(intervals):
+    """Union-merge sorted-by-t0 intervals -> (busy_s, merged, gaps)."""
+    merged: list[list[float]] = []
+    for iv in intervals:
+        if merged and iv["t0"] <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], iv["t1"])
+        else:
+            merged.append([iv["t0"], iv["t1"]])
+    busy = sum(b - a for a, b in merged)
+    gaps = [{"t0": merged[i][1], "t1": merged[i + 1][0],
+             "dur_s": merged[i + 1][0] - merged[i][1]}
+            for i in range(len(merged) - 1)
+            if merged[i + 1][0] > merged[i][1]]
+    return busy, merged, gaps
+
+
+def device_report(since: int | None = None, *, settle_s: float = 0.0,
+                  top_gaps: int = 3) -> dict:
+    """Device occupancy over the retained window::
+
+        {"dispatches": n, "busy_s": s, "window_s": w, "idle_s": w - s,
+         "utilization": s / w,            # 0.0 when nothing dispatched
+         "idle_gaps": [{"t0", "t1", "dur_s"} x top-3, largest first],
+         "programs": {name: {"dispatches": n, "busy_s": s}},
+         "pending": still-in-flight count}
+
+    The window is ``[first interval start, last interval end]`` of the
+    retained (``since``-scoped) timeline — i.e. utilization of the
+    period the device was actually in use, the number the perf ratchet
+    floors.  ``settle_s > 0`` first waits (bounded) for in-flight
+    dispatches so a *post-fit* report closes its last interval; a live
+    scrape must pass 0 (the default — never wait on the device in a
+    handler thread)."""
+    if settle_s > 0:
+        settle(settle_s)
+    ivs = timeline(since)
+    programs: dict[str, dict] = {}
+    for iv in ivs:
+        p = programs.setdefault(iv["program"],
+                                {"dispatches": 0, "busy_s": 0.0})
+        p["dispatches"] += 1
+        p["busy_s"] += iv["t1"] - iv["t0"]
+    for p in programs.values():
+        p["busy_s"] = round(p["busy_s"], 6)
+    if not ivs:
+        return {"dispatches": 0, "busy_s": 0.0, "window_s": 0.0,
+                "idle_s": 0.0, "utilization": 0.0, "idle_gaps": [],
+                "programs": {}, "pending": pending_count()}
+    busy, merged, gaps = _merge(ivs)
+    window = max(iv["t1"] for iv in ivs) - ivs[0]["t0"]
+    gaps.sort(key=lambda g: -g["dur_s"])
+    return {
+        "dispatches": len(ivs),
+        "busy_s": round(busy, 6),
+        "window_s": round(window, 6),
+        "idle_s": round(max(window - busy, 0.0), 6),
+        "utilization": round(busy / window, 4) if window > 0 else 0.0,
+        "idle_gaps": [{k: round(v, 6) for k, v in g.items()}
+                      for g in gaps[:top_gaps]],
+        "programs": dict(sorted(programs.items())),
+        "pending": pending_count(),
+    }
+
+
+def reset() -> None:
+    """Drop the timeline ring and every pending interval (test/bench
+    isolation; the registry's ``device.*`` families are cleared by the
+    caller's registry reset — ``obs.reset_all()`` does both)."""
+    with _COND:
+        _PENDING.clear()
+        _CLOSED.clear()

@@ -1,0 +1,320 @@
+"""Live metrics endpoint: the serving lane's scrape surface.
+
+A single stdlib ``http.server`` thread (no dependencies — same posture
+as the rest of :mod:`dask_ml_tpu.obs`) exports the whole metrics
+registry in Prometheus text exposition format plus a supervisor-backed
+health verdict, shipped BEFORE the serving lane itself so the scrape
+surface exists the day that lane lands (ROADMAP [serving]):
+
+* ``GET /metrics`` — every counter/gauge as a sample line, every
+  histogram as a Prometheus *summary* (``{quantile="0.5|0.95|0.99"}``
+  + ``_sum`` + ``_count``), names mangled ``pipeline.block_s`` →
+  ``pipeline_block_s``, registry tags as a ``tag="..."`` label with
+  full label-value escaping (``\\`` ``"`` and newline);
+* ``GET /healthz`` — JSON from :func:`dask_ml_tpu.resilience.
+  supervisor.healthz`: 200 while no supervised unit is dead, 503
+  otherwise — the liveness probe a deployment points at this process.
+
+Lifecycle mirrors the compile-ahead worker (design.md §13): the server
+thread is named :data:`METRICS_THREAD_NAME`, registered with the
+supervisor under the ``"obs"`` domain (one beat per request served),
+and re-registers itself after a ``diagnostics.reset()`` wipes the unit
+table — the endpoint survives a books reset cleanly.  It is strictly
+HOST-ONLY: it reads registry snapshots and supervisor verdicts, and
+must never compile or dispatch a device program
+(``analysis.rules._spmd.HOST_ONLY_THREAD_NAMES``; graftsan's dispatch
+detector raises in this thread if it ever does).  A scrape never waits
+on the device: handlers read books, they do not settle them.
+
+Armed by ``DASK_ML_TPU_METRICS_PORT`` (default off; ``0`` binds an
+OS-assigned ephemeral port — the test idiom) at package import, or
+explicitly via :func:`start`.  Binding is fail-soft on the env path (a
+taken port logs one warning and the process runs unscraped — the fit
+matters more than its scrape) and loud on the explicit one.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from .metrics import Counter, Gauge, registry as _registry
+
+__all__ = [
+    "METRICS_PORT_ENV",
+    "METRICS_THREAD_NAME",
+    "MetricsServer",
+    "prometheus_text",
+    "resolve_port",
+    "start",
+    "stop",
+    "active",
+    "rearm",
+]
+
+logger = logging.getLogger(__name__)
+
+#: policy knob: TCP port for the live metrics endpoint ('' = off, the
+#: default; ``0`` = an OS-assigned ephemeral port, reported by
+#: :func:`active`'s ``.port``).  Strict parse — a non-integer raises.
+METRICS_PORT_ENV = "DASK_ML_TPU_METRICS_PORT"
+
+#: the endpoint thread's literal name — host-only by contract, never
+#: blessed to compile or dispatch (see module docstring).
+METRICS_THREAD_NAME = "dask-ml-tpu-metrics"
+
+_QUANTILES = (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))
+
+
+def resolve_port(port: int | None = None) -> int | None:
+    """Resolve the endpoint port: explicit argument, else the
+    ``DASK_ML_TPU_METRICS_PORT`` knob; ``None`` = off.  Strict parse
+    (the repo's env_choice posture): a non-integer or negative value
+    raises instead of silently reading as off."""
+    if port is not None:
+        port = int(port)
+    else:
+        raw = os.environ.get(METRICS_PORT_ENV, "").strip()
+        if not raw:
+            return None
+        try:
+            port = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"{METRICS_PORT_ENV} must be an integer port, got {raw!r}"
+            ) from None
+    if port < 0 or port > 65535:
+        raise ValueError(f"metrics port must be 0..65535, got {port}")
+    return port
+
+
+# -- Prometheus text exposition ------------------------------------------
+
+def _mangle(name: str) -> str:
+    """Registry name -> Prometheus metric name (``[a-zA-Z_:][a-zA-Z0-9_:]*``)."""
+    out = [c if (c.isascii() and (c.isalnum() or c in "_:")) else "_"
+           for c in name]
+    if out and out[0].isdigit():
+        out.insert(0, "_")
+    return "".join(out) or "_"
+
+
+def _escape_label(value: str) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, and
+    newline (the exposition format's three escapes)."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels(*pairs) -> str:
+    items = [f'{k}="{_escape_label(str(v))}"' for k, v in pairs if v != ""]
+    return "{" + ",".join(items) + "}" if items else ""
+
+
+def _fmt(v: float) -> str:
+    if v != v:  # NaN (an empty histogram's quantile)
+        return "NaN"
+    return repr(float(v))
+
+
+def prometheus_text(items=None) -> str:
+    """The whole registry as Prometheus text exposition format (0.0.4).
+
+    Counters/gauges map directly; histograms map to summaries (the
+    registry's HDR quantiles ARE the p50/p95/p99 an SLO scraper wants)
+    with ``_sum``/``_count`` companions.  One ``# TYPE`` line per
+    metric family, families sorted, tags as a ``tag`` label."""
+    if items is None:
+        items = _registry().export_items()
+    families: dict[str, list] = {}
+    kinds: dict[str, str] = {}
+    for name, tag, inst in items:
+        m = _mangle(name)
+        families.setdefault(m, []).append((tag, inst))
+        kinds[m] = ("counter" if isinstance(inst, Counter)
+                    else "gauge" if isinstance(inst, Gauge)
+                    else "summary")
+    lines: list[str] = []
+    for m in sorted(families):
+        kind = kinds[m]
+        lines.append(f"# TYPE {m} {kind}")
+        for tag, inst in families[m]:
+            if kind in ("counter", "gauge"):
+                lines.append(f"{m}{_labels(('tag', tag))} "
+                             f"{_fmt(inst.value)}")
+                continue
+            # ONE snapshot per instrument: quantiles and sum/count come
+            # from the same locked read, so a concurrent writer can
+            # never produce a scrape whose count mismatches its
+            # quantiles (and the O(buckets) quantile pass runs once)
+            snap = inst.snapshot()
+            for qlabel, qkey in _QUANTILES:
+                lines.append(
+                    f"{m}{_labels(('tag', tag), ('quantile', qlabel))} "
+                    f"{_fmt(snap.get(qkey, float('nan')))}")
+            lines.append(f"{m}_sum{_labels(('tag', tag))} "
+                         f"{_fmt(snap.get('sum', 0.0))}")
+            lines.append(f"{m}_count{_labels(('tag', tag))} "
+                         f"{snap.get('count', 0)}")
+    return "\n".join(lines) + "\n"
+
+
+# -- the endpoint --------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "graftscope"
+    # one-request-per-connection, deliberately: the endpoint is ONE
+    # supervised thread (no anonymous handler pool to bless), so a
+    # keep-alive client parked between scrape intervals would wedge
+    # every other client AND stop()'s join.  HTTP/1.0 + an explicit
+    # Connection: close makes the stdlib handler close after each
+    # response; the socket timeout bounds a client that connects and
+    # never speaks.
+    protocol_version = "HTTP/1.0"
+    timeout = 2.0
+
+    def do_GET(self):  # noqa: N802 - stdlib handler contract
+        owner: MetricsServer = self.server._dmlt_owner
+        owner._beat()
+        if self.path == "/metrics":
+            body = prometheus_text().encode("utf-8")
+            code = 200
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif self.path == "/healthz":
+            from ..resilience import supervisor as _supervisor
+
+            verdict = _supervisor.healthz()
+            body = json.dumps(verdict, sort_keys=True).encode("utf-8")
+            code = 200 if verdict["ok"] else 503
+            ctype = "application/json"
+        else:
+            body = b"graftscope: /metrics or /healthz\n"
+            code = 404
+            ctype = "text/plain; charset=utf-8"
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *_args):  # scrapes must not spam stderr
+        pass
+
+
+class MetricsServer:
+    """One bound endpoint + its serving thread (use :func:`start`)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        self._server = HTTPServer((host, port), _Handler)
+        self._server._dmlt_owner = self
+        self.host = host
+        self.port = int(self._server.server_address[1])  # 0 -> assigned
+        self._hb = None
+        # the endpoint thread only runs the stdlib serve loop; every
+        # handler body above is host-only registry/supervisor reads.
+        # The LITERAL name is what declares it host-only to graftlint's
+        # thread-dispatch rule (_spmd.HOST_ONLY_THREAD_NAMES — the
+        # serve_forever target is unresolvable to the static index) and
+        # what graftsan's dispatch detector holds to that contract at
+        # runtime; tests assert it equals METRICS_THREAD_NAME.
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="dask-ml-tpu-metrics",
+        )
+
+    def _start(self) -> "MetricsServer":
+        from ..resilience import supervisor as _supervisor
+
+        self._thread.start()
+        self._hb = _supervisor.register(
+            METRICS_THREAD_NAME, "obs", thread=self._thread)
+        logger.info("graftscope metrics endpoint on %s:%d "
+                    "(/metrics, /healthz)", self.host, self.port)
+        return self
+
+    def _beat(self) -> None:
+        from ..resilience import supervisor as _supervisor
+
+        # a diagnostics.reset() wiped the unit table: re-register so
+        # the endpoint stays supervised (reset must not orphan it)
+        if _supervisor.lookup(METRICS_THREAD_NAME) is not self._hb:
+            self._hb = _supervisor.register(
+                METRICS_THREAD_NAME, "obs", thread=self._thread)
+        self._hb.beat()
+
+    def running(self) -> bool:
+        return self._thread.is_alive()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+        if self._hb is not None:
+            self._hb.retire()
+
+
+_LOCK = threading.Lock()
+_ACTIVE: MetricsServer | None = None
+
+
+def active() -> MetricsServer | None:
+    """The running endpoint (None when off)."""
+    return _ACTIVE
+
+
+def start(port: int | None = None, host: str = "127.0.0.1") -> \
+        MetricsServer | None:
+    """Start the endpoint on ``port`` (default: the knob; None/'' =
+    stay off and return None).  Idempotent while one is running —
+    restarting on a different port requires :func:`stop` first."""
+    global _ACTIVE
+    resolved = resolve_port(port)
+    if resolved is None:
+        return None
+    with _LOCK:
+        if _ACTIVE is not None and _ACTIVE.running():
+            return _ACTIVE
+        _ACTIVE = MetricsServer(resolved, host=host)._start()
+        return _ACTIVE
+
+
+def stop() -> None:
+    """Stop the endpoint (no-op when off)."""
+    global _ACTIVE
+    with _LOCK:
+        srv, _ACTIVE = _ACTIVE, None
+    if srv is not None:
+        srv.stop()
+
+
+def rearm() -> None:
+    """Re-register a live endpoint's supervisor heartbeat (called by
+    ``diagnostics.reset()`` right after the unit table is wiped, so a
+    reset leaves the endpoint supervised, not orphaned)."""
+    srv = _ACTIVE
+    if srv is not None and srv.running():
+        from ..resilience import supervisor as _supervisor
+
+        if _supervisor.lookup(METRICS_THREAD_NAME) is None:
+            srv._hb = _supervisor.register(
+                METRICS_THREAD_NAME, "obs", thread=srv._thread)
+
+
+def start_from_env() -> MetricsServer | None:
+    """The import-time arming path: strict knob parse (a typo'd value
+    raises), fail-soft bind (a taken port warns and continues — the
+    fit matters more than its scrape)."""
+    port = resolve_port()
+    if port is None:
+        return None
+    try:
+        return start(port)
+    except OSError as e:
+        logger.warning(
+            "graftscope: %s=%s could not bind (%s); continuing without "
+            "a metrics endpoint", METRICS_PORT_ENV, port, e)
+        return None

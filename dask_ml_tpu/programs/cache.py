@@ -48,6 +48,7 @@ import numpy as np
 
 import jax
 
+from ..obs import scope as _scope
 from ..obs.metrics import registry as _registry
 
 __all__ = [
@@ -248,23 +249,37 @@ class CachedProgram:
         return (tok, tuple(keys), stat)
 
     # -- dispatch --------------------------------------------------------
+    def _run_tracked(self, fn, args, kwargs=None):
+        """Dispatch through ``fn`` with graftscope device-time tracking:
+        the in-flight interval opens at the enqueue and closes when the
+        outputs report ready (obs/scope.py).  ``absorb()`` keeps the
+        graftsan ``ExecuteReplicated`` hook — which this same call
+        funnels through while a sanitizer is active — from opening a
+        duplicate interval; the cache end owns the attribution (it
+        knows the program's registry name)."""
+        t0 = time.perf_counter()
+        with _scope.absorb():
+            out = fn(*args, **kwargs) if kwargs else fn(*args)
+        _scope.track(self.name, t0, jax.tree_util.tree_leaves(out))
+        return out
+
     def __call__(self, *args, **kwargs):
         static = {k: v for k, v in kwargs.items() if k in self._static}
         if len(static) != len(kwargs):
             # non-static keyword operands: shapes the cache does not
             # model — the jitted twin handles them identically
             self._count("bypass")
-            return self._jitted(*args, **kwargs)
+            return self._run_tracked(self._jitted, args, kwargs)
         sig = self.signature(args, static)
         if sig is None:
             self._count("bypass")
-            return self._jitted(*args, **kwargs)
+            return self._run_tracked(self._jitted, args, kwargs)
         entry, how = self._lookup_or_compile(sig, args, static)
         if entry is None or entry.bad:
             self._count("fallback")
-            return self._jitted(*args, **kwargs)
+            return self._run_tracked(self._jitted, args, kwargs)
         try:
-            out = entry.compiled(*args)
+            out = self._run_tracked(entry.compiled, args)
         except (TypeError, ValueError) as e:
             # operand/executable mismatch (these raise BEFORE execution,
             # so donated buffers are intact): permanently route this
@@ -273,7 +288,7 @@ class CachedProgram:
             self._count("fallback")
             logger.debug("program %s: compiled-call mismatch (%s); "
                          "falling back to jit", self.name, e)
-            return self._jitted(*args, **kwargs)
+            return self._run_tracked(self._jitted, args, kwargs)
         # first-consumer accounting under the lock: two threads
         # dispatching the same warm entry concurrently must not both
         # read consumer_hits == 0 and double-book the ahead hit

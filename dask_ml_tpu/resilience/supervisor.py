@@ -47,6 +47,7 @@ __all__ = [
     "register",
     "lookup",
     "verdicts",
+    "healthz",
     "note_death",
     "note_restart",
     "report",
@@ -133,6 +134,25 @@ def verdicts() -> dict:
     with _LOCK:
         units = list(_UNITS.values())
     return {hb.name: hb.verdict() for hb in units}
+
+
+def healthz() -> dict:
+    """The liveness verdict the ``/healthz`` endpoint (obs/serve.py)
+    serves: ``ok`` is False only when a supervised unit's thread is
+    DEAD — a late beat is a warning (reported, not failing: a unit
+    between beats at its natural cadence must not flap a probe)::
+
+        {"ok": bool, "dead": [...], "late": [...], "units": n}
+    """
+    v = verdicts()
+    live = {n: s for n, s in v.items() if s != "retired"}
+    dead = sorted(n for n, s in live.items() if s == "dead")
+    return {
+        "ok": not dead,
+        "dead": dead,
+        "late": sorted(n for n, s in live.items() if s == "late"),
+        "units": len(live),
+    }
 
 
 def note_death(domain: str, name: str, error: str | None = None) -> None:

@@ -58,11 +58,13 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+import time
 from collections import defaultdict
 
 import jax
 
 from ..obs import event as _obs_event
+from ..obs import scope as _scope
 from ..obs.metrics import registry as _metrics_registry
 
 __all__ = [
@@ -259,7 +261,19 @@ def _install_hooks() -> None:
             s = _ACTIVE
             if s is not None:
                 s._record_dispatch(getattr(er_self, "name", "<program>"))
-            return orig_call(er_self, *args)
+            # graftscope device-time accounting (obs/scope.py): this is
+            # the second choke point — programs that do NOT route
+            # through the central cache (whole-array fits under a
+            # sanitizer, eager ops) still get an in-flight interval.
+            # absorbed() = the program cache is already tracking this
+            # very execution under its registry name.
+            if _scope.absorbed():
+                return orig_call(er_self, *args)
+            t0 = time.perf_counter()
+            out = orig_call(er_self, *args)
+            _scope.track(getattr(er_self, "name", "<program>"), t0,
+                         jax.tree_util.tree_leaves(out))
+            return out
 
         _pxla.ExecuteReplicated.__call__ = _dispatch_hook
 

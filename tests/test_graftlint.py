@@ -859,6 +859,84 @@ class TestBlessedCompileThread:
         assert "stage-purity" in rule_ids(active(findings))
 
 
+class TestHostOnlyThreadNames:
+    """PR-10 graftscope extension: a Thread constructed with a literal
+    name in ``_spmd.HOST_ONLY_THREAD_NAMES`` (the readiness sampler,
+    the metrics endpoint) is DECLARED host-only — the declaration lets
+    thread-dispatch accept a target it cannot resolve (the stdlib
+    ``serve_forever`` loop), because graftsan's dispatch detector holds
+    that name to the contract at runtime.  A target that provably
+    reaches device work still flags: the declaration forgives opacity,
+    never evidence."""
+
+    def test_unresolvable_target_with_host_only_name_is_clean(self):
+        # the obs/serve.py shape: the submitted callable is a method on
+        # a stdlib object the index cannot see into
+        findings = lint("""
+            import threading
+            from http.server import HTTPServer
+
+            def serve(server: HTTPServer):
+                t = threading.Thread(
+                    target=server.serve_forever, daemon=True,
+                    name="dask-ml-tpu-metrics")
+                t.start()
+        """)
+        assert "thread-dispatch" not in rule_ids(active(findings))
+
+    def test_unresolvable_target_without_the_name_still_flags(self):
+        findings = lint("""
+            import threading
+            from http.server import HTTPServer
+
+            def serve(server: HTTPServer):
+                t = threading.Thread(
+                    target=server.serve_forever, daemon=True,
+                    name="some-random-worker")
+                t.start()
+        """)
+        assert "thread-dispatch" in rule_ids(active(findings))
+
+    def test_provable_device_work_flags_despite_the_name(self):
+        # the declaration must never beat evidence: a host-only-named
+        # thread whose target provably dispatches is a contract
+        # violation the static rule can see — flag it
+        findings = lint("""
+            import threading
+            import jax
+
+            def _rogue():
+                jax.jit(lambda v: v)(1.0)
+
+            t = threading.Thread(
+                target=_rogue, name="dask-ml-tpu-scope")
+        """)
+        assert "thread-dispatch" in rule_ids(active(findings))
+
+    def test_computed_host_only_name_does_not_declare(self):
+        findings = lint("""
+            import threading
+            from http.server import HTTPServer
+
+            NAME = "dask-ml-tpu-metrics"
+
+            def serve(server: HTTPServer):
+                t = threading.Thread(
+                    target=server.serve_forever, name=NAME)
+                t.start()
+        """)
+        assert "thread-dispatch" in rule_ids(active(findings))
+
+    def test_host_only_is_not_blessed_to_compile(self):
+        # HOST_ONLY and BLESSED_COMPILE are disjoint privileges: the
+        # sampler/endpoint names must not inherit the compile-ahead
+        # thread's compile allowance
+        from dask_ml_tpu.analysis.rules._spmd import (
+            BLESSED_COMPILE_THREADS, HOST_ONLY_THREAD_NAMES)
+
+        assert not (BLESSED_COMPILE_THREADS & HOST_ONLY_THREAD_NAMES)
+
+
 class TestJitOutsideCache:
     """PR-8: streamed-step jax.jit wraps must route through programs/
     (scope: reachable from partial_fit/_pf_stage/_pf_consume/

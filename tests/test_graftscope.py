@@ -1,0 +1,695 @@
+"""graftscope tests (ISSUE 10 tentpole): device-time accounting, the
+live metrics endpoint, and the committed perf ratchet.
+
+Covers the acceptance criteria: a depth-2 streamed SGD fit's Perfetto
+export shows a device lane whose busy slices overlap the host
+parse/stage slices and ``run_report()["device"]["utilization"]`` > 0.5
+on that fit; ``GET /metrics`` during a fit returns valid Prometheus
+text including ``device_busy_s`` and ``pipeline_block_s`` quantiles
+from a supervisor-registered, graftsan-clean endpoint thread; and the
+perf ratchet (``tools/lint.sh --perf``) fails on an injected slowdown
+and on a stale baseline entry while the committed
+``tools/perf_baseline.json`` gates green.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import diagnostics, obs
+from dask_ml_tpu.obs import perf, scope, serve
+from dask_ml_tpu.pipeline import stream_partial_fit
+from dask_ml_tpu.resilience import supervisor
+
+
+@pytest.fixture(autouse=True)
+def _clean_books():
+    """Book isolation; also stop any endpoint a test left running, and
+    keep span recording armed (the conftest arms it session-wide, but
+    an earlier suite's A/B may have left it disabled — the acceptance
+    tests need host spans next to the device lane)."""
+    if not obs.enabled():
+        obs.enable()
+    diagnostics.reset()
+    yield
+    serve.stop()
+    diagnostics.reset()
+
+
+class _Leaf:
+    """A fake dispatch output leaf with a settable readiness flag."""
+
+    def __init__(self, ready=False):
+        self._ready = ready
+
+    def is_ready(self):
+        return self._ready
+
+
+class _RaisingLeaf:
+    def is_ready(self):
+        raise RuntimeError("donated buffer")
+
+
+def _sgd_blocks(n_blocks=8, rows=16384, dim=32, parse_s=0.001, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(rows, dim)).astype(np.float32)
+    w = rng.normal(size=dim)
+    y = (X @ w > 0).astype(np.int32)
+    for _ in range(n_blocks):
+        if parse_s:
+            time.sleep(parse_s)
+        yield X, y
+
+
+def _fit_streamed_sgd(depth=2, n_blocks=8):
+    from dask_ml_tpu.linear_model import SGDClassifier
+
+    model = SGDClassifier(random_state=0)
+    stream_partial_fit(model, _sgd_blocks(n_blocks), depth=depth,
+                       fit_kwargs={"classes": np.array([0, 1])})
+    return model
+
+
+# -- device-time accounting (obs/scope.py) -------------------------------
+
+class TestScope:
+    def test_track_and_sweep_close_interval(self):
+        leaf = _Leaf(ready=False)
+        t0 = time.perf_counter()
+        assert scope.track("prog.a", t0, [leaf])
+        assert scope.pending_count() == 1
+        leaf._ready = True
+        scope.sweep()
+        assert scope.pending_count() == 0
+        ivs = [iv for iv in scope.timeline() if iv["program"] == "prog.a"]
+        assert len(ivs) == 1 and not ivs[0].get("open")
+        assert ivs[0]["t1"] >= ivs[0]["t0"] == t0
+        reg = obs.registry()
+        assert reg.counter("device.dispatches", "prog.a").value == 1
+        assert reg.histogram("device.busy_s", "prog.a").count == 1
+
+    def test_tracer_outputs_are_not_dispatches(self):
+        # leaves without is_ready (tracers — a program inlining into an
+        # outer trace) must not open an interval or count a dispatch
+        assert not scope.track("prog.traced", time.perf_counter(),
+                               [object(), 3.0])
+        assert scope.pending_count() == 0
+        assert obs.registry().family("device.dispatches") == {}
+
+    def test_raising_is_ready_counts_as_ready(self):
+        # a donated buffer's is_ready raises: treat as ready, the
+        # consuming program's own interval keeps the lane continuous
+        assert scope.track("prog.donate", time.perf_counter(),
+                           [_RaisingLeaf()])
+        scope.sweep()
+        assert scope.pending_count() == 0
+
+    def test_open_interval_visible_in_timeline(self):
+        leaf = _Leaf(ready=False)
+        scope.track("prog.open", time.perf_counter(), [leaf])
+        ivs = [iv for iv in scope.timeline()
+               if iv["program"] == "prog.open"]
+        assert len(ivs) == 1 and ivs[0]["open"] is True
+        leaf._ready = True  # let the sampler retire it
+
+    def test_settle_times_out_on_wedged_program(self):
+        leaf = _Leaf(ready=False)
+        scope.track("prog.wedged", time.perf_counter(), [leaf])
+        assert scope.settle(timeout_s=0.05) is False
+        leaf._ready = True
+        assert scope.settle(timeout_s=2.0) is True
+
+    def test_absorb_is_reentrant_and_thread_local(self):
+        assert not scope.absorbed()
+        with scope.absorb():
+            assert scope.absorbed()
+            with scope.absorb():
+                assert scope.absorbed()
+            assert scope.absorbed()
+        assert not scope.absorbed()
+        seen = []
+        t = threading.Thread(
+            target=lambda: seen.append(scope.absorbed()))
+        with scope.absorb():
+            t.start()
+            t.join()
+        assert seen == [False]  # absorption never leaks across threads
+
+    def test_cursor_scopes_device_report(self):
+        a = _Leaf(ready=True)
+        scope.track("prog.before", time.perf_counter(), [a])
+        scope.sweep()
+        cur = scope.cursor()
+        b = _Leaf(ready=True)
+        scope.track("prog.after", time.perf_counter(), [b])
+        scope.sweep()
+        rep = scope.device_report(since=cur)
+        assert set(rep["programs"]) == {"prog.after"}
+        assert rep["dispatches"] == 1
+
+    def test_device_report_merges_overlaps_and_ranks_gaps(self):
+        # hand-build the timeline through the public API: two
+        # overlapping busy intervals, a gap, then a third
+        base = time.perf_counter()
+        for name, dt0, dur in (("p", 0.00, 0.10), ("q", 0.05, 0.10),
+                               ("p", 0.45, 0.05)):
+            leaf = _Leaf(ready=True)
+            with scope._COND:
+                scope._PENDING.append(
+                    scope._Pending(name, base + dt0, [leaf], scope._SEQ))
+                scope._SEQ += 1
+                scope._sweep_locked(base + dt0 + dur)
+        rep = scope.device_report()
+        assert rep["dispatches"] == 3
+        assert rep["busy_s"] == pytest.approx(0.20, abs=1e-6)
+        assert rep["window_s"] == pytest.approx(0.50, abs=1e-6)
+        assert rep["idle_s"] == pytest.approx(0.30, abs=1e-6)
+        assert rep["utilization"] == pytest.approx(0.40, abs=1e-3)
+        assert len(rep["idle_gaps"]) == 1
+        assert rep["idle_gaps"][0]["dur_s"] == pytest.approx(0.30,
+                                                            abs=1e-6)
+        assert rep["programs"]["p"]["dispatches"] == 2
+
+    def test_empty_report_shape(self):
+        rep = scope.device_report()
+        assert rep == {"dispatches": 0, "busy_s": 0.0, "window_s": 0.0,
+                       "idle_s": 0.0, "utilization": 0.0,
+                       "idle_gaps": [], "programs": {}, "pending": 0}
+
+    def test_reset_drops_timeline_keeps_nothing_pending(self):
+        scope.track("prog.r", time.perf_counter(), [_Leaf(ready=True)])
+        scope.sweep()
+        assert scope.timeline()
+        scope.reset()
+        assert scope.timeline() == []
+        assert scope.pending_count() == 0
+
+    def test_sampler_closes_interval_without_host_activity(self):
+        """The end of a busy period is found even when the host goes
+        quiet: no further track/sweep calls — the sampler thread must
+        retire the pending interval on its own."""
+        leaf = _Leaf(ready=False)
+        scope.track("prog.sampler", time.perf_counter(), [leaf])
+        leaf._ready = True
+        deadline = time.monotonic() + 5.0
+        while scope.pending_count() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert scope.pending_count() == 0
+        sampler = supervisor.lookup(scope.SCOPE_THREAD_NAME)
+        assert sampler is not None or scope._SAMPLER.is_alive()
+
+    def test_sampler_thread_is_host_only_named(self):
+        from dask_ml_tpu.analysis.rules._spmd import (
+            HOST_ONLY_THREAD_NAMES)
+
+        assert scope.SCOPE_THREAD_NAME in HOST_ONLY_THREAD_NAMES
+
+
+# -- acceptance: streamed fit occupancy + the Perfetto device lane -------
+
+class TestStreamedFitAcceptance:
+    def test_depth2_sgd_utilization_and_device_lane_overlap(self):
+        """Acceptance criterion: export_perfetto() of a depth-2
+        streamed SGD fit shows a device lane whose busy slices overlap
+        the host parse/stage slices, and
+        run_report()["device"]["utilization"] > 0.5 on that fit."""
+        _fit_streamed_sgd(depth=2)  # warmup: compiles happen here
+        diagnostics.reset()
+        _fit_streamed_sgd(depth=2)
+
+        rep = diagnostics.run_report()
+        dev = rep["device"]
+        assert dev["dispatches"] >= 8
+        assert dev["utilization"] > 0.5, dev
+        assert dev["busy_s"] > 0
+        assert dev["idle_s"] == pytest.approx(
+            dev["window_s"] - dev["busy_s"], abs=1e-5)
+        assert len(dev["idle_gaps"]) <= 3
+        # per-program attribution carries the cache's registry names
+        assert any(p["busy_s"] > 0 for p in dev["programs"].values())
+
+        trace = obs.export_perfetto()
+        events = trace["traceEvents"]
+        names = [e for e in events if e.get("ph") == "M"]
+        assert any(e["args"]["name"] == "device" and e["tid"] == 0
+                   for e in names)
+        device = [e for e in events if e.get("ph") == "X"
+                  and e["tid"] == 0]
+        host = [e for e in events if e.get("ph") == "X" and e["tid"] != 0
+                and e["name"] in ("pipeline.parse", "pipeline.stage")]
+        assert device and host
+        def overlaps(a, b):
+            return a["ts"] < b["ts"] + b["dur"] and \
+                b["ts"] < a["ts"] + a["dur"]
+        assert any(overlaps(d, h) for d in device for h in host), (
+            "no device slice overlaps a host parse/stage slice")
+        json.dumps(trace)  # the whole thing is valid trace_event JSON
+
+    def test_device_section_in_run_report_resets(self):
+        _fit_streamed_sgd(depth=0, n_blocks=2)
+        assert diagnostics.run_report()["device"]["dispatches"] > 0
+        diagnostics.reset()
+        assert diagnostics.run_report()["device"]["dispatches"] == 0
+
+    def test_depth0_also_accounts_device_time(self):
+        # the cache choke point covers the serial path identically
+        diagnostics.reset()
+        _fit_streamed_sgd(depth=0, n_blocks=3)
+        dev = diagnostics.run_report()["device"]
+        assert dev["dispatches"] >= 3
+        assert dev["busy_s"] > 0
+
+
+# -- Prometheus text format (obs/serve.py) -------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? (NaN|[-+0-9.e]+)$')
+
+
+def _assert_valid_prometheus(text):
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            assert re.match(
+                r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                r"(counter|gauge|summary)$", line), line
+        else:
+            assert _SAMPLE_RE.match(line), line
+
+
+class TestPrometheusText:
+    def test_counter_gauge_summary_shapes(self):
+        reg = obs.registry()
+        reg.counter("unit.count", "a").inc(3)
+        reg.gauge("unit.depth").set(2.5)
+        h = reg.histogram("unit.lat_s")
+        for v in (0.01, 0.02, 0.03):
+            h.record(v)
+        text = serve.prometheus_text()
+        _assert_valid_prometheus(text)
+        assert "# TYPE unit_count counter" in text
+        assert 'unit_count{tag="a"} 3.0' in text
+        assert "# TYPE unit_depth gauge" in text
+        assert "# TYPE unit_lat_s summary" in text
+        assert 'unit_lat_s{quantile="0.5"}' in text
+        assert 'unit_lat_s{quantile="0.99"}' in text
+        assert "unit_lat_s_sum" in text
+        assert "unit_lat_s_count 3" in text
+
+    def test_label_value_escaping(self):
+        """Satellite: Prometheus text-format escaping of label values —
+        tag names carrying backslash, double-quote, and newline must
+        round-trip per the exposition format's three escapes."""
+        reg = obs.registry()
+        reg.counter("unit.esc", 'say "hi"\nback\\slash').inc()
+        text = serve.prometheus_text()
+        line = next(ln for ln in text.splitlines()
+                    if ln.startswith("unit_esc{"))
+        assert '\\"hi\\"' in line
+        assert "\\n" in line and "\n" not in line[:-1].replace(
+            "\\n", "")
+        assert "\\\\slash" in line
+        # the raw newline must NOT appear inside the sample line
+        assert line == line.strip()
+        _assert_valid_prometheus(text)
+
+    def test_name_mangling(self):
+        reg = obs.registry()
+        reg.counter("1weird.name-x").inc()
+        text = serve.prometheus_text()
+        assert "# TYPE _1weird_name_x counter" in text
+
+    def test_empty_histogram_quantiles_are_nan(self):
+        obs.registry().histogram("unit.empty_s")
+        text = serve.prometheus_text()
+        assert 'unit_empty_s{quantile="0.5"} NaN' in text
+        _assert_valid_prometheus(text)
+
+
+# -- the live endpoint ---------------------------------------------------
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+            return resp.status, dict(resp.headers), resp.read().decode()
+    except urllib.error.HTTPError as e:  # 4xx/5xx still carry a body
+        return e.code, dict(e.headers), e.read().decode()
+
+
+class TestMetricsEndpoint:
+    def test_scrape_during_fit_serves_device_and_block_quantiles(self):
+        """Acceptance criterion: curl localhost:$PORT/metrics during a
+        fit returns valid Prometheus text including device_busy_s and
+        pipeline_block_s quantiles from a supervisor-registered
+        endpoint."""
+        srv = serve.start(port=0)
+        assert srv is not None and srv.port > 0
+        _fit_streamed_sgd(depth=2, n_blocks=4)  # warm compiles
+
+        scraped = {}
+
+        def scrape_mid_fit():
+            scraped["mid"] = _get(srv.port, "/metrics")
+
+        t = threading.Thread(target=scrape_mid_fit)
+        gen = _sgd_blocks(6)
+
+        def blocks_with_scrape():
+            for i, item in enumerate(gen):
+                if i == 3:
+                    t.start()
+                yield item
+
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        stream_partial_fit(SGDClassifier(random_state=0),
+                           blocks_with_scrape(), depth=2,
+                           fit_kwargs={"classes": np.array([0, 1])})
+        t.join(timeout=10)
+        status, headers, text = scraped["mid"]
+        assert status == 200
+        assert "version=0.0.4" in headers["Content-Type"]
+        _assert_valid_prometheus(text)
+        assert "# TYPE device_busy_s summary" in text
+        assert re.search(r'device_busy_s\{[^}]*quantile="0\.99"\}', text)
+        assert "# TYPE pipeline_block_s summary" in text
+        assert re.search(r'pipeline_block_s\{quantile="0\.5"\}', text)
+        assert "device_dispatches" in text
+
+        hb = supervisor.lookup(serve.METRICS_THREAD_NAME)
+        assert hb is not None and hb.verdict() == "healthy"
+        assert hb.beats >= 1  # one beat per request served
+
+    def test_healthz_ok_and_degraded(self):
+        srv = serve.start(port=0)
+        status, _, body = _get(srv.port, "/healthz")
+        assert status == 200
+        verdict = json.loads(body)
+        assert verdict["ok"] is True
+        assert serve.METRICS_THREAD_NAME not in verdict["dead"]
+
+        # a supervised unit whose thread died flips the probe to 503
+        dead_thread = threading.Thread(target=lambda: None)
+        dead_thread.start()
+        dead_thread.join()
+        hb = supervisor.register("unit-under-test", "pipeline",
+                                 thread=dead_thread)
+        try:
+            status, _, body = _get(srv.port, "/healthz")
+            assert status == 503
+            assert "unit-under-test" in json.loads(body)["dead"]
+        finally:
+            hb.retire()
+        status, _, _ = _get(srv.port, "/healthz")
+        assert status == 200
+
+    def test_unknown_path_404(self):
+        srv = serve.start(port=0)
+        status, _, body = _get(srv.port, "/nope")
+        assert status == 404
+        assert "/metrics or /healthz" in body
+
+    def test_keep_alive_client_cannot_wedge_the_endpoint(self):
+        """The endpoint is ONE serving thread: a client holding its
+        connection open between scrapes (a real Prometheus scraper's
+        default) must not block other clients — responses close the
+        connection, and a silent connection times out instead of
+        parking the serve loop forever."""
+        import http.client
+        import socket
+
+        srv = serve.start(port=0)
+        # a keep-alive scraper: the server must answer and CLOSE
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        try:
+            conn.request("GET", "/metrics",
+                         headers={"Connection": "keep-alive"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert resp.headers.get("Connection") == "close"
+            resp.read()
+            # while a second raw socket sits connected and SILENT, the
+            # endpoint must still serve others (the silent socket is
+            # bounded by the handler's socket timeout, not forever)
+            quiet = socket.create_connection(("127.0.0.1", srv.port),
+                                             timeout=10)
+            try:
+                status, _, _ = _get(srv.port, "/healthz")
+                assert status == 200
+            finally:
+                quiet.close()
+        finally:
+            conn.close()
+
+    def test_start_is_idempotent_and_stop_retires(self):
+        srv = serve.start(port=0)
+        assert serve.start(port=0) is srv
+        assert serve.active() is srv
+        port = srv.port
+        serve.stop()
+        assert serve.active() is None
+        assert supervisor.lookup(serve.METRICS_THREAD_NAME) is None
+        with pytest.raises(OSError):
+            _get(port, "/metrics")
+
+    def test_reset_zeroes_books_but_keeps_endpoint_serving(self):
+        """Satellite: diagnostics.reset() clears the device books and
+        the endpoint survives cleanly — re-registered, zeroed, still
+        serving."""
+        srv = serve.start(port=0)
+        _fit_streamed_sgd(depth=0, n_blocks=2)
+        _, _, before = _get(srv.port, "/metrics")
+        assert "device_dispatches" in before
+        diagnostics.reset()
+        assert serve.active() is srv and srv.running()
+        assert supervisor.lookup(serve.METRICS_THREAD_NAME) is not None
+        status, _, after = _get(srv.port, "/metrics")
+        assert status == 200
+        assert "device_dispatches" not in after  # books zeroed
+        # and it keeps recording fresh fits after the reset
+        _fit_streamed_sgd(depth=0, n_blocks=2)
+        _, _, again = _get(srv.port, "/metrics")
+        assert "device_dispatches" in again
+
+    def test_port_knob_strict_parse(self, monkeypatch):
+        monkeypatch.setenv(serve.METRICS_PORT_ENV, "")
+        assert serve.resolve_port() is None
+        monkeypatch.setenv(serve.METRICS_PORT_ENV, "8081")
+        assert serve.resolve_port() == 8081
+        monkeypatch.setenv(serve.METRICS_PORT_ENV, "http")
+        with pytest.raises(ValueError, match="integer port"):
+            serve.resolve_port()
+        with pytest.raises(ValueError, match="0..65535"):
+            serve.resolve_port(70000)
+
+    def test_env_arming_fail_soft_on_taken_port(self, monkeypatch):
+        srv = serve.start(port=0)
+        # a second process-level arm on the SAME port must warn and
+        # continue, not raise (the fit matters more than its scrape)
+        monkeypatch.setenv(serve.METRICS_PORT_ENV, str(srv.port))
+        serve.stop()  # clear _ACTIVE so start_from_env truly binds
+        blocker = serve.MetricsServer(srv.port)  # hold the port, no start
+        try:
+            assert serve.start_from_env() is None
+        finally:
+            blocker._server.server_close()
+
+    def test_endpoint_thread_name_is_the_host_only_literal(self):
+        from dask_ml_tpu.analysis.rules._spmd import (
+            BLESSED_COMPILE_THREADS, HOST_ONLY_THREAD_NAMES)
+
+        srv = serve.start(port=0)
+        assert srv._thread.name == serve.METRICS_THREAD_NAME
+        assert serve.METRICS_THREAD_NAME in HOST_ONLY_THREAD_NAMES
+        # host-only is NOT the compile blessing: the endpoint may never
+        # compile, even where the ahead worker may
+        assert serve.METRICS_THREAD_NAME not in BLESSED_COMPILE_THREADS
+
+    def test_scrape_is_graftsan_clean(self, sanitizer):
+        """Acceptance criterion: the endpoint thread is graftsan-clean —
+        zero steady compiles/dispatches from it.  The sanitizer is
+        fail-fast: a dispatch from the metrics thread would raise AT
+        the violating enqueue inside the handler (a 500, and a
+        violation in the report); steady() makes any compile a
+        violation too."""
+        srv = serve.start(port=0)
+        _fit_streamed_sgd(depth=2, n_blocks=3)  # warmup inside scope
+        with sanitizer.steady(guard=False):
+            _fit_streamed_sgd(depth=2, n_blocks=3)
+            status, _, text = _get(srv.port, "/metrics")
+            assert status == 200 and "device_busy_s" in text
+            status, _, _ = _get(srv.port, "/healthz")
+            assert status == 200
+        rep = sanitizer.report()
+        assert rep["violations"] == []
+        assert rep["totals"]["steady_compiles"] == 0
+
+
+# -- the perf ratchet (obs/perf.py) --------------------------------------
+
+def _snap(workloads):
+    return {"version": 1, "workloads": workloads}
+
+
+_BASE = {"blocks": 10, "p50_block_s": 0.002, "p99_block_s": 0.008,
+         "utilization": 0.8, "stall_fraction": 0.3, "wall_s": 0.05,
+         "device_busy_s": 0.03}
+
+
+def _m(**over):
+    m = dict(_BASE)
+    m.update(over)
+    return m
+
+
+class TestPerfCompare:
+    def test_clean_within_bands(self):
+        delta = perf.compare(_snap({"w": _m()}),
+                             {"w": _m(p50_block_s=0.004,
+                                      utilization=0.6)})
+        assert perf.is_clean(delta), delta
+
+    def test_new_and_stale_fail(self):
+        delta = perf.compare(_snap({"old": _m()}), {"new": _m()})
+        assert delta["new"] == ["new"]
+        assert delta["stale"] == ["old"]
+        assert not perf.is_clean(delta)
+
+    def test_p50_above_ceiling_is_regression(self):
+        # ceiling = 0.002 * 5 + 0.010 = 0.020
+        delta = perf.compare(_snap({"w": _m()}),
+                             {"w": _m(p50_block_s=0.021)})
+        assert any("p50_block_s" in r for r in delta["regressions"])
+
+    def test_p99_above_ceiling_is_regression(self):
+        # ceiling = 0.008 * 8 + 0.050 = 0.114
+        delta = perf.compare(_snap({"w": _m()}),
+                             {"w": _m(p99_block_s=0.12)})
+        assert any("p99_block_s" in r for r in delta["regressions"])
+
+    def test_utilization_floor(self):
+        delta = perf.compare(_snap({"w": _m()}),
+                             {"w": _m(utilization=0.39)})
+        assert any("utilization" in r for r in delta["regressions"])
+
+    def test_utilization_floor_skipped_for_tiny_base(self):
+        delta = perf.compare(_snap({"w": _m(utilization=0.05)}),
+                             {"w": _m(utilization=0.0)})
+        assert perf.is_clean(delta)
+
+    def test_stall_ceiling(self):
+        # ceiling = 0.3 * 3 + 0.20 = 1.1 -> use a base of 0
+        delta = perf.compare(_snap({"w": _m(stall_fraction=0.0)}),
+                             {"w": _m(stall_fraction=0.25)})
+        assert any("stall_fraction" in r for r in delta["regressions"])
+
+    def test_blocks_drift_is_regression(self):
+        delta = perf.compare(_snap({"w": _m()}), {"w": _m(blocks=12)})
+        assert any("blocks" in r for r in delta["regressions"])
+
+    def test_errored_workload_is_violation(self):
+        delta = perf.compare(_snap({"w": _m()}),
+                             {"w": _m(error="Boom: x")})
+        assert any("errored" in v for v in delta["violations"])
+
+    def test_baseline_error_cannot_grandfather(self):
+        delta = perf.compare(_snap({"w": _m(error="old boom")}),
+                             {"w": _m()})
+        assert any("grandfather" in v for v in delta["violations"])
+
+    def test_partial_checks_errors_only(self):
+        delta = perf.compare(_snap({"w": _m(), "other": _m()}),
+                             {"w": _m(p50_block_s=9.9)}, partial=True)
+        assert perf.is_clean(delta)
+        delta = perf.compare(_snap({"w": _m()}),
+                             {"w": _m(error="Boom")}, partial=True)
+        assert not perf.is_clean(delta)
+
+    def test_load_refuses_newer_version_and_malformed(self, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps({"version": 99, "workloads": {}}))
+        with pytest.raises(ValueError, match="newer"):
+            perf.load(str(p))
+        p.write_text(json.dumps({"version": 1}))
+        with pytest.raises(ValueError, match="malformed"):
+            perf.load(str(p))
+
+
+class TestPerfRatchetGate:
+    """The tier-1 half of ``tools/lint.sh --perf``: the committed
+    baseline is green on this box, and the ratchet actually fails on
+    the injected slowdown and on a stale entry."""
+
+    @pytest.fixture(scope="class")
+    def committed(self):
+        path = perf.default_path()
+        assert path is not None, "tools/perf_baseline.json missing"
+        return perf.load(path)
+
+    def test_committed_baseline_is_green(self, committed):
+        results = perf.run_suite()
+        delta = perf.compare(committed, results)
+        assert perf.is_clean(delta), delta
+
+    def test_injected_slowdown_fails_the_ratchet(self, committed):
+        """Acceptance criterion: a sleep smuggled into a step program
+        must fail the gate.  One workload, compared against its own
+        committed entry (full semantics, not partial): 50 ms per step
+        lands far above the p50 ceiling."""
+        name = "sgd_stream_d2"
+        results = {name: perf.run_workload(name, inject_s=0.05)}
+        subset = {"version": committed["version"],
+                  "workloads": {name: committed["workloads"][name]}}
+        delta = perf.compare(subset, results)
+        assert any("p50_block_s" in r for r in delta["regressions"]), (
+            delta, results)
+
+    def test_stale_baseline_entry_fails_the_ratchet(self, committed):
+        snap = {"version": committed["version"],
+                "workloads": dict(committed["workloads"],
+                                  retired_workload=_m())}
+        # full-suite semantics: compare a full snapshot against a run
+        # missing the retired entry
+        delta = perf.compare(snap, {n: _m() for n in
+                                    committed["workloads"]})
+        assert "retired_workload" in delta["stale"]
+        assert not perf.is_clean(delta)
+
+    def test_workload_registry_matches_baseline(self, committed):
+        assert sorted(perf.WORKLOADS) == sorted(committed["workloads"])
+
+
+class TestPerfCli:
+    def test_list_workloads(self, capsys):
+        assert perf.main(["--list-workloads"]) == 0
+        out = capsys.readouterr().out
+        assert "sgd_stream_d2" in out
+
+    def test_write_baseline_refuses_subset(self, capsys):
+        rc = perf.main(["--write-baseline", "/tmp/x.json",
+                        "--workloads", "sgd_stream_d2"])
+        assert rc == 2
+        assert "full suite" in capsys.readouterr().err
+
+    def test_write_baseline_refuses_injection(self, capsys):
+        rc = perf.main(["--write-baseline", "/tmp/x.json",
+                        "--inject-slowdown", "0.1"])
+        assert rc == 2
+
+    def test_inject_slowdown_refuses_subset(self, capsys):
+        # a --workloads subset runs errors-only: the injection would
+        # read as a false green — refuse the combination loudly
+        rc = perf.main(["--workloads", "sgd_stream_d2",
+                        "--inject-slowdown", "0.1"])
+        assert rc == 2
+        assert "full suite" in capsys.readouterr().err
+
+    def test_unknown_workload_is_exit_2(self, capsys):
+        assert perf.main(["--workloads", "nope"]) == 2

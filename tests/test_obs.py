@@ -98,6 +98,40 @@ class TestMetricsRegistry:
         snap = reg.snapshot()
         assert snap["counters"]["t.retry{ingest}"] == 2
 
+    def test_histogram_concurrent_writers_lose_nothing(self):
+        """Two threads recording into one ``pipeline.block_s`` family —
+        the shape the serving lane's handler pool will drive — must not
+        lose observations or corrupt the running sum: every record is
+        one lock acquisition (obs/metrics.py), so count/sum/min/max
+        stay exact under contention, including when both writers share
+        ONE instrument and when they write sibling tags of a family."""
+        reg = obs.registry()
+        n = 4000
+
+        def write(tag, value):
+            h = reg.histogram("pipeline.block_s", tag)
+            for _ in range(n):
+                h.record(value)
+
+        # same (name, tag) instrument from both threads
+        t1 = threading.Thread(target=write, args=("", 0.001))
+        t2 = threading.Thread(target=write, args=("", 0.004))
+        t1.start(); t2.start(); t1.join(); t2.join()
+        h = reg.histogram("pipeline.block_s")
+        assert h.count == 2 * n
+        assert h.sum == pytest.approx(n * 0.001 + n * 0.004)
+        assert h.min == pytest.approx(0.001)
+        assert h.max == pytest.approx(0.004)
+
+        # sibling tags of the same family, created under the race
+        t3 = threading.Thread(target=write, args=("lane-a", 0.002))
+        t4 = threading.Thread(target=write, args=("lane-b", 0.003))
+        t3.start(); t4.start(); t3.join(); t4.join()
+        assert reg.histogram("pipeline.block_s", "lane-a").count == n
+        assert reg.histogram("pipeline.block_s", "lane-b").count == n
+        snap = reg.snapshot()["histograms"]
+        assert snap["pipeline.block_s{lane-a}"]["count"] == n
+
     def test_kind_conflict_raises(self):
         reg = obs.registry()
         reg.counter("t.kind")
@@ -530,16 +564,25 @@ class TestOverheadAB:
 
         The stream wall is pinned by deterministic reader sleeps (the
         pipeline hides compute behind them), so the ratio isolates the
-        per-block span/registry cost instead of XLA dispatch noise.
-        The wall is long enough that 3% is an order of magnitude above
-        sleep/scheduler jitter, and the arms run INTERLEAVED
-        (off/on/off/on..., best-of-6 each) so a load drift across the
-        test hits both arms equally instead of masquerading as
-        overhead.  (Best-of-6, was 4: on the 2-core CI box a warm
-        process full of earlier suites' threads occasionally handed one
-        arm a bad scheduling draw all 4 rounds — more rounds tighten
-        the min statistic; the 3% threshold itself is unchanged.)
+        per-block span/registry cost instead of XLA dispatch noise, and
+        the wall is long enough that 3% is an order of magnitude above
+        sleep/scheduler jitter.
+
+        Estimator: the MEDIAN OF PAIRED PER-ROUND RATIOS.  Each round
+        runs both arms back to back (order alternating to cancel any
+        systematic first-runner bias) and contributes one on/off ratio;
+        the verdict is the median over rounds.  This replaces the
+        best-of-6 per-arm wall comparison, whose min statistic needed
+        ONE clean scheduling draw per arm — under sustained scheduler
+        starvation on the 2-core CI box one arm sometimes never got
+        one (tripped again in the PR-9 full run).  A starvation burst
+        now lands on both halves of the SAME round (ratio ≈ unaffected)
+        or skews at most that round's ratio, and the median tolerates
+        up to two bad rounds in either direction out of six.  The 3%
+        threshold itself is unchanged.
         """
+        import statistics
+
         from dask_ml_tpu.linear_model import SGDClassifier
 
         n_blocks, parse_s = 30, 0.008  # wall ~0.25 s; 3% >> timer noise
@@ -558,20 +601,27 @@ class TestOverheadAB:
                          classes=[0, 1])
             return time.perf_counter() - t0
 
+        def one_arm(arm):
+            if arm == "off":
+                obs.disable()
+                try:
+                    return one_fit()
+                finally:
+                    obs.enable()
+            return one_fit()
+
         one_fit()  # warm the XLA cache outside both arms
 
-        walls = {"off": [], "on": []}
-        for _ in range(6):
-            obs.disable()
-            try:
-                walls["off"].append(one_fit())
-            finally:
-                obs.enable()
-            walls["on"].append(one_fit())
-        wall_off, wall_on = min(walls["off"]), min(walls["on"])
-        assert wall_on <= wall_off * 1.03, (
-            f"tracing overhead {wall_on / wall_off - 1:.2%} "
-            f"(on={wall_on:.4f}s off={wall_off:.4f}s, raw={walls})"
+        ratios, raw = [], []
+        for i in range(6):
+            order = ("off", "on") if i % 2 == 0 else ("on", "off")
+            walls = {arm: one_arm(arm) for arm in order}
+            ratios.append(walls["on"] / walls["off"])
+            raw.append(walls)
+        med = statistics.median(ratios)
+        assert med <= 1.03, (
+            f"tracing overhead {med - 1:.2%} (median of paired ratios "
+            f"{[round(r, 4) for r in sorted(ratios)]}, raw={raw})"
         )
 
 

@@ -23,13 +23,22 @@
 # model-equality-vs-unfaulted-twin, and retry-ceiling invariants).
 # Tier-1 runs the same gate via tests/test_drills.py.
 #
+# --perf runs the graftscope perf suite (obs/perf.py): streamed-fit
+# workloads whose p50/p99 block latency, device utilization, and stall
+# fraction ratchet against tools/perf_baseline.json with tolerance
+# BANDS (not exact times — the gate box is loaded; the ratchet catches
+# the order-of-magnitude class: a sleep in a step program, a pipeline
+# that stopped overlapping, an idling device).  Tier-1 runs the same
+# gate via tests/test_graftscope.py.
+#
 # Usage:
 #   tools/lint.sh                 # static ratchet gate (text output)
 #   tools/lint.sh --json          # same, JSON output (CI trending)
 #   tools/lint.sh --sanitize      # static gate + runtime sanitizer gate
-#   tools/lint.sh --drills       # static gate + chaos drill gate
-#   tools/lint.sh --rebaseline    # refresh ALL THREE committed baselines
-#                                 # (lint, sanitize, drills) after
+#   tools/lint.sh --drills        # static gate + chaos drill gate
+#   tools/lint.sh --perf          # static gate + perf ratchet gate
+#   tools/lint.sh --rebaseline    # refresh ALL FOUR committed baselines
+#                                 # (lint, sanitize, drills, perf) after
 #                                 # intentional changes — each write
 #                                 # self-gates its hard invariants; a
 #                                 # half-updated set cannot be committed
@@ -41,9 +50,11 @@ cd "$(dirname "$0")/.."
 BASELINE=tools/graftlint_baseline.json
 SAN_BASELINE=tools/sanitize_baseline.json
 DRILL_BASELINE=tools/drill_baseline.json
+PERF_BASELINE=tools/perf_baseline.json
 MODE=gate
 SANITIZE=0
 DRILLS=0
+PERF=0
 EXTRA=()
 for a in "$@"; do
   case "$a" in
@@ -51,6 +62,7 @@ for a in "$@"; do
     --rebaseline) MODE=rebaseline ;;
     --sanitize) SANITIZE=1 ;;
     --drills) DRILLS=1 ;;
+    --perf) PERF=1 ;;
     *) EXTRA+=("$a") ;;
   esac
 done
@@ -69,6 +81,9 @@ if [[ "$MODE" == rebaseline ]]; then
   echo "== graftdrill (rebaseline: full chaos drill suite) =="
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m dask_ml_tpu.resilience.drills --write-baseline "$DRILL_BASELINE"
+  echo "== graftscope perf (rebaseline: cold-run latency/utilization) =="
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m dask_ml_tpu.obs.perf --write-baseline "$PERF_BASELINE"
 fi
 
 echo "== graftlint (ratchet vs $BASELINE) =="
@@ -93,6 +108,12 @@ if [[ "$DRILLS" == 1 ]]; then
   echo "== graftdrill (chaos drill suite vs $DRILL_BASELINE) =="
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m dask_ml_tpu.resilience.drills --baseline "$DRILL_BASELINE"
+fi
+
+if [[ "$PERF" == 1 ]]; then
+  echo "== graftscope perf (latency/utilization ratchet vs $PERF_BASELINE) =="
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m dask_ml_tpu.obs.perf --baseline "$PERF_BASELINE"
 fi
 
 echo "== compileall =="
