@@ -51,7 +51,7 @@ _RESULT = {
 # so a crashed/wedged run's numbers survive into the next run's JSON.
 _KNOWN_SECTIONS = {
     "lloyd", "admm", "tsqr", "scatter", "pairwise", "streamed", "packed",
-    "csv", "recompile",
+    "csv", "recompile", "serve",
 }
 ONLY_SECTIONS = {
     s.strip()
@@ -1972,6 +1972,112 @@ def main():
         extra["csv_error"] = traceback.format_exc(limit=3)
 
     section_s["streamed"] = round(time.time() - _t_sec, 1)
+    _t_sec = time.time()
+
+    # --- online serving latency (serve/, design.md §15): closed-loop
+    # and open-loop (Poisson arrivals) p50/p99/throughput for 1-row and
+    # 16-row requests against a fitted SGD model.  Closed loop times
+    # each request on the caller (the client's number, queue wait and
+    # gather window included); open loop reads the registry's
+    # serve.request_s histogram, recorded at fulfillment on the serve
+    # thread, plus batch occupancy (rows per dispatch) — the
+    # micro-batcher's coalescing win under load. ---
+    try:
+        if _want("serve") and time.time() - _START_TS < _BUDGET_S * 0.97:
+            from dask_ml_tpu import obs as _obs_serve
+            from dask_ml_tpu.linear_model import SGDClassifier
+            from dask_ml_tpu.serve import ModelServer
+
+            dV = 32
+            rngS = np.random.RandomState(7)
+            XS = rngS.normal(size=(4096, dV)).astype(np.float32)
+            yS = (XS @ rngS.normal(size=dV) > 0).astype(np.int32)
+            clfS = SGDClassifier(random_state=0)
+            clfS.partial_fit(XS, yS, classes=np.array([0, 1]))
+
+            def _pq(lats_s):
+                arr = np.sort(np.asarray(lats_s, np.float64))
+                return (round(float(arr[len(arr) // 2]) * 1e3, 3),
+                        round(float(
+                            arr[min(int(len(arr) * 0.99),
+                                    len(arr) - 1)]) * 1e3, 3))
+
+            closed_rps = None
+            with ModelServer(label="bench_serve_closed",
+                             window_s=0.0) as srv:
+                srv.load("m", clfS)
+                for _ in range(20):  # warm: programs + request path
+                    srv.predict("m", XS[:1])
+                for rows in (1, 16):
+                    N = 400 if rows == 1 else 200
+                    lats = []
+                    t0 = time.perf_counter()
+                    for i in range(N):
+                        lo = (i * rows) % 2048
+                        t1 = time.perf_counter()
+                        srv.predict("m", XS[lo:lo + rows])
+                        lats.append(time.perf_counter() - t1)
+                    dt = time.perf_counter() - t0
+                    p50, p99 = _pq(lats)
+                    if rows == 1:
+                        closed_rps = N / max(dt, 1e-9)
+                    _record({
+                        "workload": f"serve_closed_{rows}row",
+                        "requests": N,
+                        "p50_ms": p50,
+                        "p99_ms": p99,
+                        "requests_per_s": round(N / max(dt, 1e-9), 1),
+                        "rows_per_s": round(
+                            N * rows / max(dt, 1e-9), 1),
+                    })
+            # open loop: Poisson arrivals at ~60% of the measured
+            # closed-loop rate (NO floor — a floor would overrun a
+            # slow device, fill the admission queue, and abort the
+            # section via queue_full), DEFAULT gather window — latency
+            # from the fulfillment-side histogram, occupancy from the
+            # per-dispatch row books.  N scales with the rate so the
+            # section costs a few seconds on any device.
+            lam = (closed_rps or 100.0) * 0.6
+            N = int(min(400, max(100, lam * 5)))
+            gaps = np.random.RandomState(11).exponential(1.0 / lam,
+                                                         size=N)
+            reg = _obs_serve.registry()
+            with ModelServer(label="bench_serve_open") as srv:
+                srv.load("m", clfS)
+                for _ in range(20):
+                    srv.predict("m", XS[:1])
+                reg.reset(prefix="serve.request_s")
+                reg.reset(prefix="serve.batch_rows")
+                reg.reset(prefix="serve.batch_requests")
+                futs = []
+                t0 = time.perf_counter()
+                for i in range(N):
+                    time.sleep(float(gaps[i]))
+                    futs.append(srv.submit("m", XS[i % 2048:
+                                                   i % 2048 + 1]))
+                for f in futs:
+                    f.result(30.0)
+                dt = time.perf_counter() - t0
+                hist = reg.histogram("serve.request_s", "m")
+                occ = reg.histogram("serve.batch_rows")
+                n_disp = occ.snapshot().get("count", 0)
+                _record({
+                    "workload": "serve_open_poisson_1row",
+                    "requests": N,
+                    "offered_rps": round(lam, 1),
+                    "achieved_rps": round(N / max(dt, 1e-9), 1),
+                    "p50_ms": round(hist.quantile(0.50) * 1e3, 3),
+                    "p99_ms": round(hist.quantile(0.99) * 1e3, 3),
+                    "dispatches": int(n_disp),
+                    "rows_per_dispatch": round(
+                        N / max(n_disp, 1), 2),
+                })
+    except _SkipSection:
+        pass
+    except Exception:
+        extra["serve_error"] = traceback.format_exc(limit=3)
+
+    section_s["serve"] = round(time.time() - _t_sec, 1)
     try:
         # session-total observability counters for the compact line
         # (BENCH_r*.json): the per-workload deltas live on each entry's

@@ -43,6 +43,7 @@ from . import wrappers  # noqa: F401
 from . import _partial  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import resilience  # noqa: F401
+from . import serve  # noqa: F401
 from . import sanitize  # noqa: F401
 from . import obs  # noqa: F401
 from . import diagnostics  # noqa: F401
@@ -67,6 +68,7 @@ __all__ = [
     "ensemble",
     "checkpoint",
     "resilience",
+    "serve",
     "compose",
     "diagnostics",
     "obs",

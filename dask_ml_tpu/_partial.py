@@ -120,6 +120,32 @@ def fit(model, x, y=None, *, chunk_size: int | None = None, shuffle_blocks=False
         )
 
 
+def stage_predict_block(xb, policy):
+    """Host-side bucket pad of ONE predict block: returns ``(block,
+    n_real)`` where ``n_real`` is the real row count to slice back from
+    the padded predictions, or ``(block, None)`` for blocks the pad must
+    not touch (device-resident input, non-2-D hosts, no-op pads).
+
+    The ONE predict-staging entry the offline plane
+    (:func:`predict`'s prefetch stage) and the online serve plane
+    (``serve/batcher.py``) share, so the bucket discipline — and the
+    slice-back contract — cannot drift between them.  Row-wise
+    inference makes the pad exact: padding rows never influence real
+    rows' outputs.  Safe on a host worker thread: numpy + the
+    ``bucket.*`` counters only."""
+    import jax.numpy as jnp
+
+    from . import programs
+
+    if isinstance(xb, (ShardedRows, jnp.ndarray)):
+        return xb, None
+    xa = np.asarray(xb)
+    if xa.ndim != 2:
+        return xb, None
+    padded, _, _ = programs.pad_block(xa, policy=policy)
+    return padded, (None if padded is xa else xa.shape[0])
+
+
 def predict(model, x, *, chunk_size: int = 100_000,
             prefetch_depth: int | None = None):
     """Chunked predict (reference ``_partial.predict``: blockwise).
@@ -136,8 +162,6 @@ def predict(model, x, *, chunk_size: int = 100_000,
     inference makes the pad exact — padding rows never influence real
     rows' outputs.
     """
-    import jax.numpy as jnp
-
     from . import programs
     from .base import TPUEstimator
     from .pipeline import prefetch_blocks
@@ -152,16 +176,12 @@ def predict(model, x, *, chunk_size: int = 100_000,
     bucketed = policy.kind != "off" and isinstance(model, TPUEstimator)
 
     def _stage(xb):
-        """Host-side bucket pad (prefetch worker): returns (block, n)
-        where n is the real row count to slice back, or (block, None)
-        for blocks the pad must not touch (device-resident input)."""
-        if not bucketed or isinstance(xb, (ShardedRows, jnp.ndarray)):
+        """Host-side bucket pad (prefetch worker) — the shared
+        :func:`stage_predict_block` discipline, gated on the model
+        being device-native (host estimators see raw blocks)."""
+        if not bucketed:
             return xb, None
-        xa = np.asarray(xb)
-        if xa.ndim != 2:
-            return xb, None
-        padded, _, _ = programs.pad_block(xa, policy=policy)
-        return padded, (None if padded is xa else xa.shape[0])
+        return stage_predict_block(xb, policy)
 
     with obs.span("predict", estimator=type(model).__name__):
         outs = []

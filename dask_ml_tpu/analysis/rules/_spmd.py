@@ -73,6 +73,23 @@ def divergent_source(test: ast.AST) -> str | None:
 # dispatch surface.
 BLESSED_COMPILE_THREADS = frozenset({"dask-ml-tpu-compile-ahead"})
 
+# Thread names blessed to DISPATCH device programs off the main thread —
+# the serving plane's micro-batch loop (serve/runtime.py).  The serve
+# loop IS a dispatch thread by design: it owns the whole device
+# interaction for online inference (staging puts, cached-program
+# dispatch, result fetch), serialized inside one thread, so it does not
+# interleave enqueues with itself.  The static thread-dispatch rule
+# accepts a Thread constructed with one of these LITERAL names; the
+# runtime half is graftsan, which permits dispatches from these threads
+# but still treats a STEADY-STATE compile attributed to one as a hard
+# violation (the micro-batcher's bucket discipline exists precisely so
+# the serve loop never compiles after its load-time warmup) — the
+# declared contract is runtime-verified, not taken on faith.  The
+# deadlock hazard of a second dispatcher CONCURRENT with a training
+# fit is real and documented (design.md §15): the serve plane is for
+# inference processes; co-resident training keeps the main thread.
+BLESSED_DISPATCH_THREADS = frozenset({"dask-ml-tpu-serve"})
+
 # Thread names declared HOST-ONLY by contract — the graftscope readiness
 # sampler and the live metrics endpoint (obs/scope.py, obs/serve.py):
 # they read registry books, poll `is_ready()` futures, and serve HTTP;
@@ -115,6 +132,12 @@ def host_only_thread_name(ctor: ast.Call) -> str | None:
     """The literal ``name=`` of a Thread construction when it is in
     :data:`HOST_ONLY_THREAD_NAMES`, else None."""
     return _thread_literal_name(ctor, HOST_ONLY_THREAD_NAMES)
+
+
+def dispatch_blessed_thread_name(ctor: ast.Call) -> str | None:
+    """The literal ``name=`` of a Thread construction when it is in
+    :data:`BLESSED_DISPATCH_THREADS`, else None."""
+    return _thread_literal_name(ctor, BLESSED_DISPATCH_THREADS)
 
 
 # -- device work markers (interprocedural rules) --------------------------

@@ -24,7 +24,7 @@ import ast
 
 from ..core import Context, Rule, dotted_name, register
 from ._spmd import blessed_thread_name, device_work_in, \
-    host_only_thread_name
+    dispatch_blessed_thread_name, host_only_thread_name
 
 _CTOR_SUFFIXES = frozenset({"ThreadPoolExecutor", "Thread"})
 _GUARD_NAME = "_uses_device_estimator"
@@ -154,6 +154,16 @@ class ThreadDispatchRule(Rule):
                 for n in ast.walk(fn)
             )
             if guarded:
+                continue
+            if dispatch_blessed_thread_name(node) is not None:
+                # a declared dispatch-blessed thread (a LITERAL name in
+                # _spmd.BLESSED_DISPATCH_THREADS — the serving plane's
+                # micro-batch loop): it dispatches device programs as
+                # its JOB, serialized within itself.  The declaration is
+                # runtime-verified by graftsan, which permits this
+                # thread's dispatches but still hard-fails a steady
+                # compile attributed to it (tests/test_serve.py holds
+                # both ends together, same pattern as HOST_ONLY names).
                 continue
             targets = _work_targets(ctx, node)
             # a Thread constructed with a blessed compile-ahead name may

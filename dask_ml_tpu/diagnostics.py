@@ -55,7 +55,7 @@ __all__ = [
     "trace", "benchmark_step", "benchmark_slope", "_timer",
     "FaultStats", "fault_stats", "reset_fault_stats", "fault_report",
     "pipeline_report", "reset_pipeline_stats",
-    "lint_report", "sanitize_report", "program_report",
+    "lint_report", "sanitize_report", "program_report", "serve_report",
     "obs", "span", "event", "metrics_snapshot", "export_perfetto",
     "flight_dump", "run_report", "reset",
 ]
@@ -121,6 +121,24 @@ def program_report() -> dict:
     return programs.report()
 
 
+def serve_report() -> dict:
+    """The online inference plane's books (design.md §15)::
+
+        {"servers": [{label, alive, queued, budget, residency, ...}],
+         "metrics": {"serve.request_s{model}": {p50, p95, p99, ...},
+                     "serve.rejected{reason}": n, ...}}
+
+    Per-model request latency quantiles (queue wait included — the
+    client's number), queue-wait and batch-occupancy histograms,
+    rejections by reason, and each live server's residency/budget
+    state.  The same ``serve.*`` registry families export through the
+    live ``/metrics`` endpoint and ratchet through the committed
+    ``serve_latency`` perf workload."""
+    from . import serve
+
+    return serve.report()
+
+
 def run_report() -> dict:
     """The merged "what happened, in order, during THAT fit" view.
 
@@ -141,8 +159,9 @@ def run_report() -> dict:
       device-side half of the host stage split next to it.  The read
       settles briefly (≤1 s) so a just-finished fit's last in-flight
       program closes its interval.
-    * ``pipeline`` / ``faults`` / ``sanitize`` — the pre-existing
-      reporters, unchanged shapes (views over the same registry).
+    * ``pipeline`` / ``faults`` / ``sanitize`` / ``serve`` — the
+      per-plane reporters, unchanged shapes (views over the same
+      registry).
 
     Call :func:`reset` first to scope the report to one fit; export the
     same fit with :func:`export_perfetto` to render its host lanes AND
@@ -160,6 +179,7 @@ def run_report() -> dict:
         "faults": resilience["faults"],
         "resilience": resilience,
         "sanitize": sanitize_report(),
+        "serve": serve_report(),
     }
 
 

@@ -193,10 +193,74 @@ def _wl_mbk(depth, inject_s=0.0):
         _row_blocks, depth, inject_s=inject_s)
 
 
+#: serve_latency calibration: closed-loop request counts (the workload's
+#: ``blocks`` = completed requests, so the shape-drift gate still bites)
+_SERVE_1ROW = 100
+_SERVE_16ROW = 20
+
+
+def _wl_serve(inject_s=0.0):
+    """The serving SLO, CI-enforced: closed-loop 1-row and 16-row
+    requests against a fitted SGD model through a latency-first
+    ``ModelServer`` (window 0).  For this workload a "block" is a
+    REQUEST: ``p50/p99_block_s`` are end-to-end request latency
+    quantiles (queue wait included — the client's number), and
+    ``stall_fraction`` is the queue-wait share of the wall.  The
+    injected slowdown rides the server's per-dispatch test hook, so
+    ``--inject-slowdown`` fails this entry exactly like the streamed
+    ones."""
+    import numpy as np
+
+    from ..linear_model import SGDClassifier
+    from ..serve import ModelServer
+    from . import scope as _scope
+    from .metrics import registry as _registry
+
+    rng = np.random.RandomState(_SEED)
+    X = rng.normal(size=(1024, 16)).astype(np.float32)
+    w = rng.normal(size=16)
+    y = (X @ w > 0).astype(np.int32)
+    model = SGDClassifier(random_state=0)
+    model.partial_fit(X, y, classes=np.array([0, 1]))
+
+    server = ModelServer(label="perf_serve", window_s=0.0)
+    try:
+        server.load("m", model)
+        for _ in range(10):  # warmup round: programs + request path hot
+            server.predict("m", X[:1])
+        server._test_dispatch_delay_s = float(inject_s)
+        _registry().reset(prefix="serve.request_s")
+        _registry().reset(prefix="serve.queue_wait_s")
+        cur = _scope.cursor()
+        t0 = time.perf_counter()
+        for i in range(_SERVE_1ROW):
+            server.predict("m", X[i % 64:i % 64 + 1])
+        for i in range(_SERVE_16ROW):
+            lo = (i * 16) % 512
+            server.predict("m", X[lo:lo + 16])
+        wall = time.perf_counter() - t0
+        hist = _registry().histogram("serve.request_s", "m")
+        qwait = _registry().histogram("serve.queue_wait_s", "m")
+        dev = _scope.device_report(since=cur, settle_s=5.0)
+        return {
+            "blocks": _SERVE_1ROW + _SERVE_16ROW,
+            "p50_block_s": round(float(hist.quantile(0.50)), 6),
+            "p99_block_s": round(float(hist.quantile(0.99)), 6),
+            "utilization": float(dev["utilization"]),
+            "stall_fraction": round(
+                min(float(qwait.sum) / max(wall, 1e-9), 1.0), 4),
+            "wall_s": round(wall, 6),
+            "device_busy_s": dev["busy_s"],
+        }
+    finally:
+        server.close()
+
+
 WORKLOADS = {
     "sgd_stream_d0": lambda inject_s=0.0: _wl_sgd(0, inject_s),
     "sgd_stream_d2": lambda inject_s=0.0: _wl_sgd(2, inject_s),
     "mbk_stream_d2": lambda inject_s=0.0: _wl_mbk(2, inject_s),
+    "serve_latency": lambda inject_s=0.0: _wl_serve(inject_s),
 }
 
 

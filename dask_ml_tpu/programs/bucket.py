@@ -105,6 +105,36 @@ class BucketPolicy:
         top = self.sizes[-1]
         return ((n + top - 1) // top) * top
 
+    def rungs(self, max_rows: int) -> tuple:
+        """Every padded row count this policy can produce for blocks of
+        1..``max_rows`` real rows, ascending — the serve plane's
+        load-time warm set (serve/residency.py pre-compiles one predict
+        program per rung so the micro-batch loop never compiles in
+        steady state).  ``off`` returns ``()``: every length is its own
+        shape and pre-warming is meaningless."""
+        max_rows = int(max_rows)
+        if max_rows <= 0 or self.kind == "off":
+            return ()
+        if self.kind == "pow2":
+            out, b = [], 1
+            while b < max_rows:
+                out.append(b)
+                b <<= 1
+            out.append(b)
+            return tuple(out)
+        top = self.bucket(max_rows)
+        out = [b for b in self.sizes if b < top]
+        # beyond the ladder's last rung, bucket() rounds to multiples of
+        # it — enumerate those too so the warm set covers every shape a
+        # coalesced batch of <= max_rows rows can pad to
+        step = self.sizes[-1]
+        b = out[-1] + step if out and out[-1] >= step else step
+        while b < top:
+            out.append(b)
+            b += step
+        out.append(top)
+        return tuple(out)
+
     def __eq__(self, other):
         return (isinstance(other, BucketPolicy)
                 and self.kind == other.kind and self.sizes == other.sizes)

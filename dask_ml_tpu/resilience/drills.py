@@ -495,6 +495,67 @@ def _drill_ahead_crash_sgd(depth, m):
         m["model_match"], m["max_rel_diff"] = _match(model, twin)
 
 
+def _drill_serve_crash_sgd(depth, m):
+    """The serve loop dies (simulated hard death) WITH a drained request
+    batch in hand: the supervisor's dead-thread verdict surfaces in
+    ``/healthz`` while it is down, a caller already parked on a future
+    triggers the budgeted restart, and the in-flight batch is REPLAYED —
+    every submitted request resolves with a result or an explicit
+    rejection record, and every served prediction equals the direct
+    ``model.predict``.  ``depth`` is the prefetch depth the served model
+    was streamed-fitted at (the drill matrix's streaming dimension)."""
+    import time
+
+    from ..serve import ModelServer
+    from . import supervisor as _sup
+    from .elastic import FaultBudget
+
+    blocks = _class_blocks(offset=0)
+    model = _fit_sgd(list(blocks), depth,
+                     label=f"drill_serve_fit_d{depth}")
+    Xq = blocks[0][0]
+    twin = np.asarray(model.predict(Xq))
+
+    plan = FaultPlan().inject("serve-loop", at_call=3, times=1,
+                              exc=ThreadCrash("drill: serve loop death"))
+    server = ModelServer(
+        label=f"drill_serve_d{depth}", window_s=0.0,
+        budget=FaultBudget(4, 60.0, name=f"drill_serve_d{depth}"))
+    # the server's ACTUAL supervised unit name (repeat constructions of
+    # one label uniquify with #n — a hardcoded name would miss them)
+    unit = server._unit
+    try:
+        server.load("m", model)
+        results = []
+        with fault_plan(plan):
+            for _ in range(2):  # batches 1-2: healthy traffic
+                results.append(server.predict("m", Xq))
+            # batch 3: the loop crashes AFTER draining this request
+            fut = server.submit("m", Xq)
+            for _ in range(500):
+                if not server._thread.is_alive():
+                    break
+                time.sleep(0.01)
+            died = not server._thread.is_alive()
+            hz_dead = unit in _sup.healthz()["dead"]
+            # the parked future wait IS the recovery trigger: restart
+            # within the budget, replay the drained batch exactly
+            results.append(fut.result(timeout=30.0))
+            hz_back = unit not in _sup.healthz()["dead"]
+            results.append(server.predict("m", Xq))  # post-restart
+        rep = server.report()
+        m["faults_injected"] = sum(plan.fired.values())
+        m["recovered"] = (died and hz_dead and hz_back
+                          and m["faults_injected"] == 1
+                          and rep["budget"]["spent"] >= 1
+                          and rep["alive"])
+        ok = all(np.array_equal(np.asarray(r), twin) for r in results)
+        m["model_match"] = ok
+        m["max_rel_diff"] = 0.0 if ok else float("inf")
+    finally:
+        server.close()
+
+
 def _drill_exporter_enospc_mbk(depth, m):
     """Disk-full on the grafttrace JSONL sink mid-fit: the sink is
     dropped with one warning (ring + flight recording continue) and the
@@ -544,6 +605,7 @@ _IMPLS = {
     "prefetch_crash_sgd": ("prefetch-worker", _drill_prefetch_crash_sgd),
     "ahead_crash_sgd": ("compile-ahead", _drill_ahead_crash_sgd),
     "exporter_enospc_mbk": ("exporter-write", _drill_exporter_enospc_mbk),
+    "serve_crash_sgd": ("serve-loop", _drill_serve_crash_sgd),
 }
 for _name, (_point, _fn) in _IMPLS.items():
     for _depth in (0, 2):

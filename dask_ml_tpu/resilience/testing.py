@@ -30,7 +30,12 @@ pattern).  This module replaces that with a declarative registry: a
   compiles, never hang on the in-flight event);
 * ``"exporter-write"`` — the grafttrace JSONL sink's write path
   (``obs/export.py``); inject ``OSError(errno.ENOSPC, ...)`` to drill
-  the disk-full degradation (drop the sink, keep training).
+  the disk-full degradation (drop the sink, keep training);
+* ``"serve-loop"`` — the serving plane's micro-batch loop
+  (``serve/runtime.py``), fired once per drained request batch BEFORE
+  its dispatch; inject :class:`ThreadCrash` to simulate the serve loop
+  dying with a batch in hand (the supervised restart must replay it —
+  no request dropped without an explicit rejection record).
 
 Hot paths pay one global ``is None`` check when no plan is active.
 """
@@ -58,6 +63,7 @@ __all__ = [
 INJECTION_POINTS = (
     "ingest", "step", "checkpoint-write", "collective",
     "stage", "prefetch-worker", "compile-ahead", "exporter-write",
+    "serve-loop",
 )
 
 

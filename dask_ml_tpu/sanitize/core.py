@@ -359,7 +359,8 @@ class Sanitizer:
 
     def __init__(self, label: str = "sanitize", *, fail_fast: bool = True,
                  guard_steady: bool = True, blessed_threads=None):
-        from ..analysis.rules._spmd import BLESSED_COMPILE_THREADS
+        from ..analysis.rules._spmd import (BLESSED_COMPILE_THREADS,
+                                            BLESSED_DISPATCH_THREADS)
 
         self.label = label
         self.fail_fast = fail_fast
@@ -367,6 +368,12 @@ class Sanitizer:
         self.blessed_threads = frozenset(
             BLESSED_COMPILE_THREADS if blessed_threads is None
             else blessed_threads)
+        # dispatch-blessed threads (the serve loop): dispatching is
+        # their JOB — never an off-thread-dispatch violation — but they
+        # are NOT compile-blessed: a steady-phase compile attributed to
+        # one is the micro-batcher failing its warm-program contract
+        # and stays a hard violation (_record_compile below).
+        self.dispatch_blessed = frozenset(BLESSED_DISPATCH_THREADS)
         self.phase = "warmup"
         #: the EFFECTIVE guard choice of the innermost steady() block —
         #: step_guard() consults this, so a steady(guard=False) caller
@@ -460,6 +467,22 @@ class Sanitizer:
             c["compile_s"] += float(duration)
             if steady:
                 c["steady_compiles"] += 1
+        if (threading.get_ident() != self._primary_ident
+                and thread.name in self.dispatch_blessed):
+            # the serve loop: its load-time warmup compiles are legal
+            # (the cold path's home is that thread), but a STEADY
+            # compile means a request shape escaped the bucket ladder —
+            # recorded as the same hard-zero violation a primary-thread
+            # steady compile is, without the off-thread fail-fast raise
+            # (the violation must reach the report, not kill the batch).
+            if steady:
+                self._violation(
+                    "steady-state-compile", reg, thread.name,
+                    f"XLA backend compile in region {reg!r} on the "
+                    f"dispatch-blessed thread {thread.name!r} "
+                    f"(phase=steady): the serve loop must only dispatch "
+                    f"warm programs after load-time warmup")
+            return
         off_thread = threading.get_ident() != self._primary_ident
         if off_thread or steady:
             kind = ("off-thread-compile" if off_thread
@@ -486,7 +509,8 @@ class Sanitizer:
             self.dispatch_threads.add(thread.name)
         _metrics_registry().counter("dispatch.count").inc()
         if (threading.get_ident() != self._primary_ident
-                and thread.name not in self.blessed_threads):
+                and thread.name not in self.blessed_threads
+                and thread.name not in self.dispatch_blessed):
             self._violation(
                 "off-thread-dispatch", reg, thread.name,
                 f"device program {program!r} dispatched from second "

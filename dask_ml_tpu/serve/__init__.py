@@ -1,0 +1,69 @@
+"""The online inference plane (design.md §15) — the ROADMAP
+``[serving]`` lane: training has been industrial for ten PRs; this
+package is the runtime that lets the resulting models face traffic.
+
+The reference project stops at batch prediction (``ParallelPostFit``-
+style shard-wise apply, SURVEY §3.5); an online plane is a new
+subsystem, built entirely on substrate earlier PRs shipped:
+
+* **micro-batching** (:mod:`.batcher`): queued single-row / small-batch
+  requests coalesce into the shared bucket ladder
+  (``DASK_ML_TPU_BUCKET``), so every dispatch hits a warm cached
+  program (:mod:`dask_ml_tpu.programs`) — zero steady-state compiles,
+  sanitizer-verified;
+* **model residency** (:mod:`.residency`): many fitted models stay
+  device-resident at once under an HBM budget with LRU parking, and
+  homogeneous models lane-pack into one vmapped program per window
+  (the K=4–64 packing measured 1.6–7.6× on chip);
+* **admission control**: a bounded request queue sheds load with an
+  explicit ``queue_full`` rejection, per-request deadlines drop stale
+  work before dispatch — backpressure is a fast error, never silent
+  latency;
+* **ops for free** (:mod:`.runtime`): the serve loop is a supervised
+  unit (``/healthz`` flips when it dies, restarts ride the fault
+  budget with the in-flight batch replayed), and per-model p50/p99
+  request latency, batch occupancy, and rejection counters export
+  through the live ``/metrics`` endpoint and the committed perf
+  ratchet (``serve_latency`` in tools/perf_baseline.json).
+
+Quick start::
+
+    from dask_ml_tpu.serve import ModelServer
+
+    server = ModelServer()
+    server.load("churn", fitted_sgd_classifier)
+    label = server.predict("churn", one_row)        # sync, micro-batched
+    fut = server.submit("churn", rows, deadline_s=0.05)
+    labels = fut.result()
+    server.close()
+"""
+
+from .batcher import RequestRejected, ServeFuture  # noqa: F401
+from .config import (  # noqa: F401
+    DEADLINE_ENV,
+    HBM_ENV,
+    MAX_BATCH_ENV,
+    QUEUE_ENV,
+    WINDOW_ENV,
+)
+from .residency import ModelRegistry, serve_pack_key  # noqa: F401
+from .runtime import (  # noqa: F401
+    SERVE_THREAD_NAME,
+    ModelServer,
+    report,
+)
+
+__all__ = [
+    "DEADLINE_ENV",
+    "HBM_ENV",
+    "MAX_BATCH_ENV",
+    "QUEUE_ENV",
+    "WINDOW_ENV",
+    "SERVE_THREAD_NAME",
+    "ModelRegistry",
+    "ModelServer",
+    "RequestRejected",
+    "ServeFuture",
+    "report",
+    "serve_pack_key",
+]

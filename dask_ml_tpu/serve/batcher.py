@@ -1,0 +1,234 @@
+"""Micro-batching: bounded admission queue + adaptive gather window.
+
+The request path is the input pipeline's bounded-depth discipline
+(``pipeline/core.py``) turned inside out: training bounds STAGED BLOCKS
+ahead of one consumer; serving bounds QUEUED REQUESTS ahead of one
+dispatcher, and sheds load EXPLICITLY instead of blocking the caller —
+
+* a **full queue** rejects at submit with reason ``queue_full`` (the
+  client sees backpressure in microseconds, not as unbounded latency);
+* a request still queued past its **deadline** is dropped at drain
+  time, BEFORE any device work, with reason ``deadline``;
+* every rejection is a loud record: a ``serve.rejected{reason}``
+  counter increment plus a ``serve.reject`` flight-recorder event
+  carrying the request id — never a silent drop (the same posture as
+  degraded-mode block skips, design.md §13).
+
+The gather window is adaptive on the device-occupancy signal graftscope
+already tracks (``obs.scope.pending_count``): while programs are in
+flight the loop dispatches what it has immediately — arrivals coalesce
+naturally behind the running program, and waiting would only add
+latency — and only an IDLE device waits up to the configured window for
+stragglers to fill the batch (``DASK_ML_TPU_SERVE_WINDOW_MS``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+
+import numpy as np
+
+from .. import obs
+from ..obs.metrics import registry as _registry
+
+__all__ = [
+    "Request",
+    "RequestRejected",
+    "ServeFuture",
+    "MicroBatcher",
+]
+
+_req_ids = itertools.count(1)
+
+
+class RequestRejected(RuntimeError):
+    """A request was shed with an explicit reason (``queue_full`` /
+    ``deadline`` / ``oversize`` / ``unknown_model`` / ``shutdown`` /
+    ``serve_down``) — admission control and deadline drops surface HERE,
+    never as silent latency or lost futures."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(f"[{reason}] {detail}")
+        self.reason = reason
+
+
+class ServeFuture:
+    """One request's completion handle.  ``result()`` polls its owning
+    server's liveness while waiting, so a caller blocked on a future is
+    itself the recovery trigger when the serve loop died with no new
+    submits arriving (the pipeline's consumer-side liveness poll,
+    applied to the request plane)."""
+
+    __slots__ = ("_event", "_value", "_exc", "_server")
+
+    def __init__(self, server=None):
+        self._event = threading.Event()
+        self._value = None
+        self._exc = None
+        self._server = server
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def set_result(self, value) -> None:
+        self._value = value
+        self._event.set()
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._event.set()
+
+    def result(self, timeout: float | None = None):
+        deadline = None if timeout is None else \
+            time.monotonic() + float(timeout)
+        while not self._event.is_set():
+            if self._server is not None:
+                self._server._ensure_alive()
+            remaining = 0.05 if deadline is None else \
+                min(0.05, deadline - time.monotonic())
+            if remaining <= 0:
+                raise TimeoutError("serve request timed out")
+            self._event.wait(remaining)
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class Request:
+    """One submitted predict: host rows + bookkeeping.  ``mode`` is
+    ``"label"`` (decode to classes / regression values) or ``"proba"``
+    (per-class probabilities via the donated device transform)."""
+
+    __slots__ = ("id", "model", "x", "n", "future", "t_enqueue",
+                 "t_deadline", "mode")
+
+    def __init__(self, model: str, x: np.ndarray, future: ServeFuture,
+                 deadline_s: float, mode: str = "label"):
+        self.id = next(_req_ids)
+        self.model = model
+        self.x = x
+        self.n = int(x.shape[0])
+        self.future = future
+        self.mode = mode
+        self.t_enqueue = time.monotonic()
+        self.t_deadline = (self.t_enqueue + deadline_s
+                           if deadline_s > 0 else None)
+
+    def expired(self, now: float) -> bool:
+        return self.t_deadline is not None and now > self.t_deadline
+
+
+def reject(req: Request, reason: str, detail: str) -> None:
+    """The ONE rejection entry: counter + flight event + failed future."""
+    _registry().counter("serve.rejected", reason).inc()
+    obs.event("serve.reject", request=req.id, model=req.model,
+              reason=reason)
+    req.future.set_exception(RequestRejected(reason, detail))
+
+
+class MicroBatcher:
+    """The bounded request queue and its gather logic (serve-loop side).
+
+    ``offer`` runs on caller threads (admission only — one non-blocking
+    put); ``gather`` runs on the serve loop and owns the window."""
+
+    def __init__(self, *, depth: int, max_batch: int, window_s: float):
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self.max_batch = int(max_batch)
+        self.window_s = float(window_s)
+        self.depth = int(depth)
+        # a request popped mid-gather that would overflow the row
+        # ceiling: held for the NEXT batch (serve-loop-only state)
+        self._carry: Request | None = None
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    # -- caller side -----------------------------------------------------
+    def offer(self, item) -> None:
+        """Admit ``item`` or raise :class:`RequestRejected` NOW — the
+        queue bound IS the backpressure; a blocking put would just move
+        the unbounded wait into the caller."""
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            if isinstance(item, Request):
+                _registry().counter("serve.rejected", "queue_full").inc()
+                obs.event("serve.reject", request=item.id,
+                          model=item.model, reason="queue_full")
+            raise RequestRejected(
+                "queue_full",
+                f"serve queue at depth {self._q.maxsize}; shedding load"
+            ) from None
+        _registry().gauge("serve.queue_depth").set(float(self._q.qsize()))
+
+    def offer_control(self, item) -> None:
+        """Control items (model load/unload, shutdown) are never shed —
+        they block for a slot instead (rare, caller-paced)."""
+        self._q.put(item)
+
+    # -- serve-loop side -------------------------------------------------
+    def gather(self, stop: threading.Event, poll_s: float = 0.05):
+        """One micro-batch: block for the first item (``None`` when the
+        loop should re-check ``stop``), then — for plain requests —
+        coalesce more until the row ceiling, an expired window, or an
+        empty queue on a busy device.  Control items return alone."""
+        if self._carry is not None:
+            first, self._carry = self._carry, None
+        else:
+            try:
+                first = self._q.get(timeout=poll_s)
+            except queue.Empty:
+                return None
+        if not isinstance(first, Request):
+            return [first]
+        batch = [first]
+        rows = first.n
+        t0 = time.monotonic()
+        window = self.window_s
+        while rows < self.max_batch and not stop.is_set():
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                from ..obs import scope as _scope
+
+                # adaptive window: a BUSY device means arrivals already
+                # coalesce behind the running program — dispatch now;
+                # only an idle device waits for stragglers
+                if _scope.pending_count() > 0:
+                    break
+                remaining = window - (time.monotonic() - t0)
+                if remaining <= 0:
+                    break
+                time.sleep(min(remaining, 0.0005))
+                continue
+            if not isinstance(item, Request):
+                # control item mid-gather: dispatch the batch first,
+                # handle control next round (order preserved)
+                batch.append(item)
+                break
+            if rows + item.n > self.max_batch:
+                self._carry = item  # heads the next batch instead
+                break
+            batch.append(item)
+            rows += item.n
+        _registry().histogram("serve.batch_window_s").record(
+            time.monotonic() - t0)
+        _registry().gauge("serve.queue_depth").set(float(self._q.qsize()))
+        return batch
+
+    def drain_pending(self):
+        """Every queued (and carried) item, without blocking
+        (shutdown/teardown)."""
+        out = []
+        if self._carry is not None:
+            out.append(self._carry)
+            self._carry = None
+        while True:
+            try:
+                out.append(self._q.get_nowait())
+            except queue.Empty:
+                return out

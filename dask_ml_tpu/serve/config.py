@@ -1,0 +1,116 @@
+"""Serving-plane policy knobs (``DASK_ML_TPU_SERVE_*``).
+
+All resolvers follow the repo's env_choice posture: explicit argument
+wins, else the env knob, else the documented default — and an
+unparseable value raises loudly (a typo'd knob must never silently
+change admission or latency behavior).  Knobs are read at server
+construction, not per request: the serve loop's hot path never touches
+``os.environ``.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = [
+    "MAX_BATCH_ENV",
+    "WINDOW_ENV",
+    "QUEUE_ENV",
+    "DEADLINE_ENV",
+    "HBM_ENV",
+    "resolve_max_batch",
+    "resolve_window_s",
+    "resolve_queue_depth",
+    "resolve_deadline_s",
+    "resolve_hbm_budget_bytes",
+]
+
+#: policy knob: max coalesced REAL rows per serve dispatch (the
+#: micro-batch ceiling; a single request may not exceed it either —
+#: bulk scoring belongs to the offline ``_partial.predict`` plane).
+MAX_BATCH_ENV = "DASK_ML_TPU_SERVE_MAX_BATCH"
+
+#: policy knob: micro-batch gather window in milliseconds — how long
+#: the serve loop may hold the first queued request while waiting for
+#: more to coalesce.  Adaptive: the full window applies only while the
+#: device is idle; with programs in flight the loop dispatches
+#: immediately (requests already coalesce behind the running program).
+#: 0 disables the wait entirely (latency-first).
+WINDOW_ENV = "DASK_ML_TPU_SERVE_WINDOW_MS"
+
+#: policy knob: admission-control bound — max REQUESTS queued ahead of
+#: the serve loop.  A full queue sheds load with an explicit
+#: ``RequestRejected`` (reason ``queue_full``), never silent latency.
+QUEUE_ENV = "DASK_ML_TPU_SERVE_QUEUE"
+
+#: policy knob: default per-request deadline in milliseconds (0 = none).
+#: A request still queued past its deadline is dropped BEFORE dispatch
+#: with an explicit rejection (reason ``deadline``) — stale work never
+#: spends device time.
+DEADLINE_ENV = "DASK_ML_TPU_SERVE_DEADLINE_MS"
+
+#: policy knob: device-residency budget in MiB for the model registry.
+#: Loading past the budget LRU-evicts resident state to host (an
+#: evicted model's next request pays one re-upload, counted per model
+#: in the ``serve.residency_fault`` registry family).
+HBM_ENV = "DASK_ML_TPU_SERVE_HBM_MB"
+
+_DEFAULT_MAX_BATCH = 1024
+_DEFAULT_WINDOW_MS = 2.0
+_DEFAULT_QUEUE = 256
+_DEFAULT_DEADLINE_MS = 0.0
+_DEFAULT_HBM_MB = 512.0
+
+
+def _env_number(env: str, cast, default):
+    raw = os.environ.get(env, "").strip()
+    if not raw:
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        raise ValueError(
+            f"{env} must be a {cast.__name__}, got {raw!r}") from None
+
+
+def resolve_max_batch(value: int | None = None) -> int:
+    value = int(_env_number(MAX_BATCH_ENV, int, _DEFAULT_MAX_BATCH)
+                if value is None else value)
+    if value < 1:
+        raise ValueError(f"serve max batch must be >= 1, got {value}")
+    return value
+
+
+def resolve_window_s(value: float | None = None) -> float:
+    """The gather window in SECONDS (the knob is in ms)."""
+    ms = (_env_number(WINDOW_ENV, float, _DEFAULT_WINDOW_MS)
+          if value is None else float(value) * 1e3)
+    if ms < 0:
+        raise ValueError(f"serve window must be >= 0 ms, got {ms}")
+    return ms / 1e3
+
+
+def resolve_queue_depth(value: int | None = None) -> int:
+    value = int(_env_number(QUEUE_ENV, int, _DEFAULT_QUEUE)
+                if value is None else value)
+    if value < 1:
+        raise ValueError(f"serve queue depth must be >= 1, got {value}")
+    return value
+
+
+def resolve_deadline_s(value: float | None = None) -> float:
+    """The default per-request deadline in SECONDS (0 = none; the knob
+    is in ms)."""
+    ms = (_env_number(DEADLINE_ENV, float, _DEFAULT_DEADLINE_MS)
+          if value is None else float(value) * 1e3)
+    if ms < 0:
+        raise ValueError(f"serve deadline must be >= 0 ms, got {ms}")
+    return ms / 1e3
+
+
+def resolve_hbm_budget_bytes(value: float | None = None) -> int:
+    mb = (_env_number(HBM_ENV, float, _DEFAULT_HBM_MB)
+          if value is None else float(value))
+    if mb <= 0:
+        raise ValueError(f"serve HBM budget must be > 0 MiB, got {mb}")
+    return int(mb * (1 << 20))

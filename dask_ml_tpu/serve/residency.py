@@ -1,0 +1,434 @@
+"""Model residency: keep many fitted models device-resident, lane-pack
+homogeneous ones, evict LRU under an HBM budget.
+
+The registry is OWNED by the serve loop thread: every mutating entry
+point (:meth:`ModelRegistry.admit`, :meth:`ensure_resident`,
+:meth:`ensure_pack`) runs there — uploads, warm compiles, and stack
+builds are device work, and the serving plane keeps ALL device work on
+its one blessed dispatch thread (``analysis/rules/_spmd.py``
+``BLESSED_DISPATCH_THREADS``).  Read-only views (:meth:`report`,
+:meth:`names`) are safe anywhere.
+
+Three residence classes:
+
+* **sgd** (``SGDClassifier`` / ``SGDRegressor``): the fitted ``coef`` /
+  ``intercept`` device arrays are extracted once at admit; requests
+  dispatch the fused ``serve.margins`` program and decode on host.
+  Models sharing a :func:`serve_pack_key` additionally join a
+  :class:`LanePack` whose stacked ``[M, d, k]`` state serves requests
+  for DIFFERENT models in one vmapped dispatch.
+* **generic** (anything else with ``predict``): served through the
+  estimator's own predict surface on the serve thread; device-native
+  states (``_state`` pytrees) are budget-counted, host models cost 0.
+* **parked**: an LRU-evicted sgd model's state lives as host numpy;
+  its next request re-uploads (a *residency fault*, counted per model
+  in ``serve.residency_fault``) and may evict someone else.
+
+Load-time warmup: admitting a model pre-compiles its predict program
+for EVERY bucket rung a coalesced batch can pad to
+(:meth:`~dask_ml_tpu.programs.BucketPolicy.rungs`), so the steady-state
+serve loop only ever dispatches warm cached programs — the zero-steady-
+compile contract the armed-sanitizer test pins.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..obs.metrics import registry as _registry
+from . import programs as _sprog
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ResidentModel", "LanePack", "ModelRegistry", "serve_pack_key"]
+
+
+def serve_pack_key(model):
+    """Hashable serving-compatibility key, or None when the model can't
+    lane-pack.  Unlike training's :func:`~dask_ml_tpu.model_selection.
+    _packing.pack_key`, INFERENCE only needs the state SHAPES to agree —
+    the margins gemm has no loss/penalty/schedule branches — so models
+    from entirely different training configs pack together as long as
+    their coefficient matrices are congruent."""
+    from ..linear_model._sgd import _BaseSGD
+
+    if not isinstance(model, _BaseSGD) or not hasattr(model, "_state"):
+        return None
+    coef = model._state["coef"]
+    return (type(model).__name__, tuple(coef.shape), str(coef.dtype))
+
+
+def _leaf_nbytes(tree) -> int:
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        size = getattr(leaf, "size", None)
+        dtype = getattr(leaf, "dtype", None)
+        if size is not None and dtype is not None:
+            total += int(size) * np.dtype(dtype).itemsize
+    return total
+
+
+class ResidentModel:
+    """One registered model's residency record."""
+
+    __slots__ = ("name", "model", "kind", "classes", "coef", "intercept",
+                 "host_coef", "host_intercept", "state_bytes", "last_used",
+                 "pack_key", "proba_loss")
+
+    def __init__(self, name: str, model):
+        from ..linear_model._sgd import _BaseSGD, SGDClassifier
+
+        self.name = str(name)
+        self.model = model
+        self.classes = None
+        self.coef = self.intercept = None
+        self.host_coef = self.host_intercept = None
+        self.last_used = 0
+        self.proba_loss = None
+        self.pack_key = serve_pack_key(model)
+        if isinstance(model, _BaseSGD):
+            if not hasattr(model, "_state"):
+                raise ValueError(
+                    f"model {name!r} is not fitted (no _state); serve "
+                    f"residency holds fitted estimators only")
+            self.kind = ("sgd_classifier" if isinstance(model, SGDClassifier)
+                         else "sgd_regressor")
+            if self.kind == "sgd_classifier":
+                self.classes = np.asarray(model.classes_)
+                if model.loss in ("log_loss", "modified_huber"):
+                    self.proba_loss = model.loss
+            self.coef = model._state["coef"]
+            self.intercept = model._state["intercept"]
+            self.state_bytes = _leaf_nbytes((self.coef, self.intercept))
+        else:
+            if not callable(getattr(model, "predict", None)):
+                raise TypeError(
+                    f"model {name!r} ({type(model).__name__}) has no "
+                    f"predict surface to serve")
+            self.kind = "generic"
+            self.state_bytes = _leaf_nbytes(getattr(model, "_state", None))
+
+    @property
+    def resident(self) -> bool:
+        return self.kind == "generic" or self.coef is not None
+
+    @property
+    def n_features(self) -> int:
+        ref = self.coef if self.coef is not None else self.host_coef
+        return int(ref.shape[0]) if ref is not None else -1
+
+    def park(self) -> int:
+        """Drop device state to host copies; returns the bytes freed.
+        Generic models never park (their state lives inside the
+        estimator — evicting it would mutate the user's object)."""
+        if self.kind == "generic" or self.coef is None:
+            return 0
+        self.host_coef = np.asarray(self.coef)
+        self.host_intercept = np.asarray(self.intercept)
+        self.coef = self.intercept = None
+        return self.state_bytes
+
+    def unpark(self) -> int:
+        """Re-upload parked host state; returns the bytes now resident.
+        Serve-thread only (host→device puts)."""
+        import jax.numpy as jnp
+
+        if self.coef is None:
+            self.coef = jnp.asarray(self.host_coef)
+            self.intercept = jnp.asarray(self.host_intercept)
+            self.host_coef = self.host_intercept = None
+            _registry().counter("serve.residency_fault", self.name).inc()
+        return self.state_bytes
+
+    def decode(self, margins: np.ndarray):
+        """Host decode of fetched ``(b, k)`` margins into predictions."""
+        if self.kind == "sgd_regressor":
+            return margins[:, 0]
+        if margins.shape[1] == 1:
+            idx = (margins[:, 0] > 0).astype(np.intp)
+        else:
+            idx = np.argmax(margins, axis=1)
+        return self.classes[idx]
+
+    def decode_proba(self, probs: np.ndarray) -> np.ndarray:
+        """Host tail of the device proba transform: the binary case's
+        single positive-class column becomes the sklearn-shaped
+        ``(b, 2)`` pair."""
+        if probs.shape[1] == 1:
+            return np.stack([1.0 - probs[:, 0], probs[:, 0]], axis=1)
+        return probs
+
+
+class LanePack:
+    """The stacked ``[M, d, k]`` serving state of one pack key's
+    members, rebuilt lazily when membership changes (admit/evict)."""
+
+    __slots__ = ("key", "members", "coefs", "intercepts", "stack_bytes",
+                 "dirty")
+
+    def __init__(self, key):
+        self.key = key
+        self.members: list[ResidentModel] = []
+        self.coefs = self.intercepts = None
+        self.stack_bytes = 0
+        self.dirty = True
+
+    def lanes(self) -> dict:
+        return {rm.name: i for i, rm in enumerate(self.members)}
+
+    def drop_stack(self) -> int:
+        freed, self.stack_bytes = self.stack_bytes, 0
+        self.coefs = self.intercepts = None
+        self.dirty = True
+        return freed
+
+
+class ModelRegistry:
+    """Name → :class:`ResidentModel` with LRU eviction under the HBM
+    budget and per-pack lane stacks.  See the module docstring for the
+    threading contract (mutations on the serve loop only)."""
+
+    def __init__(self, *, budget_bytes: int, policy, max_batch: int):
+        self._by_name: dict[str, ResidentModel] = {}
+        self._packs: dict[tuple, LanePack] = {}
+        self.budget_bytes = int(budget_bytes)
+        self.policy = policy
+        self.max_batch = int(max_batch)
+        self._clock = 0
+        self._warmed: set = set()
+
+    # -- views -----------------------------------------------------------
+    def names(self) -> list:
+        return sorted(self._by_name)
+
+    def get(self, name: str) -> ResidentModel | None:
+        return self._by_name.get(name)
+
+    def resident_bytes(self) -> int:
+        total = sum(rm.state_bytes for rm in self._by_name.values()
+                    if rm.resident and rm.kind != "generic")
+        total += sum(p.stack_bytes for p in self._packs.values())
+        total += sum(rm.state_bytes for rm in self._by_name.values()
+                     if rm.kind == "generic")
+        return total
+
+    def report(self) -> dict:
+        return {
+            "models": {
+                rm.name: {
+                    "kind": rm.kind,
+                    "resident": rm.resident,
+                    "state_bytes": rm.state_bytes,
+                    "pack": rm.pack_key is not None,
+                }
+                for rm in self._by_name.values()
+            },
+            "packs": {
+                " ".join(map(str, key)): [rm.name for rm in p.members]
+                for key, p in self._packs.items()
+            },
+            "resident_bytes": self.resident_bytes(),
+            "budget_bytes": self.budget_bytes,
+        }
+
+    # -- admission (serve thread) ----------------------------------------
+    def admit(self, name: str, model) -> ResidentModel:
+        """Register (or replace) a model, join its lane pack, make room
+        under the budget, and warm its predict programs.  Re-loading a
+        name whose pack stack is live takes the HOT-SWAP path: one
+        donated ``serve.lane_refresh`` writes the new state into the
+        resident stack in place — the online deploy primitive — instead
+        of dropping and re-stacking all M lanes."""
+        import jax.numpy as jnp
+
+        from . import programs as _sprog
+
+        rm = ResidentModel(name, model)
+        old = self._by_name.get(name)
+        pack = self._packs.get(rm.pack_key) if rm.pack_key is not None \
+            else None
+        if (old is not None and pack is not None
+                and old.pack_key == rm.pack_key
+                and not pack.dirty and old in pack.members):
+            lane = pack.members.index(old)
+            pack.members[lane] = rm
+            self._by_name[name] = rm
+            self.touch(rm)
+            self.ensure_resident(rm)
+            pack.coefs, pack.intercepts = _sprog.lane_refresh(
+                pack.coefs, pack.intercepts, rm.coef, rm.intercept,
+                jnp.int32(lane))
+            _registry().counter("serve.lane_refresh").inc()
+            self._warm(rm)
+            self._publish()
+            return rm
+        if old is not None:
+            self._remove_from_pack(old)
+        self._by_name[name] = rm
+        self.touch(rm)
+        if rm.pack_key is not None:
+            pack = self._packs.setdefault(rm.pack_key, LanePack(rm.pack_key))
+            pack.members.append(rm)
+            pack.drop_stack()
+        self._make_room(exclude=rm)
+        self._warm(rm)
+        if rm.pack_key is not None and \
+                len(self._packs[rm.pack_key].members) >= 2:
+            # build the lane stack (and warm its vmapped program) NOW,
+            # on the admitting serve thread: load time is the warmup
+            # phase — a lazy first-dispatch build would compile in the
+            # steady phase, exactly what the sanitizer test forbids.
+            # Singleton packs skip it (single-model dispatch never
+            # touches the stack; the stack builds when a sibling loads)
+            self.ensure_pack(self._packs[rm.pack_key])
+        self._publish()
+        return rm
+
+    def evict(self, name: str) -> bool:
+        """Drop a model from the registry entirely."""
+        rm = self._by_name.pop(name, None)
+        if rm is None:
+            return False
+        self._remove_from_pack(rm)
+        rm.park()
+        self._publish()
+        return True
+
+    def touch(self, rm: ResidentModel) -> None:
+        self._clock += 1
+        rm.last_used = self._clock
+
+    def _remove_from_pack(self, rm: ResidentModel) -> None:
+        pack = self._packs.get(rm.pack_key)
+        if pack is None:
+            return
+        pack.members = [m for m in pack.members if m is not rm]
+        pack.drop_stack()
+        if not pack.members:
+            del self._packs[rm.pack_key]
+
+    def _make_room(self, exclude=()) -> None:
+        """LRU-park sgd models (dropping their pack stacks) until the
+        resident total fits the budget.  ``exclude`` (a ResidentModel or
+        an iterable of them) protects the working set being served RIGHT
+        NOW: a working set larger than the budget parks everyone else
+        and runs anyway — the budget bounds RETAINED state, it cannot
+        shrink a live batch."""
+        if isinstance(exclude, ResidentModel):
+            exclude = (exclude,)
+        keep = {id(rm) for rm in exclude}
+        candidates = sorted(
+            (rm for rm in self._by_name.values()
+             if id(rm) not in keep and rm.resident
+             and rm.kind != "generic"),
+            key=lambda rm: rm.last_used)
+        for rm in candidates:
+            if self.resident_bytes() <= self.budget_bytes:
+                return
+            pack = self._packs.get(rm.pack_key)
+            if pack is not None:
+                pack.drop_stack()
+            rm.park()
+            _registry().counter("serve.evictions").inc()
+            logger.info("serve residency: parked %r (LRU, budget %d MiB)",
+                        rm.name, self.budget_bytes >> 20)
+
+    # -- residence (serve thread) ----------------------------------------
+    def ensure_resident(self, rm: ResidentModel) -> None:
+        if rm.kind != "generic" and rm.coef is None:
+            rm.unpark()
+            self._make_room(exclude=rm)
+
+    def ensure_pack(self, pack: LanePack):
+        """The pack's stacked state, rebuilding if membership changed.
+        Members must be resident first (the stack reads their device
+        refs)."""
+        import jax.numpy as jnp
+
+        if pack.dirty:
+            for rm in pack.members:
+                self.ensure_resident(rm)
+            pack.coefs = jnp.stack([rm.coef for rm in pack.members])
+            pack.intercepts = jnp.stack(
+                [rm.intercept for rm in pack.members])
+            pack.stack_bytes = _leaf_nbytes((pack.coefs, pack.intercepts))
+            pack.dirty = False
+            self._warm_pack(pack)
+            self._make_room(exclude=pack.members)
+            self._publish()
+        return pack.coefs, pack.intercepts
+
+    # -- warmup (serve thread; compiles are load-time work) --------------
+    _WARM_CAP = 16
+
+    def _rungs(self) -> tuple:
+        rungs = self.policy.rungs(self.max_batch)
+        if len(rungs) > self._WARM_CAP:
+            # no silent caps: a pathological ladder would warm dozens of
+            # programs — keep the SMALLEST rungs (the shapes single-row
+            # and small-batch traffic actually pads to; large coalesced
+            # batches are the rare case) and say so loudly, because any
+            # dropped rung's first steady request compiles on the serve
+            # thread, which the armed sanitizer counts as a hard
+            # steady-compile violation.  The default policies never hit
+            # this (auto/1024 = 2 rungs, pow2/1024 = 11).
+            logger.warning(
+                "serve warmup: bucket policy yields %d rungs <= "
+                "max_batch %d; pre-compiling the smallest %d only — a "
+                "request coalescing past rung %d will compile at first "
+                "use (a steady-compile violation under an armed "
+                "sanitizer); lower DASK_ML_TPU_SERVE_MAX_BATCH or use "
+                "a coarser DASK_ML_TPU_BUCKET ladder",
+                len(rungs), self.max_batch, self._WARM_CAP,
+                rungs[self._WARM_CAP - 1])
+            rungs = rungs[:self._WARM_CAP]
+        return rungs
+
+    def _warm(self, rm: ResidentModel) -> None:
+        """Pre-compile (and pre-dispatch once) the single-model predict
+        programs — margins, and the donated proba transform when the
+        loss supports it — for every bucket rung this model can see."""
+        import jax.numpy as jnp
+
+        if rm.kind == "generic":
+            return
+        self.ensure_resident(rm)
+        d, k = rm.n_features, int(rm.coef.shape[1])
+        sig = ("single", d, k, rm.proba_loss)
+        if sig in self._warmed:
+            return
+        self._warmed.add(sig)
+        for b in self._rungs():
+            xb = jnp.zeros((b, d), jnp.float32)
+            m = _sprog.margins(rm.coef, rm.intercept, xb)
+            if rm.proba_loss is not None:
+                _sprog.proba(m, loss=rm.proba_loss)  # donates m: fine,
+                # the warm margins buffer is throwaway by construction
+
+    def _warm_pack(self, pack: LanePack) -> None:
+        import jax.numpy as jnp
+
+        m, d, k = pack.coefs.shape
+        sig = ("pack", m, d, k)
+        if sig in self._warmed:
+            return
+        self._warmed.add(sig)
+        for b in self._rungs():
+            xs = jnp.zeros((m, b, d), jnp.float32)
+            _sprog.lane_margins(pack.coefs, pack.intercepts, xs)
+        # the hot-swap program too: a re-load under traffic must hit a
+        # warm lane_refresh (zeros stand in for the donated stacks)
+        _sprog.lane_refresh(
+            jnp.zeros((m, d, k), jnp.float32),
+            jnp.zeros((m, k), jnp.float32),
+            jnp.zeros((d, k), jnp.float32),
+            jnp.zeros((k,), jnp.float32), jnp.int32(0))
+
+    def _publish(self) -> None:
+        reg = _registry()
+        reg.gauge("serve.resident_bytes").set(float(self.resident_bytes()))
+        reg.gauge("serve.resident_models").set(float(len(self._by_name)))

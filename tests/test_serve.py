@@ -1,0 +1,612 @@
+"""The online inference plane (dask_ml_tpu/serve/, design.md §15).
+
+Covers the serving acceptance criteria end to end: micro-batched
+correctness vs direct predict, lane-packed multi-model dispatch,
+admission control (queue_full / deadline / oversize as explicit
+rejections), residency eviction under an HBM budget, supervised
+restart with in-flight replay, the zero-steady-compile contract under
+an armed graftsan scope, donation through the serve predict programs
+(surviving a bucket-size change), and the /metrics export of request
+latency quantiles.
+"""
+
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dask_ml_tpu import diagnostics, obs
+from dask_ml_tpu.linear_model import SGDClassifier, SGDRegressor
+from dask_ml_tpu.resilience import supervisor as _supervisor
+from dask_ml_tpu.resilience.elastic import FaultBudget
+from dask_ml_tpu.resilience.testing import (
+    FaultPlan,
+    ThreadCrash,
+    fault_plan,
+)
+from dask_ml_tpu.serve import (
+    ModelServer,
+    RequestRejected,
+    SERVE_THREAD_NAME,
+    serve_pack_key,
+)
+from dask_ml_tpu.serve import programs as sprog
+
+
+def _fitted_clf(seed=0, d=8, n=512, classes=2, **kw):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    if classes == 2:
+        y = (X[:, 0] > 0).astype(np.int32)
+    else:
+        y = (np.argmax(X[:, :classes], axis=1)).astype(np.int32)
+    clf = SGDClassifier(random_state=seed, **kw)
+    clf.partial_fit(X, y, classes=np.arange(classes))
+    return clf, X
+
+
+def _fitted_reg(seed=0, d=6, n=256):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = X @ rng.normal(size=d).astype(np.float32)
+    reg = SGDRegressor(random_state=seed)
+    reg.partial_fit(X, y)
+    return reg, X
+
+
+class TestBasicServing:
+    def test_classifier_matches_direct_predict(self):
+        clf, X = _fitted_clf()
+        with ModelServer(label="t_basic", window_s=0.0) as srv:
+            assert srv.load("m", clf) is True
+            for rows in (1, 3, 16):
+                got = srv.predict("m", X[:rows])
+                np.testing.assert_array_equal(
+                    got, np.asarray(clf.predict(X[:rows])))
+
+    def test_single_row_1d_input(self):
+        clf, X = _fitted_clf()
+        with ModelServer(label="t_1d", window_s=0.0) as srv:
+            srv.load("m", clf)
+            got = srv.predict("m", X[0])
+            assert got.shape == (1,)
+            np.testing.assert_array_equal(
+                got, np.asarray(clf.predict(X[:1])))
+
+    def test_multiclass_and_regressor(self):
+        clf, Xc = _fitted_clf(classes=3, d=5)
+        reg, Xr = _fitted_reg()
+        with ModelServer(label="t_multi", window_s=0.0) as srv:
+            srv.load("c", clf)
+            srv.load("r", reg)
+            np.testing.assert_array_equal(
+                srv.predict("c", Xc[:9]), np.asarray(clf.predict(Xc[:9])))
+            np.testing.assert_allclose(
+                srv.predict("r", Xr[:9]), np.asarray(reg.predict(Xr[:9])),
+                rtol=1e-6)
+
+    def test_generic_estimator_serves(self):
+        from dask_ml_tpu.cluster import MiniBatchKMeans
+
+        rng = np.random.RandomState(3)
+        X = rng.normal(size=(256, 4)).astype(np.float32)
+        mbk = MiniBatchKMeans(n_clusters=3, random_state=0)
+        mbk.partial_fit(X)
+        with ModelServer(label="t_generic", window_s=0.0) as srv:
+            srv.load("k", mbk)
+            got = srv.predict("k", X[:7])
+            np.testing.assert_array_equal(
+                got, np.asarray(mbk.predict(X[:7])))
+
+    def test_unknown_model_and_unload(self):
+        clf, X = _fitted_clf()
+        with ModelServer(label="t_unknown", window_s=0.0) as srv:
+            with pytest.raises(RequestRejected) as ei:
+                srv.submit("nope", X[:1])
+            assert ei.value.reason == "unknown_model"
+            srv.load("m", clf)
+            assert srv.predict("m", X[:1]).shape == (1,)
+            assert srv.unload("m") is True
+            with pytest.raises(RequestRejected):
+                srv.submit("m", X[:1])
+
+    def test_oversize_and_bad_input_reject(self):
+        clf, X = _fitted_clf()
+        with ModelServer(label="t_oversize", window_s=0.0,
+                         max_batch=32) as srv:
+            srv.load("m", clf)
+            with pytest.raises(RequestRejected) as ei:
+                srv.submit("m", np.zeros((33, 8), np.float32))
+            assert ei.value.reason == "oversize"
+            with pytest.raises(RequestRejected) as ei:
+                srv.submit("m", np.zeros((4, 5), np.float32))
+            assert ei.value.reason == "bad_input"
+
+    def test_empty_request_resolves_immediately(self):
+        clf, X = _fitted_clf()
+        with ModelServer(label="t_empty", window_s=0.0) as srv:
+            srv.load("m", clf)
+            out = srv.submit("m", np.zeros((0, 8), np.float32)).result(1)
+            assert out.shape == (0,)
+
+    def test_closed_server_rejects(self):
+        clf, X = _fitted_clf()
+        srv = ModelServer(label="t_closed", window_s=0.0)
+        srv.load("m", clf)
+        srv.close()
+        with pytest.raises(RequestRejected) as ei:
+            srv.submit("m", X[:1])
+        assert ei.value.reason == "shutdown"
+
+
+class TestMicroBatching:
+    def test_concurrent_requests_coalesce(self):
+        """Acceptance criterion: batch occupancy > 1 row/dispatch under
+        load — submits landing inside one gather window dispatch as ONE
+        program."""
+        clf, X = _fitted_clf()
+        reg = obs.registry()
+        reg.reset(prefix="serve.batch_requests")
+        with ModelServer(label="t_coalesce", window_s=0.1) as srv:
+            srv.load("m", clf)
+            srv.predict("m", X[:1])  # warm the request path
+            reg.reset(prefix="serve.batch_requests")
+            reg.reset(prefix="serve.batch_rows")
+            futs = [srv.submit("m", X[i:i + 1]) for i in range(8)]
+            outs = [f.result(10) for f in futs]
+            for i, o in enumerate(outs):
+                np.testing.assert_array_equal(
+                    o, np.asarray(clf.predict(X[i:i + 1])))
+        snap = reg.histogram("serve.batch_requests").snapshot()
+        assert snap["count"] >= 1
+        # 8 requests in << window: strictly fewer dispatches than
+        # requests, i.e. occupancy above one request per dispatch
+        assert snap["count"] < 8, snap
+        rows = reg.histogram("serve.batch_rows").snapshot()
+        assert rows["sum"] / rows["count"] > 1.0
+
+    def test_row_ceiling_splits_batches(self):
+        clf, X = _fitted_clf()
+        with ModelServer(label="t_ceiling", window_s=0.1,
+                         max_batch=8) as srv:
+            srv.load("m", clf)
+            futs = [srv.submit("m", X[i * 4:(i + 1) * 4])
+                    for i in range(4)]  # 16 rows > max_batch 8
+            for i, f in enumerate(futs):
+                np.testing.assert_array_equal(
+                    f.result(10),
+                    np.asarray(clf.predict(X[i * 4:(i + 1) * 4])))
+
+
+class TestLanePacking:
+    def test_pack_key_is_shape_based(self):
+        clf1, _ = _fitted_clf(seed=0)
+        clf2, _ = _fitted_clf(seed=1, penalty="l1")  # different config
+        reg, _ = _fitted_reg()
+        assert serve_pack_key(clf1) == serve_pack_key(clf2)
+        assert serve_pack_key(clf1) != serve_pack_key(reg)
+        assert serve_pack_key(object()) is None
+
+    def test_homogeneous_models_lane_dispatch(self):
+        clf1, X = _fitted_clf(seed=0)
+        clf2, _ = _fitted_clf(seed=1, penalty="l1")
+        reg = obs.registry()
+        with ModelServer(label="t_lane", window_s=0.1) as srv:
+            srv.load("a", clf1)
+            srv.load("b", clf2)
+            srv.predict("a", X[:1])  # warm the request path
+            before = reg.counter("serve.lane_dispatches").value
+            fa = srv.submit("a", X[:8])
+            fb = srv.submit("b", X[:8])
+            np.testing.assert_array_equal(
+                fa.result(10), np.asarray(clf1.predict(X[:8])))
+            np.testing.assert_array_equal(
+                fb.result(10), np.asarray(clf2.predict(X[:8])))
+            assert reg.counter("serve.lane_dispatches").value > before
+
+
+class TestAdmissionControl:
+    def test_queue_full_sheds_load_explicitly(self):
+        clf, X = _fitted_clf()
+        reg = obs.registry()
+        with ModelServer(label="t_queue", window_s=0.0,
+                         queue_depth=2) as srv:
+            srv.load("m", clf)
+            srv.predict("m", X[:1])
+            srv._test_dispatch_delay_s = 0.3  # wedge the loop briefly
+            first = srv.submit("m", X[:1])  # drained, then slow
+            time.sleep(0.05)
+            held = []
+            rejected = 0
+            for _ in range(8):
+                try:
+                    held.append(srv.submit("m", X[:1]))
+                except RequestRejected as e:
+                    assert e.reason == "queue_full"
+                    rejected += 1
+            assert rejected >= 1
+            assert reg.family("serve.rejected").get("queue_full", 0) >= 1
+            srv._test_dispatch_delay_s = 0.0
+            first.result(10)
+            for f in held:
+                f.result(10)
+
+    def test_deadline_drops_stale_work_before_dispatch(self):
+        clf, X = _fitted_clf()
+        with ModelServer(label="t_deadline", window_s=0.0) as srv:
+            srv.load("m", clf)
+            srv.predict("m", X[:1])
+            srv._test_dispatch_delay_s = 0.25
+            blocker = srv.submit("m", X[:1])
+            time.sleep(0.05)
+            stale = srv.submit("m", X[:1], deadline_s=0.01)
+            with pytest.raises(RequestRejected) as ei:
+                stale.result(10)
+            assert ei.value.reason == "deadline"
+            srv._test_dispatch_delay_s = 0.0
+            blocker.result(10)
+
+
+class TestResidency:
+    def test_lru_eviction_under_hbm_budget(self):
+        # three ~16KB states (distinct widths, so no lane pack shares
+        # them) against a ~31KB budget: the LRU models park
+        fitted = {}
+        for i, d in enumerate((4096, 4097, 4098)):
+            clf, X = _fitted_clf(seed=i, d=d, n=64)
+            fitted[f"m{i}"] = (clf, X)
+        reg = obs.registry()
+        before = reg.counter("serve.evictions").value
+        with ModelServer(label="t_evict", window_s=0.0,
+                         hbm_budget_mb=0.03) as srv:
+            for name, (clf, _) in fitted.items():
+                srv.load(name, clf)
+            rep = srv.report()["residency"]
+            assert rep["resident_bytes"] <= rep["budget_bytes"], rep
+            assert reg.counter("serve.evictions").value > before
+            parked = [n for n, info in rep["models"].items()
+                      if not info["resident"]]
+            assert parked, rep
+            # a parked model still serves (one residency fault, then
+            # resident again)
+            name = parked[0]
+            clf, X = fitted[name]
+            got = srv.predict(name, X[:4])
+            np.testing.assert_array_equal(
+                got, np.asarray(clf.predict(X[:4])))
+            assert reg.family("serve.residency_fault").get(name, 0) >= 1
+
+
+class TestSupervisedRestart:
+    def test_crash_restart_replays_inflight(self):
+        clf, X = _fitted_clf()
+        plan = FaultPlan().inject(
+            "serve-loop", at_call=2, times=1,
+            exc=ThreadCrash("test: serve loop death"))
+        with ModelServer(label="t_crash", window_s=0.0,
+                         budget=FaultBudget(4, 60.0,
+                                            name="t_crash")) as srv:
+            unit = srv._unit
+            srv.load("m", clf)
+            with fault_plan(plan):
+                srv.predict("m", X[:2])  # batch 1: healthy
+                fut = srv.submit("m", X[:4])  # batch 2: crash in hand
+                for _ in range(500):
+                    if not srv._thread.is_alive():
+                        break
+                    time.sleep(0.01)
+                assert not srv._thread.is_alive()
+                assert unit in _supervisor.healthz()["dead"]
+                # the parked future wait triggers restart + exact replay
+                got = fut.result(30)
+                np.testing.assert_array_equal(
+                    got, np.asarray(clf.predict(X[:4])))
+                assert unit not in _supervisor.healthz()["dead"]
+                assert srv.report()["budget"]["spent"] >= 1
+            # post-restart traffic flows
+            srv.predict("m", X[:1])
+
+    def test_budget_exhaustion_rejects_loudly(self):
+        clf, X = _fitted_clf()
+        plan = FaultPlan().persistent(
+            "serve-loop", exc=ThreadCrash("test: repeated death"))
+        with ModelServer(label="t_budget", window_s=0.0,
+                         budget=FaultBudget(0, 60.0,
+                                            name="t_budget")) as srv:
+            srv.load("m", clf)
+            with fault_plan(plan):
+                fut = srv.submit("m", X[:1])
+                with pytest.raises(RequestRejected) as ei:
+                    fut.result(30)
+                assert ei.value.reason == "serve_down"
+                with pytest.raises(RequestRejected):
+                    srv.submit("m", X[:1])
+
+
+class TestDonation:
+    def test_proba_transform_donates_the_margins(self):
+        """The device probability transform consumes its margins buffer
+        in place (same-shaped output → the donation actually aliases);
+        the batch buffer is deliberately NOT donated — the gemm has no
+        same-shaped output, so that donation would be a no-op (design.md
+        §8's reasoning, applied to serving)."""
+        clf, _ = _fitted_clf(d=8)
+        coef, inter = clf._state["coef"], clf._state["intercept"]
+        xb = jnp.zeros((256, 8), jnp.float32)
+        m = sprog.margins(coef, inter, xb)
+        assert not xb.is_deleted()  # documented non-donation
+        p = sprog.proba(m, loss="log_loss")
+        assert p.shape == m.shape == (256, 1)
+        assert m.is_deleted(), "margins buffer must be consumed in place"
+
+    def test_donation_survives_a_bucket_size_change(self):
+        """Regression: every per-signature AOT executable the cache
+        mints — including the fresh one when a coalesced batch crosses
+        a bucket rung — carries the donation."""
+        clf, _ = _fitted_clf(d=8)
+        coef, inter = clf._state["coef"], clf._state["intercept"]
+        for rung in (256, 1024, 256, 4096):
+            m = sprog.margins(coef, inter,
+                              jnp.zeros((rung, 8), jnp.float32))
+            sprog.proba(m, loss="log_loss")
+            assert m.is_deleted(), f"rung {rung} lost donation"
+
+    def test_lane_refresh_updates_the_stack_in_place(self):
+        """The hot-swap program donates BOTH resident stacks: the new
+        lane state lands in the same HBM buffers, at every pack size."""
+        for M in (2, 3):
+            coefs = jnp.zeros((M, 8, 1), jnp.float32)
+            inters = jnp.zeros((M, 1), jnp.float32)
+            nc, ni = sprog.lane_refresh(
+                coefs, inters, jnp.ones((8, 1), jnp.float32),
+                jnp.full((1,), 2.0, jnp.float32), jnp.int32(1))
+            assert coefs.is_deleted() and inters.is_deleted()
+            assert float(nc[1, 0, 0]) == 1.0
+            assert float(ni[1, 0]) == 2.0
+            assert float(nc[0, 0, 0]) == 0.0
+
+    def test_bucket_crossing_requests_stay_correct(self):
+        clf, X = _fitted_clf(d=8, n=2048)
+        with ModelServer(label="t_cross", window_s=0.0) as srv:
+            srv.load("m", clf)
+            for rows in (10, 600, 10):  # 256-rung -> 1024-rung -> back
+                np.testing.assert_array_equal(
+                    srv.predict("m", X[:rows]),
+                    np.asarray(clf.predict(X[:rows])))
+
+
+class TestProbaServing:
+    def test_predict_proba_matches_direct(self):
+        clf, X = _fitted_clf()  # log_loss default
+        with ModelServer(label="t_proba", window_s=0.0) as srv:
+            srv.load("m", clf)
+            got = srv.predict_proba("m", X[:12])
+            np.testing.assert_allclose(
+                got, np.asarray(clf.predict_proba(X[:12])), rtol=1e-6)
+
+    def test_mixed_label_and_proba_requests_share_one_margins(self):
+        clf, X = _fitted_clf()
+        with ModelServer(label="t_mixed", window_s=0.1) as srv:
+            srv.load("m", clf)
+            srv.predict("m", X[:1])
+            fa = srv.submit("m", X[:4])
+            fb = srv.submit("m", X[4:8], proba=True)
+            np.testing.assert_array_equal(
+                fa.result(10), np.asarray(clf.predict(X[:4])))
+            np.testing.assert_allclose(
+                fb.result(10), np.asarray(clf.predict_proba(X[4:8])),
+                rtol=1e-6)
+
+    def test_proba_rejected_for_unsupported_loss(self):
+        clf, X = _fitted_clf(loss="hinge")
+        reg, Xr = _fitted_reg()
+        with ModelServer(label="t_noproba", window_s=0.0) as srv:
+            srv.load("h", clf)
+            srv.load("r", reg)
+            for name, rows in (("h", X[:2]), ("r", Xr[:2])):
+                with pytest.raises(RequestRejected) as ei:
+                    srv.submit(name, rows, proba=True)
+                assert ei.value.reason == "bad_input"
+
+
+class TestHotSwap:
+    def test_reload_refreshes_the_lane_in_place(self):
+        clf1, X = _fitted_clf(seed=0)
+        clf2, _ = _fitted_clf(seed=1, penalty="l1")
+        clf3, _ = _fitted_clf(seed=2, alpha=1e-2)
+        reg = obs.registry()
+        with ModelServer(label="t_swap", window_s=0.1) as srv:
+            srv.load("a", clf1)
+            srv.load("b", clf2)
+            srv.predict("a", X[:1])
+            before = reg.counter("serve.lane_refresh").value
+            srv.load("a", clf3)  # deploy: same name, live pack stack
+            assert reg.counter("serve.lane_refresh").value == before + 1
+            # lane-packed traffic serves the NEW model from the stack
+            fa = srv.submit("a", X[:8])
+            fb = srv.submit("b", X[:8])
+            np.testing.assert_array_equal(
+                fa.result(10), np.asarray(clf3.predict(X[:8])))
+            np.testing.assert_array_equal(
+                fb.result(10), np.asarray(clf2.predict(X[:8])))
+
+
+class TestLadderRungs:
+    def test_rungs_cover_every_reachable_bucket(self):
+        from dask_ml_tpu.programs import resolve_policy, bucket_rows
+
+        pol = resolve_policy("auto")
+        for max_rows in (1, 100, 1024, 70_000, 300_000):
+            rungs = set(pol.rungs(max_rows))
+            for n in (1, max_rows // 2 or 1, max_rows):
+                assert bucket_rows(n, pol) in rungs, (max_rows, n)
+
+    def test_rungs_off_and_pow2(self):
+        from dask_ml_tpu.programs import resolve_policy
+
+        assert resolve_policy("off").rungs(1000) == ()
+        p2 = resolve_policy("pow2").rungs(1000)
+        assert p2[-1] == 1024 and p2[0] == 1
+
+    def test_knob_strict_parse(self):
+        from dask_ml_tpu.serve import config
+
+        with pytest.raises(ValueError):
+            config.resolve_max_batch(0)
+        with pytest.raises(ValueError):
+            config.resolve_window_s(-1.0)
+        with pytest.raises(ValueError):
+            config.resolve_hbm_budget_bytes(0)
+
+    def test_knob_env_typo_raises(self, monkeypatch):
+        from dask_ml_tpu.serve import config
+
+        monkeypatch.setenv(config.MAX_BATCH_ENV, "lots")
+        with pytest.raises(ValueError, match="SERVE_MAX_BATCH"):
+            config.resolve_max_batch()
+
+
+class TestServeThreadContract:
+    def test_thread_name_single_source(self):
+        from dask_ml_tpu.analysis.rules._spmd import (
+            BLESSED_DISPATCH_THREADS,
+        )
+
+        assert SERVE_THREAD_NAME in BLESSED_DISPATCH_THREADS
+
+    def test_load_is_the_only_compiling_moment(self):
+        """Admission pre-compiles every bucket rung the batcher can
+        produce, so a request stream that walks the whole ladder adds
+        ZERO programs after load (the steady-compile contract's cache
+        half — the sanitizer test pins the runtime half)."""
+        clf, X = _fitted_clf(d=13, n=2048)  # width no other test uses
+        with ModelServer(label="t_warmset", window_s=0.0) as srv:
+            srv.load("m", clf)
+            before = sprog.margins.report()
+            for rows in (1, 200, 300, 1024):
+                srv.predict("m", X[:rows])
+            after = sprog.margins.report()
+            # every dispatch above was a warm hit: no new programs, no
+            # demand misses, no jit fallbacks
+            assert after["programs"] == before["programs"]
+            assert after["misses"] == before["misses"]
+            assert after["fallback"] == before["fallback"]
+
+
+class TestSteadyServeSanitized:
+    def test_steady_traffic_zero_compiles_and_blessed_dispatch(
+            self, sanitizer):
+        """Satellite + acceptance: concurrent clients against two
+        resident models sustain traffic under an ARMED graftsan scope —
+        zero steady compiles, zero violations, every dispatch on the
+        blessed serve thread, occupancy above one row per dispatch."""
+        # a width no other test serves: the loads REALLY compile here,
+        # on the serve thread, under the armed fail-fast sanitizer —
+        # proving load-time warm compiles are legal on that thread
+        clf1, X = _fitted_clf(seed=0, d=11)
+        clf2, _ = _fitted_clf(seed=1, d=11, penalty="l1")
+        reg = obs.registry()
+        with ModelServer(label="t_sanitized", window_s=0.02) as srv:
+            # warmup phase: loads compile + first traffic settles
+            srv.load("a", clf1)
+            srv.load("b", clf2)
+            for _ in range(3):
+                srv.predict("a", X[:1])
+                srv.predict("b", X[:3])
+            reg.reset(prefix="serve.batch_rows")
+            # expected answers computed in WARMUP (direct predict is
+            # eager device work — doing it inside a client thread
+            # during steady would itself be the violation)
+            specs = (("a", clf1, 0), ("a", clf1, 50),
+                     ("b", clf2, 100), ("b", clf2, 150))
+            expected = {
+                (name, lo): [np.asarray(model.predict(
+                    X[lo + i:lo + i + 2])) for i in range(10)]
+                for name, model, lo in specs
+            }
+            with sanitizer.steady():
+                errs = []
+
+                def client(name, model, lo):
+                    try:
+                        for i in range(10):
+                            got = srv.predict(
+                                name, X[lo + i:lo + i + 2], timeout=30)
+                            np.testing.assert_array_equal(
+                                got, expected[(name, lo)][i])
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+
+                threads = [
+                    threading.Thread(target=client, args=args)
+                    for args in specs
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(60)
+                assert not errs, errs
+        rep = sanitizer.report()
+        assert rep["totals"]["steady_compiles"] == 0, rep["violations"]
+        assert rep["violations"] == []
+        assert SERVE_THREAD_NAME in rep["dispatch_threads"]
+        rows = reg.histogram("serve.batch_rows").snapshot()
+        assert rows["sum"] / max(rows["count"], 1) > 1.0, rows
+
+
+class TestObservability:
+    def test_serve_report_shapes(self):
+        clf, X = _fitted_clf()
+        with ModelServer(label="t_report", window_s=0.0) as srv:
+            srv.load("m", clf)
+            srv.predict("m", X[:4])
+            rep = diagnostics.serve_report()
+            labels = [s["label"] for s in rep["servers"]]
+            assert "t_report" in labels
+            assert any(k.startswith("serve.request_s") for k in
+                       rep["metrics"])
+            assert "serve" in diagnostics.run_report()
+
+    def test_request_latency_exported_through_metrics_endpoint(self):
+        """Acceptance: measured p50/p99 request latency is scrapeable
+        from the live /metrics endpoint."""
+        from dask_ml_tpu.obs import serve as obs_serve
+
+        clf, X = _fitted_clf()
+        srv_http = obs_serve.start(0)
+        try:
+            with ModelServer(label="t_scrape", window_s=0.0) as srv:
+                srv.load("m", clf)
+                for i in range(5):
+                    srv.predict("m", X[i:i + 2])
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv_http.port}/metrics",
+                    timeout=5).read().decode()
+            assert "serve_request_s" in body
+            assert 'quantile="0.99"' in body
+            assert "serve_batch_rows" in body
+        finally:
+            obs_serve.stop()
+
+    def test_healthz_reflects_serve_unit(self):
+        clf, X = _fitted_clf()
+        with ModelServer(label="t_hz", window_s=0.0) as srv:
+            srv.load("m", clf)
+            assert _supervisor.verdicts().get(srv._unit) == "healthy"
+        assert srv._unit not in _supervisor.verdicts()
+
+    def test_duplicate_labels_get_distinct_units(self):
+        """Two servers sharing a label must not share a heartbeat — a
+        dead loop hiding behind its twin's live thread would never flip
+        /healthz."""
+        with ModelServer(label="t_dup", window_s=0.0), \
+                ModelServer(label="t_dup", window_s=0.0):
+            units = [u for u in _supervisor.verdicts()
+                     if u.startswith("serve:t_dup")]
+            assert len(units) == 2, units
+        assert not [u for u in _supervisor.verdicts()
+                    if u.startswith("serve:t_dup")]
