@@ -1,0 +1,93 @@
+"""donation-miss: a cached program with no buffer-donation decision.
+
+PR 8 built ``donate_argnames`` plumbing into the central program cache
+and design.md §8/§15 record where donation actually aliases (a
+same-shape/dtype input→output pair lets XLA reuse the input's HBM
+buffer in place) and where it is deliberately absent (the
+gemm-output-smaller class: every output strictly smaller than its
+inputs, nothing to alias).  What the repo had NO check for was the
+third state — a step program that simply never considered donation:
+SGD/MBK/IPCA-style state chains are strictly linear (the caller
+overwrites the operand with the output every call), so a missing
+``donate_argnames`` there silently doubles the resident state per
+dispatch and shows up only as an unexplained HBM bill.
+
+The true predicate ("has a same-shape/dtype input→output pair") is a
+*runtime signature* property a static pass cannot prove — shapes arrive
+per dispatch.  The enforceable static contract is the DECISION itself:
+every ``cached_program(...)`` / ``CachedProgram(...)`` call must either
+wire ``donate_argnames`` or carry an inline justified suppression
+naming why nothing aliases (the suppression text is the audit trail the
+next reader needs anyway, and graftlint's unused-suppression pass keeps
+it honest).  Donation regression *tests* (tests/test_serve.py,
+tests/test_cluster.py) pin the runtime half: donated buffers really
+delete, deliberately-undonated buffers really survive.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule, dotted_name, register
+
+#: the cache's two construction forms
+_FACTORIES = frozenset({"cached_program", "CachedProgram"})
+
+
+def _is_cache_call(ctx: Context, node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    if not name or name.rsplit(".", 1)[-1] not in _FACTORIES:
+        return False
+    if ctx.project is not None:
+        name = ctx.project.module_for(ctx).expand_alias(name)
+        # resolved through the import table: only the real factory
+        # counts (a foreign helper that happens to share the name
+        # never matches)
+        return name.endswith("programs.cached_program") or \
+            name.endswith("programs.cache.cached_program") or \
+            name.endswith("programs.cache.CachedProgram") or \
+            name.endswith("programs.CachedProgram")
+    return True
+
+
+@register
+class DonationMissRule(Rule):
+    id = "donation-miss"
+    summary = (
+        "cached_program with no donate_argnames and no justified "
+        "suppression: a step program whose state chain may be paying "
+        "double HBM residency for want of a donation decision"
+    )
+
+    def run(self, ctx: Context):
+        path = ctx.path.replace("\\", "/")
+        if "/programs/" in path or path.startswith("programs/"):
+            return  # the factory's own definition/docstring idioms
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or \
+                    not _is_cache_call(ctx, node):
+                continue
+            donates = None
+            for kw in node.keywords:
+                if kw.arg == "donate_argnames":
+                    donates = kw.value
+            if donates is not None:
+                # an explicit empty tuple is still "no donation" — the
+                # decision belongs in a suppression comment, where the
+                # justification is reviewable, not in a silent ()
+                if isinstance(donates, (ast.Tuple, ast.List)) \
+                        and not donates.elts:
+                    donates = None
+            if donates is not None:
+                continue
+            yield ctx.finding(
+                self.id, node,
+                "cached_program() without donate_argnames: if the "
+                "program's signature has a same-shape/dtype "
+                "input→output pair (a linear state chain), donation "
+                "aliases the update in place in HBM — wire "
+                "donate_argnames and add an aliasing regression test; "
+                "if every output is smaller than its inputs (the "
+                "gemm-output-smaller class, design.md §8/§15), record "
+                "that as the suppression justification",
+            )

@@ -85,8 +85,7 @@ def _kmeans_mode() -> str:
     return v
 
 
-@_fpartial(jax.jit, static_argnames=("mode", "scatter"))
-def _lloyd_step(x, mask, centers, mode="highest", scatter="segsum"):
+def _lloyd_step_fn(x, mask, centers, *, mode="highest", scatter="segsum"):
     """One Lloyd round: assign, reduce per-cluster sums/counts, update.
 
     Returns (new_centers, inertia, shift).  Everything is gemm-shaped; with
@@ -128,6 +127,23 @@ def _lloyd_step(x, mask, centers, mode="highest", scatter="segsum"):
     return new_centers, inertia, shift
 
 
+# The Lloyd hot programs route through the central program cache
+# (design.md §12): compile books + compile-ahead for the step, and —
+# now that the cache captures XLA cost_analysis per signature — the
+# roofline attribution that turned "Lloyd at 2% of bandwidth" from a
+# bench hand-estimate into device_report()'s measured per-program
+# fraction.  ``centers`` is donated in both: the (k, d) output centers
+# alias the dead input buffer in HBM.  ``x``/``mask`` are deliberately
+# NOT donated — fit reuses them across segments (and _assign reads x
+# after the loop), so that donation would delete live buffers.
+from .. import programs as _programs  # noqa: E402
+
+_lloyd_step = _programs.cached_program(
+    _lloyd_step_fn, name="kmeans.lloyd_step",
+    static_argnames=("mode", "scatter"), donate_argnames=("centers",),
+)
+
+
 # A fused Pallas Lloyd kernel (ops/lloyd.py) lived here through rounds
 # 2-5 and was DELETED after its win-or-delete chip adjudication: on a
 # TPU v5e the XLA lowering of ``_lloyd_step`` beat every kernel variant
@@ -139,9 +155,8 @@ def _lloyd_step(x, mask, centers, mode="highest", scatter="segsum"):
 # one git revert away.
 
 
-@_fpartial(jax.jit, static_argnames=("mode", "scatter"))
-def _lloyd_loop(x, mask, centers, tol, max_iter, *,
-                mode="highest", scatter="segsum"):
+def _lloyd_loop_fn(x, mask, centers, tol, max_iter, *,
+                   mode="highest", scatter="segsum"):
     """The ENTIRE Lloyd iteration as one XLA program.
 
     The reference re-enters the scheduler every round (SURVEY.md §3.2); a
@@ -157,7 +172,11 @@ def _lloyd_loop(x, mask, centers, tol, max_iter, *,
     """
 
     def step(x_, m_, c_):
-        return _lloyd_step(x_, m_, c_, mode, scatter)
+        # tracer operands: the cached step bypasses to its jitted twin,
+        # which inlines here (its donation is ignored under the outer
+        # trace — the loop program's own centers donation is the one
+        # that aliases)
+        return _lloyd_step(x_, m_, c_, mode=mode, scatter=scatter)
 
     def cond(state):
         i, _, _, shift = state
@@ -178,12 +197,30 @@ def _lloyd_loop(x, mask, centers, tol, max_iter, *,
     return centers, inertia, i, shift
 
 
-@jax.jit
-def _assign(x, mask, centers):
+# Roofline honesty note (design.md §16): cost_analysis counts this
+# fused while program's body ONCE — the trip count is data-dependent —
+# so the loop's attributed flops/bytes (hence roofline_frac) are a
+# floor over the whole dispatch, not a per-round measurement.  The
+# per-round number lives in bench.py's lloyd section, which pins the
+# round count.
+_lloyd_loop = _programs.cached_program(
+    _lloyd_loop_fn, name="kmeans.lloyd_loop",
+    static_argnames=("mode", "scatter"), donate_argnames=("centers",),
+)
+
+
+def _assign_fn(x, mask, centers):
     d2 = _sq_dists(x, centers)
     labels = jnp.argmin(d2, axis=1)
     min_d2 = jnp.min(d2, axis=1)  # same element as d2[argmin], fused lowering
     return labels, jnp.sum(min_d2 * mask)
+
+
+# no donation: the outputs ((n,) int labels + a scalar) are smaller
+# than every input and x/centers stay live in the caller — the
+# gemm-output-smaller class design.md §8 records
+# graftlint: disable=donation-miss -- outputs (labels + scalar) smaller than every input; x/centers stay live in fit/predict
+_assign = _programs.cached_program(_assign_fn, name="kmeans.assign")
 
 
 def _valid_d2(x, centers, cvalid):
@@ -328,7 +365,11 @@ class KMeans(TransformerMixin, TPUEstimator):
     def _init_centers(self, X: ShardedRows, key):
         init = self.init
         if isinstance(init, (np.ndarray, jnp.ndarray)):
-            centers = jnp.asarray(init, dtype=X.data.dtype)
+            # a COPY, never a view of the user's array: the Lloyd loop
+            # donates its centers operand, and jnp.asarray of an
+            # already-right-dtype device array would alias the user's
+            # buffer into the donation
+            centers = jnp.array(init, dtype=X.data.dtype)
             if centers.shape != (self.n_clusters, X.data.shape[1]):
                 raise ValueError(
                     f"init array must be ({self.n_clusters}, {X.data.shape[1]}), "
@@ -408,7 +449,9 @@ class KMeans(TransformerMixin, TPUEstimator):
             # deterministic) init, and the Lloyd budget continues from the
             # recorded iteration count
             it0, state = snap
-            centers = jnp.asarray(state["centers"], dtype=X.data.dtype)
+            # copy: the loop donates centers; the snapshot's array must
+            # stay valid for a retried resume
+            centers = jnp.array(state["centers"], dtype=X.data.dtype)
         else:
             centers = self._init_centers(X, key)
 

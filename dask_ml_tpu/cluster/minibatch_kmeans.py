@@ -108,14 +108,21 @@ def _mbk_step_fn(centers, counts, xb, mask):
 # the fused epoch program is unchanged.
 from .. import programs as _programs  # noqa: E402
 
-_mbk_step = _programs.cached_program(_mbk_step_fn, name="minibatch_kmeans.step")
+# centers and the Kahan mass pair are donated: partial_fit's state
+# chain is strictly linear (the attrs are overwritten by the outputs on
+# every call), so the (k,d)/(2,k) updates alias in place in HBM instead
+# of doubling the resident state per step.  xb/mask are NOT donated —
+# the gemm outputs are smaller and fit's epoch windows re-slice x.
+# Inside `_mbk_epoch`'s scan body the tracer operands bypass to the
+# jitted twin (inlined; its donation is ignored under the outer trace).
+_mbk_step = _programs.cached_program(
+    _mbk_step_fn, name="minibatch_kmeans.step",
+    donate_argnames=("centers", "counts"),
+)
 
 
-from functools import partial as _fpartial  # noqa: E402
-
-
-@_fpartial(jax.jit, static_argnames=("batch_size", "n_batches"))
-def _mbk_epoch(centers, counts, x, mask, start, *, batch_size, n_batches):
+def _mbk_epoch_fn(centers, counts, x, mask, start, *, batch_size,
+                  n_batches):
     """One epoch = lax.scan over contiguous batch windows (one dispatch).
 
     ``start`` (traced) rotates the window origin per epoch so successive
@@ -137,6 +144,17 @@ def _mbk_epoch(centers, counts, x, mask, start, *, batch_size, n_batches):
         body, (centers, counts), jnp.arange(n_batches)
     )
     return centers, counts, jnp.mean(inertias)
+
+
+# the whole-array fit's hot loop, through the cache like the streamed
+# step — with the same linear state chain, so centers/counts donate
+# (fit reassigns both from the outputs every epoch); x/mask persist
+# across epochs and must not
+_mbk_epoch = _programs.cached_program(
+    _mbk_epoch_fn, name="minibatch_kmeans.epoch",
+    static_argnames=("batch_size", "n_batches"),
+    donate_argnames=("centers", "counts"),
+)
 
 
 @jax.jit
@@ -208,7 +226,10 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
         minibatch).  k-means++ runs on a small host-pulled sample — an
         O(k) fetch, never O(n)."""
         if isinstance(self.init, (np.ndarray, jnp.ndarray)):
-            c = jnp.asarray(self.init, dtype=X.data.dtype)
+            # a COPY, never a view: the step/epoch programs donate
+            # centers — asarray of a right-dtype device array would
+            # alias the user's init buffer into the donation
+            c = jnp.array(self.init, dtype=X.data.dtype)
             if c.shape != (self.n_clusters, X.data.shape[1]):
                 raise ValueError(
                     f"init array must be ({self.n_clusters}, "
@@ -387,9 +408,11 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
             # resume: install the snapshot BEFORE _ensure_state so the
             # (discarded-anyway) k-means++ init is skipped entirely
             epoch0, state = snap
-            self.cluster_centers_ = jnp.asarray(state["centers"],
-                                                dtype=X.data.dtype)
-            self._counts = jnp.asarray(state["counts"], dtype=jnp.float32)
+            # copies: the epoch program donates centers/counts; the
+            # snapshot's arrays must stay valid for a retried resume
+            self.cluster_centers_ = jnp.array(state["centers"],
+                                              dtype=X.data.dtype)
+            self._counts = jnp.array(state["counts"], dtype=jnp.float32)
             best, bad = float(state["best"]), int(state["bad"])
             self.n_features_in_ = X.data.shape[1]
         self._ensure_state(X)
@@ -440,6 +463,12 @@ class MiniBatchKMeans(TransformerMixin, TPUEstimator):
                 else:
                     bad = 0
             best = min(best, cur)
+            # keep the public attrs pointing at LIVE buffers at every
+            # boundary: the epoch program DONATED the previous ones, and
+            # a mid-loop exit (TrainingPreempted at check_preemption
+            # below, a chaos fault) must leave a readable estimator,
+            # not deleted arrays
+            self.cluster_centers_, self._counts = centers, counts
             state = {"centers": centers, "counts": counts,
                      "best": best, "bad": bad}
             if ckpt is not None and not stop and ckpt.due(epoch + 1):

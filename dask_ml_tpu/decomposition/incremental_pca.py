@@ -88,8 +88,16 @@ def _update_fn(components, singular_values, mean, var, n_seen, batch, *, k):
 # overlap applies here.
 from .. import programs as _programs  # noqa: E402
 
+# The whole state chain is donated: partial_fit overwrites every one of
+# the five state operands with the program's outputs (components (k,d)
+# → vt[:k], singular values (k,), mean/var (d,), the int32 count), so
+# the rank-update happens in place in HBM instead of holding two copies
+# of the model state per block.  ``batch`` is NOT donated — its (n, d)
+# buffer has no same-shaped output (n > k on every legal call).
 _update = _programs.cached_program(
     _update_fn, name="ipca.update", static_argnames=("k",),
+    donate_argnames=("components", "singular_values", "mean", "var",
+                     "n_seen"),
 )
 
 
@@ -134,8 +142,11 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
     @n_samples_seen_.setter
     def n_samples_seen_(self, value):
         # accepts ints (init, legacy checkpoints) and device scalars;
-        # int32 keeps the count exact (an f32 carry saturates at 2^24)
-        self._n_seen_ = jnp.asarray(value, dtype=jnp.int32)
+        # int32 keeps the count exact (an f32 carry saturates at 2^24).
+        # jnp.array (a copy): _update donates n_seen, and asarray of an
+        # already-int32 device scalar would alias the caller's array
+        # into the donation
+        self._n_seen_ = jnp.array(value, dtype=jnp.int32)
 
     # -- staged streaming protocol (pipeline.stream_partial_fit) -----------
     def _pf_stage(self, X, y=None, check_input=True, **kwargs):
@@ -228,9 +239,11 @@ class IncrementalPCA(ComponentsOutMixin, TransformerMixin, TPUEstimator):
             )
         if getattr(self, "_anchor_", None) is None:
             # state restored from a pre-anchor checkpoint: continue at
-            # raw scale (anchor 0) so the shifted state is well-defined
+            # raw scale (anchor 0) so the shifted state is well-defined.
+            # jnp.array (a copy): _update donates mean — asarray of an
+            # already-device mean_ would alias it into the donation
             self._anchor_ = jnp.zeros((d,), dtype=x.dtype)
-            self._mean_sh_ = jnp.asarray(self.mean_)
+            self._mean_sh_ = jnp.array(self.mean_)
         # ONE program, all-device operands (the running count included),
         # derived reporting attrs computed in-program: the steady-state
         # streaming step crosses the host boundary zero times, verified

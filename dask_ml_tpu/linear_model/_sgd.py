@@ -254,6 +254,7 @@ def _eval_loss_fn(state, xb, yb, mask, hyper, *, loss):
     return jnp.sum(ell * m) / safe_denominator(jnp.sum(mask))
 
 
+# graftlint: disable=donation-miss -- output is one scalar; state/xb/yb stay live in the caller (the epoch step reads state right after)
 _eval_loss = _programs.cached_program(
     _eval_loss_fn, name="sgd.eval_loss", static_argnames=("loss",),
 )

@@ -36,6 +36,7 @@ def _class_moments_fn(x, mask, onehot):
 # streamed per-block moments through the central program cache
 # (design.md §12): GaussianNB rides Incremental/partial_fit streams, so
 # its step program gets the hit/miss books like the SGD family's
+# graftlint: disable=donation-miss -- no same-shape pair: the (k,·) block moments are strictly smaller than the (n,·) operands, and the Chan merge consumes them on host-free device state elsewhere
 _class_moments = _programs.cached_program(
     _class_moments_fn, name="naive_bayes.class_moments",
 )

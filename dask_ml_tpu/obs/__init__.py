@@ -72,6 +72,7 @@ from .flight import (  # noqa: F401
     post_mortem as flight_post_mortem,
     tail as flight_tail,
 )
+from . import roofline  # noqa: F401
 from . import scope  # noqa: F401
 from .scope import device_report  # noqa: F401
 from . import serve  # noqa: F401
@@ -91,8 +92,8 @@ __all__ = [
     "export_perfetto", "perfetto_trace", "read_jsonl",
     # flight
     "flight", "flight_dump", "flight_post_mortem", "flight_tail",
-    # graftscope: device-time accounting + scrape endpoint
-    "scope", "device_report", "serve", "prometheus_text",
+    # graftscope: device-time accounting + roofline + scrape endpoint
+    "scope", "roofline", "device_report", "serve", "prometheus_text",
     # lifecycle
     "install_jax_hooks", "reset_all",
 ]

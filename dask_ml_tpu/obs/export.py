@@ -182,12 +182,22 @@ def perfetto_trace(records=None, device=None) -> dict:
     tids: dict[str, int] = {}
     events = []
     for iv in device:
+        args = {"open": True} if iv.get("open") else {}
+        if "flops" in iv:
+            # per-dispatch roofline attribution rides the slice: flops,
+            # bytes, and — when the interval has real duration — the
+            # achieved GFLOP/s a trace reader can eyeball against peaks
+            args["flops"] = iv["flops"]
+            args["bytes"] = iv["bytes"]
+            dur_s = iv["t1"] - iv["t0"]
+            if dur_s > 0:
+                args["gflops_per_s"] = round(iv["flops"] / dur_s / 1e9, 3)
         events.append({
             "name": iv["program"], "pid": pid, "tid": 0,
             "ts": round((iv["t0"] - epoch) * 1e6, 3),
             "dur": round((iv["t1"] - iv["t0"]) * 1e6, 3),
             "ph": "X",
-            "args": ({"open": True} if iv.get("open") else {}),
+            "args": args,
         })
     for d in dicts:
         tid = tids.setdefault(d["thread"], len(tids) + 1)

@@ -32,6 +32,15 @@ new/stale/regression semantics as the other three:
 * a workload that ERRORS (or whose block count drifted from the
   snapshot — the shapes are the calibration) is a hard failure.
 
+v2 adds the ROOFLINE columns (ISSUE 12): each workload commits a
+per-program table of device busy seconds, XLA-estimated flops/bytes
+(captured at compile time by the program cache), and the roofline
+fraction against ``obs.roofline``'s peak table — and the ratchet
+floors each program's committed fraction (``ROOFLINE_FLOOR_FACTOR``),
+so "utilization may not regress" becomes per-program, not just global.
+A drifted program SET (a dispatch path silently changed) fails like a
+block-count drift.
+
 Workloads are deliberately tiny-but-not-trivial: block shapes chosen
 so the device step costs milliseconds (a measurable busy interval on
 this image) and bucket-aligned (16384 = the ``auto`` ladder's 16k rung,
@@ -74,7 +83,7 @@ __all__ = [
 #: ``tools/perf_baseline.json`` next to a repo checkout).
 PERF_BASELINE_ENV = "DASK_ML_TPU_PERF_BASELINE"
 
-_VERSION = 1
+_VERSION = 2  # v2: per-program roofline columns (flops/bytes/frac)
 _SEED = 11
 _BLOCKS = 10
 _ROWS, _DIM = 16384, 32  # 16k = an `auto` bucket rung: no pad, no drift
@@ -87,6 +96,28 @@ P99_BAND = (8.0, 0.050)
 UTIL_FLOOR_FACTOR = 0.5
 UTIL_MIN_BASE = 0.10
 STALL_BAND = (3.0, 0.20)
+#: per-program roofline-fraction floor: flops are an exact compile-time
+#: constant, so all the flap lives in measured busy seconds — the floor
+#: is wider (×0.25) than the utilization one, and only bites when the
+#: committed fraction is big enough to floor at all.
+ROOFLINE_FLOOR_FACTOR = 0.25
+ROOFLINE_MIN_BASE = 1e-4
+
+
+def _program_roofline(dev: dict) -> dict:
+    """The committed per-program roofline columns from one
+    ``device_report``: busy seconds, XLA-estimated flops/bytes, and the
+    roofline fraction (None when the program's dispatches carried no
+    cost — e.g. a jitted-twin fallback)."""
+    out = {}
+    for name, p in sorted(dev.get("programs", {}).items()):
+        out[name] = {
+            "busy_s": p.get("busy_s", 0.0),
+            "flops": p.get("flops"),
+            "bytes": p.get("bytes"),
+            "roofline_frac": p.get("roofline_frac"),
+        }
+    return out
 
 
 # -- workloads -----------------------------------------------------------
@@ -172,6 +203,7 @@ def _run_streamed(make_model, blocks_fn, depth, *, fit_kwargs=None,
             min(float(rep.get("stall_s", 0.0)) / wall, 1.0), 4),
         "wall_s": round(wall, 6),
         "device_busy_s": dev["busy_s"],
+        "programs": _program_roofline(dev),
     }
 
 
@@ -251,6 +283,7 @@ def _wl_serve(inject_s=0.0):
                 min(float(qwait.sum) / max(wall, 1e-9), 1.0), 4),
             "wall_s": round(wall, 6),
             "device_busy_s": dev["busy_s"],
+            "programs": _program_roofline(dev),
         }
     finally:
         server.close()
@@ -274,7 +307,7 @@ def run_workload(name: str, inject_s: float = 0.0) -> dict:
     except Exception as e:
         return {"blocks": 0, "p50_block_s": 0.0, "p99_block_s": 0.0,
                 "utilization": 0.0, "stall_fraction": 0.0, "wall_s": 0.0,
-                "device_busy_s": 0.0,
+                "device_busy_s": 0.0, "programs": {},
                 "error": f"{type(e).__name__}: {e}"}
 
 
@@ -397,6 +430,36 @@ def compare(snapshot: dict, results: dict, *, partial: bool = False) -> dict:
                 f"{name}: stall_fraction {m['stall_fraction']:.3f} > "
                 f"ceiling {s_ceil:.3f} — the consumer is starving "
                 f"where the committed run overlapped")
+        # per-program roofline ratchet: the utilization floor, but per
+        # cached program — a workload whose aggregate numbers hold can
+        # still lose one program's roofline standing (a donation
+        # dropped, a precision knob regressed, a program knocked onto
+        # its fallback path).  Skipped against a pre-roofline (v1)
+        # snapshot entry, which has no programs table.
+        b_progs = base.get("programs")
+        if b_progs is not None:
+            m_progs = m.get("programs", {})
+            if sorted(m_progs) != sorted(b_progs):
+                regressions.append(
+                    f"{name}: program set drifted (measured "
+                    f"{sorted(m_progs)} vs baseline {sorted(b_progs)}) "
+                    f"— a dispatch path changed; rebaseline "
+                    f"deliberately (tools/lint.sh --rebaseline)")
+            else:
+                for pname, bp in sorted(b_progs.items()):
+                    b_frac = bp.get("roofline_frac")
+                    if b_frac is None or b_frac < ROOFLINE_MIN_BASE:
+                        continue
+                    m_frac = m_progs[pname].get("roofline_frac") or 0.0
+                    floor = b_frac * ROOFLINE_FLOOR_FACTOR
+                    if m_frac < floor:
+                        regressions.append(
+                            f"{name}/{pname}: roofline_frac "
+                            f"{m_frac:.6f} < floor {floor:.6f} "
+                            f"(baseline {b_frac:.6f} × "
+                            f"{ROOFLINE_FLOOR_FACTOR}) — the program "
+                            f"is further from the machine than the "
+                            f"committed run")
 
     return {"new": new, "stale": stale, "regressions": regressions,
             "violations": violations}
@@ -509,6 +572,19 @@ def main(argv=None) -> int:
                   f"stall={m['stall_fraction']:.3f} "
                   f"wall={m['wall_s']:.3f}s"
                   + (f" ERROR={m['error']}" if m.get("error") else ""))
+            for pname, p in sorted((m.get("programs") or {}).items()):
+                frac = p.get("roofline_frac")
+                flops, nbytes = p.get("flops"), p.get("bytes")
+                # `is not None`, not truthiness: a costed zero-flop
+                # (bandwidth-only) program must print flops=0, which is
+                # a different statement from "cost capture failed"
+                print(f"  {pname}: busy={p.get('busy_s', 0.0) * 1e3:.2f}ms"
+                      + (f" flops={flops:.3e}" if flops is not None
+                         else "")
+                      + (f" bytes={nbytes:.3e}" if nbytes is not None
+                         else "")
+                      + (f" roofline={frac:.5f}" if frac is not None
+                         else " roofline=n/a"))
         for key in ("violations", "regressions", "new", "stale"):
             for line in delta[key]:
                 print(f"{key.upper()}: {line}")

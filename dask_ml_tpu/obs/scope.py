@@ -19,6 +19,12 @@ graftsan ``ExecuteReplicated`` hook — as an **in-flight interval**:
   :data:`_SAMPLE_S` seconds while work is in flight and parks on a
   condition variable otherwise.
 
+Each interval may additionally carry the dispatched executable's
+captured XLA cost estimate (flops / bytes accessed — the program cache
+hands it to :func:`track`), which :func:`device_report` joins with
+measured busy time into per-program achieved FLOP/s and a roofline
+fraction against :mod:`.roofline`'s peak table.
+
 The union of in-flight intervals is the "device busy-or-fed" timeline:
 its complement inside the observation window is **device idle time** —
 the budget currency the ROADMAP's [search-scale] lane names, and the
@@ -98,13 +104,14 @@ _BEATS_EVERY = 50
 
 
 class _Pending:
-    __slots__ = ("program", "t0", "leaves", "seq")
+    __slots__ = ("program", "t0", "leaves", "seq", "cost")
 
-    def __init__(self, program, t0, leaves, seq):
+    def __init__(self, program, t0, leaves, seq, cost=None):
         self.program = program
         self.t0 = t0
         self.leaves = leaves
         self.seq = seq
+        self.cost = cost  # {"flops", "bytes", ...} | None (roofline.py)
 
 
 _LOCK = threading.Lock()
@@ -129,15 +136,18 @@ def _leaf_ready(leaf) -> bool:
 
 # -- recording (choke-point callbacks; any dispatching thread) -----------
 
-def track(program: str, t0: float, leaves) -> bool:
+def track(program: str, t0: float, leaves, cost=None) -> bool:
     """Open an in-flight interval for one dispatched program.
 
     ``leaves`` are the dispatch's output leaves; only leaves exposing
     ``is_ready()`` participate (tracer outputs — a program inlining
     into an outer trace — have none, and are deliberately not counted
-    as dispatches).  Returns True when an interval was opened.
-    Host-only: a time read, a lock, a list append, a registry
-    increment."""
+    as dispatches).  ``cost`` is the dispatched executable's captured
+    cost_analysis (:func:`~.roofline.capture_cost`; the program cache
+    passes it on the AOT path) — it rides the interval so the closed
+    timeline carries flops/bytes per dispatch.  Returns True when an
+    interval was opened.  Host-only: a time read, a lock, a list
+    append, a registry increment."""
     live = [x for x in leaves if hasattr(x, "is_ready")]
     if not live:
         return False
@@ -147,7 +157,7 @@ def track(program: str, t0: float, leaves) -> bool:
         _sweep_locked(now)
         seq = _SEQ
         _SEQ += 1
-        _PENDING.append(_Pending(str(program), float(t0), live, seq))
+        _PENDING.append(_Pending(str(program), float(t0), live, seq, cost))
         _ensure_sampler_locked()
         _COND.notify()
     _registry().counter("device.dispatches", str(program)).inc()
@@ -185,12 +195,15 @@ def _close_locked(p: _Pending, t1: float) -> None:
         "t1": max(float(t1), p.t0),
         "seq": p.seq,
     }
+    if p.cost is not None:
+        iv["flops"] = p.cost.get("flops", 0.0)
+        iv["bytes"] = p.cost.get("bytes", 0.0)
     _CLOSED.append(iv)
     if len(_CLOSED) > _RING_CAP:
         del _CLOSED[: len(_CLOSED) - _RING_CAP]
 
 
-def _sweep_locked(now: float) -> list[dict]:
+def _sweep_locked(now: float) -> list[tuple]:
     done = [p for p in _PENDING if all(_leaf_ready(x) for x in p.leaves)]
     if not done:
         return []
@@ -198,12 +211,34 @@ def _sweep_locked(now: float) -> list[dict]:
     for p in done:
         _PENDING.remove(p)
         _close_locked(p, now)
-        closed.append((p.program, max(now - p.t0, 0.0)))
+        closed.append((p.program, max(now - p.t0, 0.0), p.cost))
     # registry publication outside the hot predicate but still under
-    # _LOCK: instrument locks nest inside, never the other way around
+    # _LOCK: instrument locks nest inside, never the other way around.
+    # roofline.py is pure host stdlib, so the attribution stays legal
+    # on the sampler thread; the peaks lookup is loop-invariant and
+    # hoisted so a busy sweep pays it once, not per interval.
     reg = _registry()
-    for program, dur in closed:
+    peaks = None
+    if any(cost is not None for _, _, cost in closed):
+        from . import roofline as _roofline
+
+        # fail-soft lookup: a malformed DASK_ML_TPU_PEAKS must raise on
+        # the reporting surfaces, not kill the sampler or a dispatch
+        peaks = _roofline.try_peaks_for(_roofline.detected_platform())
+    for program, dur, cost in closed:
         reg.histogram("device.busy_s", program).record(dur)
+        if cost is None:
+            continue
+        # roofline attribution lands with the interval: flops/bytes as
+        # monotone counters (a /metrics scraper can rate() them), the
+        # last closed interval's roofline fraction as a live gauge
+        reg.counter("device.flops", program).inc(int(cost["flops"]))
+        reg.counter("device.bytes", program).inc(int(cost["bytes"]))
+        att = _roofline.attribution(cost["flops"], cost["bytes"], dur,
+                                    peaks)
+        if att["roofline_frac"] is not None:
+            reg.gauge("device.roofline_frac", program).set(
+                att["roofline_frac"])
     return closed
 
 
@@ -355,18 +390,40 @@ def device_report(since: int | None = None, *, settle_s: float = 0.0,
     floors.  ``settle_s > 0`` first waits (bounded) for in-flight
     dispatches so a *post-fit* report closes its last interval; a live
     scrape must pass 0 (the default — never wait on the device in a
-    handler thread)."""
+    handler thread).
+
+    Each program whose dispatches carried captured cost_analysis
+    (:mod:`.roofline`) additionally reports its accumulated ``flops`` /
+    ``bytes`` and the joined ``achieved_flops_per_s`` /
+    ``achieved_bytes_per_s`` / ``intensity`` / ``roofline_frac``
+    against the peak table; the top-level ``roofline`` block names the
+    platform and peaks (with provenance) those fractions used — absent
+    when the platform is undetected, None fractions when peaks are
+    unknown (honesty over invention)."""
     if settle_s > 0:
         settle(settle_s)
     ivs = timeline(since)
     programs: dict[str, dict] = {}
+    work: dict[str, list] = {}  # program -> [flops, bytes, costed_busy]
     for iv in ivs:
         p = programs.setdefault(iv["program"],
                                 {"dispatches": 0, "busy_s": 0.0})
         p["dispatches"] += 1
         p["busy_s"] += iv["t1"] - iv["t0"]
-    for p in programs.values():
+        if "flops" in iv and not iv.get("open"):
+            w = work.setdefault(iv["program"], [0.0, 0.0, 0.0])
+            w[0] += iv["flops"]
+            w[1] += iv["bytes"]
+            w[2] += iv["t1"] - iv["t0"]
+    from . import roofline as _roofline
+
+    platform = _roofline.detected_platform()
+    peaks = _roofline.peaks_for(platform)
+    for name, p in programs.items():
         p["busy_s"] = round(p["busy_s"], 6)
+        w = work.get(name)
+        if w is not None:
+            p.update(_roofline.attribution(w[0], w[1], w[2], peaks))
     if not ivs:
         return {"dispatches": 0, "busy_s": 0.0, "window_s": 0.0,
                 "idle_s": 0.0, "utilization": 0.0, "idle_gaps": [],
@@ -374,7 +431,7 @@ def device_report(since: int | None = None, *, settle_s: float = 0.0,
     busy, merged, gaps = _merge(ivs)
     window = max(iv["t1"] for iv in ivs) - ivs[0]["t0"]
     gaps.sort(key=lambda g: -g["dur_s"])
-    return {
+    out = {
         "dispatches": len(ivs),
         "busy_s": round(busy, 6),
         "window_s": round(window, 6),
@@ -385,6 +442,9 @@ def device_report(since: int | None = None, *, settle_s: float = 0.0,
         "programs": dict(sorted(programs.items())),
         "pending": pending_count(),
     }
+    if platform is not None:
+        out["roofline"] = {"platform": platform, "peaks": peaks}
+    return out
 
 
 def reset() -> None:

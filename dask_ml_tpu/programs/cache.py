@@ -48,6 +48,7 @@ import numpy as np
 
 import jax
 
+from ..obs import roofline as _roofline
 from ..obs import scope as _scope
 from ..obs.metrics import registry as _registry
 
@@ -175,7 +176,8 @@ def _sharding_token(x) -> str | None:
 
 
 class _Entry:
-    __slots__ = ("compiled", "source", "compile_s", "consumer_hits", "bad")
+    __slots__ = ("compiled", "source", "compile_s", "consumer_hits", "bad",
+                 "cost")
 
     def __init__(self, compiled, source: str, compile_s: float):
         self.compiled = compiled
@@ -183,6 +185,11 @@ class _Entry:
         self.compile_s = compile_s
         self.consumer_hits = 0
         self.bad = False
+        # XLA's static flop/byte estimate for THIS signature's
+        # executable (obs/roofline.py; None when the backend cannot
+        # say) — joined with the dispatch's device interval so
+        # device_report() can attribute achieved FLOP/s per program
+        self.cost = _roofline.capture_cost(compiled)
 
 
 def _new_counters() -> dict:
@@ -249,18 +256,22 @@ class CachedProgram:
         return (tok, tuple(keys), stat)
 
     # -- dispatch --------------------------------------------------------
-    def _run_tracked(self, fn, args, kwargs=None):
+    def _run_tracked(self, fn, args, kwargs=None, cost=None):
         """Dispatch through ``fn`` with graftscope device-time tracking:
         the in-flight interval opens at the enqueue and closes when the
         outputs report ready (obs/scope.py).  ``absorb()`` keeps the
         graftsan ``ExecuteReplicated`` hook — which this same call
         funnels through while a sanitizer is active — from opening a
         duplicate interval; the cache end owns the attribution (it
-        knows the program's registry name)."""
+        knows the program's registry name).  ``cost`` is the entry's
+        captured cost_analysis on the AOT path (None on the jitted-twin
+        fallback — an unattributed dispatch reports time but no work,
+        honest either way)."""
         t0 = time.perf_counter()
         with _scope.absorb():
             out = fn(*args, **kwargs) if kwargs else fn(*args)
-        _scope.track(self.name, t0, jax.tree_util.tree_leaves(out))
+        _scope.track(self.name, t0, jax.tree_util.tree_leaves(out),
+                     cost=cost)
         return out
 
     def __call__(self, *args, **kwargs):
@@ -279,7 +290,7 @@ class CachedProgram:
             self._count("fallback")
             return self._run_tracked(self._jitted, args, kwargs)
         try:
-            out = self._run_tracked(entry.compiled, args)
+            out = self._run_tracked(entry.compiled, args, cost=entry.cost)
         except (TypeError, ValueError) as e:
             # operand/executable mismatch (these raise BEFORE execution,
             # so donated buffers are intact): permanently route this
@@ -392,6 +403,13 @@ class CachedProgram:
         try:
             compiled = self._jitted.lower(*args, **static).compile()
             entry = _Entry(compiled, source, time.perf_counter() - t0)
+            try:
+                # tell the roofline layer what platform cost estimates
+                # belong to (roofline itself never imports jax, so the
+                # host-only sampler/scrape threads can read it freely)
+                _roofline.note_platform(jax.default_backend())
+            except Exception:  # pragma: no cover - backend query failure
+                pass
         except Exception as e:
             if source == "ahead":
                 # the consumer's own demand path still works; record and
@@ -475,6 +493,8 @@ class CachedProgram:
             out = dict(self.counters)
             out["programs"] = len(self._entries)
             out["inflight"] = len(self._inflight)
+            out["cost_known"] = sum(1 for e in self._entries.values()
+                                    if e.cost is not None)
         for k in ("compile_s", "ahead_compile_s", "saved_s", "wait_s"):
             out[k] = round(out[k], 6)
         return out

@@ -53,6 +53,7 @@ def _margins_fn(coef, intercept, xb):
     return xb @ coef + intercept
 
 
+# graftlint: disable=donation-miss -- gemm-output-smaller: the (b,k) margins cannot alias (b,d)/(d,k) inputs, and coef/intercept are the resident model state (module docstring)
 margins = _programs.cached_program(_margins_fn, name="serve.margins")
 
 
@@ -62,6 +63,7 @@ def _lane_margins_fn(coefs, intercepts, xs):
     return jax.vmap(_margins_fn)(coefs, intercepts, xs)
 
 
+# graftlint: disable=donation-miss -- gemm-output-smaller, and the stacked coefs/intercepts are the residency registry's LIVE lane state (donating them would delete the pack)
 lane_margins = _programs.cached_program(
     _lane_margins_fn, name="serve.lane_margins")
 

@@ -412,3 +412,42 @@ class TestStreamedTruncatedSVD:
 
         with pytest.raises(ValueError, match="empty"):
             TruncatedSVD(n_components=2).fit_streamed(lambda: iter([]))
+
+
+class TestIPCADonation:
+    """ISSUE-12 aliasing regression: the rank-update's five-tensor state
+    chain is donated (in-place in HBM), the batch buffer is not."""
+
+    def test_update_donates_state_chain_not_batch(self):
+        import jax.numpy as jnp
+
+        from dask_ml_tpu.decomposition.incremental_pca import _update
+
+        rng = np.random.RandomState(2)
+        k, d, n = 3, 8, 64
+        comp = jnp.zeros((k, d), jnp.float32)
+        sv = jnp.zeros((k,), jnp.float32)
+        mean = jnp.zeros((d,), jnp.float32)
+        var = jnp.zeros((d,), jnp.float32)
+        n_seen = jnp.asarray(0, jnp.int32)
+        batch = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        out = _update(comp, sv, mean, var, n_seen, batch, k=k)
+        for name, arr in (("components", comp), ("singular_values", sv),
+                          ("mean", mean), ("var", var),
+                          ("n_seen", n_seen)):
+            assert arr.is_deleted(), f"{name} must be consumed in place"
+        assert not batch.is_deleted(), "batch is deliberately NOT donated"
+        assert out[0].shape == (k, d)
+
+    def test_partial_fit_chain_consistent_under_donation(self):
+        rng = np.random.RandomState(4)
+        X1 = rng.normal(size=(50, 8)).astype(np.float32)
+        X2 = rng.normal(size=(50, 8)).astype(np.float32)
+        a = dd.IncrementalPCA(n_components=3)
+        a.partial_fit(X1)
+        comp_after_1 = np.asarray(a.components_)
+        a.partial_fit(X2)  # donation must not corrupt the chain
+        b = dd.IncrementalPCA(n_components=3)
+        b.partial_fit(X1)
+        np.testing.assert_allclose(np.asarray(b.components_),
+                                   comp_after_1, rtol=1e-5)

@@ -1873,6 +1873,8 @@ class TestFramework:
             "jit-outside-cache",
             # PR 9: the static twin of the chaos drill suite
             "swallowed-fault",
+            # ISSUE 12: every cached program makes a donation decision
+            "donation-miss",
         }
 
     def test_select_unknown_rule_raises(self):
@@ -2004,3 +2006,83 @@ class TestDiagnosticsLintReport:
         report = diagnostics.lint_report([str(tmp_path)])
         assert report["active"] == 1
         assert report["counts"]["key-reuse"]["active"] == 1
+
+
+class TestDonationMiss:
+    """ISSUE-12: every cached_program call must make its donation
+    decision — donate_argnames wired, or an inline justified
+    suppression naming why nothing aliases (the gemm-output-smaller
+    class)."""
+
+    def test_flags_cached_program_without_donation(self):
+        findings = lint("""
+            from dask_ml_tpu import programs as _programs
+
+            def step(state, x):
+                return state
+
+            _step = _programs.cached_program(step, name="m.step")
+        """)
+        fs = [f for f in active(findings) if f.rule == "donation-miss"]
+        assert fs and "donate_argnames" in fs[0].message
+
+    def test_explicit_empty_tuple_still_flags(self):
+        # an empty donate_argnames=() is "no donation" without the
+        # reviewable justification a suppression carries
+        findings = lint("""
+            from dask_ml_tpu import programs as _programs
+
+            def step(state, x):
+                return state
+
+            _step = _programs.cached_program(
+                step, name="m.step", donate_argnames=())
+        """)
+        assert "donation-miss" in rule_ids(active(findings))
+
+    def test_wired_donation_is_clean(self):
+        findings = lint("""
+            from dask_ml_tpu import programs as _programs
+
+            def step(state, x):
+                return state
+
+            _step = _programs.cached_program(
+                step, name="m.step", donate_argnames=("state",))
+        """)
+        assert "donation-miss" not in rule_ids(active(findings))
+
+    def test_justified_suppression_is_honored(self):
+        findings = lint("""
+            from dask_ml_tpu import programs as _programs
+
+            def loss(state, x):
+                return 0.0
+
+            # graftlint: disable=donation-miss -- scalar output, nothing aliases
+            _loss = _programs.cached_program(loss, name="m.loss")
+        """)
+        fs = [f for f in findings if f.rule == "donation-miss"]
+        assert fs and all(f.suppressed for f in fs)
+
+    def test_direct_class_form_flags_too(self):
+        findings = lint("""
+            from dask_ml_tpu.programs.cache import CachedProgram
+
+            def step(state, x):
+                return state
+
+            _step = CachedProgram(step, name="m.step")
+        """)
+        assert "donation-miss" in rule_ids(active(findings))
+
+    def test_foreign_same_name_helper_does_not_match(self):
+        findings = lint("""
+            from mylib import cached_program
+
+            def step(state, x):
+                return state
+
+            _step = cached_program(step, name="m.step")
+        """)
+        assert "donation-miss" not in rule_ids(active(findings))

@@ -693,3 +693,193 @@ class TestPerfCli:
 
     def test_unknown_workload_is_exit_2(self, capsys):
         assert perf.main(["--workloads", "nope"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# roofline (ISSUE 12): peak table, cost capture, the device_report join,
+# and the per-program ratchet columns
+# ---------------------------------------------------------------------------
+
+from dask_ml_tpu.obs import roofline  # noqa: E402
+
+
+class _FakeCompiled:
+    def __init__(self, payload):
+        self._payload = payload
+
+    def cost_analysis(self):
+        if isinstance(self._payload, Exception):
+            raise self._payload
+        return self._payload
+
+
+class TestRoofline:
+    def test_default_peaks_have_provenance(self):
+        cpu = roofline.peaks_for("cpu")
+        tpu = roofline.peaks_for("tpu")
+        assert cpu["source"].startswith("measured")
+        assert tpu["source"].startswith("assumed")
+        assert cpu["flops_per_s"] > 0 and cpu["bytes_per_s"] > 0
+
+    def test_unknown_platform_has_no_peaks(self):
+        assert roofline.peaks_for("quantum") is None
+        assert roofline.peaks_for(None) is None
+
+    def test_env_override_and_reset(self, monkeypatch):
+        monkeypatch.setenv(roofline.PEAKS_ENV,
+                           "cpu:flops=2e11,bytes=3e10;xpu:flops=1,bytes=2")
+        roofline.reset_cache()
+        try:
+            cpu = roofline.peaks_for("cpu")
+            assert cpu == {"flops_per_s": 2e11, "bytes_per_s": 3e10,
+                           "source": "env"}
+            assert roofline.peaks_for("xpu")["source"] == "env"
+        finally:
+            monkeypatch.delenv(roofline.PEAKS_ENV)
+            roofline.reset_cache()
+
+    @pytest.mark.parametrize("raw", [
+        "cpu", "cpu:flops=1", "cpu:flops=1,bytes=x",
+        "cpu:flops=0,bytes=1", "cpu:flops=1,watts=2",
+    ])
+    def test_malformed_env_raises(self, raw):
+        with pytest.raises(ValueError):
+            roofline.parse_peaks(raw)
+
+    def test_attribution_memory_bound_equals_bandwidth_fraction(self):
+        peaks = {"flops_per_s": 100.0, "bytes_per_s": 10.0,
+                 "source": "test"}
+        att = roofline.attribution(1.0, 10.0, 2.0, peaks)
+        # memory-bound: bound = I * peak_bytes, so the fraction equals
+        # achieved bytes/s over peak bytes/s (= 5/10)
+        assert att["roofline_frac"] == pytest.approx(0.5)
+        assert att["achieved_bytes_per_s"] == pytest.approx(5.0)
+        assert att["intensity"] == pytest.approx(0.1)
+
+    def test_attribution_compute_bound_and_zero_flop(self):
+        peaks = {"flops_per_s": 100.0, "bytes_per_s": 10.0,
+                 "source": "test"}
+        # intensity 100 -> bound = peak_flops
+        att = roofline.attribution(1000.0, 10.0, 20.0, peaks)
+        assert att["roofline_frac"] == pytest.approx(0.5)
+        # pure data movement scores on bandwidth alone
+        att0 = roofline.attribution(0.0, 10.0, 1.0, peaks)
+        assert att0["roofline_frac"] == pytest.approx(1.0)
+        assert att0["intensity"] == pytest.approx(0.0)
+
+    def test_attribution_without_peaks_reports_rates_only(self):
+        att = roofline.attribution(10.0, 10.0, 1.0, None)
+        assert att["roofline_frac"] is None
+        assert att["achieved_flops_per_s"] == pytest.approx(10.0)
+
+    def test_capture_cost_shapes_and_failsoft(self):
+        ok = roofline.capture_cost(_FakeCompiled(
+            [{"flops": 8.0, "bytes accessed": 4.0,
+              "bytes accessedout{}": 2.0}]))
+        assert ok == {"flops": 8.0, "bytes": 4.0, "out_bytes": 2.0}
+        # dict form (newer jax), raising backends, junk, and XLA's
+        # negative "unknown" sentinel all stay fail-soft
+        assert roofline.capture_cost(_FakeCompiled(
+            {"flops": 1.0, "bytes accessed": 1.0}))["flops"] == 1.0
+        assert roofline.capture_cost(
+            _FakeCompiled(RuntimeError("relayed"))) is None
+        assert roofline.capture_cost(_FakeCompiled([])) is None
+        assert roofline.capture_cost(_FakeCompiled(
+            [{"flops": -1.0, "bytes accessed": 4.0}])) is None
+
+    def test_cached_dispatch_attributes_flops_in_report_and_registry(self):
+        from dask_ml_tpu import programs
+
+        def gemm(a, b):
+            return a @ b
+
+        prog = programs.cached_program(gemm, name="rftest.gemm")
+        a = np.ones((256, 64), np.float32)
+        b = np.ones((64, 32), np.float32)
+        cur = scope.cursor()
+        prog(a, b)
+        prog(a, b)
+        rep = scope.device_report(since=cur, settle_s=5.0)
+        p = rep["programs"]["rftest.gemm"]
+        assert p["flops"] > 0 and p["bytes"] > 0
+        assert p["roofline_frac"] is not None and p["roofline_frac"] > 0
+        assert rep["roofline"]["peaks"]["source"]
+        # the registry carries the same attribution for /metrics
+        reg = obs.registry()
+        assert reg.counter("device.flops", "rftest.gemm").value > 0
+        assert reg.counter("device.bytes", "rftest.gemm").value > 0
+        txt = serve.prometheus_text()
+        assert "device_flops" in txt and "device_roofline_frac" in txt
+
+    def test_fallback_dispatch_reports_time_without_work(self):
+        # an interval tracked WITHOUT cost (the jitted-twin fallback /
+        # graftsan hook path) must not invent flops
+        t0 = time.perf_counter()
+        scope.track("rftest.nocost", t0, [_Leaf(ready=True)])
+        rep = scope.device_report(settle_s=1.0)
+        p = rep["programs"]["rftest.nocost"]
+        assert "flops" not in p and "roofline_frac" not in p
+
+
+_PROGS = {"sgd.step": {"busy_s": 0.01, "flops": 1e6, "bytes": 2e6,
+                       "roofline_frac": 0.01}}
+
+
+class TestPerfRooflineRatchet:
+    def test_program_floor_regression(self):
+        base = _m(programs=_PROGS)
+        meas = _m(programs={"sgd.step": dict(_PROGS["sgd.step"],
+                                             roofline_frac=0.001)})
+        delta = perf.compare(_snap({"w": base}), {"w": meas})
+        assert any("roofline_frac" in r for r in delta["regressions"])
+
+    def test_program_within_floor_is_clean(self):
+        base = _m(programs=_PROGS)
+        meas = _m(programs={"sgd.step": dict(_PROGS["sgd.step"],
+                                             roofline_frac=0.004)})
+        delta = perf.compare(_snap({"w": base}), {"w": meas})
+        assert perf.is_clean(delta), delta
+
+    def test_program_set_drift_fails(self):
+        base = _m(programs=_PROGS)
+        meas = _m(programs={"other.prog": dict(_PROGS["sgd.step"])})
+        delta = perf.compare(_snap({"w": base}), {"w": meas})
+        assert any("program set drifted" in r for r in delta["regressions"])
+
+    def test_v1_snapshot_without_programs_skips_program_checks(self):
+        # a pre-roofline baseline entry has no programs table: the v2
+        # runner's extra columns must not fail the ratchet by themselves
+        delta = perf.compare(_snap({"w": _m()}), {"w": _m(programs=_PROGS)})
+        assert perf.is_clean(delta), delta
+
+    def test_tiny_committed_fraction_cannot_floor(self):
+        base = _m(programs={"p": {"busy_s": 0.01, "flops": 1.0,
+                                  "bytes": 1.0,
+                                  "roofline_frac": 1e-6}})
+        meas = _m(programs={"p": {"busy_s": 0.01, "flops": 1.0,
+                                  "bytes": 1.0, "roofline_frac": 0.0}})
+        delta = perf.compare(_snap({"w": base}), {"w": meas})
+        assert perf.is_clean(delta), delta
+
+    def test_malformed_peaks_is_failsoft_on_the_sweep_path(self,
+                                                           monkeypatch):
+        # a typo'd DASK_ML_TPU_PEAKS must not kill the sampler or a
+        # dispatch: the sweep's lookup degrades to no-peaks (warn once),
+        # while the strict parse still raises on the loud surfaces
+        monkeypatch.setenv(roofline.PEAKS_ENV, "tpu:flops=4.9e13")
+        roofline.reset_cache()
+        try:
+            with pytest.raises(ValueError):
+                roofline.peaks_for("cpu")
+            assert roofline.try_peaks_for("cpu") is None
+            t0 = time.perf_counter()
+            scope.track("rftest.badpeaks", t0, [_Leaf(ready=True)],
+                        cost={"flops": 8.0, "bytes": 4.0})
+            scope.sweep()  # must not raise
+            rep_programs = {}
+            # device_report is a loud surface: it raises on the bad knob
+            with pytest.raises(ValueError):
+                scope.device_report(settle_s=1.0)
+        finally:
+            monkeypatch.delenv(roofline.PEAKS_ENV)
+            roofline.reset_cache()

@@ -531,8 +531,13 @@ class TestAllowSiteCitations:
         ahead-crash drill verify the thread never dispatches) and the
         JSONL sink's shutdown ``swallowed-fault`` escape
         (obs/export.py — the sink already warned once when it was
-        dropped; the exporter-ENOSPC drill pins that contract) — so
-        the count is now 11."""
+        dropped; the exporter-ENOSPC drill pins that contract) — count
+        11.  ISSUE 12 added FIVE, all ``donation-miss`` justifications
+        for the deliberate non-donations (the gemm-output-smaller
+        class: kmeans.assign, sgd.eval_loss, naive_bayes
+        class_moments, serve margins + lane_margins) — each
+        runtime-verified by an aliasing regression test asserting the
+        undonated buffers really survive — so the count is now 16."""
         import subprocess
 
         out = subprocess.run(
@@ -542,8 +547,8 @@ class TestAllowSiteCitations:
         total = sum(int(line.rsplit(":", 1)[1])
                     for line in out.stdout.splitlines() if ":" in line)
         # analysis/core.py's docstring EXAMPLE is not a live suppression
-        assert total - 1 <= 13
-        assert total - 1 == 11, (
+        assert total - 1 <= 18
+        assert total - 1 == 16, (
             "suppression count moved — update this test AND re-audit "
             "the AllowSite citations")
 

@@ -28,8 +28,12 @@
 # fraction ratchet against tools/perf_baseline.json with tolerance
 # BANDS (not exact times — the gate box is loaded; the ratchet catches
 # the order-of-magnitude class: a sleep in a step program, a pipeline
-# that stopped overlapping, an idling device).  Tier-1 runs the same
-# gate via tests/test_graftscope.py.
+# that stopped overlapping, an idling device).  Since v2 every
+# workload also prints + ratchets its PER-PROGRAM ROOFLINE columns
+# (busy_s / flops / bytes / roofline_frac vs the obs/roofline.py peak
+# table, design.md §16) with a x0.25 per-program floor and a
+# program-set drift gate.  Tier-1 runs the same gate via
+# tests/test_graftscope.py.
 #
 # Usage:
 #   tools/lint.sh                 # static ratchet gate (text output)
