@@ -88,7 +88,15 @@ BLESSED_COMPILE_THREADS = frozenset({"dask-ml-tpu-compile-ahead"})
 # deadlock hazard of a second dispatcher CONCURRENT with a training
 # fit is real and documented (design.md §15): the serve plane is for
 # inference processes; co-resident training keeps the main thread.
-BLESSED_DISPATCH_THREADS = frozenset({"dask-ml-tpu-serve"})
+# ``dask-ml-tpu-search`` is the adaptive-search orchestrator loop
+# (model_selection/_orchestrator.py, design.md §17): during a
+# concurrent search it is the ONE thread issuing device programs — the
+# caller blocks in fit() and the prefetch/pool workers stay host-only —
+# so the single-dispatcher discipline holds exactly as it does for the
+# serve loop, and graftsan runtime-verifies it the same way (dispatches
+# legal, steady compiles still hard violations).
+BLESSED_DISPATCH_THREADS = frozenset({"dask-ml-tpu-serve",
+                                      "dask-ml-tpu-search"})
 
 # Thread names declared HOST-ONLY by contract — the graftscope readiness
 # sampler and the live metrics endpoint (obs/scope.py, obs/serve.py):

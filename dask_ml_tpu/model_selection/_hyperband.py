@@ -13,11 +13,17 @@ thread-concurrent brackets would emit collectives in different orders on
 different processes and deadlock (``core/distributed.py``).  Concurrent
 brackets on a multi-process group are rejected with a clear error.
 
-This split is deliberate, not a TODO: bracket rounds serialize on the
-device mesh regardless of host-side concurrency (one SPMD program at a
-time), so concurrency only buys host/device overlap — measured at 1.53×
-wall on a single controller and shrinking with scale.  See
-docs/design.md §4 ("Pod-scale Hyperband") for the numbers.
+Single-process, concurrent brackets now run on the TRUE concurrent
+control plane (``_orchestrator.py``, design.md §17): all brackets share
+one event loop hosted on the blessed ``dask-ml-tpu-search`` dispatch
+thread, their units interleave at block granularity (one bracket's
+staged block dispatches while another's program runs and a third's
+block H2D-stages on the host workers), and homogeneous survivors
+re-pack into vmapped cohorts after every halving round.  This closes
+the single-controller sequentialization bound round 5 accepted as a
+"known asterisk" (measured 1.53× wall); the ``search`` bench section
+carries the A/B.  ``DASK_ML_TPU_SEARCH_CONCURRENCY=off`` restores the
+serialized round loop exactly.
 """
 
 from __future__ import annotations
@@ -192,8 +198,14 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
                 *[bracket_fit(s, sha) for s, sha in brackets]
             )
 
+        from . import _orchestrator as _orch
+
         with hb_span:
-            results = asyncio.run(run_all())
+            # device estimators: the whole multi-bracket loop runs on
+            # the blessed orchestrator thread — every bracket's device
+            # work shares the ONE dispatch thread (design.md §17)
+            results = _orch.run_search(
+                run_all, threaded=_orch.device_concurrency(self.estimator))
 
         # merge results across brackets with globally unique model ids
         all_models, all_info = {}, {}

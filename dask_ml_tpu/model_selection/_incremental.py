@@ -32,15 +32,6 @@ from ..utils import check_random_state
 from ._split import train_test_split
 from .. import sanitize as _san
 
-#: runtime-verified twin of the packed-scores host-sync-loop suppression
-#: in train_cohort (see sanitize/sites.py)
-_PACKED_SCORE_SYNC = _san.AllowSite(
-    "search-packed-scores", rule="host-sync-loop",
-    cites="8950af7eda0878b7",
-    note="packed_accuracy fetched the whole (M,) cohort score vector in "
-         "one round-trip; the per-model float() reads host numpy",
-)
-
 logger = logging.getLogger(__name__)
 
 # Shared training pool for the adaptive searches (the scheduler+worker
@@ -355,34 +346,38 @@ class BaseIncrementalSearchCV(TPUEstimator):
 
         prefetch_depth = resolve_depth(None)
 
+        def _warm_unit(model, calls0, n_calls):
+            """Compile-ahead (programs/, design.md §12): heterogeneous
+            configs whose static hyperparams differ each need their own
+            step program — pre-build this unit's from the next block's
+            shape on the blessed compile thread, so the burst starts on
+            a warm executable instead of stalling on XLA."""
+            warm = getattr(model, "_pf_warm", None)
+            if warm is None or n_calls <= 0:
+                return
+            from .. import programs as _programs
+
+            Xw, _yw = blocks[calls0 % n_blocks]
+            # knob check OUTSIDE the best-effort net: a typo'd
+            # DASK_ML_TPU_COMPILE_AHEAD must raise loudly (the
+            # strict-parse contract), not read as a shapeless block.
+            # Host blocks only: device-resident blocks take the
+            # unbucketed ShardedRows step, whose signature the
+            # shape-based warm cannot predict
+            if _programs.compile_ahead_enabled() and \
+                    not isinstance(Xw, ShardedRows) and \
+                    isinstance(getattr(Xw, "shape", None), tuple) and \
+                    not hasattr(Xw, "aval"):
+                try:
+                    warm(Xw.shape,
+                         classes=(fit_params or {}).get("classes"))
+                except (TypeError, ValueError):
+                    pass  # shapeless/1-D blocks: warm is best-effort
+
         def train_one(ident, n_calls):
             model, meta = models[ident]
             calls0 = meta["partial_fit_calls"]
-            # compile-ahead (programs/, design.md §12): heterogeneous
-            # configs whose static hyperparams differ each need their own
-            # step program — pre-build this unit's from the next block's
-            # shape on the blessed compile thread, so the burst below
-            # starts on a warm executable instead of stalling on XLA
-            warm = getattr(model, "_pf_warm", None)
-            if warm is not None and n_calls > 0:
-                from .. import programs as _programs
-
-                Xw, _yw = blocks[calls0 % n_blocks]
-                # knob check OUTSIDE the best-effort net: a typo'd
-                # DASK_ML_TPU_COMPILE_AHEAD must raise loudly (the
-                # strict-parse contract), not read as a shapeless block.
-                # Host blocks only: device-resident blocks take the
-                # unbucketed ShardedRows step, whose signature the
-                # shape-based warm cannot predict
-                if _programs.compile_ahead_enabled() and \
-                        not isinstance(Xw, ShardedRows) and \
-                        isinstance(getattr(Xw, "shape", None), tuple) and \
-                        not hasattr(Xw, "aval"):
-                    try:
-                        warm(Xw.shape,
-                             classes=(fit_params or {}).get("classes"))
-                    except (TypeError, ValueError):
-                        pass  # shapeless/1-D blocks: warm is best-effort
+            _warm_unit(model, calls0, n_calls)
             if (n_calls > 1 and prefetch_depth > 0
                     and hasattr(model, "_pf_stage")):
                 from ..resilience.elastic import ElasticPolicy
@@ -418,6 +413,41 @@ class BaseIncrementalSearchCV(TPUEstimator):
             info[ident].append(meta)
             return meta
 
+        def _score_cohort(cohort, idents):
+            """Packed scoring: with the default (accuracy) scorer the
+            whole cohort scores as ONE vmapped dispatch + one (M,)
+            fetch, instead of M separate model.score round-trips — and
+            it is the multi-controller-safe form (single collective
+            program).  Returns (scores_or_None, per_model_score_time)."""
+            if self.scoring is not None:
+                return None, 0.0
+            try:
+                t0s = time.time()
+                scores = cohort.packed_accuracy(X_test, y_test)
+                return scores, (time.time() - t0s) / max(len(idents), 1)
+            except (TypeError, ValueError):
+                return None, 0.0  # non-classifier/custom: fall back
+
+        def _finish_cohort(idents, n_calls, pf_time, packed_scores,
+                           packed_score_time):
+            """Write one trained cohort's records back per member —
+            shared by the serialized and the orchestrated paths."""
+            for i, ident in enumerate(idents):
+                model, meta = models[ident]
+                meta = dict(meta)
+                meta["partial_fit_calls"] += n_calls
+                meta["partial_fit_time"] = pf_time
+                if packed_scores is not None:
+                    # packed_scores is host numpy already: packed_accuracy
+                    # fetched the whole (M,) vector in ONE round-trip
+                    meta["score"] = float(packed_scores[i])
+                    meta["score_time"] = packed_score_time
+                else:
+                    meta = _score((model, meta), X_test, y_test, scorer)
+                meta["elapsed_wall_time"] = time.time() - start_time
+                models[ident] = (model, meta)
+                info[ident].append(meta)
+
         def train_cohort(idents, n_calls):
             """Lockstep group of packable models: ONE fused dispatch per
             block advances the whole group (see _packing module docstring).
@@ -434,40 +464,14 @@ class BaseIncrementalSearchCV(TPUEstimator):
                 Xb, yb = blocks[(calls0 + j) % n_blocks]
                 cohort.step(Xb, yb)
             t_fit_end = time.time()  # scoring must not inflate pf_time
-            # packed scoring: with the default (accuracy) scorer the whole
-            # cohort scores as ONE vmapped dispatch + one (M,) fetch,
-            # instead of M separate model.score round-trips — and it is
-            # the multi-controller-safe form (single collective program).
-            packed_scores = None
-            if self.scoring is None:
-                try:
-                    t0s = time.time()
-                    packed_scores = cohort.packed_accuracy(X_test, y_test)
-                    packed_score_time = (
-                        (time.time() - t0s) / max(len(idents), 1)
-                    )
-                except (TypeError, ValueError):
-                    packed_scores = None  # non-classifier/custom: fall back
+            packed_scores, packed_score_time = _score_cohort(cohort, idents)
             cohort.finalize()
             # train_one semantics: partial_fit_time is the duration of ONE
             # model's ONE block call — amortize the cohort-wide wall time
             # over (models x calls) so packed and unpacked timings compare
             pf_time = (t_fit_end - t0) / max(n_calls * len(idents), 1)
-            for i, ident in enumerate(idents):
-                model, meta = models[ident]
-                meta = dict(meta)
-                meta["partial_fit_calls"] += n_calls
-                meta["partial_fit_time"] = pf_time
-                if packed_scores is not None:
-                    with _PACKED_SCORE_SYNC.allow():
-                        # graftlint: disable=host-sync-loop -- packed_scores is host numpy already: packed_accuracy fetched the whole (M,) vector in ONE round-trip
-                        meta["score"] = float(packed_scores[i])
-                    meta["score_time"] = packed_score_time
-                else:
-                    meta = _score((model, meta), X_test, y_test, scorer)
-                meta["elapsed_wall_time"] = time.time() - start_time
-                models[ident] = (model, meta)
-                info[ident].append(meta)
+            _finish_cohort(idents, n_calls, pf_time, packed_scores,
+                           packed_score_time)
 
         def pack_groups(instructions):
             """Group instructed models by (static config, budget, step
@@ -581,16 +585,214 @@ class BaseIncrementalSearchCV(TPUEstimator):
             finally:
                 hb.retire()
 
+        # -- concurrent orchestrator unit bodies (design.md §17) ---------
+        # These run ONLY on the blessed ``dask-ml-tpu-search`` loop
+        # thread (_orchestrator.run_search): every device dispatch stays
+        # on this one thread, staging rides the per-unit UnitStream
+        # (prefetch worker / pool threads, host-only), and units yield
+        # between block dispatches so sibling units — and sibling
+        # Hyperband brackets on the same loop — keep the device fed.
+
+        async def _drive_stream(sched, stream):
+            """Interleaved consume loop of one unit's staged feed:
+            await the next staged block off-thread, take a dispatch
+            turn (graftscope in-flight throttle), dispatch."""
+            try:
+                while True:
+                    item = await sched.stage(stream.next_staged)
+                    if item is stream.DONE:
+                        return
+                    await sched.turn()
+                    stream.consume(item)
+            finally:
+                stream.close()
+
+        def _unit_stream(sched, consumer, blocks_iter, unit_span):
+            from ..pipeline import UnitStream
+            from ..resilience.elastic import ElasticPolicy
+
+            return UnitStream(
+                consumer, blocks_iter, depth=prefetch_depth,
+                fit_kwargs=fit_params, label="search_ingest",
+                # burst recovery draws from the fit-wide budget
+                elastic=ElasticPolicy(budget=self._fault_budget,
+                                      label="search_ingest"),
+                parent_span=unit_span)
+
+        async def _single_body(sched, ident, n_calls, unit_span):
+            model, meta = models[ident]
+            calls0 = meta["partial_fit_calls"]
+            _warm_unit(model, calls0, n_calls)
+            t0 = time.time()
+            if n_calls > 0 and hasattr(model, "_pf_stage") \
+                    and hasattr(model, "_pf_consume"):
+                # NO _san.region here, unlike train_one: regions are a
+                # thread-local STACK, and interleaved unit coroutines
+                # on the one dispatcher thread would cross-attribute
+                # and corrupt it (the detached-span problem, which
+                # regions don't solve) — orchestrated units attribute
+                # at the scope level instead
+                await _drive_stream(sched, _unit_stream(
+                    sched, model,
+                    (block_for(model, (calls0 + j) % n_blocks)
+                     for j in range(n_calls)),
+                    unit_span))
+                meta = dict(meta)
+                meta["partial_fit_calls"] += n_calls
+                # train_one semantics: partial_fit_time is ONE call's
+                # duration — amortize the streamed burst over its calls
+                meta["partial_fit_time"] = \
+                    (time.time() - t0) / max(n_calls, 1)
+            else:
+                for _ in range(n_calls):
+                    await sched.turn()
+                    block_idx = meta["partial_fit_calls"] % n_blocks
+                    Xb, yb = block_for(model, block_idx)
+                    model, meta = _partial_fit(
+                        (model, meta), Xb, yb, fit_params
+                    )
+            await sched.turn()  # the score is a dispatch + fetch too
+            meta = _score((model, meta), X_test, y_test, scorer)
+            meta["elapsed_wall_time"] = time.time() - start_time
+            models[ident] = (model, meta)
+            info[ident].append(meta)
+            return meta
+
+        async def _cohort_body(sched, idents, n_calls, unit_span):
+            from ._packing import Cohort
+
+            cohort = Cohort(
+                [models[i][0] for i in idents],
+                classes=(fit_params or {}).get("classes"),
+            )
+            calls0 = models[idents[0]][1]["partial_fit_calls"]
+            t0 = time.time()
+            # no _san.region: see _single_body (thread-local stack vs
+            # interleaved coroutines)
+            await _drive_stream(sched, _unit_stream(
+                sched, cohort,
+                (blocks[(calls0 + j) % n_blocks]
+                 for j in range(n_calls)),
+                unit_span))
+            t_fit_end = time.time()  # scoring must not inflate pf_time
+            await sched.turn()
+            packed_scores, packed_score_time = _score_cohort(cohort, idents)
+            cohort.finalize()
+            pf_time = (t_fit_end - t0) / max(n_calls * len(idents), 1)
+            _finish_cohort(idents, n_calls, pf_time, packed_scores,
+                           packed_score_time)
+
+        async def run_unit_async(sched, body_factory, unit_ids, n_calls):
+            """Async twin of :func:`run_unit`: the same round-start
+            snapshot rollback, the same ``search-unit`` fault books and
+            fit-wide :class:`FaultBudget` draw, the same supervisor
+            heartbeat — but a failed unit REQUEUES (re-enters this
+            round's gather after yielding) instead of stalling its
+            siblings while it recovers.  One requeue; a second failure
+            propagates loudly, exactly the sync contract.
+
+            The bookkeeping below deliberately mirrors
+            :func:`resilience.retry.retry` (retries=1, no backoff) —
+            an awaitable body cannot ride the sync primitive.  The
+            parity contract (faults == retries + failures per tag,
+            budget drawn only when a retry is scheduled, retry/failure
+            obs events) is PINNED by tests/test_search_orchestrator.py
+            ::TestFaultParity against the same assertions
+            tests/test_fault_injection.py holds the sync path to — a
+            change to the shared primitive's accounting must update
+            both or those tests disagree."""
+            import copy
+
+            from ..resilience import supervisor as _supervisor
+            from ..resilience.retry import fault_stats as _fault_stats
+
+            snapshot = {i: copy.deepcopy(models[i]) for i in unit_ids}
+            info_snapshot = {i: len(info[i]) for i in unit_ids}
+            stats = _fault_stats()
+            hb = _supervisor.register(
+                f"search-unit:{'-'.join(map(str, unit_ids))}", "search")
+            attempt = 0
+            try:
+                while True:
+                    try:
+                        # a DETACHED span: interleaved units on one loop
+                        # thread must never stack-parent (design.md §11)
+                        with _obs.span("search.unit",
+                                       parent=round_span["id"],
+                                       detached=True,
+                                       models=len(unit_ids),
+                                       n_calls=n_calls,
+                                       prefix=self.prefix) as us:
+                            hb.beat()
+                            return await body_factory(
+                                us.span_id or round_span["id"])
+                    except Exception as exc:
+                        stats.record_fault("search-unit")
+                        with self._fit_failures_lock:
+                            self._fit_failures += len(unit_ids)
+                        for i in unit_ids:
+                            models[i] = snapshot[i]
+                            del info[i][info_snapshot[i]:]
+                        if attempt >= 1 or \
+                                not self._fault_budget.acquire(
+                                    "search-unit"):
+                            stats.record_failure("search-unit")
+                            _obs.event("resilience.failure",
+                                       tag="search-unit", attempt=attempt,
+                                       error=_obs.fmt_exc(exc))
+                            raise
+                        stats.record_retry("search-unit")
+                        _obs.event("resilience.retry", tag="search-unit",
+                                   attempt=attempt,
+                                   error=_obs.fmt_exc(exc))
+                        sched.note_requeue()
+                        attempt += 1
+                        await asyncio.sleep(0)  # requeue: siblings first
+            finally:
+                hb.retire()
+
         async def run_round(instructions):
             """Fan this round's training units over the shared thread pool
             so independent models — and, above us, concurrent Hyperband
             brackets on the same event loop — overlap in WALL CLOCK, not
             just cooperatively (reference: the futures plane gets this from
             the cluster; host sklearn fits release the GIL in C kernels and
-            device fits overlap via JAX async dispatch)."""
+            device fits overlap via JAX async dispatch).
+
+            On the orchestrated path (this coroutine running on the
+            blessed ``dask-ml-tpu-search`` loop — see
+            :mod:`._orchestrator`) device units instead become
+            coroutines interleaved at BLOCK granularity on this one
+            dispatch thread: while one unit's step program runs, the
+            next unit's staged block dispatches and further units'
+            blocks parse + H2D-stage on the host workers."""
+            from . import _orchestrator as _orch
+
             loop = asyncio.get_running_loop()
             pool = _train_executor()
             packed, singles = pack_groups(instructions)
+            sched = _orch.current_scheduler()
+            if sched is not None:
+                coros = [
+                    run_unit_async(
+                        sched,
+                        lambda us, idents=list(idents), n=n_calls:
+                            _cohort_body(sched, idents, n, us),
+                        list(idents), n_calls)
+                    for (key, n_calls, _), idents in
+                    sorted(packed.items(), key=lambda kv: repr(kv[0]))
+                ]
+                coros += [
+                    run_unit_async(
+                        sched,
+                        lambda us, ident=ident, n=n_calls:
+                            _single_body(sched, ident, n, us),
+                        [ident], n_calls)
+                    for ident, n_calls in sorted(singles)
+                ]
+                if coros:
+                    await asyncio.gather(*coros)
+                return
             # mesh scoping is thread-local: re-establish the CALLER's mesh
             # inside each worker so device-native fits keep the fleet/user
             # mesh instead of falling back to the all-devices default
@@ -637,14 +839,23 @@ class BaseIncrementalSearchCV(TPUEstimator):
             if futs:
                 await asyncio.gather(*futs)
 
+        def _record_round(t0_round: float) -> None:
+            # per-round latency feeds the `search.round_s` histogram the
+            # committed `search_util` perf workload ratchets (p50/p99
+            # round latency under search load, design.md §17)
+            _obs.registry().histogram("search.round_s").record(
+                time.perf_counter() - t0_round)
+
         # initial round: one call each (skipped when resuming — the
         # snapshot already contains at least the initial round)
         if not resumed:
+            t0_round = time.perf_counter()
             with _obs.span("search.round", parent=fit_parent,
                            detached=True, round=0,
                            models=len(models)) as rs:
                 round_span["id"] = rs.span_id or fit_parent
                 await run_round({ident: 1 for ident in models})
+            _record_round(t0_round)
             if ckpt is not None:
                 ckpt.save(models, info, self._capture_policy_state(),
                           elapsed=time.time() - start_time)
@@ -672,12 +883,14 @@ class BaseIncrementalSearchCV(TPUEstimator):
             if not instructions:
                 break
             round_no += 1
+            t0_round = time.perf_counter()
             with _obs.span("search.round", parent=fit_parent,
                            detached=True, round=round_no,
                            models=sum(1 for v in instructions.values()
                                       if v > 0)) as rs:
                 round_span["id"] = rs.span_id or fit_parent
                 await run_round(instructions)
+            _record_round(t0_round)
             if ckpt is not None:
                 ckpt.save(models, info, self._capture_policy_state(),
                           elapsed=time.time() - start_time)
@@ -725,13 +938,19 @@ class BaseIncrementalSearchCV(TPUEstimator):
         return self
 
     def fit(self, X, y=None, **fit_params):
+        from . import _orchestrator as _orch
+
         X_train, X_test, y_train, y_test = self._split(X, y)
-        # asyncio.run blocks this thread, so a regular stack span is the
-        # whole-search root; the coroutine's detached round spans parent
-        # under it via fit_parent (see _fit)
+        # the search loop blocks this thread either way (asyncio.run
+        # here, or a join on the blessed orchestrator thread), so a
+        # regular stack span is the whole-search root; the coroutine's
+        # detached round spans parent under it via fit_parent (see
+        # _fit — run_search's adopt() carries the id across the hop)
         with _obs.span("search.fit", search=type(self).__qualname__):
-            models, info = asyncio.run(
-                self._fit(X_train, y_train, X_test, y_test, **fit_params)
+            models, info = _orch.run_search(
+                lambda: self._fit(X_train, y_train, X_test, y_test,
+                                  **fit_params),
+                threaded=_orch.device_concurrency(self.estimator),
             )
         return self._process_results(models, info)
 

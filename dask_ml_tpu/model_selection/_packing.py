@@ -36,8 +36,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import programs as _programs
 from ..core.mesh import DATA_AXIS, MODEL_AXIS, get_mesh
-from ..linear_model._sgd import SGDClassifier, SGDRegressor, sgd_step
+from ..linear_model._sgd import _HYPER_KEYS, SGDClassifier, SGDRegressor, \
+    sgd_step
 
 __all__ = ["pack_key", "Cohort", "DISPATCH_STATS", "reset_dispatch_stats"]
 
@@ -112,13 +114,8 @@ def _packed_accuracy_jit(rep_sharding):
     return jax.jit(_packed_accuracy_impl, out_shardings=rep_sharding)
 
 
-@partial(
-    jax.jit,
-    static_argnames=("loss", "penalty", "schedule", "fit_intercept"),
-    donate_argnames=("states",),
-)
-def _packed_step(states, xb, yb, mask, hypers, *, loss, penalty, schedule,
-                 fit_intercept):
+def _packed_step_impl(states, xb, yb, mask, hypers, *, loss, penalty,
+                      schedule, fit_intercept):
     """vmap of the single-model fused step over the stacked model axis.
     Data (xb/yb/mask) is broadcast; states and hyperparameters carry the
     model axis.  One XLA program, M models."""
@@ -131,6 +128,20 @@ def _packed_step(states, xb, yb, mask, hypers, *, loss, penalty, schedule,
     return jax.vmap(step, in_axes=(0, None, None, 0, 0))(
         states, xb, yb, mask, hypers
     )
+
+
+# One compiled program per (statics, M, shapes); the stacked state is
+# donated so the whole cohort advances in place in HBM.  Routed through
+# the central program cache (design.md §12) so the concurrent search
+# orchestrator can WARM the next round's re-packed signature on the
+# blessed compile-ahead thread (``Cohort.warm``) and graftscope
+# attributes the packed program's device time + roofline cost under its
+# own name.
+_packed_step = _programs.cached_program(
+    _packed_step_impl, name="search.packed_step",
+    static_argnames=("loss", "penalty", "schedule", "fit_intercept"),
+    donate_argnames=("states",),
+)
 
 
 def _model_sharding(mesh, ndim):
@@ -163,6 +174,12 @@ class Cohort:
         self._classes = classes
         self._stacked = None
         self._losses = None
+        # captured HERE (the dispatch thread, under the caller's mesh
+        # scope): warm() runs on the prefetch worker, whose thread-local
+        # mesh would read as the default — the model-axis width decides
+        # whether _stack() will shard (and so whether a shape-struct
+        # warm can ever match the real signature)
+        self._model_ax = get_mesh().shape.get(MODEL_AXIS, 1)
 
     # -- target prep (shared across the cohort: same y, same classes) ----
     def _prep(self, X, y, with_weights=True):
@@ -240,9 +257,9 @@ class Cohort:
                 )
         return stacked, hypers
 
-    def step(self, X, y):
-        """Advance every model in the cohort by one block: ONE dispatch."""
-        xb, yb, masks, _base = self._prep(X, y)
+    def _advance(self, xb, yb, masks):
+        """The device half every training entry funnels through: stack
+        lazily, dispatch ONE packed step, book the stats."""
         if self._stacked is None:
             self._stacked, self._hypers = self._stack()
         m0 = self._m0
@@ -254,6 +271,103 @@ class Cohort:
         DISPATCH_STATS["dispatches"] += 1
         DISPATCH_STATS["models_stepped"] += len(self.models)
         return self
+
+    def step(self, X, y):
+        """Advance every model in the cohort by one block: ONE dispatch."""
+        xb, yb, masks, _base = self._prep(X, y)
+        return self._advance(xb, yb, masks)
+
+    def partial_fit(self, X, y=None, **kwargs):
+        """Duck-type the estimator surface for the shared pipeline
+        discipline: a cohort consumes ``(X, y)`` blocks exactly like a
+        single model (``classes`` already rode in at construction —
+        extra fit kwargs are the single-model plane's concern and were
+        validated before the cohort was packed)."""
+        return self.step(X, y)
+
+    # -- staged streaming protocol (pipeline.UnitStream) -----------------
+    def _pf_stage(self, X, y, classes=None, sample_weight=None, **kwargs):
+        """Host parse → target encode → bucket-pad → device upload for
+        ONE cohort block; returns the staged ``(xb, yb, mask)`` payload
+        for :meth:`_pf_consume`, or None to decline THAT block (the
+        pipeline then routes it through :meth:`partial_fit` on the
+        dispatch thread).  Declines device-resident blocks (staging them
+        would dispatch programs off-thread — the PR-1 deadlock class),
+        per-call weighting, and weighted members (their per-lane masks
+        are a device program).  Safe on the prefetch worker thread:
+        pure host work plus H2D puts."""
+        from ..core.sharded import ShardedRows
+
+        if (kwargs or sample_weight is not None or y is None
+                or isinstance(X, (ShardedRows, jnp.ndarray))
+                or isinstance(y, (ShardedRows, jnp.ndarray))
+                or any(getattr(m, "class_weight", None) is not None
+                       for m in self.models)):
+            return None
+        m0 = self._m0
+        if isinstance(m0, SGDClassifier):
+            if not hasattr(m0, "classes_"):
+                cls = classes if classes is not None else self._classes
+                if cls is None:
+                    return None  # first consume derives classes serially
+                for m in self.models:
+                    if not hasattr(m, "classes_"):
+                        m._set_classes(cls)
+            targets = m0._encode_targets(np.asarray(y))
+        else:
+            targets = m0._targets_host(y)
+        staged = m0._prep_block_host(X, targets)
+        # compile-ahead: the re-packed round's stacked program builds on
+        # the blessed compile thread while the previous block computes
+        self.warm(staged[0].shape, staged[1].shape[1])
+        return staged
+
+    def _pf_consume(self, staged):
+        """Device step on a block pre-staged by :meth:`_pf_stage` — the
+        shared ``mask`` broadcasts over the model axis here (weighted
+        cohorts declined at stage time).  Dispatch-thread only."""
+        xb, yb, mask = staged
+        for m in self.models:
+            m._ensure_state(xb.shape[1])
+        masks = jnp.broadcast_to(mask, (len(self.models),) + mask.shape)
+        return self._advance(xb, yb, masks)
+
+    # -- compile-ahead (programs.ahead; design.md §12/§17) ---------------
+    def warm(self, xshape, k) -> bool:
+        """Enqueue an ahead-of-time compile of the packed step for a
+        staged block of shape ``xshape`` (already bucketed) and ``k``
+        output columns — the re-pack twin of ``_BaseSGD._warm_step``,
+        keyed by the cohort size too (every halving round's survivor
+        re-pack is a NEW stacked signature).  Pure host work (shape
+        structs + a queue put): safe from the prefetch worker."""
+        if not _programs.compile_ahead_enabled():
+            return False
+        m0 = self._m0
+        M = len(self.models)
+        if self._model_ax > 1 and M % self._model_ax == 0:
+            # _stack() will device_put the stacked state with a
+            # MODEL_AXIS NamedSharding — a signature these plain shape
+            # structs cannot predict (cache._leaf_key keys sharding),
+            # so the warm would compile a program no dispatch ever hits
+            return False
+        b, d = int(xshape[0]), int(xshape[1])
+        k = int(k)
+        key = (M, b, d, k, m0.loss, m0.penalty, m0.learning_rate,
+               m0.fit_intercept)
+        if getattr(self, "_warm_memo", None) == key:
+            return False
+        self._warm_memo = key
+        f32 = jnp.float32
+        sds = jax.ShapeDtypeStruct
+        states = {"coef": sds((M, d, k), f32),
+                  "intercept": sds((M, k), f32), "t": sds((M,), f32)}
+        hypers = {name: sds((M,), f32) for name in _HYPER_KEYS}
+        return _packed_step.warm(
+            (states, sds((b, d), f32), sds((b, k), f32),
+             sds((M, b), f32), hypers),
+            loss=m0.loss, penalty=m0.penalty, schedule=m0.learning_rate,
+            fit_intercept=m0.fit_intercept,
+        )
 
     def packed_accuracy(self, X, y):
         """All M models' held-out accuracies as ONE vmapped program and

@@ -358,6 +358,42 @@ def timeline(since: int | None = None, open_until: float | None = None):
     return out
 
 
+def _search_section() -> dict | None:
+    """The adaptive-search registry families (``search.*`` —
+    model_selection, design.md §17), rendered next to the device
+    occupancy they budget against.  ``round_s`` records for EVERY
+    search path (the sequential loop included); the scheduler families
+    — ``dispatch_turns``, ``throttled``, ``queue_wait_s``,
+    ``requeues``, the ``inflight`` gauge — appear only when the
+    concurrent orchestrator actually ran (their absence next to
+    ``round_s`` means the searches took the serialized path).  None
+    when no search ran in this process (the section must not invent an
+    empty story).  Pure registry reads — host-only, scrape-safe."""
+    reg = _registry()
+    counters: dict = {}
+    gauges: dict = {}
+    hists: dict = {}
+    for name, tag, inst in reg.export_items():
+        if not name.startswith("search."):
+            continue
+        key = f"{name[len('search.'):]}" + (f"{{{tag}}}" if tag else "")
+        snap = getattr(inst, "snapshot", None)
+        if callable(snap):
+            s = snap()
+            hists[key] = {k: s[k] for k in ("count", "sum", "p50", "p99")
+                          if k in s}
+        elif type(inst).__name__ == "Gauge":
+            gauges[key] = inst.value
+        else:
+            counters[key] = inst.value
+    if not (counters or gauges or hists):
+        return None
+    out: dict = dict(sorted(counters.items()))
+    out.update(sorted(gauges.items()))
+    out.update(sorted(hists.items()))
+    return out
+
+
 def _merge(intervals):
     """Union-merge sorted-by-t0 intervals -> (busy_s, merged, gaps)."""
     merged: list[list[float]] = []
@@ -399,7 +435,15 @@ def device_report(since: int | None = None, *, settle_s: float = 0.0,
     against the peak table; the top-level ``roofline`` block names the
     platform and peaks (with provenance) those fractions used — absent
     when the platform is undetected, None fractions when peaks are
-    unknown (honesty over invention)."""
+    unknown (honesty over invention).
+
+    When an adaptive search has run in this process, a ``search`` block
+    rides along (``search.*`` registry families — per-round latency for
+    every search path, plus the orchestrator's dispatch turns, throttle
+    events, requeues, in-flight gauge, and queue-wait when the
+    CONCURRENT plane ran): the scheduler budgets against exactly this
+    report's idle time, so its books belong next to the occupancy they
+    defend (design.md §17)."""
     if settle_s > 0:
         settle(settle_s)
     ivs = timeline(since)
@@ -424,10 +468,14 @@ def device_report(since: int | None = None, *, settle_s: float = 0.0,
         w = work.get(name)
         if w is not None:
             p.update(_roofline.attribution(w[0], w[1], w[2], peaks))
+    search = _search_section()
     if not ivs:
-        return {"dispatches": 0, "busy_s": 0.0, "window_s": 0.0,
-                "idle_s": 0.0, "utilization": 0.0, "idle_gaps": [],
-                "programs": {}, "pending": pending_count()}
+        out = {"dispatches": 0, "busy_s": 0.0, "window_s": 0.0,
+               "idle_s": 0.0, "utilization": 0.0, "idle_gaps": [],
+               "programs": {}, "pending": pending_count()}
+        if search is not None:
+            out["search"] = search
+        return out
     busy, merged, gaps = _merge(ivs)
     window = max(iv["t1"] for iv in ivs) - ivs[0]["t0"]
     gaps.sort(key=lambda g: -g["dur_s"])
@@ -444,6 +492,8 @@ def device_report(since: int | None = None, *, settle_s: float = 0.0,
     }
     if platform is not None:
         out["roofline"] = {"platform": platform, "peaks": peaks}
+    if search is not None:
+        out["search"] = search
     return out
 
 

@@ -8,6 +8,7 @@ See :mod:`dask_ml_tpu.pipeline.core` for the overlap design and
 from .core import (  # noqa: F401
     DEPTH_ENV,
     PREFETCH_THREAD_NAME,
+    UnitStream,
     prefetch_blocks,
     resolve_depth,
     stream_partial_fit,
@@ -21,6 +22,7 @@ from .stats import (  # noqa: F401
 __all__ = [
     "DEPTH_ENV",
     "PREFETCH_THREAD_NAME",
+    "UnitStream",
     "resolve_depth",
     "prefetch_blocks",
     "stream_partial_fit",

@@ -68,6 +68,7 @@ from .stats import PipelineStats
 __all__ = [
     "DEPTH_ENV",
     "PREFETCH_THREAD_NAME",
+    "UnitStream",
     "resolve_depth",
     "prefetch_blocks",
     "stream_partial_fit",
@@ -162,8 +163,15 @@ def _parse_and_stage(src, stage, stats: PipelineStats, blk: int,
     return staged
 
 
+#: sentinel: `_staged_iter`'s trace_parent default — "capture the
+#: consumer's innermost open span at first next()", the historical
+#: behavior; an orchestrating caller passes its unit span id instead
+#: (its first next() runs on a helper thread with an empty stack).
+_CAPTURE_PARENT = object()
+
+
 def _staged_iter(src, stage, depth: int, stats: PipelineStats,
-                 policy: ElasticPolicy):
+                 policy: ElasticPolicy, trace_parent=_CAPTURE_PARENT):
     """Yield ``stage(item)`` for each item of ``src``, staged up to
     ``depth`` blocks ahead on a host worker thread, under the elastic
     restart driver.
@@ -202,12 +210,28 @@ def _staged_iter(src, stage, depth: int, stats: PipelineStats,
             state["blk"] += 1
         return verdict
 
+    # thread stitching (design.md §11): the worker's parse/stage spans
+    # attach under the consumer's innermost open span (the
+    # pipeline.stream span) instead of becoming orphan roots — this
+    # generator body runs on the consumer thread at first next(), so
+    # the default capture happens in the right place.  An orchestrated
+    # UnitStream advances the generator from helper threads and passes
+    # its stream-span id explicitly instead.
+    if trace_parent is _CAPTURE_PARENT:
+        trace_parent = obs.current_span_id()
+
     if depth <= 0:
         while True:
             item, state["pending"] = state["pending"], None
             try:
-                staged = _parse_and_stage(src, stage, stats, state["blk"],
-                                          item=item)
+                # adopt: with an empty stack on the advancing thread
+                # (the orchestrated depth-0 case) the parse/stage spans
+                # still attach under the owning stream span; with a
+                # live stack (the classic consumer-thread loop) stack
+                # parentage wins and adopt is inert
+                with obs.adopt(trace_parent):
+                    staged = _parse_and_stage(src, stage, stats,
+                                              state["blk"], item=item)
             except _BlockFault as fault:
                 if _handle(fault) == "retry":
                     state["pending"] = fault.item
@@ -219,12 +243,6 @@ def _staged_iter(src, stage, depth: int, stats: PipelineStats,
 
     # depth >= 1: bounded queue + one host-only staging worker per
     # (re)start — the driver below restarts it on recoverable faults
-    # thread stitching (design.md §11): the worker's parse/stage spans
-    # attach under the consumer's innermost open span (the
-    # pipeline.stream span) instead of becoming orphan roots — this
-    # generator body runs on the consumer thread at first next(), so
-    # the capture happens in the right place
-    trace_parent = obs.current_span_id()
 
     while True:
         q: queue.Queue = queue.Queue(maxsize=depth)
@@ -378,6 +396,42 @@ def _supports_staging(model) -> bool:
     return hasattr(model, "_pf_stage") and hasattr(model, "_pf_consume")
 
 
+def _protocol_fns(model, kw: dict, staged_proto: bool):
+    """The (stage, consume) pair of one partial_fit stream — THE shared
+    prefetch discipline: ``stage`` runs on the host worker
+    (``_pf_stage`` or identity), ``consume`` on the dispatch thread
+    (``_pf_consume`` or plain ``partial_fit``), with the per-block
+    decline fallback.  Used by :func:`stream_partial_fit` and
+    :class:`UnitStream` so the two planes cannot drift."""
+
+    def _raw_consume(blk):
+        bx, by = blk
+        if by is None:
+            model.partial_fit(bx, **kw)
+        else:
+            model.partial_fit(bx, by, **kw)
+
+    if not staged_proto:
+        return (lambda blk: blk), _raw_consume
+
+    # the raw block rides along ONLY when staging declined (None),
+    # so the fallback can serial-partial_fit exactly that block;
+    # a successfully staged block drops its host copy immediately —
+    # queued memory stays one copy per block, not two
+    def _stage(blk):
+        staged = model._pf_stage(blk[0], blk[1], **kw)
+        return (blk if staged is None else None), staged
+
+    def _consume(item):
+        blk, staged = item
+        if staged is None:
+            _raw_consume(blk)
+        else:
+            model._pf_consume(staged)
+
+    return _stage, _consume
+
+
 def stream_partial_fit(model, blocks, *, depth: int | None = None,
                        fit_kwargs: dict | None = None, on_block=None,
                        label: str = "partial_fit_stream", elastic=None):
@@ -433,34 +487,7 @@ def stream_partial_fit(model, blocks, *, depth: int | None = None,
     policy = elastic if elastic is not None else ElasticPolicy(label=label)
     staged_proto = depth > 0 and _supports_staging(model)
     stats = PipelineStats(label=label, depth=depth, staged=staged_proto)
-
-    def _raw_consume(blk):
-        bx, by = blk
-        if by is None:
-            model.partial_fit(bx, **kw)
-        else:
-            model.partial_fit(bx, by, **kw)
-
-    if staged_proto:
-        # the raw block rides along ONLY when staging declined (None),
-        # so the fallback can serial-partial_fit exactly that block;
-        # a successfully staged block drops its host copy immediately —
-        # queued memory stays one copy per block, not two
-        def _stage(blk):
-            staged = model._pf_stage(blk[0], blk[1], **kw)
-            return (blk if staged is None else None), staged
-
-        def _consume(item):
-            blk, staged = item
-            if staged is None:
-                _raw_consume(blk)
-            else:
-                model._pf_consume(staged)
-    else:
-        def _stage(blk):
-            return blk
-
-        _consume = _raw_consume
+    _stage, _consume = _protocol_fns(model, kw, staged_proto)
 
     def _consume_elastic(item, blk):
         """Step-fault recovery (opt-in, ``policy.step_retries``): retry
@@ -514,3 +541,136 @@ def stream_partial_fit(model, blocks, *, depth: int | None = None,
         finally:
             feed.close()
             stats.finish()
+
+
+class UnitStream:
+    """One training unit's staged block feed, consumption handed to an
+    EXTERNAL orchestrator (the concurrent search control plane,
+    design.md §17).
+
+    :func:`stream_partial_fit` owns its whole loop: stage on the
+    worker, consume inline, done.  A scheduler multiplexing MANY units
+    on one dispatch thread needs the same staging discipline with the
+    two halves split apart:
+
+    * :meth:`next_staged` — block (host-only: a queue get against the
+      prefetch worker, or the inline parse+stage at depth 0) until the
+      next staged item is ready; returns :data:`DONE` at exhaustion.
+      Safe on a helper thread — it never dispatches a device program.
+    * :meth:`consume` — run the device step for one staged item.  MUST
+      be called on the orchestrator's one dispatch thread, in source
+      order (the determinism contract is per unit, exactly as in
+      ``stream_partial_fit``).
+
+    Everything else is shared verbatim with the classic stream: the
+    same ``_pf_stage``/``_pf_consume`` protocol (with per-block decline
+    fallback), the same elastic worker-restart policy, the same
+    :class:`~.stats.PipelineStats` books and ``pipeline.block_s``
+    latency histogram, and the same span tree — the stream span is
+    DETACHED under the caller's unit span (``parent_span``), with the
+    worker's parse/stage spans stitched beneath it.
+    """
+
+    #: source-exhausted sentinel returned by :meth:`next_staged`
+    DONE = _DONE
+
+    def __init__(self, model, blocks, *, depth: int | None = None,
+                 fit_kwargs: dict | None = None,
+                 label: str = "search_ingest", elastic=None,
+                 parent_span: int | None = None):
+        kw = dict(fit_kwargs or {})
+        depth = resolve_depth(depth)
+        policy = elastic if elastic is not None else \
+            ElasticPolicy(label=label)
+        staged_proto = depth > 0 and _supports_staging(model)
+        self.model = model
+        self.blocks = 0
+        self._stats = PipelineStats(label=label, depth=depth,
+                                    staged=staged_proto)
+        stage, self._consume = _protocol_fns(model, kw, staged_proto)
+        # detached stream span: entered here (construction, any thread)
+        # and closed at close() — it never touches a thread stack, so
+        # interleaved units cannot cross-link (design.md §11)
+        self._span = obs.span(
+            "pipeline.stream", parent=parent_span, detached=True,
+            label=label, depth=depth, staged=staged_proto,
+            estimator=type(model).__name__)
+        self._span.__enter__()
+        self._parent = self._span.span_id or parent_span
+        self._feed = _staged_iter(iter(blocks), stage, depth,
+                                  self._stats, policy,
+                                  trace_parent=self._parent)
+        self._closed = False
+        # close/advance handshake: an orchestrator cancelled mid-await
+        # calls close() from its loop thread while next_staged() is
+        # still executing the generator on a pool thread — gen.close()
+        # on an executing generator raises and would LEAK the prefetch
+        # worker.  The flag pair defers the actual close to the
+        # in-flight advance's exit (which runs it safely on that
+        # thread the moment next() returns).
+        self._close_lock = threading.Lock()
+        self._advancing = False
+        self._close_deferred = False
+
+    # -- staging half (any host thread) ----------------------------------
+    def next_staged(self):
+        """The next staged item, or :data:`DONE`.  Blocking, host-only."""
+        with self._close_lock:
+            if self._closed:
+                return _DONE
+            self._advancing = True
+        try:
+            try:
+                return next(self._feed)
+            except StopIteration:
+                return _DONE
+        finally:
+            with self._close_lock:
+                self._advancing = False
+                deferred = self._close_deferred
+                self._close_deferred = False
+            if deferred:
+                self._finish_close()
+
+    # -- device half (the orchestrator's dispatch thread) ----------------
+    def consume(self, item) -> None:
+        """Dispatch one staged block's device step (or the serial
+        ``partial_fit`` fallback for a block staging declined)."""
+        t0 = time.perf_counter()
+        with obs.span("pipeline.compute", parent=self._parent,
+                      detached=True, block=self.blocks):
+            self._consume(item)
+        dt = time.perf_counter() - t0
+        self._stats.compute_s += dt
+        self._stats.blocks += 1
+        obs.registry().histogram("pipeline.block_s").record(dt)
+        self.blocks += 1
+
+    def close(self) -> None:
+        """Stop the worker, record the stats, close the stream span.
+        Idempotent; safe from any thread (the classic stream's
+        ``finally``).  If a :meth:`next_staged` is mid-flight on a pool
+        thread, the feed close DEFERS to that call's exit — closing an
+        executing generator would raise and leak the worker."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+            if self._advancing:
+                self._close_deferred = True
+                return
+        self._finish_close()
+
+    def _finish_close(self) -> None:
+        try:
+            self._feed.close()
+        finally:
+            self._stats.finish()
+            self._span.__exit__(None, None, None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -241,6 +241,45 @@ def _wl_mbk_fit():
     return s
 
 
+def _wl_search_concurrent():
+    """The concurrent search control plane under an armed sanitizer
+    (design.md §17): a small multi-bracket Hyperband fit whose brackets
+    interleave as coroutines on the blessed ``dask-ml-tpu-search``
+    dispatch thread, units streaming through per-unit staged feeds and
+    homogeneous survivors re-packing into vmapped cohorts.  The warmup
+    round (the first fit) pays every program — the packed step per
+    cohort size, packed accuracy, the single-model step + score — and
+    the steady round re-runs the IDENTICAL search (same seeds, same
+    shapes, same bracket schedule): zero new compiles, and every
+    dispatch attributed to a blessed thread (the orchestrator loop) or
+    MainThread — a rogue-thread dispatch is a hard violation.  The
+    steady phase runs ``guard=False`` like the whole-fit workloads:
+    each fit re-creates its models (state init + H2D staging are
+    warmup-class work); the compile/dispatch contract is the gate."""
+    from ..linear_model import SGDClassifier
+    from ..model_selection import HyperbandSearchCV
+
+    rng = np.random.RandomState(_SEED)
+    X = rng.normal(size=(256, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.1 * rng.normal(size=256) > 0).astype(np.int32)
+
+    def _fit():
+        from .. import programs
+
+        HyperbandSearchCV(
+            SGDClassifier(random_state=0),
+            {"alpha": [1e-4, 3e-4, 1e-3, 3e-3]},
+            max_iter=4, random_state=0, test_size=0.25, chunk_size=64,
+        ).fit(X, y, classes=np.array([0, 1]))
+        programs.drain_ahead()
+
+    with sanitize(label="search_concurrent") as s:
+        _fit()
+        with s.steady(guard=False):
+            _fit()
+    return s
+
+
 def _wl_glm_fit():
     from ..linear_model import LogisticRegression
 
@@ -266,6 +305,7 @@ WORKLOADS = {
     "kmeans_fit_ckpt": _wl_kmeans_fit_ckpt,
     "mbk_fit": _wl_mbk_fit,
     "glm_fit": _wl_glm_fit,
+    "search_concurrent": _wl_search_concurrent,
 }
 
 

@@ -77,9 +77,10 @@ class ResidentModel:
 
     __slots__ = ("name", "model", "kind", "classes", "coef", "intercept",
                  "host_coef", "host_intercept", "state_bytes", "last_used",
-                 "pack_key", "proba_loss")
+                 "pack_key", "proba_loss", "device_native")
 
     def __init__(self, name: str, model):
+        from ..base import TPUEstimator
         from ..linear_model._sgd import _BaseSGD, SGDClassifier
 
         self.name = str(name)
@@ -90,6 +91,10 @@ class ResidentModel:
         self.last_used = 0
         self.proba_loss = None
         self.pack_key = serve_pack_key(model)
+        # device-native generic estimators (TPUEstimator predicts are
+        # jitted programs) take the bucket-padded dispatch path and get
+        # load-time predict warmup; host sklearn models see raw rows
+        self.device_native = isinstance(model, TPUEstimator)
         if isinstance(model, _BaseSGD):
             if not hasattr(model, "_state"):
                 raise ValueError(
@@ -395,6 +400,7 @@ class ModelRegistry:
         import jax.numpy as jnp
 
         if rm.kind == "generic":
+            self._warm_generic(rm)
             return
         self.ensure_resident(rm)
         d, k = rm.n_features, int(rm.coef.shape[1])
@@ -408,6 +414,40 @@ class ModelRegistry:
             if rm.proba_loss is not None:
                 _sprog.proba(m, loss=rm.proba_loss)  # donates m: fine,
                 # the warm margins buffer is throwaway by construction
+
+    def _warm_generic(self, rm: ResidentModel) -> None:
+        """Load-time predict warmup for device-native GENERIC estimators
+        — the serving twin of the training plane's ``_pf_warm`` hook:
+        the request path for these models is their own (jitted) predict
+        surface over bucket-padded rows (runtime._dispatch_single), so
+        driving predict once per reachable rung HERE, on the admitting
+        serve thread, moves every per-shape compile into the load phase
+        and the steady request path never compiles (the armed-sanitizer
+        contract the SGD family already meets).  Host sklearn models
+        skip: they see raw rows and never compile.  A model that does
+        not expose its feature width cannot be warmed — logged loudly,
+        because its first per-shape request WILL compile."""
+        if not rm.device_native:
+            return
+        d = getattr(rm.model, "n_features_in_", None)
+        if d is None:
+            logger.warning(
+                "serve warmup: generic model %r exposes no "
+                "n_features_in_; its predict programs cannot pre-compile "
+                "and the first request of each batch shape will compile "
+                "on the serve loop (a steady-compile violation under an "
+                "armed sanitizer)", rm.name)
+            return
+        # NO cross-model dedup here, unlike the SGD path: a generic
+        # predict's compiled signature depends on the model's fitted
+        # state shapes (e.g. a (k, d) centers operand — two same-class
+        # models with different k compile different programs), which
+        # this registry cannot enumerate generically.  Re-warming an
+        # already-warm signature costs a few fast dispatches at load —
+        # load is the expensive moment by design; a skipped warm would
+        # be a steady-phase compile, the hard violation.
+        for b in self._rungs():
+            rm.model.predict(np.zeros((b, int(d)), np.float32))
 
     def _warm_pack(self, pack: LanePack) -> None:
         import jax.numpy as jnp

@@ -549,11 +549,23 @@ class ModelServer:
         self.registry.touch(rm)
         probs = None
         if rm.kind == "generic":
-            # host estimators see RAW rows — the same device-native
-            # gate _partial.predict applies: padding a host model's
-            # input wastes its whole-batch compute and is only exact
-            # for strictly row-wise predicts
-            preds = np.asarray(rm.model.predict(X))
+            if rm.device_native:
+                # device-native generics dispatch their own jitted
+                # predict over BUCKET-PADDED rows (the same shared
+                # stage_predict_block discipline, same slice-back
+                # contract) so every request shape resolves to a rung
+                # the load-time warmup already compiled — the steady
+                # request path never compiles for ANY admitted model
+                padded, n = stage_predict_block(X, self.registry.policy)
+                preds = np.asarray(rm.model.predict(padded))
+                if n is not None:
+                    preds = preds[:n]
+            else:
+                # host estimators see RAW rows — the same device-native
+                # gate _partial.predict applies: padding a host model's
+                # input wastes its whole-batch compute and is only
+                # exact for strictly row-wise predicts
+                preds = np.asarray(rm.model.predict(X))
         else:
             # the ONE predict-staging entry the offline plane also
             # uses, so the pad discipline cannot drift between planes

@@ -1,0 +1,430 @@
+"""The concurrent search control plane (ISSUE 13, design.md §17).
+
+Four contracts:
+
+* **Span-tree correctness under interleaved brackets** — every
+  ``search.unit`` span parents under a ``search.round`` of ITS OWN
+  bracket (detached spans with explicit parents; stack parentage would
+  cross-link coroutines interleaving on the one loop thread), at
+  prefetch depth 0 and 2.
+* **Result equality** — the concurrent orchestrator produces the same
+  scores as the sequential single-controller path at rtol 1e-5 (same
+  configs, same seeds, same block order per model).
+* **Dispatch-thread discipline** — orchestrated device work runs on the
+  blessed ``dask-ml-tpu-search`` thread (the BLESSED_DISPATCH_THREADS
+  contract both graftlint and graftsan key on).
+* **Fault semantics parity** — a failed async unit requeues once from
+  its round-start snapshot with the same ``search-unit`` fault books as
+  the thread-pool path, and persistent faults propagate loudly.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import obs
+from dask_ml_tpu.linear_model import SGDClassifier
+from dask_ml_tpu.model_selection import (
+    HyperbandSearchCV,
+    IncrementalSearchCV,
+)
+from dask_ml_tpu.model_selection._orchestrator import (
+    SEARCH_THREAD_NAME,
+    concurrency_enabled,
+    resolve_inflight,
+)
+
+
+@pytest.fixture
+def xy(rng):
+    X = rng.normal(size=(512, 8)).astype(np.float32)
+    w = rng.normal(size=8)
+    y = (X @ w > 0).astype(np.int32)
+    return X, y
+
+
+@pytest.fixture
+def sequential_env(monkeypatch):
+    monkeypatch.setenv("DASK_ML_TPU_SEARCH_CONCURRENCY", "off")
+
+
+def _hyperband(**kw):
+    kw.setdefault("max_iter", 4)
+    kw.setdefault("random_state", 0)
+    kw.setdefault("test_size", 0.25)
+    return HyperbandSearchCV(
+        SGDClassifier(random_state=0),
+        {"alpha": [1e-4, 3e-4, 1e-3, 3e-3]}, **kw,
+    )
+
+
+def _collect(node, name, out):
+    if node is None:
+        return out
+    if node["name"] == name:
+        out.append(node)
+    for c in node.get("children", ()):
+        _collect(c, name, out)
+    return out
+
+
+class TestKnobs:
+    def test_concurrency_strict_parse(self, monkeypatch):
+        monkeypatch.setenv("DASK_ML_TPU_SEARCH_CONCURRENCY", "banana")
+        with pytest.raises(ValueError, match="SEARCH_CONCURRENCY"):
+            concurrency_enabled()
+        monkeypatch.setenv("DASK_ML_TPU_SEARCH_CONCURRENCY", "off")
+        assert concurrency_enabled() is False
+        monkeypatch.delenv("DASK_ML_TPU_SEARCH_CONCURRENCY")
+        assert concurrency_enabled() is True
+
+    def test_inflight_strict_parse(self, monkeypatch):
+        monkeypatch.setenv("DASK_ML_TPU_SEARCH_INFLIGHT", "0")
+        with pytest.raises(ValueError, match="SEARCH_INFLIGHT"):
+            resolve_inflight()
+        monkeypatch.setenv("DASK_ML_TPU_SEARCH_INFLIGHT", "nope")
+        with pytest.raises(ValueError, match="SEARCH_INFLIGHT"):
+            resolve_inflight()
+        monkeypatch.setenv("DASK_ML_TPU_SEARCH_INFLIGHT", "3")
+        assert resolve_inflight() == 3
+
+
+class TestSpanTree:
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_units_parent_under_their_own_bracket(self, xy, depth,
+                                                  monkeypatch):
+        """Interleaved brackets: each bracket's units nest under that
+        bracket's rounds — no cross-linking, at depth 0 and 2."""
+        monkeypatch.setenv("DASK_ML_TPU_PREFETCH_DEPTH", str(depth))
+        X, y = xy
+        obs.clear_spans()
+        _hyperband().fit(X, y, classes=np.array([0, 1]))
+        tree = obs.span_tree()
+        assert tree is not None and tree["name"] == "search.fit"
+        brackets = _collect(tree, "search.bracket", [])
+        assert len(brackets) >= 2, "expected a multi-bracket schedule"
+        seen_units = 0
+        for b in brackets:
+            tag = f"bracket={b['attrs']['bracket']}"
+            rounds = _collect(b, "search.round", [])
+            assert rounds, b["attrs"]
+            for r in rounds:
+                units = _collect(r, "search.unit", [])
+                for u in units:
+                    seen_units += 1
+                    # the unit's own prefix attr names its bracket —
+                    # a cross-linked unit would sit under a round
+                    # whose bracket tag disagrees
+                    assert tag in u["attrs"]["prefix"], (
+                        tag, u["attrs"])
+        assert seen_units >= len(brackets), "no units recorded"
+        # units never leak to the root through stack parentage
+        root_units = [
+            u for u in _collect(tree, "search.unit", [])
+        ]
+        rounds_all = _collect(tree, "search.round", [])
+        units_in_rounds = sum(
+            len(_collect(r, "search.unit", [])) for r in rounds_all)
+        assert len(root_units) == units_in_rounds
+
+    def test_unit_pipeline_spans_nest_under_unit(self, xy, monkeypatch):
+        monkeypatch.setenv("DASK_ML_TPU_PREFETCH_DEPTH", "2")
+        X, y = xy
+        obs.clear_spans()
+        IncrementalSearchCV(
+            SGDClassifier(random_state=0),
+            {"penalty": ["l2", "l1"]}, n_initial_parameters=2,
+            max_iter=2, random_state=0,
+        ).fit(X, y, classes=np.array([0, 1]))
+        tree = obs.span_tree()
+        units = _collect(tree, "search.unit", [])
+        assert units
+        streams = [s for u in units
+                   for s in _collect(u, "pipeline.stream", [])]
+        assert streams, "unit staged feeds must nest under their units"
+
+
+class TestResultEquality:
+    def test_concurrent_matches_sequential(self, xy, monkeypatch):
+        X, y = xy
+        conc = _hyperband(max_iter=9).fit(X, y, classes=np.array([0, 1]))
+        monkeypatch.setenv("DASK_ML_TPU_SEARCH_CONCURRENCY", "off")
+        seq = _hyperband(max_iter=9, sequential_brackets=True).fit(
+            X, y, classes=np.array([0, 1]))
+        assert conc.best_params_ == seq.best_params_
+        np.testing.assert_allclose(
+            np.asarray(conc.cv_results_["test_score"]),
+            np.asarray(seq.cv_results_["test_score"]), rtol=1e-5)
+        assert (conc.cv_results_["partial_fit_calls"]
+                == seq.cv_results_["partial_fit_calls"])
+
+    def test_incremental_depth0_matches_depth2(self, xy, monkeypatch):
+        X, y = xy
+
+        def run(depth):
+            monkeypatch.setenv("DASK_ML_TPU_PREFETCH_DEPTH", str(depth))
+            return IncrementalSearchCV(
+                SGDClassifier(random_state=0),
+                {"alpha": [1e-4, 1e-2]}, n_initial_parameters=2,
+                max_iter=3, random_state=0,
+            ).fit(X, y, classes=np.array([0, 1]))
+
+        a, b = run(0), run(2)
+        np.testing.assert_allclose(
+            np.asarray(a.cv_results_["test_score"]),
+            np.asarray(b.cv_results_["test_score"]), rtol=1e-5)
+
+
+class TestDispatchDiscipline:
+    def test_device_work_runs_on_blessed_search_thread(self, xy):
+        import threading
+
+        seen = set()
+
+        class SpySGD(SGDClassifier):
+            def _pf_consume(self, staged):
+                seen.add(threading.current_thread().name)
+                return super()._pf_consume(staged)
+
+        X, y = xy
+        IncrementalSearchCV(
+            SpySGD(random_state=0), {"penalty": ["l2", "l1"]},
+            n_initial_parameters=2, max_iter=2, random_state=0,
+        ).fit(X, y, classes=np.array([0, 1]))
+        assert seen == {SEARCH_THREAD_NAME}, seen
+
+    def test_off_switch_restores_caller_thread(self, xy, sequential_env):
+        import threading
+
+        seen = set()
+
+        class SpySGD(SGDClassifier):
+            def _pf_consume(self, staged):
+                seen.add(threading.current_thread().name)
+                return super()._pf_consume(staged)
+
+        X, y = xy
+        IncrementalSearchCV(
+            SpySGD(random_state=0), {"penalty": ["l2", "l1"]},
+            n_initial_parameters=2, max_iter=2, random_state=0,
+        ).fit(X, y, classes=np.array([0, 1]))
+        assert seen == {threading.current_thread().name}, seen
+
+    def test_scheduler_books_land_in_device_report(self, xy):
+        from dask_ml_tpu import diagnostics
+        from dask_ml_tpu.obs import scope
+
+        diagnostics.reset()
+        X, y = xy
+        IncrementalSearchCV(
+            SGDClassifier(random_state=0), {"alpha": [1e-4, 1e-2]},
+            n_initial_parameters=2, max_iter=2, random_state=0,
+        ).fit(X, y, classes=np.array([0, 1]))
+        rep = scope.device_report()
+        assert "search" in rep
+        assert rep["search"]["dispatch_turns"] > 0
+        assert rep["search"]["round_s"]["count"] >= 2
+
+    def test_search_section_absent_without_search(self):
+        from dask_ml_tpu import diagnostics
+        from dask_ml_tpu.obs import scope
+
+        diagnostics.reset()
+        assert "search" not in scope.device_report()
+
+    def test_concurrent_fits_serialize_on_one_dispatcher(self, xy):
+        """Two device searches from two user threads: the process-wide
+        dispatcher lock means at most ONE blessed search thread is ever
+        live (graftsan blesses by NAME — two live dispatchers would
+        each look legal while interleaving enqueues), and both fits
+        still complete correctly."""
+        import threading
+
+        live_peak = []
+
+        class SpySGD(SGDClassifier):
+            def _pf_consume(self, staged):
+                live_peak.append(sum(
+                    1 for t in threading.enumerate()
+                    if t.name == SEARCH_THREAD_NAME and t.is_alive()))
+                return super()._pf_consume(staged)
+
+        X, y = xy
+        results = {}
+
+        def fit_one(tag):
+            # heterogeneous statics: units stay unpacked so the spy's
+            # _pf_consume (not the cohort's) observes every dispatch
+            s = IncrementalSearchCV(
+                SpySGD(random_state=0), {"penalty": ["l2", "l1"]},
+                n_initial_parameters=2, max_iter=2, random_state=0,
+            ).fit(X, y, classes=np.array([0, 1]))
+            results[tag] = s.best_score_
+
+        threads = [threading.Thread(target=fit_one, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert set(results) == {0, 1}
+        assert results[0] == pytest.approx(results[1])
+        assert live_peak, "spy saw no dispatches"
+        assert max(live_peak) == 1, max(live_peak)
+
+
+class TestFaultParity:
+    def _faulty(self):
+        from dask_ml_tpu.resilience.testing import maybe_fault
+
+        class FaultySGD(SGDClassifier):
+            def _pf_consume(self, staged):
+                maybe_fault("orchestrated-step")
+                return super()._pf_consume(staged)
+
+        return FaultySGD
+
+    def test_transient_fault_requeues_once(self, xy):
+        from dask_ml_tpu import diagnostics
+        from dask_ml_tpu.resilience import fault_plan
+        from dask_ml_tpu.resilience.retry import fault_stats
+
+        diagnostics.reset()
+        X, y = xy
+        # heterogeneous statics: units stay UNPACKED, so the injection
+        # rides each model's own _pf_consume
+        with fault_plan() as plan:
+            plan.inject("orchestrated-step", at_call=4)
+            search = IncrementalSearchCV(
+                self._faulty()(random_state=0),
+                {"penalty": ["l2", "l1", "elasticnet"]},
+                n_initial_parameters=3, max_iter=3, random_state=0,
+            ).fit(X, y, classes=np.array([0, 1]))
+        assert plan.fired["orchestrated-step"] == 1
+        assert search.fit_failures_ == 1
+        s = fault_stats().snapshot()
+        assert s["faults"].get("search-unit") == 1
+        assert s["retries"].get("search-unit") == 1
+        assert "search-unit" not in s["failures"]
+        reg = obs.registry()
+        assert sum(reg.family("search.requeues").values()) == 1
+
+    def test_transient_fault_recovery_is_exact_state(self, xy):
+        from dask_ml_tpu import diagnostics
+        from dask_ml_tpu.resilience import fault_plan
+
+        X, y = xy
+
+        def run(inject):
+            diagnostics.reset()
+            with fault_plan() as plan:
+                if inject:
+                    plan.inject("orchestrated-step", at_call=4)
+                return IncrementalSearchCV(
+                    self._faulty()(random_state=0),
+                    {"penalty": ["l2", "l1", "elasticnet"]},
+                    n_initial_parameters=3, max_iter=3, random_state=0,
+                ).fit(X, y, classes=np.array([0, 1]))
+
+        clean, faulty = run(False), run(True)
+        assert faulty.fit_failures_ == 1
+        np.testing.assert_allclose(
+            np.asarray(clean.cv_results_["test_score"]),
+            np.asarray(faulty.cv_results_["test_score"]), rtol=1e-5)
+
+    def test_persistent_fault_propagates(self, xy):
+        import threading
+        import time
+
+        from dask_ml_tpu import diagnostics
+        from dask_ml_tpu.resilience import FaultInjected, fault_plan
+        from dask_ml_tpu.resilience.retry import fault_stats
+
+        diagnostics.reset()
+        X, y = xy
+        with fault_plan() as plan:
+            plan.persistent("orchestrated-step")
+            with pytest.raises(FaultInjected):
+                IncrementalSearchCV(
+                    self._faulty()(random_state=0),
+                    {"penalty": ["l2", "l1"]}, n_initial_parameters=2,
+                    max_iter=2, random_state=0,
+                ).fit(X, y, classes=np.array([0, 1]))
+        s = fault_stats().snapshot()
+        assert s["failures"].get("search-unit", 0) >= 1
+        # the abort path tears down units cancelled mid-stage: their
+        # UnitStreams must still stop their prefetch workers (the
+        # deferred-close handshake) — a leaked worker busy-polls its
+        # bounded queue forever
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            leaked = [t for t in threading.enumerate()
+                      if t.name == "dask-ml-tpu-prefetch" and t.is_alive()]
+            if not leaked:
+                break
+            time.sleep(0.05)
+        assert not leaked, leaked
+
+
+class TestCohortStagingProtocol:
+    """Cohort._pf_stage/_pf_consume — the re-pack twin of the SGD
+    staged protocol the orchestrator streams cohorts through."""
+
+    def _cohort(self, n=3, classes=(0, 1)):
+        from dask_ml_tpu.model_selection._packing import Cohort
+
+        models = [SGDClassifier(alpha=10.0 ** -(i + 2), random_state=0)
+                  for i in range(n)]
+        return Cohort(models, classes=np.asarray(classes))
+
+    def test_stage_consume_matches_step(self, rng):
+        X = rng.normal(size=(64, 5)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int32)
+        a, b = self._cohort(), self._cohort()
+        staged = a._pf_stage(X, y)
+        assert staged is not None
+        a._pf_consume(staged)
+        b.step(X, y)
+        for ma, mb in zip(a.finalize(), b.finalize()):
+            np.testing.assert_allclose(
+                np.asarray(ma._state["coef"]),
+                np.asarray(mb._state["coef"]), rtol=1e-6)
+
+    def test_stage_declines_device_blocks(self, rng):
+        from dask_ml_tpu.core.sharded import shard_rows
+
+        X = rng.normal(size=(64, 5)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        cohort = self._cohort(classes=(0.0, 1.0))
+        assert cohort._pf_stage(shard_rows(X), shard_rows(y)) is None
+
+    def test_stage_declines_weighted_members(self, rng):
+        from dask_ml_tpu.model_selection._packing import Cohort
+
+        X = rng.normal(size=(64, 5)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int32)
+        models = [SGDClassifier(class_weight={0: 1.0, 1: 2.0},
+                                alpha=10.0 ** -(i + 2), random_state=0)
+                  for i in range(2)]
+        cohort = Cohort(models, classes=np.array([0, 1]))
+        assert cohort._pf_stage(X, y) is None
+
+    def test_warm_ahead_hits(self, rng):
+        """Cohort.warm pre-builds the re-packed signature on the
+        blessed compile thread and the first packed dispatch HITS it —
+        the programs/ half of the orchestrator lane."""
+        from dask_ml_tpu import programs
+        from dask_ml_tpu.model_selection._packing import _packed_step
+
+        X = rng.normal(size=(48, 7)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int32)
+        cohort = self._cohort(n=4)
+        staged = cohort._pf_stage(X, y)  # stage() warms as a side effect
+        assert staged is not None
+        assert programs.drain_ahead(timeout=30.0)
+        before = dict(_packed_step.counters)
+        cohort._pf_consume(staged)
+        after = _packed_step.counters
+        assert after["misses"] == before["misses"], \
+            "packed dispatch missed the warm-ahead signature"

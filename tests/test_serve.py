@@ -610,3 +610,62 @@ class TestObservability:
             assert len(units) == 2, units
         assert not [u for u in _supervisor.verdicts()
                     if u.startswith("serve:t_dup")]
+
+
+class TestGenericWarmup:
+    """ISSUE 13 satellite: device-native GENERIC estimators get
+    load-time predict warmup + bucket-padded dispatch, so the steady
+    request path never compiles for ANY admitted model — pinned under
+    an armed sanitizer, like the SGD family above."""
+
+    def _fitted_mbk(self, d=6):
+        from dask_ml_tpu.cluster import MiniBatchKMeans
+
+        rng = np.random.RandomState(3)
+        X = rng.normal(size=(256, d)).astype(np.float32)
+        return MiniBatchKMeans(n_clusters=3, random_state=0).fit(X), X
+
+    def test_generic_steady_requests_never_compile(self, sanitizer):
+        mbk, X = self._fitted_mbk()
+        with ModelServer(label="t_generic_warm", window_s=0.0) as srv:
+            srv.load("mbk", mbk)          # warmup: per-rung compiles
+            srv.predict("mbk", X[:1])
+            with sanitizer.steady():
+                # ladder-walking shapes: every request pads to a rung
+                # the load already compiled
+                for n in (1, 3, 7, 16, 33):
+                    got = srv.predict("mbk", X[:n])
+                    assert len(got) == n
+        rep = sanitizer.report()
+        assert rep["totals"]["steady_compiles"] == 0, rep["violations"]
+        assert rep["violations"] == []
+
+    def test_generic_padded_predictions_match_direct(self):
+        mbk, X = self._fitted_mbk()
+        direct = np.asarray(mbk.predict(X[:33]))
+        with ModelServer(label="t_generic_eq", window_s=0.0) as srv:
+            srv.load("mbk", mbk)
+            served = srv.predict("mbk", X[:33])
+        np.testing.assert_array_equal(direct, served)
+
+    def test_host_generic_still_sees_raw_rows(self):
+        """Host sklearn models keep the raw-row path: padding would
+        waste their whole-batch compute (the _partial.predict gate)."""
+        from sklearn.linear_model import LogisticRegression
+
+        rng = np.random.RandomState(5)
+        X = rng.normal(size=(64, 4))
+        y = (X[:, 0] > 0).astype(int)
+        seen = []
+
+        class SpyLR(LogisticRegression):
+            def predict(self, X):
+                seen.append(np.asarray(X).shape[0])
+                return super().predict(X)
+
+        model = SpyLR(max_iter=50).fit(X, y)
+        with ModelServer(label="t_generic_host", window_s=0.0) as srv:
+            srv.load("lr", model)
+            got = srv.predict("lr", X[:5])
+        assert len(got) == 5
+        assert 5 in seen and all(s in (64, 5) for s in seen), seen
