@@ -51,7 +51,7 @@ _RESULT = {
 # so a crashed/wedged run's numbers survive into the next run's JSON.
 _KNOWN_SECTIONS = {
     "lloyd", "admm", "tsqr", "scatter", "pairwise", "streamed", "packed",
-    "csv", "recompile", "serve", "search", "roofline",
+    "csv", "recompile", "serve", "search", "roofline", "ingest",
 }
 ONLY_SECTIONS = {
     s.strip()
@@ -1980,6 +1980,190 @@ def main():
         extra["csv_error"] = traceback.format_exc(limit=3)
 
     section_s["streamed"] = round(time.time() - _t_sec, 1)
+    _t_sec = time.time()
+
+    # --- sharded dataset ingest (data/, design.md §18): the parallel-
+    # reader A/B (1 vs 4 readers over the SAME key-shuffled columnar
+    # dataset — identical stream order by construction, so the arms are
+    # model-equality-checked at rtol 1e-5), CSV vs columnar parse cost,
+    # and the windowed-path VmHWM ceiling.  Two A/B arms: "real" parse
+    # (pread + zlib + decode — on a 1-core gate box the readers compete
+    # for the same core, so this arm is honest but saturation-bound,
+    # same situation as the search section's in-memory pair) and a
+    # remote-store emulation (3 ms/block fetch latency inside each
+    # reader — an object-store GET has RTT the page cache does not),
+    # where reader parallelism is the whole win. ---
+    try:
+        if _want("ingest") and time.time() - _START_TS < _BUDGET_S * 0.95:
+            import shutil
+            import subprocess
+            import tempfile
+
+            from dask_ml_tpu import data as _dsdata
+            from dask_ml_tpu.diagnostics import (
+                pipeline_report, reset_pipeline_stats)
+            from dask_ml_tpu.io import stream_csv_blocks
+            from dask_ml_tpu.linear_model import SGDClassifier
+            from dask_ml_tpu.obs import scope as _ing_scope
+            from dask_ml_tpu.pipeline import stream_partial_fit
+
+            nI, dI = (2_097_152, 32) if on_tpu else (262_144, 16)
+            blkI = 16384  # an `auto` ladder rung: pad-free stream
+            rngI = np.random.RandomState(23)
+            XI = rngI.normal(size=(nI, dI)).astype(np.float32)
+            wI = rngI.normal(size=dI)
+            yI = (XI @ wI > 0).astype(np.int32)
+            ds_dir = tempfile.mkdtemp(prefix="bench-ingest-")
+            try:
+                t0 = time.perf_counter()
+                _dsdata.write_dataset(ds_dir, XI, yI, shards=4,
+                                      block_rows=blkI)
+                write_s = time.perf_counter() - t0
+                ds_bytes = sum(
+                    os.path.getsize(os.path.join(ds_dir, f))
+                    for f in os.listdir(ds_dir))
+
+                # CSV vs columnar parse cost: drain-only rows/s over the
+                # same logical rows (CSV arm scaled down if huge — the
+                # text file for 2M x 32 would be ~1.3 GB)
+                n_csv = min(nI, 262_144)
+                csv_path = os.path.join(ds_dir, "ab.csv")
+                with open(csv_path, "w") as f:
+                    for lo in range(0, n_csv, 16384):
+                        blk = XI[lo:lo + 16384]
+                        f.write("\n".join(
+                            ",".join(f"{v:.6g}" for v in row)
+                            for row in blk) + "\n")
+                t0 = time.perf_counter()
+                got_csv = sum(b.shape[0]
+                              for b in stream_csv_blocks(csv_path, blkI))
+                csv_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                got_col = 0
+                with _dsdata.ShardedDataset(
+                        ds_dir, key=23, readers=1, shuffle=False,
+                        label="bench_ingest_scan").iter_blocks(
+                            epoch=0) as scan:
+                    for xb, _yb in scan:
+                        got_col += xb.shape[0]
+                col_s = time.perf_counter() - t0
+                _record({
+                    "workload": f"ingest_parse_csv_vs_columnar_{dI}d",
+                    "csv_rows": got_csv,
+                    "csv_rows_per_s": round(got_csv / max(csv_s, 1e-9), 1),
+                    "columnar_rows": got_col,
+                    "columnar_rows_per_s": round(
+                        got_col / max(col_s, 1e-9), 1),
+                    "parse_speedup": round(
+                        (got_col / max(col_s, 1e-9))
+                        / max(got_csv / max(csv_s, 1e-9), 1e-9), 2),
+                    "dataset_mb": round(ds_bytes / 1e6, 1),
+                    "write_s": round(write_s, 2),
+                })
+
+                def _fit_arm(readers, latency_s, tag):
+                    """One streamed-fit arm: rows/s + stall + util +
+                    coef for the equality check."""
+                    clf = SGDClassifier(random_state=0)
+                    reset_pipeline_stats()
+                    cur = _ing_scope.cursor()
+                    ds = _dsdata.ShardedDataset(
+                        ds_dir, key=23, readers=readers,
+                        fetch_latency_s=latency_s,
+                        label=f"bench_ingest_{tag}")
+                    t0 = time.perf_counter()
+                    stream_partial_fit(
+                        clf, ds, depth=2,
+                        fit_kwargs={"classes": np.array([0, 1])},
+                        label=f"bench_ingest_{tag}")
+                    dt = time.perf_counter() - t0
+                    rep = pipeline_report()
+                    dev = _ing_scope.device_report(since=cur,
+                                                   settle_s=5.0)
+                    wall = float(rep.get("wall_s", 0.0)) or 1e-9
+                    return {
+                        "rows_per_s": round(nI / max(dt, 1e-9), 1),
+                        "wall_s": round(dt, 3),
+                        "stall_fraction": round(min(
+                            float(rep.get("stall_s", 0.0)) / wall,
+                            1.0), 4),
+                        "device_util": float(dev["utilization"]),
+                    }, np.asarray(clf.coef_, np.float64).ravel()
+
+                # 10 ms/block fetch emulation: conservative against a
+                # same-region object-store GET (tens of ms first-byte)
+                # and large enough to DOMINATE the 1-core box's
+                # serialized zlib parse — at 3 ms the latency share was
+                # too small to overlap into a stable ratio (measured
+                # 1.13-1.51x run to run; parse ~10 ms/block is the
+                # same order, so the A/B measured noise)
+                for tag, lat in (("real", 0.0), ("remote10ms", 0.010)):
+                    # warm arm (compiles paid once, page cache hot)
+                    _fit_arm(1, lat, f"{tag}_warm")
+                    a1, c1 = _fit_arm(1, lat, f"{tag}_r1")
+                    a4, c4 = _fit_arm(4, lat, f"{tag}_r4")
+                    denom = np.maximum(np.abs(c1), 1e-12)
+                    max_rel = float(np.max(np.abs(c4 - c1) / denom))
+                    _record({
+                        "workload": f"ingest_readers_ab_{tag}",
+                        "rows": nI,
+                        "block_rows": blkI,
+                        "r1_rows_per_s": a1["rows_per_s"],
+                        "r4_rows_per_s": a4["rows_per_s"],
+                        "speedup": round(
+                            a4["rows_per_s"]
+                            / max(a1["rows_per_s"], 1e-9), 3),
+                        "r1_stall_fraction": a1["stall_fraction"],
+                        "r4_stall_fraction": a4["stall_fraction"],
+                        "r1_device_util": a1["device_util"],
+                        "r4_device_util": a4["device_util"],
+                        "max_rel_diff": max_rel,
+                        "results_match": bool(max_rel < 1e-5),
+                    })
+
+                # VmHWM ceiling for the windowed dataset path: a child
+                # process streams the whole dataset (readers=4) and
+                # reports its own peak — the 1B-row config's bounded-
+                # host-RAM claim, measured at this geometry (peak must
+                # stay O(window), not O(rows)).
+                child = (
+                    "import numpy as np\n"
+                    "from dask_ml_tpu import data\n"
+                    f"ds = data.ShardedDataset({ds_dir!r}, key=23, "
+                    "readers=4, label='bench_vmhwm')\n"
+                    "rows = sum(xb.shape[0] "
+                    "for xb, yb in ds.iter_blocks(epoch=0))\n"
+                    "peak = ''\n"
+                    "for line in open('/proc/self/status'):\n"
+                    "    if line.startswith('VmHWM'):\n"
+                    "        peak = line.split()[1]\n"
+                    "print(rows, peak)\n"
+                )
+                try:
+                    out = subprocess.run(
+                        [sys.executable, "-c", child],
+                        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                        capture_output=True, text=True, timeout=600,
+                        check=True).stdout.split()
+                    if len(out) >= 2 and out[1]:
+                        _record({
+                            "workload": "ingest_vmhwm_windowed",
+                            "rows": int(out[0]),
+                            "dataset_mb": round(ds_bytes / 1e6, 1),
+                            "vmhwm_mb": round(int(out[1]) / 1024.0, 1),
+                        })
+                except (subprocess.SubprocessError, OSError,
+                        ValueError):
+                    extra["ingest_vmhwm_error"] = \
+                        traceback.format_exc(limit=2)
+            finally:
+                shutil.rmtree(ds_dir, ignore_errors=True)
+    except _SkipSection:
+        pass
+    except Exception:
+        extra["ingest_error"] = traceback.format_exc(limit=3)
+
+    section_s["ingest"] = round(time.time() - _t_sec, 1)
     _t_sec = time.time()
 
     # --- online serving latency (serve/, design.md §15): closed-loop

@@ -34,6 +34,7 @@ from . import linear_model  # noqa: F401
 from . import feature_extraction  # noqa: F401
 from . import impute  # noqa: F401
 from . import io  # noqa: F401
+from . import data  # noqa: F401
 from . import pipeline  # noqa: F401
 from . import ops  # noqa: F401
 from . import naive_bayes  # noqa: F401
@@ -62,6 +63,7 @@ __all__ = [
     "feature_extraction",
     "impute",
     "io",
+    "data",
     "pipeline",
     "ops",
     "naive_bayes",

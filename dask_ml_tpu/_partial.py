@@ -61,12 +61,36 @@ def fit(model, x, y=None, *, chunk_size: int | None = None, shuffle_blocks=False
     ``shuffle_blocks`` is IGNORED — a one-shot stream has no random
     access to permute, and ``Incremental``'s default (True) must not
     make direct reader feeds error; blocks train in stream order.
+
+    ``x`` may also be a sharded dataset (:mod:`dask_ml_tpu.data` —
+    anything with the ``iter_blocks`` protocol): targets ride the
+    dataset's columns, its N parallel readers feed the prefetch worker
+    through the merge queue, and ``shuffle_blocks`` is likewise ignored
+    — the dataset owns the GLOBAL key-derived per-epoch shuffle (every
+    epoch a deterministic permutation; no shuffle buffer in host RAM).
     ``prefetch_depth`` (default: the ``DASK_ML_TPU_PREFETCH_DEPTH``
     knob) overlaps the next block's parse + H2D staging with the
     current block's device step; results are bit-identical at every
     depth.
     """
     from .pipeline import stream_partial_fit
+
+    if hasattr(x, "iter_blocks"):  # sharded dataset (dask_ml_tpu.data)
+        if y is not None:
+            raise ValueError(
+                "with a sharded dataset, y must ride the dataset's "
+                "columns, not be passed separately"
+            )
+        if shuffle_blocks:
+            logger.debug(
+                "shuffle_blocks ignored for a dataset source: the "
+                "dataset owns its key-derived global shuffle"
+            )
+        with obs.span("fit", estimator=type(model).__name__,
+                      source="dataset"):
+            return stream_partial_fit(
+                model, x, depth=prefetch_depth, fit_kwargs=kwargs,
+            )
 
     if hasattr(x, "__next__"):
         if y is not None:
@@ -120,6 +144,18 @@ def fit(model, x, y=None, *, chunk_size: int | None = None, shuffle_blocks=False
         )
 
 
+def _x_only(stream):
+    """Feature blocks of a dataset stream (targets dropped — inference
+    has no use for them); closes the stream's readers on exit."""
+    try:
+        for blk in stream:
+            yield blk[0] if isinstance(blk, tuple) else blk
+    finally:
+        close = getattr(stream, "close", None)
+        if close is not None:
+            close()
+
+
 def stage_predict_block(xb, policy):
     """Host-side bucket pad of ONE predict block: returns ``(block,
     n_real)`` where ``n_real`` is the real row count to slice back from
@@ -166,7 +202,9 @@ def predict(model, x, *, chunk_size: int = 100_000,
     from .base import TPUEstimator
     from .pipeline import prefetch_blocks
 
-    if hasattr(x, "__next__"):
+    if hasattr(x, "iter_blocks"):  # sharded dataset: predict over X
+        blocks = _x_only(x.iter_blocks())
+    elif hasattr(x, "__next__"):
         blocks = x
     else:
         xv = unshard(x) if isinstance(x, ShardedRows) else np.asarray(x)

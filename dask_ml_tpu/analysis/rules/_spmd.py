@@ -109,9 +109,16 @@ BLESSED_DISPATCH_THREADS = frozenset({"dask-ml-tpu-serve",
 # so the dispatch detector raises IN one of these threads at the
 # violating enqueue and a steady compile attributed to one is a hard
 # violation (tests/test_graftscope.py holds both ends together).
+# ``dask-ml-tpu-data-reader`` is the sharded dataset layer's parallel
+# shard readers (data/readers.py, design.md §18): they pread +
+# decompress columnar shard bytes into host numpy blocks for the merge
+# queue and never touch jax — the ``ingest_parallel`` graftsan workload
+# runtime-verifies exactly that (zero compiles/dispatches/transfers
+# attributed to reader threads during a steady fed fit).
 HOST_ONLY_THREAD_NAMES = frozenset({
     "dask-ml-tpu-scope",
     "dask-ml-tpu-metrics",
+    "dask-ml-tpu-data-reader",
 })
 
 

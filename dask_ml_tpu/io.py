@@ -24,6 +24,8 @@ __all__ = [
     "stream_binary_blocks",
     "read_csv_sharded",
     "stream_text_lines",
+    "stream_dataset",
+    "to_columnar",
 ]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
@@ -256,46 +258,102 @@ def stream_binary_blocks(path: str, block_rows: int, n_features: int, *,
         raise ValueError(f"block_rows must be >= 1, got {block_rows}")
     if n_features < 1:
         raise ValueError(f"n_features must be >= 1, got {n_features}")
-    from .resilience.retry import retry as _retry
-    from .resilience.testing import maybe_fault
-
+    if offset_bytes < 0:
+        raise ValueError(f"offset_bytes must be >= 0, got {offset_bytes}")
     row_bytes = 4 * int(n_features)
+    try:
+        total = os.path.getsize(path)
+    except OSError as e:
+        raise OSError(e.errno or 2, e.strerror or "stat failed", path)
     if n_rows is None:
-        try:
-            total = os.path.getsize(path)
-        except OSError as e:
-            raise OSError(e.errno or 2, e.strerror or "stat failed", path)
         n_rows = max(total - int(offset_bytes), 0) // row_bytes
     n_rows = int(n_rows)
+    # up-front extent validation, EAGER (this wrapper runs at call time,
+    # not first next()): a truncated file must fail HERE, not as a short
+    # read in the middle of an epoch — mid-stream the model has already
+    # trained on a partial pass, the worst failure shape
+    need = int(offset_bytes) + n_rows * row_bytes
+    if need > total:
+        raise ValueError(
+            f"{path}: {n_rows} rows x {n_features} float32 features at "
+            f"offset {offset_bytes} needs {need} bytes, file has {total} "
+            f"— truncated file or wrong shape")
 
-    def _read_block(lo, rows):
-        maybe_fault("ingest")
-        return read_binary(
-            path, (rows, int(n_features)),
-            offset_bytes=int(offset_bytes) + lo * row_bytes,
-        )
+    def _blocks():
+        from .resilience.retry import retry as _retry
+        from .resilience.testing import maybe_fault
 
-    for lo in range(0, n_rows, int(block_rows)):
-        rows = min(int(block_rows), n_rows - lo)
-        yield _retry(_read_block, lo, rows, retries=int(retries),
-                     backoff=retry_backoff, deadline=retry_deadline_s,
-                     budget=retry_budget, tag="ingest")
+        def _read_block(lo, rows):
+            maybe_fault("ingest")
+            return read_binary(
+                path, (rows, int(n_features)),
+                offset_bytes=int(offset_bytes) + lo * row_bytes,
+            )
+
+        for lo in range(0, n_rows, int(block_rows)):
+            rows = min(int(block_rows), n_rows - lo)
+            yield _retry(_read_block, lo, rows, retries=int(retries),
+                         backoff=retry_backoff, deadline=retry_deadline_s,
+                         budget=retry_budget, tag="ingest")
+
+    return _blocks()
 
 
-def stream_text_lines(path: str, block_lines: int = 10_000):
+def stream_text_lines(path: str, block_lines: int = 10_000, *,
+                      retries: int = 0, retry_backoff: float = 0.1,
+                      retry_deadline_s: float | None = 120.0,
+                      retry_budget=None):
     """Yield lists of (at most) ``block_lines`` stripped text lines —
     out-of-core text ingest feeding the streaming vectorizers
     (``feature_extraction.text.*.stream_transform``): the file is read
-    incrementally, never whole."""
-    block: list[str] = []
-    with open(path, "r", encoding="utf-8") as f:
-        for line in f:
+    incrementally, never whole.
+
+    ``retries`` re-attempts each BLOCK read on a transient fault with
+    exponential backoff (:func:`dask_ml_tpu.resilience.retry`, tag
+    ``"ingest"`` — the PR-4 ingest contract the numeric streams already
+    carry): reads are byte-offset-addressed, so a failed attempt
+    reopens, seeks to the block's start, and re-reads exactly the same
+    lines — nothing skipped, nothing repeated.  ``retry_deadline_s``
+    wall-clock-bounds each block's retry loop and ``retry_budget``
+    optionally shares the fit-wide
+    :class:`~dask_ml_tpu.resilience.FaultBudget` (see
+    :func:`read_csv`)."""
+    if block_lines < 1:
+        raise ValueError(f"block_lines must be >= 1, got {block_lines}")
+    from .resilience.retry import retry as _retry
+    from .resilience.testing import maybe_fault
+
+    state: dict = {"pos": 0, "f": None}
+
+    def _read_block():
+        maybe_fault("ingest")
+        f = state["f"]
+        if f is None or f.closed:
+            f = state["f"] = open(path, "r", encoding="utf-8")
+        f.seek(state["pos"])
+        # readline (not iteration): line iteration read-ahead makes
+        # tell() illegal, and the saved offset is the retry contract
+        block: list[str] = []
+        while len(block) < block_lines:
+            line = f.readline()
+            if not line:
+                break
             block.append(line.rstrip("\n"))
-            if len(block) >= block_lines:
-                yield block
-                block = []
-    if block:
-        yield block
+        state["pos"] = f.tell()
+        return block
+
+    try:
+        while True:
+            block = _retry(_read_block, retries=int(retries),
+                           backoff=retry_backoff,
+                           deadline=retry_deadline_s, budget=retry_budget,
+                           tag="ingest")
+            if not block:
+                break
+            yield block
+    finally:
+        if state["f"] is not None and not state["f"].closed:
+            state["f"].close()
 
 
 def read_csv_sharded(path: str, *, has_header: bool = False, mesh=None,
@@ -308,3 +366,58 @@ def read_csv_sharded(path: str, *, has_header: bool = False, mesh=None,
                  retry_backoff=retry_backoff),
         mesh,
     )
+
+
+#: file suffixes ``to_columnar`` treats as raw float32 (anything else
+#: parses as CSV)
+_BINARY_SUFFIXES = (".bin", ".raw", ".f32")
+
+
+def to_columnar(path: str, out_dir: str, *, source: str = "auto",
+                n_features: int | None = None, has_header: bool = False,
+                label_col: int | None = None, shards: int = 4,
+                block_rows: int = 4096, compression: str = "zlib"):
+    """Convert a CSV or raw-float32 file into a sharded columnar
+    dataset directory (:mod:`dask_ml_tpu.data`) — one streaming pass,
+    bounded memory, bucket-aligned blocks.
+
+    The columnar form is what repeated epochs should stream: parse cost
+    is paid ONCE here instead of per epoch, blocks are individually
+    addressable (the key-derived shuffle and reader replay need that),
+    and ``block_rows`` (default 4096, an ``auto`` ladder rung) makes
+    ``programs.bucket.pad_block`` a no-op on the hot path.
+    ``label_col`` splits that column off as the target ``y``.
+    Returns the :class:`~dask_ml_tpu.data.DatasetManifest`.
+    """
+    from . import data as _data
+
+    if source == "auto":
+        source = "binary" if path.lower().endswith(_BINARY_SUFFIXES) \
+            else "csv"
+    if source == "csv":
+        return _data.convert_csv(
+            out_dir=out_dir, path=path, has_header=has_header,
+            label_col=label_col, shards=shards, block_rows=block_rows,
+            compression=compression)
+    if source == "binary":
+        if n_features is None:
+            raise ValueError(
+                "to_columnar needs n_features for a raw binary source")
+        return _data.convert_binary(
+            out_dir=out_dir, path=path, n_features=int(n_features),
+            label_col=label_col, shards=shards, block_rows=block_rows,
+            compression=compression)
+    raise ValueError(
+        f"source must be 'auto', 'csv', or 'binary', got {source!r}")
+
+
+def stream_dataset(path, **kwargs):
+    """Open a sharded columnar dataset (a manifest path / dataset
+    directory / :class:`~dask_ml_tpu.data.DatasetManifest`) as a
+    :class:`~dask_ml_tpu.data.ShardedDataset` — the parallel-reader,
+    key-shuffled successor of the single-stream ``stream_*_blocks``
+    generators; feed it to ``_partial.fit`` / ``wrappers.Incremental``
+    / ``pipeline.stream_partial_fit`` directly."""
+    from .data import ShardedDataset
+
+    return ShardedDataset(path, **kwargs)

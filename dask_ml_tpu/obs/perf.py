@@ -225,6 +225,71 @@ def _wl_mbk(depth, inject_s=0.0):
         _row_blocks, depth, inject_s=inject_s)
 
 
+#: ingest_stall calibration: the sharded-dataset feed (4 readers over 4
+#: zlib columnar shards) streaming the same 10 × 16384-row blocks as
+#: the sgd workloads — committed stall ceiling + p50/p99 block latency
+#: under the parallel feed (ISSUE 14).
+_INGEST_READERS = 4
+_INGEST_SHARDS = 4
+
+_ingest_dir: list = []
+
+
+def _ingest_dataset_dir() -> str:
+    """Build (once per process, removed at exit) the perf dataset:
+    ``_BLOCKS`` bucket-rung blocks of (16384, 32) float32 + int32
+    targets, zlib-compressed, spread over 4 shards — so the measured
+    round pays real pread + decompress + decode per block on the reader
+    threads."""
+    import atexit
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from .. import data as _data
+
+    if _ingest_dir:
+        return _ingest_dir[0]
+    d = tempfile.mkdtemp(prefix="graftperf-ds-")
+    rng = np.random.RandomState(_SEED)
+    w = rng.normal(size=_DIM)
+    X = rng.normal(size=(_ROWS, _DIM)).astype(np.float32)
+    y = (X @ w > 0).astype(np.int32)
+    _data.write_dataset(
+        d, np.tile(X, (_BLOCKS, 1)), np.tile(y, _BLOCKS),
+        shards=_INGEST_SHARDS, block_rows=_ROWS, compression="zlib")
+    _ingest_dir.append(d)
+    atexit.register(shutil.rmtree, d, ignore_errors=True)
+    return d
+
+
+def _wl_ingest(inject_s=0.0):
+    """The parallel-ingest SLO, CI-enforced: a depth-2 streamed SGD fit
+    fed by the 4-reader sharded dataset.  Same committed metric shape
+    as the sgd stream workloads — ``p50/p99_block_s`` per consumed
+    block, ``stall_fraction`` the consumer's starve share (the number
+    the parallel readers exist to hold down), ``utilization`` the
+    device-busy share — so the ratchet catches a reader pool that
+    stopped overlapping (stall ceiling) or a merge queue that went
+    quadratic (latency bands)."""
+    import numpy as np
+
+    from .. import data as _data
+    from ..linear_model import SGDClassifier
+
+    dirp = _ingest_dataset_dir()
+
+    def _blocks(offset):
+        return _data.ShardedDataset(
+            dirp, key=_SEED, readers=_INGEST_READERS,
+            label="perf_ingest").iter_blocks(epoch=offset)
+
+    return _run_streamed(
+        lambda: SGDClassifier(random_state=0), _blocks, 2,
+        fit_kwargs={"classes": np.array([0, 1])}, inject_s=inject_s)
+
+
 #: serve_latency calibration: closed-loop request counts (the workload's
 #: ``blocks`` = completed requests, so the shape-drift gate still bites)
 _SERVE_1ROW = 100
@@ -388,6 +453,7 @@ WORKLOADS = {
     "mbk_stream_d2": lambda inject_s=0.0: _wl_mbk(2, inject_s),
     "serve_latency": lambda inject_s=0.0: _wl_serve(inject_s),
     "search_util": lambda inject_s=0.0: _wl_search(inject_s),
+    "ingest_stall": lambda inject_s=0.0: _wl_ingest(inject_s),
 }
 
 

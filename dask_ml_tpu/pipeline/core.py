@@ -69,6 +69,7 @@ __all__ = [
     "DEPTH_ENV",
     "PREFETCH_THREAD_NAME",
     "UnitStream",
+    "as_block_source",
     "resolve_depth",
     "prefetch_blocks",
     "stream_partial_fit",
@@ -355,6 +356,38 @@ def _staged_iter(src, stage, depth: int, stats: PipelineStats,
         # loop: a fresh worker resumes from state (held raw item first)
 
 
+def as_block_source(blocks):
+    """Normalize a stream source to ONE block iterator — the pipeline's
+    multi-source staged feed entry.
+
+    A sharded dataset (the ``iter_blocks`` protocol,
+    :mod:`dask_ml_tpu.data`) opens its merged stream here: N parallel
+    reader threads producing into a bounded reorder queue, re-serialized
+    into the single deterministic sequence this pipeline's one staging
+    worker consumes — so "many sources" (shard files, readers, epochs)
+    compose UNDER the existing single-feed contract instead of widening
+    it (the worker still never dispatches; order is still a value).
+    Anything else is plain ``iter()``.  The returned iterator's
+    ``restartable_source`` attribute (the dataset streams set it) opts
+    parse faults into the elastic driver's budgeted re-pull.
+    """
+    if hasattr(blocks, "iter_blocks"):
+        return blocks.iter_blocks()
+    return iter(blocks)
+
+
+def _close_source(src) -> None:
+    """Release a source that holds live resources (a dataset stream's
+    reader threads, a generator's frame) once its stream is finished or
+    abandoned.  Plain iterators without ``close`` are untouched."""
+    close = getattr(src, "close", None)
+    if close is not None:
+        try:
+            close()
+        except Exception:  # pragma: no cover - source teardown is best-effort
+            pass
+
+
 def _identity(x):
     return x
 
@@ -379,7 +412,8 @@ def prefetch_blocks(blocks, *, depth: int | None = None,
     # generator finishes/closes — both on the consumer thread, so stack
     # discipline holds; the worker's parse/stage spans stitch under it
     with obs.span("pipeline.stream", label=label, depth=depth):
-        feed = _staged_iter(iter(blocks), stage, depth, stats, policy)
+        src = as_block_source(blocks)
+        feed = _staged_iter(src, stage, depth, stats, policy)
         try:
             for staged in feed:
                 t0 = time.perf_counter()
@@ -389,6 +423,7 @@ def prefetch_blocks(blocks, *, depth: int | None = None,
                 stats.blocks += 1
         finally:
             feed.close()  # stop the worker promptly on early exit
+            _close_source(src)  # …and the source's readers/frame
             stats.finish()
 
 
@@ -512,7 +547,8 @@ def stream_partial_fit(model, blocks, *, depth: int | None = None,
     with obs.span("pipeline.stream", label=label, depth=depth,
                   staged=staged_proto,
                   estimator=type(model).__name__):
-        feed = _staged_iter(iter(blocks), _stage, depth, stats, policy)
+        src = as_block_source(blocks)
+        feed = _staged_iter(src, _stage, depth, stats, policy)
         done = 0
         try:
             for item in feed:
@@ -540,6 +576,7 @@ def stream_partial_fit(model, blocks, *, depth: int | None = None,
             raise
         finally:
             feed.close()
+            _close_source(src)
             stats.finish()
 
 
@@ -597,7 +634,8 @@ class UnitStream:
             estimator=type(model).__name__)
         self._span.__enter__()
         self._parent = self._span.span_id or parent_span
-        self._feed = _staged_iter(iter(blocks), stage, depth,
+        self._src = as_block_source(blocks)
+        self._feed = _staged_iter(self._src, stage, depth,
                                   self._stats, policy,
                                   trace_parent=self._parent)
         self._closed = False
@@ -665,6 +703,7 @@ class UnitStream:
         try:
             self._feed.close()
         finally:
+            _close_source(self._src)
             self._stats.finish()
             self._span.__exit__(None, None, None)
 

@@ -556,6 +556,64 @@ def _drill_serve_crash_sgd(depth, m):
         server.close()
 
 
+def _drill_data_reader_crash_sgd(depth, m):
+    """A sharded-dataset reader thread dies WITHOUT reporting
+    (simulated hard death) mid-epoch: the merged stream's liveness poll
+    catches it, a BUDGETED restart spawns a replacement that replays
+    the dead reader's in-flight shard range, and the merge queue's
+    sequence dedup keeps delivery exactly-once — so the fit completes
+    with exactly one restart charged and the model equals a twin
+    streamed from the unfaulted dataset (the global key-derived order
+    is a value: faulted and unfaulted runs see identical streams).
+    ``depth`` is the downstream prefetch depth (the drill matrix's
+    streaming dimension: at depth 0 the consumer pulls the merge queue
+    inline; at 2 through the staging worker)."""
+    import shutil
+    import tempfile
+
+    from .. import data as _data
+    from ..linear_model import SGDClassifier
+    from ..obs.metrics import registry as _registry
+    from ..pipeline import stream_partial_fit
+    from .elastic import FaultBudget
+
+    rng = np.random.RandomState(_SEED)
+    X = rng.normal(size=(2048, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.1 * rng.normal(size=2048) > 0).astype(np.int32)
+    d = tempfile.mkdtemp(prefix="graftdrill-data-")
+    try:
+        manifest = _data.write_dataset(d, X, y, shards=4, block_rows=256)
+        label = f"drill_data_reader_d{depth}"
+
+        def _fit_ds(budget=None):
+            model = SGDClassifier(random_state=0)
+            ds = _data.ShardedDataset(d, key=_SEED, readers=2,
+                                      budget=budget, label=label)
+            stream_partial_fit(
+                model, ds, depth=depth,
+                fit_kwargs={"classes": np.array([0, 1])}, label=label)
+            return model
+
+        twin = _model_vec(_fit_ds())
+        budget = FaultBudget(4, 60.0, name=label)
+        plan = FaultPlan().inject("data-reader", at_call=3, times=1,
+                                  exc=ThreadCrash("drill: reader death"))
+        blocks0 = _registry().family("data.blocks").get(label, 0)
+        with fault_plan(plan):
+            model = _fit_ds(budget=budget)
+        delivered = _registry().family("data.blocks").get(label, 0) - blocks0
+        m["faults_injected"] = sum(plan.fired.values())
+        # recovery = the crash fired, exactly one budgeted restart was
+        # charged, and the merge queue delivered every block exactly
+        # once (no skip, no duplicate)
+        m["recovered"] = (m["faults_injected"] == 1
+                          and budget.spent == 1
+                          and delivered == manifest.n_blocks)
+        m["model_match"], m["max_rel_diff"] = _match(model, twin)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _drill_exporter_enospc_mbk(depth, m):
     """Disk-full on the grafttrace JSONL sink mid-fit: the sink is
     dropped with one warning (ring + flight recording continue) and the
@@ -606,6 +664,7 @@ _IMPLS = {
     "ahead_crash_sgd": ("compile-ahead", _drill_ahead_crash_sgd),
     "exporter_enospc_mbk": ("exporter-write", _drill_exporter_enospc_mbk),
     "serve_crash_sgd": ("serve-loop", _drill_serve_crash_sgd),
+    "data_reader_crash_sgd": ("data-reader", _drill_data_reader_crash_sgd),
 }
 for _name, (_point, _fn) in _IMPLS.items():
     for _depth in (0, 2):

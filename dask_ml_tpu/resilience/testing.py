@@ -35,7 +35,13 @@ pattern).  This module replaces that with a declarative registry: a
   (``serve/runtime.py``), fired once per drained request batch BEFORE
   its dispatch; inject :class:`ThreadCrash` to simulate the serve loop
   dying with a batch in hand (the supervised restart must replay it —
-  no request dropped without an explicit rejection record).
+  no request dropped without an explicit rejection record);
+* ``"data-reader"`` — the sharded dataset layer's reader threads
+  (``data/readers.py``), fired once per produced block BEFORE the
+  shard read; inject :class:`ThreadCrash` to simulate a reader dying
+  silently mid-shard (the consumer's liveness poll must catch it, the
+  budgeted restart must replay the in-flight shard range, and the
+  merge queue's dedup must keep delivery exactly-once).
 
 Hot paths pay one global ``is None`` check when no plan is active.
 """
@@ -63,7 +69,7 @@ __all__ = [
 INJECTION_POINTS = (
     "ingest", "step", "checkpoint-write", "collective",
     "stage", "prefetch-worker", "compile-ahead", "exporter-write",
-    "serve-loop",
+    "serve-loop", "data-reader",
 )
 
 

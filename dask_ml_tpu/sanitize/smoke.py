@@ -280,6 +280,55 @@ def _wl_search_concurrent():
     return s
 
 
+def _wl_ingest_parallel():
+    """A steady fit fed by a 4-reader sharded dataset (design.md §18):
+    the merged multi-reader stream re-serializes into the ONE prefetch
+    worker, so the device plane must look exactly like the classic
+    depth-2 stream — and the reader threads are HOST-ONLY by declared
+    contract (``dask-ml-tpu-data-reader`` ∈
+    ``_spmd.HOST_ONLY_THREAD_NAMES``): a compile, program dispatch, or
+    transfer attributed to a reader is a hard violation, runtime-
+    verified here, not taken on faith.  Warmup epoch pays the compiles;
+    the steady epoch streams a DIFFERENT key-derived permutation of the
+    same bucket-aligned 256-row blocks (shuffle changes order, never
+    shape) — zero new programs, zero pad copies
+    (``bucket.padded_blocks`` stays 0: the format's chunks are ladder
+    rungs)."""
+    import shutil
+    import tempfile
+
+    from .. import data as _data
+    from .. import programs
+    from ..linear_model import SGDClassifier
+    from ..pipeline import stream_partial_fit
+
+    rng = np.random.RandomState(_SEED)
+    X = rng.normal(size=(2048, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.1 * rng.normal(size=2048) > 0).astype(np.int32)
+    d = tempfile.mkdtemp(prefix="graftsan-ds-")
+    try:
+        _data.write_dataset(d, X, y, shards=4, block_rows=256)
+        model = SGDClassifier(random_state=0)
+
+        def _round(epoch):
+            ds = _data.ShardedDataset(d, key=_SEED, readers=4,
+                                      label="ingest_parallel")
+            stream_partial_fit(
+                model, ds.iter_blocks(epoch=epoch), depth=2,
+                fit_kwargs={"classes": np.array([0, 1])},
+                label="ingest_parallel")
+
+        with sanitize(label="ingest_parallel") as s:
+            _round(0)
+            programs.drain_ahead()
+            with s.steady():
+                _round(1)
+                programs.drain_ahead()
+        return s
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _wl_glm_fit():
     from ..linear_model import LogisticRegression
 
@@ -306,6 +355,7 @@ WORKLOADS = {
     "mbk_fit": _wl_mbk_fit,
     "glm_fit": _wl_glm_fit,
     "search_concurrent": _wl_search_concurrent,
+    "ingest_parallel": _wl_ingest_parallel,
 }
 
 

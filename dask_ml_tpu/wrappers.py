@@ -70,9 +70,11 @@ class ParallelPostFit(TPUEstimator):
     def predict_blocks(self, X, method="predict", chunk_size=100_000):
         """Yield per-chunk inference results instead of concatenating
         them in host memory — the "inference over huge X" form of
-        ParallelPostFit.  ``X`` may be an array, a ShardedRows, or an
-        ITERABLE of row blocks (e.g. ``io.stream_csv_blocks`` or a
-        vectorizer's ``stream_transform``); each yielded block's result is
+        ParallelPostFit.  ``X`` may be an array, a ShardedRows, a
+        sharded dataset (:mod:`dask_ml_tpu.data` — its parallel readers
+        feed inference; target columns are dropped), or an ITERABLE of
+        row blocks (e.g. ``io.stream_csv_blocks`` or a vectorizer's
+        ``stream_transform``); each yielded block's result is
         the caller's to write out/reduce, so peak host memory is one
         chunk's worth regardless of the total row count.
 
@@ -89,6 +91,10 @@ class ParallelPostFit(TPUEstimator):
             # sparse estimator outputs (e.g. a transformer) stay sparse:
             # np.asarray(csr) is a useless 0-d object array
             return out if scipy.sparse.issparse(out) else np.asarray(out)
+        if hasattr(X, "iter_blocks"):  # sharded dataset: X columns only
+            for xb in _partial._x_only(X.iter_blocks()):
+                yield _as_block(fn(xb))
+            return
         if isinstance(X, ShardedRows):
             if isinstance(est, TPUEstimator):
                 # device-native: chunk the INPUT as device views so each

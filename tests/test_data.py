@@ -1,0 +1,479 @@
+"""Sharded dataset layer (dask_ml_tpu/data, design.md §18): columnar
+format roundtrip + validation, key-derived shuffle determinism (and the
+host Threefry twin's bit-equality with jax.random.fold_in), merge-queue
+order independence from reader count, exact-once delivery under reader
+crashes, FitCheckpoint-style mid-epoch resume, the pad-no-op contract
+of format-aligned streams, and the estimator entrypoints (dataset
+accepted wherever block iterators are)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from dask_ml_tpu import _partial, data, io
+from dask_ml_tpu.data import format as dformat
+from dask_ml_tpu.data import shuffle as dshuffle
+from dask_ml_tpu.obs.metrics import registry as _registry
+from dask_ml_tpu.pipeline import stream_partial_fit
+from dask_ml_tpu.resilience.elastic import BudgetExhausted, FaultBudget
+from dask_ml_tpu.resilience.testing import (FaultPlan, ThreadCrash,
+                                            fault_plan)
+
+_SEED = 5
+
+
+def _xy(n=2048, d=8, seed=_SEED):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] + 0.1 * rng.normal(size=n) > 0).astype(np.int32)
+    return X, y
+
+
+def _build(tmp_path, n=2048, d=8, shards=4, block_rows=256, **kw):
+    X, y = _xy(n, d)
+    m = data.write_dataset(str(tmp_path / "ds"), X, y, shards=shards,
+                           block_rows=block_rows, **kw)
+    return X, y, m, str(tmp_path / "ds")
+
+
+def _drain(ds, epoch=None, start=None):
+    out = []
+    with ds.iter_blocks(epoch=epoch, start=start) as st:
+        for xb, yb in st:
+            out.append((xb.copy(), None if yb is None else yb.copy()))
+    return out
+
+
+class TestShuffle:
+    def test_fold_in_matches_jax_bit_exact(self):
+        """The host Threefry twin IS jax.random.fold_in — bit-identical
+        keys, so the SURVEY §3.2 recipe holds without any device work
+        on the reader threads."""
+        for seed in (0, 1, 42, 123456789):
+            k = jax.random.PRNGKey(seed)
+            assert np.array_equal(np.asarray(k),
+                                  dshuffle.key_from_seed(seed))
+            for d in (0, 1, 7, 1000, 2**31 - 1):
+                want = np.asarray(jax.random.fold_in(k, d))
+                got = dshuffle.fold_in(np.asarray(k), d)
+                assert np.array_equal(want, got), (seed, d)
+
+    def test_as_key_accepts_jax_key(self):
+        k = jax.random.PRNGKey(3)
+        assert np.array_equal(dshuffle.as_key(k), np.asarray(k))
+        assert np.array_equal(dshuffle.as_key(3), np.asarray(k))
+        with pytest.raises(ValueError):
+            dshuffle.as_key(np.zeros(3, np.uint32))
+
+    def test_permutation_deterministic_and_complete(self):
+        k = dshuffle.key_from_seed(9)
+        p1 = dshuffle.permutation(k, 1000)
+        p2 = dshuffle.permutation(k, 1000)
+        assert np.array_equal(p1, p2)
+        assert np.array_equal(np.sort(p1), np.arange(1000))
+        assert not np.array_equal(
+            p1, dshuffle.permutation(dshuffle.fold_in(k, 1), 1000))
+
+    def test_epoch_plan_identity_and_shuffle(self):
+        plan = dshuffle.epoch_plan(0, 0, [3, 2, 4], shuffle=False)
+        assert list(plan.order()) == [(0, 0), (0, 1), (0, 2), (1, 0),
+                                      (1, 1), (2, 0), (2, 1), (2, 2),
+                                      (2, 3)]
+        sh = dshuffle.epoch_plan(0, 0, [3, 2, 4], shuffle=True)
+        assert sorted(sh.order()) == sorted(plan.order())
+        assert sh.n_blocks == 9
+        # locate() inverts the flat order
+        flat = list(sh.order())
+        for seq in (0, 4, 8):
+            p, off = sh.locate(seq)
+            s = sh.shard_order[p]
+            assert flat[seq] == (s, int(sh.block_orders[s][off]))
+
+
+class TestFormat:
+    def test_roundtrip_compressed_and_raw(self, tmp_path):
+        X, y = _xy(700, 5)
+        for comp in ("zlib", "none"):
+            p = str(tmp_path / f"shard-{comp}.dmltc")
+            cols = [dformat.ColumnSpec("X", "float32", (5,)),
+                    dformat.ColumnSpec("y", "int32")]
+            with dformat.ColumnarWriter(p, cols, block_rows=256,
+                                        compression=comp) as w:
+                w.append(X[:100], y[:100])   # slabs smaller than a block
+                w.append(X[100:], y[100:])   # …and larger
+            with dformat.ColumnarReader(p) as r:
+                assert r.rows == 700
+                assert r.n_blocks == 3       # 256 + 256 + 188 tail
+                xs, ys = [], []
+                for i in range(r.n_blocks):
+                    xb, yb = r.read_block(i)
+                    xs.append(xb)
+                    ys.append(yb)
+                assert np.array_equal(np.concatenate(xs), X)
+                assert np.array_equal(np.concatenate(ys), y)
+                assert ys[0].dtype == np.int32
+
+    def test_writer_rejects_off_ladder_block_rows(self, tmp_path):
+        cols = [dformat.ColumnSpec("X", "float32", (4,))]
+        with pytest.raises(ValueError, match="rung"):
+            dformat.ColumnarWriter(str(tmp_path / "x.dmltc"), cols,
+                                   block_rows=100)
+        # policy='off' opts out deliberately
+        w = dformat.ColumnarWriter(str(tmp_path / "x.dmltc"), cols,
+                                   block_rows=100, policy="off")
+        w.append(np.zeros((100, 4), np.float32))
+        w.close()
+
+    def test_truncated_file_fails_at_open(self, tmp_path):
+        X, y = _xy(600, 4)
+        p = str(tmp_path / "shard.dmltc")
+        cols = [dformat.ColumnSpec("X", "float32", (4,)),
+                dformat.ColumnSpec("y", "int32")]
+        with dformat.ColumnarWriter(p, cols, block_rows=256) as w:
+            w.append(X, y)
+        size = os.path.getsize(p)
+        with open(p, "r+b") as f:
+            f.truncate(size - 7)
+        with pytest.raises(ValueError, match="truncated|tail"):
+            dformat.ColumnarReader(p)
+        with open(str(tmp_path / "junk.dmltc"), "wb") as f:
+            f.write(b"not a shard at all" * 4)
+        with pytest.raises(ValueError):
+            dformat.ColumnarReader(str(tmp_path / "junk.dmltc"))
+
+    def test_manifest_validate_and_for_host(self, tmp_path):
+        _X, _y, m, d = _build(tmp_path, shards=5)
+        loaded = data.DatasetManifest.load(d)
+        loaded.validate()
+        assert loaded.rows == m.rows and loaded.n_blocks == m.n_blocks
+        parts = [loaded.for_host(i, 2) for i in range(2)]
+        assert sum(p.n_shards for p in parts) == loaded.n_shards
+        assert sum(p.rows for p in parts) == loaded.rows
+        with pytest.raises(ValueError):
+            loaded.for_host(2, 2)
+
+
+class TestShardedDataset:
+    def test_order_independent_of_reader_count(self, tmp_path):
+        """Same key ⇒ the SAME global permutation at every reader
+        count (the merge queue re-serializes), and across runs."""
+        X, y, m, d = _build(tmp_path)
+        ref = _drain(data.ShardedDataset(d, key=7, readers=1), epoch=0)
+        for readers in (2, 4):
+            got = _drain(data.ShardedDataset(d, key=7, readers=readers),
+                         epoch=0)
+            assert len(got) == len(ref) == m.n_blocks
+            for (xa, ya), (xb, yb) in zip(ref, got):
+                assert np.array_equal(xa, xb)
+                assert np.array_equal(ya, yb)
+        again = _drain(data.ShardedDataset(d, key=7, readers=4), epoch=0)
+        assert all(np.array_equal(a[0], b[0])
+                   for a, b in zip(ref, again))
+
+    def test_epochs_differ_and_cover_all_rows(self, tmp_path):
+        X, y, m, d = _build(tmp_path)
+        e0 = _drain(data.ShardedDataset(d, key=7, readers=2), epoch=0)
+        e1 = _drain(data.ShardedDataset(d, key=7, readers=2), epoch=1)
+        assert not all(np.array_equal(a[0], b[0])
+                       for a, b in zip(e0, e1))
+        for ep in (e0, e1):  # every epoch is a full permutation
+            assert sum(b[0].shape[0] for b in ep) == X.shape[0]
+            assert np.isclose(
+                sum(float(b[0].sum()) for b in ep), float(X.sum()),
+                rtol=1e-4)
+
+    def test_multi_epoch_stream_and_start_resume(self, tmp_path):
+        X, y, m, d = _build(tmp_path)
+        full = _drain(data.ShardedDataset(d, key=3, epochs=2, readers=2))
+        assert len(full) == 2 * m.n_blocks
+        # start=k replays exactly the unseen suffix — across the
+        # epoch boundary too
+        for k in (3, m.n_blocks, m.n_blocks + 2):
+            suf = _drain(data.ShardedDataset(d, key=3, epochs=2,
+                                             readers=2), start=k)
+            assert len(suf) == len(full) - k
+            for (xa, _), (xb, _) in zip(full[k:], suf):
+                assert np.array_equal(xa, xb)
+
+    def test_identity_scan_matches_file_order(self, tmp_path):
+        X, y, m, d = _build(tmp_path, shards=2)
+        got = _drain(data.ShardedDataset(d, readers=1, shuffle=False),
+                     epoch=0)
+        want = []
+        for i in range(m.n_shards):
+            with m.open_shard(i) as r:
+                for b in range(r.n_blocks):
+                    want.append(r.read_block(b))
+        for (xa, ya), (xw, yw) in zip(got, want):
+            assert np.array_equal(xa, xw)
+            assert np.array_equal(ya, yw)
+
+    def test_reader_crash_budgeted_restart_exact_once(self, tmp_path):
+        X, y, m, d = _build(tmp_path)
+        ref = _drain(data.ShardedDataset(d, key=2, readers=2), epoch=0)
+        plan = FaultPlan().inject("data-reader", at_call=3, times=1,
+                                  exc=ThreadCrash("test"))
+        budget = FaultBudget(4, 60.0, name="t-data")
+        ds = data.ShardedDataset(d, key=2, readers=2, budget=budget,
+                                 label="t-data")
+        with fault_plan(plan):
+            got = _drain(ds, epoch=0)
+        assert sum(plan.fired.values()) == 1
+        assert budget.spent == 1  # ONE budgeted restart
+        assert len(got) == len(ref)  # exact-once: no skip, no dup
+        for (xa, _), (xb, _) in zip(ref, got):
+            assert np.array_equal(xa, xb)
+
+    def test_reported_reader_fault_restarts_too(self, tmp_path):
+        X, y, m, d = _build(tmp_path)
+        ref = _drain(data.ShardedDataset(d, key=2, readers=2), epoch=0)
+        plan = FaultPlan().inject("data-reader", at_call=2, times=1,
+                                  exc=OSError(5, "injected io error"))
+        with fault_plan(plan):
+            got = _drain(data.ShardedDataset(
+                d, key=2, readers=2,
+                budget=FaultBudget(4, 60.0, name="t-data2")), epoch=0)
+        assert len(got) == len(ref)
+        assert all(np.array_equal(a[0], b[0]) for a, b in zip(ref, got))
+
+    def test_two_crashes_same_shard_still_exact_once(self, tmp_path):
+        """A replacement reader that ALSO dies on the same shard: the
+        second restart must replay the recorded claim (an unrecorded
+        resume would skip the shard forever and hang the consumer) —
+        the double-death regression."""
+        X, y, m, d = _build(tmp_path, shards=2)
+        ref = _drain(data.ShardedDataset(d, key=2, readers=1), epoch=0)
+        plan = FaultPlan().inject("data-reader", at_call=(2, 3), times=2,
+                                  exc=ThreadCrash("test"))
+        budget = FaultBudget(6, 60.0, name="t-double")
+        with fault_plan(plan):
+            got = _drain(data.ShardedDataset(d, key=2, readers=1,
+                                             budget=budget,
+                                             label="t-double"), epoch=0)
+        assert sum(plan.fired.values()) == 2
+        assert budget.spent == 2
+        assert len(got) == len(ref)
+        assert all(np.array_equal(a[0], b[0]) for a, b in zip(ref, got))
+
+    def test_persistent_crash_exhausts_budget_loudly(self, tmp_path):
+        _X, _y, _m, d = _build(tmp_path)
+        plan = FaultPlan().persistent("data-reader",
+                                      exc=ThreadCrash("always"))
+        ds = data.ShardedDataset(d, key=2, readers=2,
+                                 budget=FaultBudget(2, 60.0, name="t3"),
+                                 label="t3")
+        with fault_plan(plan):
+            with pytest.raises(BudgetExhausted):
+                _drain(ds, epoch=0)
+
+    def test_knob_resolvers_strict(self, monkeypatch):
+        monkeypatch.setenv(data.READERS_ENV, "6")
+        assert data.resolve_readers() == 6
+        monkeypatch.setenv(data.READERS_ENV, "zero")
+        with pytest.raises(ValueError):
+            data.resolve_readers()
+        monkeypatch.setenv(data.QUEUE_ENV, "0")
+        with pytest.raises(ValueError):
+            data.resolve_queue_blocks()
+        monkeypatch.delenv(data.READERS_ENV)
+        monkeypatch.delenv(data.QUEUE_ENV)
+        assert data.resolve_queue_blocks(readers=3) == 6
+
+
+class TestEstimatorEntrypoints:
+    def test_stream_partial_fit_pad_noop_and_equality(self, tmp_path):
+        """A format-aligned dataset stream dispatches with ZERO padded
+        blocks (the bucket no-op fast path), and the model equals one
+        trained on the same blocks in memory."""
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X, y, m, d = _build(tmp_path, block_rows=256)
+        blocks = _drain(data.ShardedDataset(d, key=0, readers=1),
+                        epoch=0)
+        m_mem = SGDClassifier(random_state=0)
+        stream_partial_fit(m_mem, blocks, depth=2,
+                           fit_kwargs={"classes": np.array([0, 1])})
+        reg = _registry()
+        pad0 = reg.family("bucket.padded_blocks").get("", 0)
+        blk0 = reg.family("bucket.blocks").get("", 0)
+        m_ds = SGDClassifier(random_state=0)
+        stream_partial_fit(m_ds,
+                           data.ShardedDataset(d, key=0, readers=4),
+                           depth=2,
+                           fit_kwargs={"classes": np.array([0, 1])})
+        assert reg.family("bucket.blocks").get("", 0) - blk0 == \
+            m.n_blocks
+        assert reg.family("bucket.padded_blocks").get("", 0) == pad0
+        np.testing.assert_allclose(np.asarray(m_ds.coef_),
+                                   np.asarray(m_mem.coef_), rtol=1e-5)
+
+    def test_partial_fit_and_incremental_accept_dataset(self, tmp_path):
+        from dask_ml_tpu.linear_model import SGDClassifier
+        from dask_ml_tpu.wrappers import Incremental
+
+        X, y, m, d = _build(tmp_path)
+        est = SGDClassifier(random_state=0)
+        _partial.fit(est, data.ShardedDataset(d, key=0, readers=2),
+                     classes=np.array([0, 1]))
+        assert np.asarray(est.coef_).shape[-1] == X.shape[1]
+        with pytest.raises(ValueError, match="ride the dataset"):
+            _partial.fit(SGDClassifier(),
+                         data.ShardedDataset(d, key=0), y)
+        inc = Incremental(SGDClassifier(random_state=0))
+        inc.fit(data.ShardedDataset(d, key=0, readers=2),
+                classes=np.array([0, 1]))
+        np.testing.assert_allclose(np.asarray(inc.estimator_.coef_),
+                                   np.asarray(est.coef_), rtol=1e-5)
+
+    def test_predict_and_predict_blocks_accept_dataset(self, tmp_path):
+        from dask_ml_tpu.linear_model import SGDClassifier
+        from dask_ml_tpu.wrappers import ParallelPostFit
+
+        X, y, m, d = _build(tmp_path)
+        est = SGDClassifier(random_state=0)
+        est.partial_fit(X, y, classes=np.array([0, 1]))
+        direct = np.asarray(est.predict(X))
+        ds = data.ShardedDataset(d, key=0, readers=2, shuffle=False)
+        p = _partial.predict(est, ds)
+        assert p.shape == direct.shape
+        ppf = ParallelPostFit(estimator=est)
+        ppf.fit(X[:64], y[:64], classes=np.array([0, 1]))
+        chunks = list(ppf.predict_blocks(
+            data.ShardedDataset(d, key=0, readers=2, shuffle=False)))
+        assert sum(c.shape[0] for c in chunks) == X.shape[0]
+
+    def test_fit_checkpoint_style_resume_replays_suffix(self, tmp_path):
+        """A fit that consumed k blocks resumes with start=k and lands
+        on the full-epoch model exactly (the FitCheckpoint mid-epoch
+        resume contract: the unseen suffix replays, nothing else)."""
+        from dask_ml_tpu.linear_model import SGDClassifier
+
+        X, y, m, d = _build(tmp_path)
+        full = SGDClassifier(random_state=0)
+        stream_partial_fit(full,
+                           data.ShardedDataset(d, key=1, readers=2),
+                           depth=2,
+                           fit_kwargs={"classes": np.array([0, 1])})
+
+        class _Stop(Exception):
+            pass
+
+        part = SGDClassifier(random_state=0)
+        k = 3
+        seen = [0]
+
+        def _on_block(i, model):
+            seen[0] = i
+            if i == k:
+                raise _Stop
+
+        with pytest.raises(_Stop):
+            stream_partial_fit(
+                part, data.ShardedDataset(d, key=1, readers=2), depth=2,
+                fit_kwargs={"classes": np.array([0, 1])},
+                on_block=_on_block)
+        assert seen[0] == k
+        stream_partial_fit(
+            part,
+            data.ShardedDataset(d, key=1, readers=2).iter_blocks(
+                start=k),
+            depth=2, fit_kwargs={"classes": np.array([0, 1])})
+        np.testing.assert_allclose(np.asarray(part.coef_),
+                                   np.asarray(full.coef_), rtol=1e-5)
+
+
+class TestConverters:
+    def test_csv_converter_roundtrip(self, tmp_path):
+        X, y = _xy(600, 6)
+        csvp = str(tmp_path / "in.csv")
+        arr = np.hstack([X, y[:, None].astype(np.float32)])
+        with open(csvp, "w") as f:
+            for row in arr:
+                f.write(",".join(f"{v:.7g}" for v in row) + "\n")
+        m = io.to_columnar(csvp, str(tmp_path / "out"), label_col=-1,
+                           shards=2, block_rows=256)
+        assert m.rows == 600
+        got_x, got_y = [], []
+        for xb, yb in data.ShardedDataset(m, shuffle=False,
+                                          readers=1).iter_blocks(epoch=0):
+            got_x.append(xb)
+            got_y.append(yb)
+        assert sum(b.shape[0] for b in got_x) == 600
+        assert int(np.concatenate(got_y).sum()) == int(y.sum())
+        # float roundtrip through %.7g text: near-exact
+        np.testing.assert_allclose(
+            np.sort(np.concatenate(got_x).ravel()),
+            np.sort(X.ravel()), rtol=1e-5, atol=1e-6)
+
+    def test_binary_converter_roundtrip(self, tmp_path):
+        X, _y = _xy(500, 4)
+        binp = str(tmp_path / "in.bin")
+        X.tofile(binp)
+        m = io.to_columnar(binp, str(tmp_path / "out"), n_features=4,
+                           shards=2, block_rows=256)
+        assert m.rows == 500
+        tot = np.concatenate([
+            xb for xb, _ in data.ShardedDataset(
+                m, shuffle=False, readers=1).iter_blocks(epoch=0)])
+        assert np.isclose(float(tot.sum()), float(X.sum()), rtol=1e-5)
+        with pytest.raises(ValueError, match="n_features"):
+            io.to_columnar(binp, str(tmp_path / "out2"))
+
+    def test_convert_blocks_preserves_wide_int_labels(self, tmp_path):
+        """Integer labels above 2**24 must not round-trip through the
+        float32 feature cast (the converter splits the label column off
+        first)."""
+        rng = np.random.RandomState(0)
+        X = rng.normal(size=(300, 3)).astype(np.float64)
+        ids = (np.arange(300, dtype=np.int64) + 2**24 + 1)
+        slab = np.concatenate([X, ids[:, None].astype(np.float64)],
+                              axis=1)
+        # float64 carries the ids exactly; a float32 detour would not
+        m = data.convert_blocks(
+            str(tmp_path / "out"), [slab], n_features=4, label_col=-1,
+            label_dtype="int64", shards=1, block_rows=256)
+        got = np.concatenate([
+            yb for _xb, yb in data.ShardedDataset(
+                m, shuffle=False, readers=1).iter_blocks(epoch=0)])
+        assert got.dtype == np.int64
+        assert np.array_equal(np.sort(got), ids)
+
+
+class TestIOHardening:
+    def test_stream_binary_blocks_validates_size_up_front(self,
+                                                          tmp_path):
+        X, _y = _xy(100, 8)
+        binp = str(tmp_path / "t.bin")
+        X.tofile(binp)
+        with pytest.raises(ValueError, match="truncated|needs"):
+            # generator validates eagerly — no iteration required
+            io.stream_binary_blocks(binp, 16, 8, n_rows=200)
+        # derived n_rows still streams every complete row
+        got = sum(b.shape[0]
+                  for b in io.stream_binary_blocks(binp, 16, 8))
+        assert got == 100
+
+    def test_stream_text_lines_retry_exact(self, tmp_path):
+        p = str(tmp_path / "t.txt")
+        with open(p, "w") as f:
+            f.write("\n".join(f"line{i}" for i in range(25)) + "\n")
+        plan = FaultPlan().inject("ingest", at_call=2, times=1)
+        with fault_plan(plan):
+            out = [ln for blk in io.stream_text_lines(
+                p, 10, retries=2, retry_backoff=0.0) for ln in blk]
+        assert sum(plan.fired.values()) == 1
+        assert out == [f"line{i}" for i in range(25)]
+
+    def test_stream_text_lines_no_retry_propagates(self, tmp_path):
+        from dask_ml_tpu.resilience.testing import FaultInjected
+
+        p = str(tmp_path / "t.txt")
+        with open(p, "w") as f:
+            f.write("a\nb\n")
+        plan = FaultPlan().inject("ingest", at_call=1, times=1)
+        with fault_plan(plan):
+            with pytest.raises(FaultInjected):
+                list(io.stream_text_lines(p, 10))
