@@ -692,6 +692,56 @@ def main():
     _obs_prev = {}
     _scope_cursor = {"pos": 0}
 
+    class _spans_armed:
+        """Arm span recording (ring-only) around one A/B section.
+
+        The bench keeps tracing OFF globally (counters only, zero span
+        overhead on the throughput workloads); the graftpath critical
+        sections need the span timeline, so the A/B sections arm it
+        for exactly their own duration — overhead is bounded at <=3%
+        of traced wall by the committed obs ratchet, far inside the
+        A/B dispersion gates, and BOTH arms of a pair run armed so the
+        comparison stays fair."""
+
+        def __enter__(self):
+            self._was = _obs.enabled()
+            if not self._was:
+                _obs.enable()
+            return self
+
+        def __exit__(self, *exc):
+            if not self._was:
+                _obs.disable()
+            return False
+
+    def _critical_arm():
+        """Compact graftpath verdict of the arm that just finished
+        (the most recent root span): the bottleneck class + evidence
+        numbers each A/B arm records so a saturation-pinned pair is
+        LABELLED by the tool, not argued in prose."""
+        try:
+            cp = _obs.critical_path()
+            return {
+                "verdict": cp["verdict"]["class"],
+                "confidence": cp["verdict"].get("confidence"),
+                "overlap_efficiency": cp.get("overlap_efficiency"),
+                "shares": cp.get("shares"),
+            }
+        except Exception:  # observability must never sink a bench
+            return None
+
+    def _pair_critical(arms: dict, cpu_over_walls) -> dict:
+        """The pair-level `critical` block: each arm's verdict plus the
+        machine-readable saturation label — when EVERY arm's
+        cpu_over_wall is ~1 the host core(s) were the binding resource
+        in both arms and the wall ratio carries no overlap information
+        (the 1-CPU-core gate-box failure mode the ROADMAP names)."""
+        cw = [c for c in cpu_over_walls if c is not None]
+        return {
+            **arms,
+            "saturation_pinned": bool(cw and min(cw) >= 0.9),
+        }
+
     def _obs_read():
         """Current registry scalars — the ONE key list both the
         per-workload deltas and the end-of-run obs_totals use."""
@@ -1645,13 +1695,17 @@ def main():
             _rt_env = os.environ.get("DASK_ML_TPU_BUCKET")
 
             def _rt_run(policy):
+                from dask_ml_tpu.obs import scope as _rt_scope
+
                 os.environ["DASK_ML_TPU_BUCKET"] = policy
                 try:
                     _programs.reset_counters()
                     reg = _obs.registry()
                     c0 = reg.counter("compile.count").value
                     s0 = reg.histogram("compile.duration_s").sum
+                    cur = _rt_scope.cursor()
                     clf = _RTClf(random_state=0)
+                    cp0 = time.process_time()
                     t0 = time.perf_counter()
                     _rt_stream(clf, _rt_blocks(),
                                fit_kwargs={"classes": [0.0, 1.0]},
@@ -1659,6 +1713,9 @@ def main():
                     float(clf._loss_)  # sync the donated chain
                     _programs.drain_ahead()
                     wall = time.perf_counter() - t0
+                    cpu = time.process_time() - cp0
+                    dev = _rt_scope.device_report(since=cur,
+                                                  settle_s=5.0)
                     tot = _programs.report()["totals"]
                     return {
                         "wall_s": round(wall, 3),
@@ -1671,6 +1728,12 @@ def main():
                             / max(tot["hits"] + tot["misses"], 1), 3),
                         "ahead_hits": tot["ahead_hits"],
                         "compile_s_hidden": tot["saved_s"],
+                        # saturation evidence, uniform across A/B
+                        # sections (the search section's idiom)
+                        "cpu_over_wall": round(
+                            cpu / max(wall, 1e-9), 3),
+                        "device_util": dev["utilization"],
+                        "critical": _critical_arm(),
                     }, np.asarray(clf.coef_)
                 finally:
                     if _rt_env is None:
@@ -1678,8 +1741,9 @@ def main():
                     else:
                         os.environ["DASK_ML_TPU_BUCKET"] = _rt_env
 
-            off, coef_off = _rt_run("off")
-            on, coef_on = _rt_run("auto")
+            with _spans_armed():
+                off, coef_off = _rt_run("off")
+                on, coef_on = _rt_run("auto")
             # model-equality contract: padding rows are exact zeros in
             # every masked reduction, but a different padded SHAPE can
             # re-tile XLA's reduction tree (SIMD lanes vs remainder
@@ -1704,6 +1768,9 @@ def main():
                 "bit_identical": bool(np.array_equal(coef_off, coef_on)),
                 "max_rel_diff": max_rel,
                 "results_match": bool(max_rel < 1e-6),
+                "critical": _pair_critical(
+                    {"off": off["critical"], "on": on["critical"]},
+                    (off["cpu_over_wall"], on["cpu_over_wall"])),
             })
     except Exception:
         extra["recompile_tax_error"] = traceback.format_exc(limit=3)
@@ -2063,7 +2130,8 @@ def main():
 
                 def _fit_arm(readers, latency_s, tag):
                     """One streamed-fit arm: rows/s + stall + util +
-                    coef for the equality check."""
+                    cpu_over_wall + graftpath verdict + coef for the
+                    equality check."""
                     clf = SGDClassifier(random_state=0)
                     reset_pipeline_stats()
                     cur = _ing_scope.cursor()
@@ -2071,12 +2139,14 @@ def main():
                         ds_dir, key=23, readers=readers,
                         fetch_latency_s=latency_s,
                         label=f"bench_ingest_{tag}")
+                    c0 = time.process_time()
                     t0 = time.perf_counter()
                     stream_partial_fit(
                         clf, ds, depth=2,
                         fit_kwargs={"classes": np.array([0, 1])},
                         label=f"bench_ingest_{tag}")
                     dt = time.perf_counter() - t0
+                    cpu = time.process_time() - c0
                     rep = pipeline_report()
                     dev = _ing_scope.device_report(since=cur,
                                                    settle_s=5.0)
@@ -2088,6 +2158,13 @@ def main():
                             float(rep.get("stall_s", 0.0)) / wall,
                             1.0), 4),
                         "device_util": float(dev["utilization"]),
+                        # saturation evidence, machine-readable in
+                        # EVERY A/B section (the search section's
+                        # idiom): ~1.0 on both arms means the host
+                        # core was the binding resource
+                        "cpu_over_wall": round(
+                            cpu / max(dt, 1e-9), 3),
+                        "critical": _critical_arm(),
                     }, np.asarray(clf.coef_, np.float64).ravel()
 
                 # 10 ms/block fetch emulation: conservative against a
@@ -2097,29 +2174,40 @@ def main():
                 # too small to overlap into a stable ratio (measured
                 # 1.13-1.51x run to run; parse ~10 ms/block is the
                 # same order, so the A/B measured noise)
-                for tag, lat in (("real", 0.0), ("remote10ms", 0.010)):
-                    # warm arm (compiles paid once, page cache hot)
-                    _fit_arm(1, lat, f"{tag}_warm")
-                    a1, c1 = _fit_arm(1, lat, f"{tag}_r1")
-                    a4, c4 = _fit_arm(4, lat, f"{tag}_r4")
-                    denom = np.maximum(np.abs(c1), 1e-12)
-                    max_rel = float(np.max(np.abs(c4 - c1) / denom))
-                    _record({
-                        "workload": f"ingest_readers_ab_{tag}",
-                        "rows": nI,
-                        "block_rows": blkI,
-                        "r1_rows_per_s": a1["rows_per_s"],
-                        "r4_rows_per_s": a4["rows_per_s"],
-                        "speedup": round(
-                            a4["rows_per_s"]
-                            / max(a1["rows_per_s"], 1e-9), 3),
-                        "r1_stall_fraction": a1["stall_fraction"],
-                        "r4_stall_fraction": a4["stall_fraction"],
-                        "r1_device_util": a1["device_util"],
-                        "r4_device_util": a4["device_util"],
-                        "max_rel_diff": max_rel,
-                        "results_match": bool(max_rel < 1e-5),
-                    })
+                with _spans_armed():
+                    for tag, lat in (("real", 0.0),
+                                     ("remote10ms", 0.010)):
+                        # warm arm (compiles paid once, page cache hot)
+                        _fit_arm(1, lat, f"{tag}_warm")
+                        a1, c1 = _fit_arm(1, lat, f"{tag}_r1")
+                        a4, c4 = _fit_arm(4, lat, f"{tag}_r4")
+                        denom = np.maximum(np.abs(c1), 1e-12)
+                        max_rel = float(np.max(np.abs(c4 - c1) / denom))
+                        _record({
+                            "workload": f"ingest_readers_ab_{tag}",
+                            "rows": nI,
+                            "block_rows": blkI,
+                            "r1_rows_per_s": a1["rows_per_s"],
+                            "r4_rows_per_s": a4["rows_per_s"],
+                            "speedup": round(
+                                a4["rows_per_s"]
+                                / max(a1["rows_per_s"], 1e-9), 3),
+                            "r1_stall_fraction": a1["stall_fraction"],
+                            "r4_stall_fraction": a4["stall_fraction"],
+                            "r1_device_util": a1["device_util"],
+                            "r4_device_util": a4["device_util"],
+                            "r1_cpu_over_wall": a1["cpu_over_wall"],
+                            "r4_cpu_over_wall": a4["cpu_over_wall"],
+                            "max_rel_diff": max_rel,
+                            "results_match": bool(max_rel < 1e-5),
+                            # each arm's bottleneck verdict + the
+                            # tool's saturation label (design.md §19)
+                            "critical": _pair_critical(
+                                {"r1": a1["critical"],
+                                 "r4": a4["critical"]},
+                                (a1["cpu_over_wall"],
+                                 a4["cpu_over_wall"])),
+                        })
 
                 # VmHWM ceiling for the windowed dataset path: a child
                 # process streams the whole dataset (readers=4) and
@@ -2343,7 +2431,7 @@ def main():
                 wall = time.perf_counter() - t0
                 cpu = time.process_time() - c0
                 dev = _srch_scope.device_report(since=cur, settle_s=5.0)
-                return hb, wall, cpu, dev
+                return hb, wall, cpu, dev, _critical_arm()
             finally:
                 if saved is None:
                     os.environ.pop("DASK_ML_TPU_SEARCH_CONCURRENCY",
@@ -2353,15 +2441,19 @@ def main():
 
         def _srch_pair(prefix, est_factory, extra_cols=None):
             _srch_fit(est_factory(), False)  # warm: compiles out
-            hb_c, wall_c, cpu_c, dev_c = _srch_fit(est_factory(), False)
-            hb_s, wall_s, cpu_s, dev_s = _srch_fit(est_factory(), True)
+            hb_c, wall_c, cpu_c, dev_c, cr_c = \
+                _srch_fit(est_factory(), False)
+            hb_s, wall_s, cpu_s, dev_s, cr_s = \
+                _srch_fit(est_factory(), True)
             n_cfg = hb_c.metadata_["n_models"]
             np.testing.assert_allclose(
                 np.asarray(hb_c.cv_results_["test_score"]),
                 np.asarray(hb_s.cv_results_["test_score"]), rtol=1e-5)
-            for name, wall, cpu, dev in (
-                    (f"{prefix}_concurrent", wall_c, cpu_c, dev_c),
-                    (f"{prefix}_sequential", wall_s, cpu_s, dev_s)):
+            for name, wall, cpu, dev, cr in (
+                    (f"{prefix}_concurrent", wall_c, cpu_c, dev_c,
+                     cr_c),
+                    (f"{prefix}_sequential", wall_s, cpu_s, dev_s,
+                     cr_s)):
                 _record({
                     "workload": name,
                     "configs": int(n_cfg),
@@ -2371,6 +2463,7 @@ def main():
                     "device_util": dev["utilization"],
                     "device_idle_s": dev["idle_s"],
                     "device_busy_s": dev["busy_s"],
+                    "critical": cr,
                     **(extra_cols or {}),
                 })
             _record({
@@ -2382,13 +2475,22 @@ def main():
                 "idle_delta_s": round(
                     dev_s["idle_s"] - dev_c["idle_s"], 4),
                 "results_equal_rtol": 1e-5,
+                # per-arm verdicts + the tool's saturation label: a
+                # ~1.0x pair with both arms host-saturated is PINNED,
+                # not a refuted overlap hypothesis (design.md §19)
+                "critical": _pair_critical(
+                    {"concurrent": cr_c, "sequential": cr_s},
+                    (round(cpu_c / max(wall_c, 1e-9), 3),
+                     round(cpu_s / max(wall_s, 1e-9), 3))),
                 **(extra_cols or {}),
             })
             return wall_s / max(wall_c, 1e-9)
 
-        _srch_pair("search", lambda: _SrchSGD(random_state=0))
-        _srch_pair("search_relay", lambda: _RelaySGD(random_state=0),
-                   {"emulated_stage_latency_ms": _RELAY_MS})
+        with _spans_armed():
+            _srch_pair("search", lambda: _SrchSGD(random_state=0))
+            _srch_pair("search_relay",
+                       lambda: _RelaySGD(random_state=0),
+                       {"emulated_stage_latency_ms": _RELAY_MS})
     except _SkipSection:
         pass
     except Exception:

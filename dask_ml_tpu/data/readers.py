@@ -237,6 +237,14 @@ class _DatasetStream:
         self._ds = ds
         self._epochs = list(epoch_range)
         self._first_start = max(int(start), 0)
+        # graftpath stitching (design.md §19): the stream is opened on
+        # the consuming side (as_block_source, inside the pipeline's
+        # stream span) — capture that span id so the READER threads'
+        # work intervals (``data.parse`` pread+decompress, ``data.fetch``
+        # emulated RTT) attach under the owning stream instead of being
+        # dropped as rootless; None (no open span / tracing off) keeps
+        # the readers span-silent.
+        self._trace_parent = obs.current_span_id()
         self._budget = ds.budget if ds.budget is not None \
             else FaultBudget.from_env(name=f"{ds.label}-readers")
         self._cond = threading.Condition()
@@ -251,6 +259,12 @@ class _DatasetStream:
     # -- epoch lifecycle ----------------------------------------------
     def _open_epoch(self, epoch: int, start: int) -> None:
         ds = self._ds
+        if self._trace_parent is None:
+            # stream constructed outside any span (a dataset built
+            # ahead of the fit): re-capture at first pull, which runs
+            # under the pipeline's stream/parse scope — so the reader
+            # intervals still join the owning fit's timeline
+            self._trace_parent = obs.current_span_id()
         self._plan = ds.plan(epoch)
         self._next_seq = min(start, self._plan.n_blocks)
         self._end_seq = self._plan.n_blocks
@@ -274,7 +288,11 @@ class _DatasetStream:
         hb = _supervisor.register(
             f"data-reader:{ds.label}#e{self._epoch}r{rid}", "data")
         # host-only reader by contract (_spmd.HOST_ONLY_THREAD_NAMES):
-        # it preads + decompresses shard bytes and never touches jax
+        # it preads + decompresses shard bytes and never touches jax —
+        # obs.record_span (the graftpath data.parse/data.fetch
+        # intervals) is pure-stdlib span bookkeeping, unprovable to the
+        # static index only because it is a cross-module call
+        # graftlint: disable=thread-dispatch -- host-only shard reader: pread + zlib + stdlib span records, never device program dispatch (runtime-verified: graftsan raises on a dispatching READER_THREAD_NAME)
         t = threading.Thread(
             target=self._reader, args=(rid, hb, resume_pos),
             daemon=True, name="dask-ml-tpu-data-reader",
@@ -356,8 +374,22 @@ class _DatasetStream:
                         _maybe_fault("data-reader")
                         hb.beat()
                         if ds.fetch_latency_s:
+                            # the emulated remote-store GET is a FETCH
+                            # interval, distinct from parse CPU — the
+                            # critical-path engine attributes them to
+                            # different categories (fetch-bound vs
+                            # parse-bound are different fixes)
+                            t_f = time.perf_counter()
                             time.sleep(ds.fetch_latency_s)
+                            obs.record_span(
+                                "data.fetch", t_f, time.perf_counter(),
+                                parent=self._trace_parent, seq=seq)
+                        t_p = time.perf_counter()
                         block = reader.read_block(int(order[j]))
+                        obs.record_span(
+                            "data.parse", t_p, time.perf_counter(),
+                            parent=self._trace_parent, shard=shard,
+                            seq=seq)
                         if not self._offer(seq, block):
                             return
                 finally:
@@ -439,8 +471,14 @@ class _DatasetStream:
 
     def _await_block(self):
         """The next in-order block of the live epoch, or None when the
-        epoch is drained."""
+        epoch is drained.  A contiguous wait for the head-of-line block
+        is the data plane's reorder-queue wait: it lands in the
+        ``data.queue_wait_s`` histogram (scraped via ``/metrics``) and
+        as ONE ``data.queue_wait`` span for the critical-path engine —
+        which attributes it to the readers' concurrent ``data.parse``
+        work when that explains it (design.md §19)."""
         ds = self._ds
+        wait_t0 = None
         while True:
             with self._cond:
                 if self._next_seq >= self._end_seq:
@@ -450,10 +488,18 @@ class _DatasetStream:
                     self._next_seq += 1
                     self._cond.notify_all()  # slide the window
                 else:
+                    if wait_t0 is None:
+                        wait_t0 = time.perf_counter()
                     self._cond.wait(timeout=_POLL_S)
             if block is None:
                 self._check_readers()  # liveness poll (outside the lock)
                 continue
+            if wait_t0 is not None:
+                now = time.perf_counter()
+                _registry().histogram(
+                    "data.queue_wait_s", ds.label).record(now - wait_t0)
+                obs.record_span("data.queue_wait", wait_t0, now,
+                                seq=self._next_seq - 1)
             self.blocks_delivered += 1
             rows = int(np.shape(block[0])[0]) if len(block) else 0
             self.rows_delivered += rows

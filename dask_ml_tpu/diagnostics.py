@@ -150,6 +150,14 @@ def run_report() -> dict:
       in), search rounds/units, with retry/checkpoint/violation events
       attached to the spans they occurred under.  ``None`` when tracing
       is disabled or nothing has completed.
+    * ``critical_path`` — graftpath's causal join of that root with the
+      graftscope device timeline and the queue-wait signals
+      (design.md §19): parse/stage/queue-wait/dispatch/device/fetch/
+      idle category seconds summing to the wall within
+      ``DASK_ML_TPU_CRITICAL_TOL``, overlap efficiency, and the
+      bottleneck verdict with its evidence chain.  Falls back to the
+      serve window's per-request queue/window/device/fetch split when
+      no root span exists.
     * ``metrics`` — the registry snapshot: counters, gauges, and
       histograms with p50/p95/p99 (``pipeline.block_s``,
       ``compile.duration_s``, ...).
@@ -168,9 +176,18 @@ def run_report() -> dict:
     its measured device lane in one trace.
     """
     resilience = fault_report()
+    # graftpath AFTER the settled device read below would re-settle;
+    # compute it first on its own settle so the last in-flight program
+    # closes before the window is attributed
+    obs.scope.settle(1.0)
     return {
         "schema": obs.SCHEMA_VERSION,
         "span_tree": obs.span_tree(),
+        # the causal critical path of the most recent root (fit/search),
+        # falling back to the serve window when no root exists —
+        # categories sum to wall within the documented tolerance and
+        # the bottleneck verdict carries its evidence (design.md §19)
+        "critical_path": obs.critical_path(),
         "metrics": obs.metrics_snapshot(),
         "device": obs.scope.device_report(settle_s=1.0),
         "pipeline": pipeline_report(),

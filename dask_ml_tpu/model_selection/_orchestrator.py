@@ -198,6 +198,11 @@ class SearchScheduler:
             waited = time.perf_counter() - t0
             reg.counter("search.throttled").inc()
             reg.histogram("search.queue_wait_s").record(waited)
+            # the park as an interval for the critical-path engine
+            # (queue_wait category — FED, not idle, per the honesty
+            # contract; parent = the search span the loop adopted)
+            _obs.record_span("search.queue_wait", t0,
+                             time.perf_counter())
         reg.gauge("search.inflight").set(float(_scope.pending_count()))
         await asyncio.sleep(0)
 

@@ -57,6 +57,7 @@ from .spans import (  # noqa: F401
     fmt_exc,
     last_root,
     open_span_paths,
+    record_span,
     span,
     span_records,
     span_tree,
@@ -77,11 +78,14 @@ from . import scope  # noqa: F401
 from .scope import device_report  # noqa: F401
 from . import serve  # noqa: F401
 from .serve import prometheus_text  # noqa: F401
+from . import critical  # noqa: F401
+from .critical import critical_path, serve_critical  # noqa: F401
 
 __all__ = [
     # spans
     "SCHEMA_VERSION", "TRACE_ENV", "RING_ENV",
-    "span", "event", "fmt_exc", "adopt", "current_span_id",
+    "span", "record_span", "event", "fmt_exc", "adopt",
+    "current_span_id",
     "enable", "disable", "enabled",
     "open_span_paths", "last_root", "span_records", "span_tree",
     "clear_spans", "Span", "SpanRecord",
@@ -94,6 +98,8 @@ __all__ = [
     "flight", "flight_dump", "flight_post_mortem", "flight_tail",
     # graftscope: device-time accounting + roofline + scrape endpoint
     "scope", "roofline", "device_report", "serve", "prometheus_text",
+    # graftpath: the causal critical-path engine (design.md §19)
+    "critical", "critical_path", "serve_critical",
     # lifecycle
     "install_jax_hooks", "reset_all",
 ]
@@ -109,14 +115,15 @@ def install_jax_hooks() -> None:
 
 def reset_all() -> None:
     """Zero the whole spine: metrics registry, span rings + last root,
-    the flight recorder, and the graftscope device timeline.
-    ``diagnostics.reset()`` is the public one-call form (it also
-    clears the legacy reporters' residue and re-registers the live
-    metrics-endpoint/sampler heartbeats)."""
+    the flight recorder, the graftscope device timeline, and the
+    graftpath last-verdict join.  ``diagnostics.reset()`` is the public
+    one-call form (it also clears the legacy reporters' residue and
+    re-registers the live metrics-endpoint/sampler heartbeats)."""
     reset_metrics()
     clear_spans()
     flight.clear()
     scope.reset()
+    critical.reset()
 
 
 # graftscope endpoint env arming (DASK_ML_TPU_METRICS_PORT): a set port

@@ -148,6 +148,11 @@ def read_jsonl(path: str) -> tuple[dict, list[dict]]:
     return first, records
 
 
+#: host span names that are device DISPATCH SITES — the source ends of
+#: the graftpath flow arrows into the device lane
+_FLOW_DISPATCH_NAMES = frozenset({"pipeline.compute"})
+
+
 def _json_attrs(attrs: dict) -> dict:
     return {k: (v if isinstance(v, (str, int, float, bool, type(None)))
                 else repr(v))
@@ -215,6 +220,34 @@ def perfetto_trace(records=None, device=None) -> dict:
                 **common, "ph": "X",
                 "dur": round((d["t1"] - d["t0"]) * 1e6, 3),
             })
+    # graftpath flow events (design.md §19): bind each device-lane slice
+    # to the host span that was driving the device when it was enqueued
+    # — the dispatch-site spans (``pipeline.compute``) whose window
+    # contains the interval's enqueue moment.  Perfetto renders the
+    # pair as an arrow from the host lane into the device lane, so the
+    # causal chain host-step → device-program is visible in the trace,
+    # not just inferable from vertical alignment.  Ambiguity resolves
+    # to the SMALLEST containing span (the innermost dispatch scope);
+    # an interval no dispatch span contains (serve-plane dispatches, a
+    # sanitizer-hook track from an unspanned thread) gets no arrow.
+    dispatch_spans = sorted(
+        ((d["t0"], d["t1"], tids[d["thread"]]) for d in dicts
+         if d["kind"] != "event" and d["name"] in _FLOW_DISPATCH_NAMES),
+        key=lambda s: s[1] - s[0])
+    flow_id = 0
+    flows = []
+    for iv in device:
+        host = next(((t0, t1, tid) for t0, t1, tid in dispatch_spans
+                     if t0 <= iv["t0"] <= t1), None)
+        if host is None:
+            continue
+        flow_id += 1
+        ts = round((iv["t0"] - epoch) * 1e6, 3)
+        common = {"name": "graftpath", "cat": "graftpath",
+                  "pid": pid, "id": flow_id}
+        flows.append({**common, "ph": "s", "tid": host[2], "ts": ts})
+        flows.append({**common, "ph": "f", "bp": "e", "tid": 0,
+                      "ts": ts})
     meta = [
         {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
          "args": {"name": thread}}
@@ -223,7 +256,8 @@ def perfetto_trace(records=None, device=None) -> dict:
     if device:
         meta.insert(0, {"ph": "M", "pid": pid, "tid": 0,
                         "name": "thread_name", "args": {"name": "device"}})
-    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    return {"traceEvents": meta + events + flows,
+            "displayTimeUnit": "ms"}
 
 
 def export_perfetto(path: str | None = None, records=None,

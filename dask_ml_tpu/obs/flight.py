@@ -78,6 +78,24 @@ def post_mortem(reason: str = "", n: int = FLIGHT_SIZE) -> str:
             lines.append(f"  {thread}: {path}")
     else:
         lines.append("open spans: (none)")
+    # OPEN graftscope intervals ride the dump too: a hang during a long
+    # device program used to show only host spans — no device context —
+    # so a watchdog/preemption post-mortem could not tell "wedged
+    # program" from "starved host".  Read-only (no sweep, no poll);
+    # guarded like the rest of the forensic path.
+    try:
+        from . import scope as _scope
+
+        open_ivs = _scope.open_intervals()
+    except Exception:  # pragma: no cover - forensic path must not throw
+        open_ivs = []
+    if open_ivs:
+        lines.append("open device intervals:")
+        for iv in open_ivs:
+            lines.append(f"  {iv['program']}: in flight "
+                         f"{iv['age_s']:.3f}s")
+    else:
+        lines.append("open device intervals: (none)")
     events = tail(n)
     lines.append(f"last {len(events)} events:")
     for e in events:

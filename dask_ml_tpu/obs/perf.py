@@ -41,6 +41,24 @@ so "utilization may not regress" becomes per-program, not just global.
 A drifted program SET (a dispatch path silently changed) fails like a
 block-count drift.
 
+v3 adds the GRAFTPATH columns (ISSUE 15, :mod:`.critical`): each
+workload commits its ``overlap_efficiency`` (hidden host time / host
+time — the structural number a saturation-pinned wall ratio cannot
+fake) and its ``bottleneck`` verdict (``{"class", "share"}``), and the
+ratchet
+
+* **floors overlap efficiency** (``OVERLAP_FLOOR_FACTOR`` × committed,
+  checked when the committed value is ≥ ``OVERLAP_MIN_BASE``): a
+  pipeline that silently stops overlapping fails the gate even when
+  its p50 stays inside the latency band;
+* **pins the bottleneck class**: a CONFIDENT flip — committed share
+  and measured share both ≥ ``BOTTLENECK_PIN_SHARE`` with different
+  classes — is a regression (a sleep smuggled into the step path flips
+  a device-bound workload to dispatcher-bound long before any wall
+  band notices on a fast box).  Unconfident wobble between near-equal
+  categories deliberately does NOT pin — the gate box is loaded and a
+  32/30 split is not a verdict.
+
 Workloads are deliberately tiny-but-not-trivial: block shapes chosen
 so the device step costs milliseconds (a measurable busy interval on
 this image) and bucket-aligned (16384 = the ``auto`` ladder's 16k rung,
@@ -83,7 +101,7 @@ __all__ = [
 #: ``tools/perf_baseline.json`` next to a repo checkout).
 PERF_BASELINE_ENV = "DASK_ML_TPU_PERF_BASELINE"
 
-_VERSION = 2  # v2: per-program roofline columns (flops/bytes/frac)
+_VERSION = 3  # v3: graftpath columns (overlap_efficiency, bottleneck)
 _SEED = 11
 _BLOCKS = 10
 _ROWS, _DIM = 16384, 32  # 16k = an `auto` bucket rung: no pad, no drift
@@ -102,6 +120,13 @@ STALL_BAND = (3.0, 0.20)
 #: committed fraction is big enough to floor at all.
 ROOFLINE_FLOOR_FACTOR = 0.25
 ROOFLINE_MIN_BASE = 1e-4
+#: graftpath (v3) bands: overlap efficiency floors like utilization
+#: (half the committed value, only when committed is real), and the
+#: bottleneck class pins only on a CONFIDENT flip — both the committed
+#: and the measured winning category at >= this share of the wall.
+OVERLAP_FLOOR_FACTOR = 0.5
+OVERLAP_MIN_BASE = 0.10
+BOTTLENECK_PIN_SHARE = 0.5
 
 
 def _program_roofline(dev: dict) -> dict:
@@ -172,12 +197,28 @@ def _inject(model, sleep_s: float):
     return model
 
 
+def _graftpath_cols(cp: dict | None) -> dict:
+    """The two committed v3 columns from one critical-path result
+    (None → explicit nulls: an entry must say "no verdict", not omit
+    the field and read as pre-v3)."""
+    if not cp:
+        return {"overlap_efficiency": None, "bottleneck": None}
+    v = cp.get("verdict") or {}
+    return {
+        "overlap_efficiency": cp.get("overlap_efficiency"),
+        "bottleneck": {"class": v.get("class", "unknown"),
+                       "share": round(float(v.get("confidence") or 0.0),
+                                      4)},
+    }
+
+
 def _run_streamed(make_model, blocks_fn, depth, *, fit_kwargs=None,
                   inject_s: float = 0.0) -> dict:
     """Warmup round (compiles) then a measured round of the SAME model
     over fresh same-shaped blocks; returns the committed metrics."""
     from .. import diagnostics
     from ..pipeline import stream_partial_fit
+    from . import critical as _critical
     from . import scope as _scope
     from .metrics import registry as _registry
 
@@ -193,6 +234,9 @@ def _run_streamed(make_model, blocks_fn, depth, *, fit_kwargs=None,
     hist = _registry().histogram("pipeline.block_s")
     rep = diagnostics.pipeline_report()
     dev = _scope.device_report(since=cur, settle_s=5.0)
+    # graftpath verdict of the measured stream (the device report
+    # above already settled, so the window's last interval is closed)
+    cp = _critical.critical_path()
     wall = float(rep.get("wall_s", 0.0)) or 1e-9
     return {
         "blocks": int(rep.get("blocks", 0)),
@@ -204,6 +248,7 @@ def _run_streamed(make_model, blocks_fn, depth, *, fit_kwargs=None,
         "wall_s": round(wall, 6),
         "device_busy_s": dev["busy_s"],
         "programs": _program_roofline(dev),
+        **_graftpath_cols(cp),
     }
 
 
@@ -328,6 +373,7 @@ def _wl_serve(inject_s=0.0):
         server._test_dispatch_delay_s = float(inject_s)
         _registry().reset(prefix="serve.request_s")
         _registry().reset(prefix="serve.queue_wait_s")
+        _registry().reset(prefix="serve.req_")  # the graftpath split
         cur = _scope.cursor()
         t0 = time.perf_counter()
         for i in range(_SERVE_1ROW):
@@ -339,6 +385,12 @@ def _wl_serve(inject_s=0.0):
         hist = _registry().histogram("serve.request_s", "m")
         qwait = _registry().histogram("serve.queue_wait_s", "m")
         dev = _scope.device_report(since=cur, settle_s=5.0)
+        from . import critical as _critical
+
+        # the serve plane's verdict comes from the per-request split
+        # the measured window recorded (queue/window/device/fetch);
+        # overlap efficiency is a pipeline number and stays null here
+        sc = _critical.serve_critical()
         return {
             "blocks": _SERVE_1ROW + _SERVE_16ROW,
             "p50_block_s": round(float(hist.quantile(0.50)), 6),
@@ -349,6 +401,7 @@ def _wl_serve(inject_s=0.0):
             "wall_s": round(wall, 6),
             "device_busy_s": dev["busy_s"],
             "programs": _program_roofline(dev),
+            **_graftpath_cols(sc),
         }
     finally:
         server.close()
@@ -427,6 +480,9 @@ def _wl_search(inject_s=0.0):
     hist = _registry().histogram("search.round_s")
     qwait = _registry().histogram("search.queue_wait_s")
     dev = _scope.device_report(since=cur, settle_s=5.0)
+    from . import critical as _critical
+
+    cp = _critical.critical_path()  # root: the measured search.fit
     # pin the committed table to CACHED programs only: the search's
     # scoring path runs plain-jit ops that graftscope only sees when
     # graftsan's ExecuteReplicated hook happens to be installed (e.g.
@@ -444,6 +500,7 @@ def _wl_search(inject_s=0.0):
         "wall_s": round(wall, 6),
         "device_busy_s": dev["busy_s"],
         "programs": programs,
+        **_graftpath_cols(cp),
     }
 
 
@@ -459,7 +516,17 @@ WORKLOADS = {
 
 def run_workload(name: str, inject_s: float = 0.0) -> dict:
     """Run one workload; an exception becomes an ``error`` metric (a
-    hard ratchet failure), never a crash of the suite."""
+    hard ratchet failure), never a crash of the suite.  Span recording
+    is armed (ring-only) around the workload if it is not already —
+    the v3 graftpath columns are assembled from the span timeline, and
+    a CLI run (``tools/lint.sh --perf``) has no conftest to arm it —
+    and RESTORED after: an in-process caller (bench.py's roofline
+    section) keeps its own tracing-off posture."""
+    from . import spans as _spans
+
+    armed_here = not _spans.enabled()
+    if armed_here:
+        _spans.enable()
     try:
         return WORKLOADS[name](inject_s=inject_s)
     except KeyError:
@@ -468,7 +535,11 @@ def run_workload(name: str, inject_s: float = 0.0) -> dict:
         return {"blocks": 0, "p50_block_s": 0.0, "p99_block_s": 0.0,
                 "utilization": 0.0, "stall_fraction": 0.0, "wall_s": 0.0,
                 "device_busy_s": 0.0, "programs": {},
+                "overlap_efficiency": None, "bottleneck": None,
                 "error": f"{type(e).__name__}: {e}"}
+    finally:
+        if armed_here:
+            _spans.disable()
 
 
 def run_suite(names=None, inject_s: float = 0.0) -> dict:
@@ -590,6 +661,39 @@ def compare(snapshot: dict, results: dict, *, partial: bool = False) -> dict:
                 f"{name}: stall_fraction {m['stall_fraction']:.3f} > "
                 f"ceiling {s_ceil:.3f} — the consumer is starving "
                 f"where the committed run overlapped")
+        # graftpath v3: overlap-efficiency floor + bottleneck-class pin
+        # (both skipped against a pre-v3 snapshot entry, which carries
+        # neither column — same posture as the programs table below)
+        b_oe = base.get("overlap_efficiency")
+        if b_oe is not None and b_oe >= OVERLAP_MIN_BASE:
+            m_oe = m.get("overlap_efficiency") or 0.0
+            floor = b_oe * OVERLAP_FLOOR_FACTOR
+            if m_oe < floor:
+                regressions.append(
+                    f"{name}: overlap_efficiency {m_oe:.3f} < floor "
+                    f"{floor:.3f} (baseline {b_oe:.3f} × "
+                    f"{OVERLAP_FLOOR_FACTOR}) — the pipeline stopped "
+                    f"hiding host time under device time; the wall "
+                    f"bands may not notice on a fast box, the "
+                    f"structure gate does")
+        b_bn = base.get("bottleneck")
+        if b_bn is not None and isinstance(b_bn, dict):
+            m_bn = m.get("bottleneck") or {}
+            b_cls = b_bn.get("class", "unknown")
+            m_cls = m_bn.get("class", "unknown")
+            if (b_cls not in ("unknown",)
+                    and m_cls != b_cls
+                    and float(b_bn.get("share") or 0.0)
+                    >= BOTTLENECK_PIN_SHARE
+                    and float(m_bn.get("share") or 0.0)
+                    >= BOTTLENECK_PIN_SHARE):
+                regressions.append(
+                    f"{name}: bottleneck verdict flipped {b_cls} "
+                    f"(share {b_bn.get('share')}) → {m_cls} (share "
+                    f"{m_bn.get('share')}) — the workload's critical "
+                    f"path moved to a different plane; fix it or "
+                    f"rebaseline deliberately "
+                    f"(tools/lint.sh --rebaseline)")
         # per-program roofline ratchet: the utilization floor, but per
         # cached program — a workload whose aggregate numbers hold can
         # still lose one program's roofline standing (a donation
@@ -726,11 +830,20 @@ def main(argv=None) -> int:
                          indent=2, sort_keys=True))
     else:
         for name, m in sorted(results.items()):
+            # graftpath columns (v3): overlap n/a = no host stage time
+            # to hide (the serve plane); the verdict share in parens
+            bn = m.get("bottleneck") or {}
+            oe = m.get("overlap_efficiency")
             print(f"{name}: p50={m['p50_block_s'] * 1e3:.2f}ms "
                   f"p99={m['p99_block_s'] * 1e3:.2f}ms "
                   f"util={m['utilization']:.3f} "
                   f"stall={m['stall_fraction']:.3f} "
-                  f"wall={m['wall_s']:.3f}s"
+                  f"wall={m['wall_s']:.3f}s "
+                  + (f"overlap={oe:.3f} " if oe is not None
+                     else "overlap=n/a ")
+                  + (f"bottleneck={bn.get('class')}"
+                     f"({bn.get('share', 0):.2f})" if bn
+                     else "bottleneck=n/a")
                   + (f" ERROR={m['error']}" if m.get("error") else ""))
             for pname, p in sorted((m.get("programs") or {}).items()):
                 frac = p.get("roofline_frac")

@@ -73,6 +73,7 @@ __all__ = [
     "timeline",
     "device_report",
     "pending_count",
+    "open_intervals",
     "rearm",
     "reset",
 ]
@@ -339,6 +340,21 @@ def pending_count() -> int:
         return len(_PENDING)
 
 
+def open_intervals() -> list[dict]:
+    """Every still-in-flight dispatch as ``{"program", "t0", "age_s"}``
+    (oldest first) — the forensic read the flight-recorder dump uses: a
+    hang DURING a long device program must show which program was in
+    flight and for how long, not just the host-side open spans.  Pure
+    host read; no sweep (the dump path must not poll readiness)."""
+    now = time.perf_counter()
+    with _LOCK:
+        out = [{"program": p.program, "t0": p.t0,
+                "age_s": round(max(now - p.t0, 0.0), 6)}
+               for p in _PENDING]
+    out.sort(key=lambda iv: iv["t0"])
+    return out
+
+
 def timeline(since: int | None = None, open_until: float | None = None):
     """Retained intervals (oldest first): closed ones from the ring
     plus — so a live scrape mid-fit sees the current busy period —
@@ -469,12 +485,17 @@ def device_report(since: int | None = None, *, settle_s: float = 0.0,
         if w is not None:
             p.update(_roofline.attribution(w[0], w[1], w[2], peaks))
     search = _search_section()
+    from . import critical as _critical
+
+    verdicts = _critical.last_verdicts()
     if not ivs:
         out = {"dispatches": 0, "busy_s": 0.0, "window_s": 0.0,
                "idle_s": 0.0, "utilization": 0.0, "idle_gaps": [],
                "programs": {}, "pending": pending_count()}
         if search is not None:
             out["search"] = search
+        if verdicts:
+            out["critical"] = verdicts
         return out
     busy, merged, gaps = _merge(ivs)
     window = max(iv["t1"] for iv in ivs) - ivs[0]["t0"]
@@ -494,6 +515,11 @@ def device_report(since: int | None = None, *, settle_s: float = 0.0,
         out["roofline"] = {"platform": platform, "peaks": peaks}
     if search is not None:
         out["search"] = search
+    # graftpath join (design.md §19): the most recent per-plane
+    # bottleneck verdicts next to the occupancy they interpret —
+    # absent when no verdict has been computed (no invented story)
+    if verdicts:
+        out["critical"] = verdicts
     return out
 
 

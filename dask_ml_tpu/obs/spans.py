@@ -49,6 +49,7 @@ __all__ = [
     "RING_ENV",
     "Span",
     "span",
+    "record_span",
     "event",
     "fmt_exc",
     "adopt",
@@ -242,6 +243,38 @@ def span(name: str, *, parent: int | None = None, detached: bool = False,
     if not _STATE.enabled:
         return _NOOP
     return Span(name, parent, detached, attrs)
+
+
+def record_span(name: str, t0: float, t1: float, *,
+                parent: int | None = None, **attrs) -> None:
+    """Record an ALREADY-ELAPSED interval as a completed span.
+
+    The retroactive form :mod:`.critical`'s wait signals need: a
+    contiguous queue wait is only known to have been a wait once it
+    ends (the consumer's ``q.get`` loop, the reorder-merge wait), so
+    the producer stamps ``t0`` when the wait begins and calls this when
+    it resolves.  Parentage follows :func:`event`'s rule (innermost
+    open span on this thread, else the adopt target) unless ``parent``
+    is given — pass an explicit parent from rootless threads (dataset
+    readers), or skip the call entirely when no parent exists, so a
+    retroactive record can never steal ``last_root`` from a real fit.
+    No-op while tracing is disabled."""
+    if not _STATE.enabled:
+        return
+    if parent is None:
+        st = getattr(_TLS, "stack", None)
+        parent = (st[-1].span_id if st
+                  else getattr(_TLS, "adopt", None))
+    if parent is None:
+        # a retroactive record may not become a root: _emit would
+        # publish it as last_root and run_report's tree would show a
+        # stray wait instead of the fit — drop instead (the registry
+        # histograms the producers also write keep the totals)
+        return
+    _emit(SpanRecord(
+        "span", next(_ids), parent, name, float(t0),
+        max(float(t1), float(t0)), threading.current_thread().name,
+        attrs))
 
 
 def event(name: str, *, parent: int | None = None, **attrs) -> None:

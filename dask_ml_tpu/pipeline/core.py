@@ -94,6 +94,11 @@ _DONE = object()  # worker sentinel: source exhausted
 #: the worker's liveness (the dead-thread verdict's detection latency)
 _POLL_S = 0.05
 
+#: a contiguous consumer wait on the staged queue shorter than this is
+#: loop overhead, not a stall — no ``pipeline.stall`` span is recorded
+#: for it (the stats.stall_s scalar still counts every microsecond)
+_STALL_SPAN_MIN_S = 0.002
+
 
 class _BlockFault(Exception):
     """Internal: one block's pipeline failure with position + phase
@@ -305,9 +310,19 @@ def _staged_iter(src, stage, depth: int, stats: PipelineStats,
         hb_box[0] = hb
         worker.start()
         fault: _BlockFault | None = None
+        # consumer-starvation interval tracking (graftpath, design.md
+        # §19): a contiguous wait on the staged queue spans several
+        # _POLL_S-bounded gets; wait_t0 marks where it began and the
+        # whole interval lands as ONE ``pipeline.stall`` span when the
+        # block finally arrives — the queue-wait signal the critical-
+        # path engine attributes (to the producer's concurrent parse/
+        # stage when one explains it, to queue_wait when nothing does).
+        wait_t0: float | None = None
         try:
             while True:
                 t0 = time.perf_counter()
+                if wait_t0 is None:
+                    wait_t0 = t0
                 try:
                     msg = q.get(timeout=_POLL_S)
                 except queue.Empty:
@@ -327,6 +342,11 @@ def _staged_iter(src, stage, depth: int, stats: PipelineStats,
                         break  # crash verdict below
                 else:
                     stats.stall_s += time.perf_counter() - t0
+                now = time.perf_counter()
+                if now - wait_t0 >= _STALL_SPAN_MIN_S:
+                    obs.record_span("pipeline.stall", wait_t0, now,
+                                    block=state["blk"])
+                wait_t0 = None
                 if msg[0] == "done":
                     return
                 if msg[0] == "fault":

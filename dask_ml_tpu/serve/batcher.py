@@ -100,10 +100,17 @@ class ServeFuture:
 class Request:
     """One submitted predict: host rows + bookkeeping.  ``mode`` is
     ``"label"`` (decode to classes / regression values) or ``"proba"``
-    (per-class probabilities via the donated device transform)."""
+    (per-class probabilities via the donated device transform).
+
+    ``id`` is the request's TRACE ID: it rides the whole causal chain —
+    submit (``t_enqueue``) → batcher coalesce (``t_dequeue``, stamped
+    when the gather loop pops the request off the admission queue) →
+    dispatch → fetch — so the runtime can record an exact per-request
+    queue/window/device/fetch latency split (design.md §19) and the
+    flight-recorder slow-request exemplars name the request they saw."""
 
     __slots__ = ("id", "model", "x", "n", "future", "t_enqueue",
-                 "t_deadline", "mode")
+                 "t_dequeue", "t_deadline", "mode")
 
     def __init__(self, model: str, x: np.ndarray, future: ServeFuture,
                  deadline_s: float, mode: str = "label"):
@@ -114,6 +121,7 @@ class Request:
         self.future = future
         self.mode = mode
         self.t_enqueue = time.monotonic()
+        self.t_dequeue = None  # stamped by MicroBatcher.gather
         self.t_deadline = (self.t_enqueue + deadline_s
                            if deadline_s > 0 else None)
 
@@ -171,6 +179,14 @@ class MicroBatcher:
         self._q.put(item)
 
     # -- serve-loop side -------------------------------------------------
+    @staticmethod
+    def _stamp_dequeue(item) -> None:
+        """End of the request's QUEUE leg: the first time the gather
+        loop holds it.  A carried request keeps its original stamp —
+        the carry wait is the batcher's choice, i.e. window time."""
+        if isinstance(item, Request) and item.t_dequeue is None:
+            item.t_dequeue = time.monotonic()
+
     def gather(self, stop: threading.Event, poll_s: float = 0.05):
         """One micro-batch: block for the first item (``None`` when the
         loop should re-check ``stop``), then — for plain requests —
@@ -183,6 +199,7 @@ class MicroBatcher:
                 first = self._q.get(timeout=poll_s)
             except queue.Empty:
                 return None
+        self._stamp_dequeue(first)
         if not isinstance(first, Request):
             return [first]
         batch = [first]
@@ -205,6 +222,7 @@ class MicroBatcher:
                     break
                 time.sleep(min(remaining, 0.0005))
                 continue
+            self._stamp_dequeue(item)
             if not isinstance(item, Request):
                 # control item mid-gather: dispatch the batch first,
                 # handle control next round (order preserved)

@@ -26,7 +26,13 @@
 Honesty contract (mirrors graftscope's): request latency INCLUDES queue
 wait and the adaptive gather window — the number a client experiences —
 while ``serve.batch_window_s`` and ``serve.queue_wait_s`` split out how
-much of it was the batcher's own choice.
+much of it was the batcher's own choice.  Since graftpath (design.md
+§19) every fulfilled request additionally records its EXACT four-leg
+split — ``serve.req_{queue,window,device,fetch}_s``, contiguous stamps
+on one clock so they sum to ``serve.request_s`` — keyed by the
+request's trace id from submit through coalesce, dispatch, and fetch;
+the slowest request seen leaves a flight-recorder exemplar carrying
+that id and its split.
 """
 
 from __future__ import annotations
@@ -132,6 +138,9 @@ class ModelServer:
         #: perf-harness hook: an injected per-dispatch sleep the
         #: committed latency ratchet must fail on (obs/perf.py)
         self._test_dispatch_delay_s = 0.0
+        #: slowest request seen (monotone): the flight-recorder
+        #: exemplar threshold — serve-loop-only state, no lock needed
+        self._slowest_s = 0.0
         self._start_loop()
         with _SERVERS_LOCK:
             _SERVERS.append(self)
@@ -524,13 +533,55 @@ class ModelServer:
                 if not r.future.done():
                     r.future.set_exception(exc)
 
-    def _fulfill(self, reqs: list, preds_by_req: list) -> None:
+    def _fulfill(self, reqs: list, preds_by_req: list,
+                 t_dispatch0: float | None = None,
+                 t_dispatched: float | None = None) -> None:
+        """Resolve the group's futures and record each request's exact
+        latency split (design.md §19).  Four CONTIGUOUS legs per
+        request — stamped on one clock, so they sum to ``request_s``
+        exactly:
+
+        * ``queue``  — submit → popped off the admission queue;
+        * ``window`` — popped → this group's dispatch began (the gather
+          window's coalescing wait plus batch grouping);
+        * ``device`` — dispatch began → the device program call
+          returned (staging put + program enqueue; on an inline/sync
+          backend the execution itself — on an async one the residual
+          device time surfaces in the fetch leg, same honesty note as
+          ``diagnostics._sync``);
+        * ``fetch``  — program call returned → future resolved (result
+          fetch, host decode, per-request slice-back).
+        """
         reg = _registry()
         done = time.monotonic()
         for r, p in zip(reqs, preds_by_req):
             r.future.set_result(p)
-            reg.histogram("serve.request_s", r.model).record(
-                done - r.t_enqueue)
+            lat = done - r.t_enqueue
+            reg.histogram("serve.request_s", r.model).record(lat)
+            if t_dispatch0 is None or t_dispatched is None or \
+                    r.t_dequeue is None:
+                continue  # a path without stamps records only the total
+            split = {
+                "queue": max(r.t_dequeue - r.t_enqueue, 0.0),
+                "window": max(t_dispatch0 - r.t_dequeue, 0.0),
+                "device": max(t_dispatched - t_dispatch0, 0.0),
+                "fetch": max(done - t_dispatched, 0.0),
+            }
+            for leg, dt in split.items():
+                reg.histogram(f"serve.req_{leg}_s", r.model).record(dt)
+            # slowest-request exemplar: a monotone-max record in the
+            # flight recorder, so a post-mortem shows WHERE the worst
+            # request's time went (trace id + split), not just that a
+            # p99 existed
+            if lat > self._slowest_s:
+                self._slowest_s = lat
+                obs.event(
+                    "serve.slow_request", request=r.id, model=r.model,
+                    request_ms=round(lat * 1e3, 3),
+                    queue_ms=round(split["queue"] * 1e3, 3),
+                    window_ms=round(split["window"] * 1e3, 3),
+                    device_ms=round(split["device"] * 1e3, 3),
+                    fetch_ms=round(split["fetch"] * 1e3, 3))
 
     @staticmethod
     def _concat_rows(reqs: list) -> np.ndarray:
@@ -544,6 +595,7 @@ class ModelServer:
         from . import programs as _sprog
 
         reg = _registry()
+        t_dispatch0 = time.monotonic()  # the group's device leg begins
         X = self._concat_rows(reqs)
         n_real = X.shape[0]
         self.registry.touch(rm)
@@ -557,7 +609,9 @@ class ModelServer:
                 # the load-time warmup already compiled — the steady
                 # request path never compiles for ANY admitted model
                 padded, n = stage_predict_block(X, self.registry.policy)
-                preds = np.asarray(rm.model.predict(padded))
+                m = rm.model.predict(padded)
+                t_dispatched = time.monotonic()
+                preds = np.asarray(m)
                 if n is not None:
                     preds = preds[:n]
             else:
@@ -565,7 +619,9 @@ class ModelServer:
                 # gate _partial.predict applies: padding a host model's
                 # input wastes its whole-batch compute and is only
                 # exact for strictly row-wise predicts
-                preds = np.asarray(rm.model.predict(X))
+                preds = rm.model.predict(X)
+                t_dispatched = time.monotonic()
+                preds = np.asarray(preds)
         else:
             # the ONE predict-staging entry the offline plane also
             # uses, so the pad discipline cannot drift between planes
@@ -573,6 +629,7 @@ class ModelServer:
             self.registry.ensure_resident(rm)
             xb = jnp.asarray(padded)
             m = _sprog.margins(rm.coef, rm.intercept, xb)
+            t_dispatched = time.monotonic()  # program enqueued
             mnp = np.asarray(m)  # fetched BEFORE the transform below
             if any(r.mode == "proba" for r in reqs):
                 # in-place on device: proba donates (and overwrites)
@@ -589,7 +646,7 @@ class ModelServer:
             src = probs if r.mode == "proba" else preds
             out.append(src[lo:lo + r.n])
             lo += r.n
-        self._fulfill(reqs, out)
+        self._fulfill(reqs, out, t_dispatch0, t_dispatched)
 
     def _dispatch_pack(self, key, groups: list) -> None:
         """Requests for >= 2 homogeneous models in one window: ONE
@@ -602,6 +659,7 @@ class ModelServer:
         from . import programs as _sprog
 
         reg = _registry()
+        t_dispatch0 = time.monotonic()  # the group's device leg begins
         pack = self.registry._packs[key]
         for rm, _ in groups:
             self.registry.ensure_resident(rm)
@@ -621,8 +679,9 @@ class ModelServer:
             for r in reqs:
                 xs[lane, lo:lo + r.n] = r.x
                 lo += r.n
-        out = np.asarray(
-            _sprog.lane_margins(coefs, intercepts, jnp.asarray(xs)))
+        m = _sprog.lane_margins(coefs, intercepts, jnp.asarray(xs))
+        t_dispatched = time.monotonic()  # program enqueued
+        out = np.asarray(m)
         n_requests = 0
         for rm, reqs in groups:
             lane_m = out[lanes[rm.name]]
@@ -631,7 +690,7 @@ class ModelServer:
             for r in reqs:
                 outs.append(preds[lo:lo + r.n])
                 lo += r.n
-            self._fulfill(reqs, outs)
+            self._fulfill(reqs, outs, t_dispatch0, t_dispatched)
             reg.counter("serve.dispatches", rm.name).inc()
             n_requests += len(reqs)
         reg.counter("serve.lane_dispatches").inc()

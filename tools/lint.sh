@@ -32,8 +32,14 @@
 # workload also prints + ratchets its PER-PROGRAM ROOFLINE columns
 # (busy_s / flops / bytes / roofline_frac vs the obs/roofline.py peak
 # table, design.md §16) with a x0.25 per-program floor and a
-# program-set drift gate.  Tier-1 runs the same gate via
-# tests/test_graftscope.py.
+# program-set drift gate.  Since v3 every workload also prints +
+# ratchets its GRAFTPATH columns (design.md §19): overlap efficiency
+# (hidden host time / host time, floored at x0.5 of the committed
+# value) and the bottleneck verdict (device/parse/stage/dispatcher/
+# queue-bound with its share; a CONFIDENT class flip — both shares
+# >= 0.5 — fails the gate even when every wall band holds, which is
+# exactly what --inject-slowdown demonstrates).  Tier-1 runs the same
+# gate via tests/test_graftscope.py.
 #
 # Usage:
 #   tools/lint.sh                 # static ratchet gate (text output)
