@@ -402,6 +402,10 @@ def _compact_line(result):
         if "device_util" in w_obs:
             ent["util"] = w_obs["device_util"]
             ent["idle_s"] = w_obs["device_idle_s"]
+        # graftlock contention: this workload's lock-wait delta rides
+        # the compact line next to the obs totals block
+        if "lock_wait_s" in w_obs:
+            ent["lkw_s"] = w_obs["lock_wait_s"]
         if w.get("from_partial"):
             ent["carried"] = True
         ws.append(ent)
@@ -689,6 +693,19 @@ def main():
     from dask_ml_tpu import obs as _obs
 
     _obs.install_jax_hooks()
+    # graftlock contention: arm the lock monitor for the whole bench so
+    # every package NamedLock books lock.wait_s/held_s into the same
+    # registry; per-workload wait deltas ride the obs blocks below and
+    # the compact line (violations are not gated here — that is the
+    # lint.sh --locks ratchet's job, on the smoke suite, not the bench)
+    try:
+        from dask_ml_tpu import _locks as _named_locks
+        from dask_ml_tpu.sanitize import locks as _graftlock
+
+        if _named_locks.monitor() is None:
+            _named_locks.set_monitor(_graftlock.LockMonitor())
+    except Exception:
+        extra["lock_monitor_error"] = traceback.format_exc(limit=2)
     _obs_prev = {}
     _scope_cursor = {"pos": 0}
 
@@ -748,9 +765,17 @@ def main():
         reg = _obs.registry()
         # graftscope device seconds: sum over the per-program busy
         # histogram family (tags = program names)
-        dev_busy = sum(inst.sum for name, _tag, inst in reg.export_items()
-                       if name == "device.busy_s")
+        dev_busy = 0.0
+        lock_wait = 0.0
+        for name, _tag, inst in reg.export_items():
+            if name == "device.busy_s":
+                dev_busy += inst.sum
+            elif name == "lock.wait_s":
+                lock_wait += inst.sum
         return {
+            # µs-scale when uncontended — keep 6 decimals so a real
+            # contention delta is visible, not rounded into the floor
+            "lock_wait_s": round(lock_wait, 6),
             "compiles": reg.counter("compile.count").value,
             "compile_s": round(
                 reg.histogram("compile.duration_s").sum, 3),
@@ -774,7 +799,7 @@ def main():
             d = v - _obs_prev.get(k, 0)
             if d < 0:  # a reset_*() inside a section restarted the books
                 d = v
-            delta[k] = round(d, 3)
+            delta[k] = round(d, 6 if k == "lock_wait_s" else 3)
         _obs_prev.update(cur)
         out = {k: (int(v) if k in ("compiles", "retries", "faults",
                                    "device_dispatches")

@@ -1,0 +1,203 @@
+"""Named lock factory — every lock the package creates, behind one door.
+
+``threading.Lock()`` is anonymous: a post-mortem stack shows WHERE a
+thread is blocked but not WHICH lock it wants, and nothing in the
+process can enumerate the locks that exist, let alone the order they
+are taken in.  With a five-plane concurrent runtime (serve loop,
+search dispatcher, compile-ahead builder, shard readers, prefetch
+workers, plus the obs sampler/endpoint threads) that opacity is the
+difference between "the PR-1 deadlock took a day of stack-reading"
+and "the order graph names the cycle".
+
+So the package's locks are constructed HERE, with a canonical dotted
+name::
+
+    _SERVERS_LOCK = make_lock("serve.servers")
+    self._lock    = make_lock("serve.server")
+    self._cond    = make_condition("data.readers")
+
+A :class:`NamedLock` is a thin veneer over the real ``threading``
+primitive: when no monitor is armed (the default, and the production
+state) ``acquire``/``release`` delegate straight through — one
+attribute read of overhead.  When graftlock's runtime half
+(:mod:`dask_ml_tpu.sanitize.locks`) arms a monitor via
+:func:`set_monitor`, every acquisition reports (name, thread, wait
+seconds) and every release reports held seconds, feeding the
+per-thread lockset, the global order graph, and the
+``lock.wait_s``/``lock.held_s`` registry histograms.
+
+Naming convention: ``<plane>.<role>`` (``programs.cache``,
+``search.dispatcher``, ``obs.scope``).  Instances of one class share
+one name — the order graph reasons about lock CLASSES, exactly like
+the static ``lock-order-cycle`` rule, so "any ModelServer._lock then
+any CachedProgram._lock" is one edge regardless of instance count.
+
+Deliberately NOT converted: the metrics registry's instrument leaf
+locks (obs/metrics.py).  They are the hottest locks in the process
+(every counter inc), they are leaves by construction (nothing is
+acquired under them), and the monitor itself books histograms through
+them — naming them would buy nothing and cost a recursion guard on
+the hottest path.  The static rules see them regardless (a raw
+``threading.Lock()`` is as visible to the AST as a factory call).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = [
+    "NamedCondition",
+    "NamedLock",
+    "make_condition",
+    "make_lock",
+    "make_rlock",
+    "monitor",
+    "set_monitor",
+]
+
+#: the armed LockMonitor (sanitize/locks.py) or None.  Read ONCE per
+#: acquire/release into a local so an arm/disarm racing an acquisition
+#: sees a consistent monitor for that event pair.
+_MONITOR = None
+
+
+def set_monitor(mon) -> None:
+    """Arm (or, with None, disarm) the process-wide lock monitor."""
+    global _MONITOR
+    _MONITOR = mon
+
+
+def monitor():
+    """The armed monitor, or None."""
+    return _MONITOR
+
+
+class NamedLock:
+    """A ``threading.Lock``/``RLock`` with a canonical name and a
+    monitor hook.  Context-manager and acquire/release surfaces match
+    the raw primitive; ``reentrant=True`` wraps an RLock (the monitor
+    sees the reacquisition depth and skips self-edges)."""
+
+    __slots__ = ("name", "reentrant", "_inner")
+
+    def __init__(self, name: str, *, reentrant: bool = False):
+        self.name = name
+        self.reentrant = reentrant
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        mon = _MONITOR
+        if mon is None:
+            return self._inner.acquire(blocking, timeout)
+        t0 = time.perf_counter()
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            mon.on_acquire(self, time.perf_counter() - t0)
+        return got
+
+    def release(self) -> None:
+        mon = _MONITOR
+        if mon is not None:
+            mon.on_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        kind = "RLock" if self.reentrant else "Lock"
+        return f"<NamedLock {self.name!r} ({kind})>"
+
+
+class NamedCondition:
+    """A ``threading.Condition`` whose underlying lock is a
+    :class:`NamedLock` (fresh, or a caller-shared one).  ``wait``
+    reports the release/reacquire pair to the monitor — a waiter does
+    NOT hold the lock while parked, and the order graph must not think
+    it does."""
+
+    __slots__ = ("name", "_nlock", "_cond")
+
+    def __init__(self, name: str, lock: NamedLock | None = None):
+        self.name = name
+        self._nlock = lock if lock is not None \
+            else NamedLock(name, reentrant=True)
+        self._cond = threading.Condition(self._nlock._inner)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return self._nlock.acquire(blocking, timeout)
+
+    def release(self) -> None:
+        self._nlock.release()
+
+    def __enter__(self):
+        self._nlock.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._nlock.release()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        mon = _MONITOR
+        if mon is not None:
+            mon.on_release(self._nlock)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            # the reacquire wait is real contention, but its start is
+            # unobservable (the OS wakes us already holding the lock);
+            # book the event with zero wait rather than guessing
+            if mon is not None:
+                mon.on_acquire(self._nlock, 0.0)
+
+    def wait_for(self, predicate, timeout: float | None = None) -> bool:
+        # re-implemented over self.wait so the monitor sees every park
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + timeout
+                waittime = endtime - time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<NamedCondition {self.name!r}>"
+
+
+def make_lock(name: str) -> NamedLock:
+    """A named non-reentrant mutex (``threading.Lock`` semantics)."""
+    return NamedLock(name)
+
+
+def make_rlock(name: str) -> NamedLock:
+    """A named reentrant mutex (``threading.RLock`` semantics)."""
+    return NamedLock(name, reentrant=True)
+
+
+def make_condition(name: str, lock: NamedLock | None = None) \
+        -> NamedCondition:
+    """A named condition variable; ``lock`` shares an existing
+    :class:`NamedLock` (the ``threading.Condition(existing)`` idiom),
+    else a fresh reentrant one is created under the same name."""
+    return NamedCondition(name, lock)

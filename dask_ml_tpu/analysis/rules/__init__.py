@@ -15,6 +15,7 @@ from . import host_sync  # noqa: F401
 from . import jit_bypass  # noqa: F401
 from . import jit_hazards  # noqa: F401
 from . import knobs  # noqa: F401
+from . import locks  # noqa: F401
 from . import prng  # noqa: F401
 from . import recompile  # noqa: F401
 from . import retries  # noqa: F401

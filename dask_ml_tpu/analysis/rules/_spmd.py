@@ -122,6 +122,45 @@ HOST_ONLY_THREAD_NAMES = frozenset({
 })
 
 
+# The staging worker of the input pipeline (pipeline/core.py): parses
+# blocks and issues host->device transfer puts, compile-forbidden and
+# dispatch-forbidden.  Not blessed and not declared host-only above
+# (its H2D puts are transfers, which HOST_ONLY would overclaim) — named
+# here so the graftlock roster is closed over every literal the package
+# constructs.
+PREFETCH_THREAD_NAME = "dask-ml-tpu-prefetch"
+
+#: every literal thread name the package constructs — the graftlock
+#: thread roster (design.md §20).  A package-prefixed thread name NOT
+#: in this set acquiring a contracted lock is a runtime violation: the
+#: roster is closed, so an unknown ``dask-ml-tpu-*`` name is a plane
+#: that skipped review.
+KNOWN_THREAD_NAMES = frozenset(
+    BLESSED_COMPILE_THREADS | BLESSED_DISPATCH_THREADS
+    | HOST_ONLY_THREAD_NAMES | {PREFETCH_THREAD_NAME}
+)
+
+#: graftlock runtime contracts: canonical lock name (the literal handed
+#: to ``_locks.make_lock``/``make_rlock``/``make_condition``) → thread
+#: classes allowed to ACQUIRE it.  ``"host"`` is any thread whose name
+#: does not start with ``dask-ml-tpu-`` (the main thread, pool workers,
+#: a user's own threads).  Locks not listed are unrestricted — a
+#: contract is only declared where the owning module's design pins the
+#: acquiring planes, and the runtime monitor (sanitize/locks.py) turns
+#: an off-roster acquisition into a ratcheted violation.
+LOCK_THREAD_CONTRACTS: dict = {
+    # the server registry and per-server state: mutated by user-facing
+    # calls (host threads) and the serve loop itself, never by any
+    # other package plane (serve/runtime.py ownership contract)
+    "serve.servers": frozenset({"host", "dask-ml-tpu-serve"}),
+    "serve.server": frozenset({"host", "dask-ml-tpu-serve"}),
+    # the one-live-dispatcher gate: taken by the CALLER of an
+    # orchestrated fit (which then blocks in join), never from inside
+    # any package thread (model_selection/_orchestrator.py)
+    "search.dispatcher": frozenset({"host"}),
+}
+
+
 def _thread_literal_name(ctor: ast.Call, names: frozenset) -> str | None:
     """The literal ``name=`` of a ``threading.Thread(...)`` construction
     when it is in ``names``, else None.  Only a string LITERAL counts —

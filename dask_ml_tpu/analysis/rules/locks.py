@@ -1,0 +1,1211 @@
+"""graftlock's static half: whole-program lock-order and shared-state
+ownership analysis (design.md §20).
+
+Three project-wide rules over the PR-4 ``graph.py`` engine, sharing one
+:class:`LockModel` built per lint:
+
+* ``lock-order-cycle`` — the project's lock-acquisition graph: an edge
+  ``A -> B`` means some path acquires B while holding A (directly via a
+  nested ``with``/``acquire()``, or interprocedurally because a call
+  made under A reaches an acquisition of B).  A cycle is a deadlock
+  waiting for the interleaving that runs it; a self-edge on a
+  non-reentrant lock is a self-deadlock outright.
+
+* ``unguarded-shared-state`` — module-level or instance mutables
+  written from two or more thread classes (reachability from
+  ``Thread(target=)``/pool submits, the thread-dispatch machinery's
+  entry discovery) with no common lock across every write path.  Write
+  paths count lexical ``with lock:`` guards AND locks provably held at
+  every call site of the enclosing function (so a helper only ever
+  called under the book lock is guarded, not flagged).  Single
+  self-contained mutation calls on ``collections.deque`` objects are
+  exempt — one ``deque.append`` is atomic under the GIL, which is the
+  flight ring's documented design (obs/flight.py).
+
+* ``lock-held-across-dispatch`` — a device dispatch, a blocking
+  queue ``get``/thread ``join``, or a retry ``sleep`` reachable while
+  any lock is held: the deadlock-shaped class (the holder parks, every
+  waiter parks behind it).
+
+Lock identity is structural — ``module.VAR`` for module-level locks,
+``Class.attr`` for instance locks — and reasons about lock CLASSES
+(all instances of ``CachedProgram._lock`` are one node), exactly like
+the runtime order graph in :mod:`dask_ml_tpu.sanitize.locks`.  Both
+the package's named factory (``_locks.make_lock("name")``, whose
+literal becomes the display name) and raw ``threading.Lock()``
+constructions are recognized.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule, dotted_name, register
+from ._spmd import device_work_in, is_collective_call
+
+__all__ = ["LockModel", "lock_graph", "lock_model"]
+
+#: the package's named-lock factory callables (dask_ml_tpu/_locks.py)
+_FACTORY_SUFFIXES = frozenset({"make_lock", "make_rlock",
+                               "make_condition"})
+#: raw threading primitives (last dotted segment)
+_RAW_SUFFIXES = frozenset({"Lock", "RLock", "Condition"})
+_REENTRANT = frozenset({"RLock", "make_rlock", "make_condition",
+                        "Condition"})
+
+#: mutation-method names that write their receiver in place
+_MUTATORS = frozenset({
+    "append", "appendleft", "add", "update", "pop", "popleft",
+    "popitem", "clear", "extend", "remove", "discard", "insert",
+    "setdefault", "sort",
+})
+#: deque mutations that are one GIL-atomic bytecode-level call —
+#: lock-free by design when every write to the object is one of these
+_DEQUE_ATOMIC = frozenset({"append", "appendleft", "pop", "popleft",
+                           "clear", "extend"})
+#: mutable initializer callables for shared-state discovery
+_MUTABLE_CTORS = frozenset({"dict", "list", "set", "deque",
+                            "defaultdict", "OrderedDict", "Counter"})
+
+#: blocking-call heuristics for lock-held-across-dispatch
+_QUEUE_HINTS = ("queue", "_q")
+_THREAD_HINTS = ("thread", "worker")
+
+#: device-work kinds that count as a dispatch under a lock (``dynamic``
+#: deliberately excluded: an unresolvable callee under a lock is
+#: everywhere once registry callbacks exist, and flagging it would
+#: drown the rule — the runtime half covers what the static one skips)
+_DISPATCH_KINDS = frozenset({"collective", "program", "device-cast",
+                             "dispatch", "fetch"})
+
+#: jax calls that are host-side ADMINISTRATION, not device work:
+#: process-config mutation and callback registration.  ``device_work_in``
+#: classifies any non-transfer jax call as "program" (right for the
+#: thread rules: an unexpected jax call on a worker thread IS a
+#: hazard), but holding a lock across them blocks nothing — the
+#: persistent-cache arming (programs/cache.py) and the compile-listener
+#: install (obs/jaxhooks.py) do exactly this by design.
+_HOST_SIDE_JAX_SUFFIXES = frozenset({
+    "update", "register_event_duration_secs_listener",
+})
+
+
+def _is_host_side_jax(kind: str, detail: str) -> bool:
+    return kind == "program" and \
+        detail.rsplit(".", 1)[-1] in _HOST_SIDE_JAX_SUFFIXES
+
+
+class LockDef:
+    """One lock class: structural identity plus its declared name."""
+
+    __slots__ = ("identity", "display", "reentrant", "path", "line",
+                 "is_condition")
+
+    def __init__(self, identity, display, reentrant, path, line,
+                 is_condition=False):
+        self.identity = identity
+        self.display = display or identity
+        self.reentrant = reentrant
+        self.path = path
+        self.line = line
+        self.is_condition = is_condition
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"LockDef({self.identity})"
+
+
+class StateDef:
+    """One shared mutable: module global or instance attribute."""
+
+    __slots__ = ("identity", "path", "line", "is_deque", "writes")
+
+    def __init__(self, identity, path, line, is_deque):
+        self.identity = identity
+        self.path = path
+        self.line = line
+        self.is_deque = is_deque
+        #: list of (node, fn_key, held frozenset, atomic bool, path)
+        self.writes = []
+
+
+def _ctor_info(call: ast.Call):
+    """``(kind_name, literal_name, shared_arg)`` when ``call``
+    constructs a lock — via the named factory or raw threading — else
+    None.  ``shared_arg`` is the lock expression a Condition wraps
+    (``threading.Condition(_LOCK)`` / ``make_condition(n, _LOCK)``)."""
+    name = dotted_name(call.func)
+    if not name:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    if last in _FACTORY_SUFFIXES:
+        lit = None
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            lit = call.args[0].value
+        shared = call.args[1] if (last == "make_condition"
+                                  and len(call.args) > 1) else None
+        return last, lit, shared
+    if last in _RAW_SUFFIXES:
+        head = name.split(".", 1)[0]
+        if head not in ("threading", last):
+            return None  # somebody else's Lock class
+        shared = call.args[0] if (last == "Condition" and call.args) \
+            else None
+        return last, None, shared
+    return None
+
+
+def _mutable_init(value: ast.AST):
+    """``(True, is_deque)`` when ``value`` is a mutable initializer."""
+    if isinstance(value, (ast.Dict, ast.List, ast.Set)):
+        return True, False
+    if isinstance(value, ast.Call):
+        name = dotted_name(value.func)
+        last = name.rsplit(".", 1)[-1] if name else None
+        if last in _MUTABLE_CTORS:
+            return True, last == "deque"
+    return False, False
+
+
+class LockModel:
+    """The shared analysis all three rules read: lock definitions, the
+    per-function acquisition walk, the order graph, thread-entry
+    reachability classes, and shared-state write sites."""
+
+    def __init__(self, project):
+        self.project = project
+        self.locks: dict[str, LockDef] = {}
+        # (module_name, var) -> LockDef ; (class_qualname, attr) -> LockDef
+        self._module_locks: dict = {}
+        self._class_locks: dict = {}
+        self.states: dict[str, StateDef] = {}
+        self._module_states: dict = {}
+        self._class_states: dict = {}
+        # id(fn node) -> frozenset of identities transitively acquired
+        self._acquired_memo: dict = {}
+        # id(fn node) -> True when fn transitively blocks (device work /
+        # queue get / join / sleep)
+        self._blocking_memo: dict = {}
+        #: order graph: (from_id, to_id) -> (path, line, via text)
+        self.edges: dict = {}
+        #: self-deadlocks: direct re-acquisition of a non-reentrant lock
+        self.self_cycles: list = []
+        #: per-function walk results
+        self._fn_walks: dict = {}   # id(node) -> _Walk
+        self._fn_infos: dict = {}   # id(node) -> FunctionInfo
+        #: thread entries: label -> set of id(fn node) reached
+        self.entry_reach: dict = {}
+        self._main_reach: set = set()
+        self._entry_held: dict = {}
+        #: unique-method fallback: method name -> FunctionInfo when
+        #: exactly ONE indexed class defines it (None = ambiguous).
+        #: Name-based resolution cannot see through ``registry().f()``
+        #: receiver chains; a project-unique method name can — and the
+        #: thread-class/ownership analysis needs that reach (the
+        #: metrics books are written via exactly such chains)
+        self._method_index: dict = {}
+        for mod in project.modules:
+            for cls in mod.classes.values():
+                for mname, minfo in cls.methods.items():
+                    if mname.startswith("__"):
+                        continue
+                    if mname in self._method_index:
+                        self._method_index[mname] = None
+                    else:
+                        self._method_index[mname] = minfo
+        self._build()
+
+    # -- phase 1: definitions --------------------------------------------
+    def _collect_defs(self):
+        for mod in self.project.modules:
+            for stmt in mod.ctx.tree.body:
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    var = stmt.targets[0].id
+                elif isinstance(stmt, ast.AnnAssign) \
+                        and isinstance(stmt.target, ast.Name) \
+                        and stmt.value is not None:
+                    var = stmt.target.id
+                else:
+                    continue
+                info = _ctor_info(stmt.value) if \
+                    isinstance(stmt.value, ast.Call) else None
+                if info is not None:
+                    kind, lit, shared = info
+                    shared_def = self._resolve_shared(mod, shared)
+                    if shared_def is not None:
+                        # a Condition over an existing lock IS that lock
+                        self._module_locks[(mod.name, var)] = shared_def
+                        continue
+                    ident = f"{mod.name}.{var}"
+                    d = LockDef(ident, lit, kind in _REENTRANT,
+                                mod.path, stmt.lineno,
+                                kind in ("Condition", "make_condition"))
+                    self.locks[ident] = d
+                    self._module_locks[(mod.name, var)] = d
+                    continue
+                is_mut, is_deque = _mutable_init(stmt.value)
+                if is_mut:
+                    ident = f"{mod.name}.{var}"
+                    s = StateDef(ident, mod.path, stmt.lineno, is_deque)
+                    self.states[ident] = s
+                    self._module_states[(mod.name, var)] = s
+            for cls in mod.classes.values():
+                for m in cls.methods.values():
+                    for node in ast.walk(m.node):
+                        if isinstance(node, ast.Assign) \
+                                and len(node.targets) == 1:
+                            t = node.targets[0]
+                        elif isinstance(node, ast.AnnAssign) \
+                                and node.value is not None:
+                            t = node.target
+                        else:
+                            continue
+                        if not (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            continue
+                        key = (cls.qualname, t.attr)
+                        info = _ctor_info(node.value) if \
+                            isinstance(node.value, ast.Call) else None
+                        if info is not None and key not in \
+                                self._class_locks:
+                            kind, lit, _shared = info
+                            ident = f"{cls.qualname}.{t.attr}"
+                            d = LockDef(ident, lit,
+                                        kind in _REENTRANT,
+                                        mod.path, node.lineno,
+                                        kind in ("Condition",
+                                                 "make_condition"))
+                            self.locks[ident] = d
+                            self._class_locks[key] = d
+                            continue
+                        if m.name != "__init__":
+                            continue
+                        is_mut, is_deque = _mutable_init(node.value)
+                        if is_mut and key not in self._class_states:
+                            ident = f"{cls.qualname}.{t.attr}"
+                            s = StateDef(ident, mod.path, node.lineno,
+                                         is_deque)
+                            self.states[ident] = s
+                            self._class_states[key] = s
+
+    def _resolve_shared(self, mod, shared):
+        if shared is None or not isinstance(shared, ast.Name):
+            return None
+        return self._module_locks.get((mod.name, shared.id))
+
+    # -- lock-expression resolution --------------------------------------
+    def resolve_lock(self, mod, cls, expr) -> LockDef | None:
+        """The LockDef a ``with X:`` / ``X.acquire()`` receiver denotes,
+        or None when it is not a known lock."""
+        if isinstance(expr, ast.Name):
+            d = self._module_locks.get((mod.name, expr.id))
+            if d is not None:
+                return d
+            # imported lock: expand through the import table
+            full = mod.imports.get(expr.id)
+            if full:
+                owner, _, var = full.rpartition(".")
+                m2 = self.project.by_name.get(owner)
+                if m2 is not None:
+                    return self._module_locks.get((m2.name, var))
+            return None
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and \
+                    expr.value.id in ("self", "cls") and cls is not None:
+                return self._lookup_class_lock(cls, expr.attr)
+            name = dotted_name(expr)
+            if name:
+                full = mod.expand_alias(name)
+                owner, _, var = full.rpartition(".")
+                m2 = self.project.by_name.get(owner)
+                if m2 is not None:
+                    return self._module_locks.get((m2.name, var))
+        return None
+
+    def _lookup_class_lock(self, cls, attr):
+        seen = set()
+        todo = [cls]
+        while todo:
+            c = todo.pop(0)
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            d = self._class_locks.get((c.qualname, attr))
+            if d is not None:
+                return d
+            for b in c.base_names:
+                bc = self.project.resolve_class_name(c.module, b)
+                if bc is not None:
+                    todo.append(bc)
+        return None
+
+    def _lookup_class_state(self, cls, attr):
+        seen = set()
+        todo = [cls]
+        while todo:
+            c = todo.pop(0)
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            s = self._class_states.get((c.qualname, attr))
+            if s is not None:
+                return s
+            for b in c.base_names:
+                bc = self.project.resolve_class_name(c.module, b)
+                if bc is not None:
+                    todo.append(bc)
+        return None
+
+    # -- phase 2: per-function walks -------------------------------------
+    class _Walk:
+        __slots__ = ("acquisitions", "calls", "writes", "blocking",
+                     "pending_joins")
+
+        def __init__(self):
+            #: (LockDef, node, frozenset held-before)
+            self.acquisitions = []
+            #: (call node, Resolution, frozenset held)
+            self.calls = []
+            #: (StateDef, node, frozenset held, atomic)
+            self.writes = []
+            #: (node, why) direct blocking ops with the held set
+            self.blocking = []
+            #: thread.join() under a lock, resolved after all walks —
+            #: (call node, why, mod, cls, frozenset held)
+            self.pending_joins = []
+
+    def _owner_class(self, info):
+        if info.cls is not None:
+            return info.cls
+        # nested/transient FunctionInfo: find the lexically enclosing
+        # class so self.X still resolves
+        for p in info.module.ctx.parents(info.node):
+            if isinstance(p, ast.ClassDef):
+                return info.module.classes.get(p.name)
+        return None
+
+    def walk_function(self, info):
+        key = id(info.node)
+        w = self._fn_walks.get(key)
+        if w is not None:
+            return w
+        w = self._Walk()
+        self._fn_walks[key] = w
+        self._fn_infos.setdefault(key, info)
+        mod = info.module
+        cls = self._owner_class(info)
+        device = {}
+        if self.project is not None:
+            for node, kind, detail in device_work_in(
+                    self.project, mod, info.node):
+                device[id(node)] = (kind, detail)
+        self._walk_stmts(info.node.body, [], w, mod, cls, device)
+        return w
+
+    def _walk_stmts(self, stmts, held, w, mod, cls, device):
+        """``held`` is an ordered list of LockDefs; acquire()/release()
+        mutate it for the remainder of the statement list."""
+        for stmt in stmts:
+            self._walk_stmt(stmt, held, w, mod, cls, device)
+
+    def _walk_stmt(self, stmt, held, w, mod, cls, device):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested bodies run when called, not here
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            entered = []
+            for item in stmt.items:
+                d = self.resolve_lock(mod, cls, item.context_expr)
+                if d is None and isinstance(item.context_expr, ast.Call):
+                    # with lock.acquire_timeout()-style wrappers: not
+                    # modeled; but scan the expression for calls below
+                    self._scan_expr(item.context_expr, held, w, mod,
+                                    cls, device)
+                    continue
+                if d is not None:
+                    self._note_acquire(d, item.context_expr, held, w)
+                    held.append(d)
+                    entered.append(d)
+                else:
+                    self._scan_expr(item.context_expr, held, w, mod,
+                                    cls, device)
+            self._walk_stmts(stmt.body, held, w, mod, cls, device)
+            for d in reversed(entered):
+                held.remove(d)
+            return
+        if isinstance(stmt, (ast.If,)):
+            self._scan_expr(stmt.test, held, w, mod, cls, device)
+            self._walk_stmts(list(stmt.body), list(held), w, mod, cls,
+                             device)
+            self._walk_stmts(list(stmt.orelse), list(held), w, mod, cls,
+                             device)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_expr(stmt.iter, held, w, mod, cls, device)
+            self._walk_stmts(list(stmt.body), list(held), w, mod, cls,
+                             device)
+            self._walk_stmts(list(stmt.orelse), list(held), w, mod, cls,
+                             device)
+            return
+        if isinstance(stmt, ast.While):
+            self._scan_expr(stmt.test, held, w, mod, cls, device)
+            self._walk_stmts(list(stmt.body), list(held), w, mod, cls,
+                             device)
+            self._walk_stmts(list(stmt.orelse), list(held), w, mod, cls,
+                             device)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_stmts(list(stmt.body), held, w, mod, cls, device)
+            for h in stmt.handlers:
+                self._walk_stmts(list(h.body), list(held), w, mod, cls,
+                                 device)
+            self._walk_stmts(list(stmt.orelse), list(held), w, mod, cls,
+                             device)
+            self._walk_stmts(list(stmt.finalbody), held, w, mod, cls,
+                             device)
+            return
+        # leaf statement: acquire()/release() bookkeeping, then scan
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            f = call.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in ("acquire", "release"):
+                d = self.resolve_lock(mod, cls, f.value)
+                if d is not None:
+                    if f.attr == "acquire":
+                        self._note_acquire(d, call, held, w)
+                        held.append(d)
+                    elif d in held:
+                        held.remove(d)
+                    return
+        self._scan_expr(stmt, held, w, mod, cls, device)
+
+    def _note_acquire(self, d, node, held, w):
+        held_set = frozenset(x.identity for x in held)
+        w.acquisitions.append((d, node, held_set))
+        if d.identity in held_set and not d.reentrant:
+            self.self_cycles.append((d, node))
+
+    def _scan_expr(self, node, held, w, mod, cls, device):
+        """Record calls (with the current held set), shared-state
+        writes, and direct blocking ops inside one leaf statement or
+        expression."""
+        held_set = frozenset(x.identity for x in held)
+        held_ids = {x.identity for x in held}
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(n, ast.Call):
+                self._scan_call(n, held_set, held_ids, w, mod, cls,
+                                device)
+            elif isinstance(n, (ast.Assign, ast.AugAssign, ast.Delete)):
+                self._scan_write_stmt(n, held_set, w, mod, cls)
+        return
+
+    def _scan_call(self, n, held_set, held_ids, w, mod, cls, device):
+        res = self.project.resolve_call(mod, n)
+        w.calls.append((n, res, held_set))
+        # mutation-method write on known shared state
+        f = n.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            s = self._resolve_state(mod, cls, f.value)
+            if s is not None:
+                atomic = s.is_deque and f.attr in _DEQUE_ATOMIC
+                w.writes.append((s, n, held_set, atomic))
+        if held_set:
+            dev = device.get(id(n))
+            if dev is not None and _is_host_side_jax(*dev):
+                dev = None
+            if dev is not None and dev[0] in _DISPATCH_KINDS:
+                w.blocking.append(
+                    (n, f"{dev[0]} {dev[1]} under {self._held_text(held_set)}"))
+            else:
+                why = self._direct_block_reason(n, held_ids)
+                if why:
+                    if isinstance(f, ast.Attribute) and f.attr == "join":
+                        # deferred: a join is exempt when the joined
+                        # thread provably never wants the held lock
+                        w.pending_joins.append(
+                            (n, why, mod, cls, held_set))
+                    else:
+                        w.blocking.append(
+                            (n,
+                             f"{why} under {self._held_text(held_set)}"))
+
+    @staticmethod
+    def _held_text(held_set):
+        return "+".join(sorted(held_set))
+
+    def _direct_block_reason(self, call, held_ids):
+        name = dotted_name(call.func)
+        if not name:
+            return None
+        last = name.rsplit(".", 1)[-1]
+        recv = name.rsplit(".", 1)[0].lower() if "." in name else ""
+        if last == "sleep":
+            return f"{name}() sleep"
+        if last == "get" and (recv.endswith(_QUEUE_HINTS[1])
+                              or _QUEUE_HINTS[0] in recv
+                              or recv in ("q", "self._q")):
+            return f"blocking {name}()"
+        if last == "join" and any(h in recv for h in _THREAD_HINTS):
+            return f"blocking {name}()"
+        if last == "wait" and isinstance(call.func, ast.Attribute):
+            # Event/Condition wait parks the thread.  cond.wait() on a
+            # HELD condition releases it while parked — the documented
+            # condition protocol, not a hold-across-block
+            held_cond = self._wait_lock(call.func.value)
+            if held_cond is not None and held_cond.identity in held_ids:
+                return None
+            if "event" in recv or recv.endswith("_ev") or recv == "ev":
+                return f"blocking {name}()"
+        return None
+
+    def _wait_lock(self, expr):
+        # receiver of .wait(): try every module/class scope cheaply —
+        # the walker's mod/cls are not threaded here, so re-resolve via
+        # the identity maps on a best-effort basis
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls"):
+            for (qual, attr), d in self._class_locks.items():
+                if attr == expr.attr:
+                    return d
+        if isinstance(expr, ast.Name):
+            for (mname, var), d in self._module_locks.items():
+                if var == expr.id:
+                    return d
+        return None
+
+    def _scan_write_stmt(self, n, held_set, w, mod, cls):
+        targets = n.targets if isinstance(n, (ast.Assign, ast.Delete)) \
+            else [n.target]
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                s = self._resolve_state(mod, cls, t.value)
+                if s is not None:
+                    w.writes.append((s, n, held_set, False))
+            elif isinstance(t, ast.Attribute) and \
+                    isinstance(n, (ast.Assign, ast.AugAssign)):
+                s = self._resolve_state(mod, cls, t)
+                if s is not None:
+                    w.writes.append((s, n, held_set, False))
+            elif isinstance(t, ast.Name) and \
+                    isinstance(n, (ast.Assign, ast.AugAssign)):
+                # module-global rebind only counts under a `global` decl
+                s = self._module_states.get((mod.name, t.id))
+                if s is not None and self._declared_global(mod, n, t.id):
+                    w.writes.append((s, n, held_set, False))
+
+    @staticmethod
+    def _declared_global(mod, node, name):
+        for p in mod.ctx.parents(node):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return any(isinstance(x, ast.Global) and name in x.names
+                           for x in ast.walk(p))
+        return False
+
+    def _resolve_state(self, mod, cls, expr):
+        if isinstance(expr, ast.Name):
+            s = self._module_states.get((mod.name, expr.id))
+            if s is not None:
+                return s
+            full = mod.imports.get(expr.id)
+            if full:
+                owner, _, var = full.rpartition(".")
+                m2 = self.project.by_name.get(owner)
+                if m2 is not None:
+                    return self._module_states.get((m2.name, var))
+            return None
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and \
+                    expr.value.id in ("self", "cls") and cls is not None:
+                return self._lookup_class_state(cls, expr.attr)
+            name = dotted_name(expr)
+            if name:
+                full = mod.expand_alias(name)
+                owner, _, var = full.rpartition(".")
+                m2 = self.project.by_name.get(owner)
+                if m2 is not None:
+                    return self._module_states.get((m2.name, var))
+        return None
+
+    # -- phase 3: transitive acquisition + the order graph ---------------
+    def _all_functions(self):
+        for mod in self.project.modules:
+            for f in mod.functions.values():
+                yield f
+            for cls in mod.classes.values():
+                for m in cls.methods.values():
+                    yield m
+
+    def acquired_in(self, info) -> frozenset:
+        """Identities of every lock transitively acquired by ``info``
+        (direct + resolvable callees), cycle-guarded and memoized."""
+        key = id(info.node)
+        got = self._acquired_memo.get(key)
+        if got is not None:
+            return got
+        self._acquired_memo[key] = frozenset()  # cycle guard
+        w = self.walk_function(info)
+        out = {d.identity for d, _n, _h in w.acquisitions}
+        for _call, res, _held in w.calls:
+            tgt = self._callee_info(res)
+            if tgt is not None:
+                out |= self.acquired_in(tgt)
+        got = frozenset(out)
+        self._acquired_memo[key] = got
+        return got
+
+    def _callee_info(self, res):
+        if res.kind == "function":
+            return res.target
+        if res.kind == "class" and res.target is not None:
+            return res.target.methods.get("__init__")
+        if res.kind == "method" and res.name:
+            return self._method_index.get(res.name)
+        return None
+
+    def blocks_in(self, info) -> str | None:
+        """First blocking/dispatching reason transitively reachable
+        from ``info`` ignoring held-sets (used for calls made UNDER a
+        lock), or None."""
+        key = id(info.node)
+        if key in self._blocking_memo:
+            return self._blocking_memo[key]
+        self._blocking_memo[key] = None  # cycle guard
+        mod = info.module
+        why = None
+        for node, kind, detail in device_work_in(self.project, mod,
+                                                 info.node):
+            if kind in _DISPATCH_KINDS and \
+                    not _is_host_side_jax(kind, detail):
+                why = f"{kind} {detail} in {info.qualname}"
+                break
+        if why is None:
+            for call in _own_calls(info.node):
+                name = dotted_name(call.func)
+                if not name:
+                    continue
+                last = name.rsplit(".", 1)[-1]
+                recv = name.rsplit(".", 1)[0].lower() if "." in name \
+                    else ""
+                if last == "sleep":
+                    why = f"{name}() sleep in {info.qualname}"
+                    break
+                if last == "get" and (_QUEUE_HINTS[0] in recv
+                                      or recv.endswith(_QUEUE_HINTS[1])
+                                      or recv == "q"):
+                    why = f"blocking {name}() in {info.qualname}"
+                    break
+        if why is None:
+            w = self.walk_function(info)
+            for _call, res, _held in w.calls:
+                tgt = self._callee_info(res)
+                if tgt is not None:
+                    sub = self.blocks_in(tgt)
+                    if sub is not None:
+                        why = sub
+                        break
+        self._blocking_memo[key] = why
+        return why
+
+    def _close_walks(self):
+        """Interprocedural closure: walking resolvable callees of every
+        walked function pulls nested defs into the walk set."""
+        frontier = list(self._fn_walks)
+        while frontier:
+            next_frontier = []
+            for key in frontier:
+                w = self._fn_walks[key]
+                for _call, res, _held in list(w.calls):
+                    tgt = self._callee_info(res)
+                    if tgt is not None and id(tgt.node) not in \
+                            self._fn_walks:
+                        self.walk_function(tgt)
+                        next_frontier.append(id(tgt.node))
+            frontier = next_frontier
+
+    def _build(self):
+        self._collect_defs()
+        for info in list(self._all_functions()):
+            self.walk_function(info)
+        self._close_walks()
+        self._discover_entries()
+        self._close_walks()
+        # order-graph edges (after every reachable function is walked)
+        for key, w in self._fn_walks.items():
+            info = self._fn_infos[key]
+            for d, node, held in w.acquisitions:
+                for h in held:
+                    if h != d.identity:
+                        self._edge(h, d.identity, info, node)
+            for call, res, held in w.calls:
+                if not held:
+                    continue
+                tgt = self._callee_info(res)
+                if tgt is None:
+                    continue
+                for m in self.acquired_in(tgt):
+                    for h in held:
+                        if h != m:
+                            self._edge(h, m, info, call)
+        self._resolve_pending_joins()
+        self._solve_entry_held()
+
+    def _resolve_pending_joins(self):
+        """join-under-lock deadlocks only when the joined thread itself
+        wants a held lock; otherwise holding across the join IS the
+        serialization (the orchestrator's one-dispatcher contract).
+        Exempt joins whose thread target provably acquires none of the
+        held locks — unresolvable targets stay flagged."""
+        for w in self._fn_walks.values():
+            for n, why, mod, cls, held in w.pending_joins:
+                if not self._join_exempt(n, mod, cls, held):
+                    w.blocking.append(
+                        (n, f"{why} under {self._held_text(held)}"))
+            w.pending_joins = []
+
+    def _join_exempt(self, call, mod, cls, held) -> bool:
+        from .threads import _work_targets
+
+        ctor = self._thread_ctor_for(call.func.value, mod, cls)
+        if ctor is None:
+            return False
+        targets = _work_targets(mod.ctx, ctor)
+        if not targets:
+            return False
+        acquired: set = set()
+        for t in targets:
+            res = self.project.resolve_callable(mod, t)
+            tgt = self._callee_info(res)
+            if tgt is None:
+                return False  # cannot prove disjointness: keep it
+            acquired |= self.acquired_in(tgt)
+        return not (acquired & held)
+
+    def _thread_ctor_for(self, recv, mod, cls):
+        """The unique ``Thread(...)`` constructor bound to the join
+        receiver (local/module name or ``self.attr``), or None when
+        absent or ambiguously rebound."""
+        def _is_thread_ctor(v):
+            if not isinstance(v, ast.Call):
+                return False
+            name = dotted_name(v.func)
+            return bool(name) and name.rsplit(".", 1)[-1] == "Thread"
+
+        ctor = None
+        if isinstance(recv, ast.Name):
+            for node in ast.walk(mod.ctx.tree):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id == recv.id \
+                        and _is_thread_ctor(node.value):
+                    if ctor is not None:
+                        return None
+                    ctor = node.value
+            return ctor
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and \
+                recv.value.id in ("self", "cls") and cls is not None:
+            for m in cls.methods.values():
+                for node in ast.walk(m.node):
+                    if not (isinstance(node, ast.Assign)
+                            and len(node.targets) == 1):
+                        continue
+                    t = node.targets[0]
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self" and \
+                            t.attr == recv.attr and \
+                            _is_thread_ctor(node.value):
+                        if ctor is not None:
+                            return None
+                        ctor = node.value
+            return ctor
+        return None
+
+    def _edge(self, a, b, info, node):
+        if (a, b) not in self.edges:
+            self.edges[(a, b)] = (info.module.path, node.lineno,
+                                  info.qualname)
+
+    # -- phase 4: thread entries + classes -------------------------------
+    def _discover_entries(self):
+        from .threads import _work_targets
+
+        entry_nodes = {}
+        for mod in self.project.modules:
+            ctx = mod.ctx
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                last = name.rsplit(".", 1)[-1] if name else None
+                if last not in ("Thread", "ThreadPoolExecutor"):
+                    continue
+                targets = _work_targets(ctx, node)
+                if not targets:
+                    continue
+                label = None
+                if last == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "name" and \
+                                isinstance(kw.value, ast.Constant) and \
+                                isinstance(kw.value.value, str):
+                            label = kw.value.value
+                if label is None:
+                    label = f"{mod.name}:{node.lineno}"
+                for t in targets:
+                    res = self.project.resolve_callable(mod, t)
+                    tgt = self._callee_info(res)
+                    if tgt is not None:
+                        entry_nodes.setdefault(label, []).append(tgt)
+        for label, infos in entry_nodes.items():
+            reach = set()
+            for info in infos:
+                reach |= self._reach_from(info)
+            self.entry_reach[label] = reach
+        threaded = set()
+        for reach in self.entry_reach.values():
+            threaded |= reach
+        # main-reachable: closure from every function NOT inside any
+        # thread entry's reach (public surface, module helpers)
+        adj = {}
+        for key, w in self._fn_walks.items():
+            outs = set()
+            for _call, res, _held in w.calls:
+                tgt = self._callee_info(res)
+                if tgt is not None:
+                    outs.add(id(tgt.node))
+            adj[key] = outs
+        todo = [k for k in self._fn_walks if k not in threaded]
+        main = set(todo)
+        while todo:
+            k = todo.pop()
+            for nxt in adj.get(k, ()):
+                if nxt not in main:
+                    main.add(nxt)
+                    todo.append(nxt)
+        self._main_reach = main
+
+    def _reach_from(self, info) -> set:
+        """BFS over this model's call records (with the unique-method
+        fallback), walking newly discovered functions on the way."""
+        reach = set()
+        todo = [info]
+        while todo:
+            cur = todo.pop()
+            key = id(cur.node)
+            if key in reach:
+                continue
+            reach.add(key)
+            w = self.walk_function(cur)
+            for _call, res, _held in w.calls:
+                tgt = self._callee_info(res)
+                if tgt is not None and id(tgt.node) not in reach:
+                    todo.append(tgt)
+        return reach
+
+    def classes_of(self, fn_key) -> frozenset:
+        out = {label for label, reach in self.entry_reach.items()
+               if fn_key in reach}
+        if fn_key in self._main_reach:
+            out.add("main")
+        return frozenset(out)
+
+    # -- phase 5: locks held at function entry (must-analysis) -----------
+    def _solve_entry_held(self):
+        TOP = None  # unknown: no call site seen yet
+        entry = {k: TOP for k in self._fn_walks}
+        callers = {}  # callee key -> list of (caller key, held frozenset)
+        for key, w in self._fn_walks.items():
+            for call, res, held in w.calls:
+                tgt = self._callee_info(res)
+                if tgt is not None and id(tgt.node) in self._fn_walks:
+                    callers.setdefault(id(tgt.node), []).append(
+                        (key, held))
+        for _round in range(6):
+            changed = False
+            for callee, sites in callers.items():
+                acc = TOP
+                for caller, held in sites:
+                    ch = entry.get(caller)
+                    site_held = held | ch if ch else held
+                    acc = site_held if acc is None else (acc & site_held)
+                if acc is not None and acc != entry.get(callee):
+                    entry[callee] = acc
+                    changed = True
+            if not changed:
+                break
+        self._entry_held = {k: (v or frozenset())
+                            for k, v in entry.items()}
+
+    def entry_held(self, fn_key) -> frozenset:
+        return self._entry_held.get(fn_key, frozenset())
+
+    # -- verdicts ---------------------------------------------------------
+    def state_writes(self):
+        """Yield ``(StateDef, [(node, fn_key, held, atomic, path)])``
+        for every shared state with at least one write from function
+        bodies (module-level writes are import-time: single-threaded
+        by construction)."""
+        per_state: dict = {}
+        for key, w in self._fn_walks.items():
+            info = self._fn_infos[key]
+            owner = self._owner_class(info)
+            for s, node, held, atomic in w.writes:
+                if owner is not None and info.name == "__init__" and \
+                        s.identity.startswith(owner.qualname + "."):
+                    continue  # construction happens-before sharing
+                eff = held | self.entry_held(key)
+                per_state.setdefault(s.identity, []).append(
+                    (node, key, eff, atomic, info.module.path))
+        for ident, writes in sorted(per_state.items()):
+            yield self.states[ident], writes
+
+
+def _own_calls(fn_node):
+    from ..graph import calls_in
+
+    return calls_in(fn_node)
+
+
+def lock_model(project) -> LockModel:
+    """The per-project LockModel, built once and cached on the
+    Project (all three rules and the tests share it)."""
+    m = getattr(project, "_graftlock_model", None)
+    if m is None:
+        m = LockModel(project)
+        project._graftlock_model = m
+    return m
+
+
+def lock_graph(project) -> dict:
+    """The lock-order graph as ``{(from, to): (path, line, via)}`` —
+    exposed for tests and the design-doc table generator."""
+    return dict(lock_model(project).edges)
+
+
+def _ctx_for_path(project, path) -> Context | None:
+    m = project.by_path.get(path)
+    return m.ctx if m is not None else None
+
+
+@register
+class LockOrderCycleRule(Rule):
+    id = "lock-order-cycle"
+    summary = (
+        "cyclic lock-acquisition order (lock B taken while holding A on "
+        "one path, A while holding B on another) — a deadlock waiting "
+        "for the interleaving that runs both paths at once"
+    )
+    project_wide = True
+
+    def run_project(self, project):
+        model = lock_model(project)
+        for d, node in model.self_cycles:
+            ctx = _ctx_for_path(project, d.path)
+            site_ctx = None
+            for mod in project.modules:
+                if any(n is node for n in ast.walk(mod.ctx.tree)):
+                    site_ctx = mod.ctx
+                    break
+            ctx = site_ctx or ctx
+            if ctx is not None:
+                yield ctx.finding(
+                    self.id, node,
+                    f"non-reentrant lock {d.display} re-acquired while "
+                    f"already held — self-deadlock (make it an RLock or "
+                    f"restructure the nesting)")
+        for cycle in _cycles(model.edges):
+            # report at the lexically FIRST edge of the cycle so the
+            # fingerprint is stable under unrelated edits
+            edges = list(zip(cycle, cycle[1:] + cycle[:1]))
+            sites = sorted(
+                (model.edges[e], e) for e in edges if e in model.edges)
+            if not sites:
+                continue
+            (path, line, via), (a, b) = sites[0]
+            ctx = _ctx_for_path(project, path)
+            if ctx is None:
+                continue
+            order = " -> ".join(cycle + [cycle[0]])
+            node = _node_at(ctx, line)
+            yield ctx.finding(
+                self.id, node,
+                f"lock-order cycle {order}: {via} acquires "
+                f"{_display(model, b)} while holding "
+                f"{_display(model, a)}, and another path acquires them "
+                f"in the reverse order — impose one global order "
+                f"(design.md §20) or merge the locks")
+
+
+def _display(model, ident):
+    d = model.locks.get(ident)
+    return d.display if d is not None else ident
+
+
+def _node_at(ctx, line):
+    class _N:
+        pass
+
+    n = _N()
+    n.lineno = line
+    n.col_offset = 0
+    n.end_lineno = line
+    return n
+
+
+def _cycles(edges) -> list:
+    """Elementary cycles of the order graph as node lists, via SCC
+    decomposition (each nontrivial SCC is reported once, as its sorted
+    node cycle — enough to name the locks involved)."""
+    graph: dict = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index = {}
+    low = {}
+    stack = []
+    on_stack = set()
+    out = []
+    counter = [0]
+
+    def strongconnect(v):
+        # iterative Tarjan: (node, iterator) frames
+        work = [(v, iter(graph[v]))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for wnode in it:
+                if wnode not in index:
+                    index[wnode] = low[wnode] = counter[0]
+                    counter[0] += 1
+                    stack.append(wnode)
+                    on_stack.add(wnode)
+                    work.append((wnode, iter(graph[wnode])))
+                    advanced = True
+                    break
+                if wnode in on_stack:
+                    low[node] = min(low[node], index[wnode])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    x = stack.pop()
+                    on_stack.discard(x)
+                    scc.append(x)
+                    if x == node:
+                        break
+                if len(scc) > 1:
+                    out.append(sorted(scc))
+
+    for v in list(graph):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+@register
+class UnguardedSharedStateRule(Rule):
+    id = "unguarded-shared-state"
+    summary = (
+        "module-level or instance mutable written from two or more "
+        "thread classes with no common lock across every write path — "
+        "a data race the GIL only hides until the interleaving lands "
+        "mid-read-modify-write"
+    )
+    project_wide = True
+
+    def run_project(self, project):
+        model = lock_model(project)
+        for s, writes in model.state_writes():
+            classes = set()
+            for _node, fn_key, _held, _atomic, _path in writes:
+                classes |= model.classes_of(fn_key)
+            if len(classes) < 2:
+                continue
+            non_atomic = [wr for wr in writes if not wr[3]]
+            if not non_atomic:
+                continue  # pure GIL-atomic deque traffic (flight ring)
+            common = None
+            for _node, _key, held, _atomic, _path in non_atomic:
+                common = held if common is None else (common & held)
+            if common:
+                continue
+            bare = [wr for wr in non_atomic if not wr[2]]
+            node, _key, _held, _atomic, path = (bare or non_atomic)[0]
+            ctx = _ctx_for_path(project, path)
+            if ctx is None:
+                continue
+            others = len(non_atomic) - 1
+            yield ctx.finding(
+                self.id, node,
+                f"{s.identity} is written from thread classes "
+                f"{{{', '.join(sorted(classes))}}} with no common lock "
+                f"on every write path ({others} other write "
+                f"site{'s' if others != 1 else ''}) — guard every "
+                f"write with one lock, or prove single-owner access "
+                f"and keep the writes on one thread class")
+
+
+@register
+class LockHeldAcrossDispatchRule(Rule):
+    id = "lock-held-across-dispatch"
+    summary = (
+        "device dispatch, blocking queue get/thread join, or sleep "
+        "reachable while a lock is held — the holder parks with the "
+        "lock taken and every contender parks behind it (the "
+        "deadlock-shaped class)"
+    )
+    project_wide = True
+
+    def run_project(self, project):
+        model = lock_model(project)
+        seen = set()
+        for key, w in model._fn_walks.items():
+            info = model._fn_infos[key]
+            path = info.module.path
+            ctx = _ctx_for_path(project, path)
+            if ctx is None:
+                continue
+            for node, why in w.blocking:
+                k = (path, node.lineno, why)
+                if k in seen:
+                    continue
+                seen.add(k)
+                yield ctx.finding(
+                    self.id, node,
+                    f"{why} — release the lock before blocking "
+                    f"(snapshot under the lock, dispatch outside it)")
+            for call, res, held in w.calls:
+                if not held:
+                    continue
+                tgt = model._callee_info(res)
+                if tgt is None:
+                    continue
+                sub = model.blocks_in(tgt)
+                if sub is None:
+                    continue
+                k = (path, call.lineno, sub)
+                if k in seen:
+                    continue
+                seen.add(k)
+                yield ctx.finding(
+                    self.id, call,
+                    f"call under {model._held_text(held)} reaches "
+                    f"{sub} — release the lock before blocking "
+                    f"(snapshot under the lock, dispatch outside it)")

@@ -40,6 +40,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from .._locks import make_condition
 import time
 
 import numpy as np
@@ -247,7 +249,7 @@ class _DatasetStream:
         self._trace_parent = obs.current_span_id()
         self._budget = ds.budget if ds.budget is not None \
             else FaultBudget.from_env(name=f"{ds.label}-readers")
-        self._cond = threading.Condition()
+        self._cond = make_condition("data.readers")
         self._closed = False
         self._epoch_live = False
         self.blocks_delivered = 0

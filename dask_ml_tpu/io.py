@@ -15,6 +15,8 @@ import os
 import subprocess
 import threading
 
+from ._locks import make_lock
+
 import numpy as np
 
 __all__ = [
@@ -32,7 +34,7 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
 _SRC = os.path.join(_NATIVE_DIR, "loader.cpp")
 _SO = os.path.join(_NATIVE_DIR, "_loader.so")
 
-_lock = threading.Lock()
+_lock = make_lock("io.registry")
 _lib = None
 
 

@@ -19,6 +19,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
+
+from .._locks import make_lock
 import time
 from collections import defaultdict
 
@@ -38,7 +40,7 @@ logger = logging.getLogger(__name__)
 # threadpools of the reference, collapsed to one process).  Module-level so
 # concurrent Hyperband brackets share workers instead of oversubscribing.
 _EXECUTOR = None
-_EXECUTOR_LOCK = threading.Lock()
+_EXECUTOR_LOCK = make_lock("search.executor")
 
 
 def _train_executor():
@@ -257,7 +259,7 @@ class BaseIncrementalSearchCV(TPUEstimator):
     async def _fit(self, X_train, y_train, X_test, y_test, **fit_params):
         self._reset_policy()
         self._fit_failures = 0
-        self._fit_failures_lock = threading.Lock()
+        self._fit_failures_lock = make_lock("search.scores")
         # per-fit shared fault budget (design.md §13): every unit's
         # requeue retry AND every streamed burst's elastic recovery
         # draw from this ONE pool, so cascading faults across many

@@ -56,6 +56,8 @@ from __future__ import annotations
 import asyncio
 import os
 import threading
+
+from .._locks import make_lock
 import time
 
 from .. import obs as _obs
@@ -109,7 +111,7 @@ _TLS = threading.local()
 #: second concurrent threaded search BLOCKS here until the first
 #: finishes (concurrent device fits were never legal — a device fit
 #: occupies every device anyway, so serializing loses nothing).
-_DISPATCHER_LOCK = threading.Lock()
+_DISPATCHER_LOCK = make_lock("search.dispatcher")
 
 
 def concurrency_enabled() -> bool:

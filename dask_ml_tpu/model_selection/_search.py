@@ -21,6 +21,8 @@ from __future__ import annotations
 import logging
 import os
 import threading
+
+from .._locks import make_lock
 from concurrent.futures import ThreadPoolExecutor, as_completed
 
 import numpy as np
@@ -182,7 +184,7 @@ class _OnceCache:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("search.folds")
         self._entries: dict = {}
         self._uses: dict = {}
 
@@ -467,7 +469,7 @@ class _BaseSearchCV(TPUEstimator):
         # OOM at scale); with fold-major task order below, at most
         # ~n_workers folds are live at once — the old transient peak,
         # dedup kept.
-        fold_lock = threading.Lock()
+        fold_lock = make_lock("search.folds")
         fold_cache: dict = {}
         fold_refs = {fi: n_cand for fi in range(len(splits))}
         # share fold slices ONLY for device inputs: jax arrays are

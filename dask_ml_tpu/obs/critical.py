@@ -92,6 +92,8 @@ from __future__ import annotations
 import os
 import threading
 
+from .._locks import make_lock
+
 from .metrics import registry as _registry
 from . import scope as _scope
 from . import spans as _spans
@@ -173,7 +175,7 @@ _SPECIFIC = {
     "pipeline.stall": "stall",
 }
 
-_LOCK = threading.Lock()
+_LOCK = make_lock("obs.critical")
 _LAST: dict[str, dict] = {}  # plane -> last computed verdict block
 
 

@@ -25,6 +25,8 @@ import json
 import os
 import sys
 import threading
+
+from .._locks import make_lock
 import time
 
 from . import spans as _spans
@@ -44,7 +46,7 @@ class JsonlSink:
 
     def __init__(self, path: str):
         self.path = str(path)
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.export")
         d = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(d, exist_ok=True)
         self._f = open(self.path, "a", encoding="utf-8")

@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import threading
 
+from .._locks import make_lock
+
 from . import metrics as _metrics
 
 __all__ = ["install", "COMPILE_EVENT"]
@@ -28,7 +30,7 @@ __all__ = ["install", "COMPILE_EVENT"]
 #: jax.monitoring event key: one firing per XLA backend compile
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
-_LOCK = threading.Lock()
+_LOCK = make_lock("obs.jaxhooks")
 _INSTALLED = False
 
 

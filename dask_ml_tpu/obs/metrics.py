@@ -125,37 +125,44 @@ class Histogram:
     def quantile(self, q: float) -> float:
         """Estimated ``q``-quantile (0 <= q <= 1); NaN when empty."""
         with self._lock:
-            if not self.count:
-                return math.nan
-            # nearest-rank: p99 of 5 samples is the max, not the 4th —
-            # the convention an SLO reader expects from small samples
-            rank = q * self.count
-            seen = 0
-            for idx in sorted(self._buckets):
-                seen += self._buckets[idx]
-                if seen >= rank:
-                    if idx == 0:
-                        return 0.0
-                    # geometric midpoint of the bucket, clamped to the
-                    # exact observed range so a 1-sample histogram
-                    # reports its sample, not a bucket boundary
-                    mid = _FLOOR * _GROWTH ** (idx - 0.5)
-                    return min(max(mid, self.min), self.max)
-            return self.max  # pragma: no cover - rank < count always hits
+            return self._quantile_locked(q)
+
+    def _quantile_locked(self, q: float) -> float:
+        if not self.count:
+            return math.nan
+        # nearest-rank: p99 of 5 samples is the max, not the 4th —
+        # the convention an SLO reader expects from small samples
+        rank = q * self.count
+        seen = 0
+        for idx in sorted(self._buckets):
+            seen += self._buckets[idx]
+            if seen >= rank:
+                if idx == 0:
+                    return 0.0
+                # geometric midpoint of the bucket, clamped to the
+                # exact observed range so a 1-sample histogram
+                # reports its sample, not a bucket boundary
+                mid = _FLOOR * _GROWTH ** (idx - 0.5)
+                return min(max(mid, self.min), self.max)
+        return self.max  # pragma: no cover - rank < count always hits
 
     def snapshot(self) -> dict:
+        # one acquisition across every field read: releasing after the
+        # empty-check and reading count/sum/min/max bare let a
+        # concurrent record() interleave mid-update and produce a torn
+        # snapshot (count bumped, sum not yet)
         with self._lock:
             if not self.count:
                 return {"count": 0}
-        return {
-            "count": self.count,
-            "sum": round(self.sum, 9),
-            "min": round(self.min, 9),
-            "max": round(self.max, 9),
-            "p50": round(self.quantile(0.50), 9),
-            "p95": round(self.quantile(0.95), 9),
-            "p99": round(self.quantile(0.99), 9),
-        }
+            return {
+                "count": self.count,
+                "sum": round(self.sum, 9),
+                "min": round(self.min, 9),
+                "max": round(self.max, 9),
+                "p50": round(self._quantile_locked(0.50), 9),
+                "p95": round(self._quantile_locked(0.95), 9),
+                "p99": round(self._quantile_locked(0.99), 9),
+            }
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}

@@ -47,6 +47,8 @@ from __future__ import annotations
 import os
 import threading
 
+from .._locks import make_lock
+
 __all__ = [
     "PEAKS_ENV",
     "DEFAULT_PEAKS",
@@ -83,7 +85,7 @@ DEFAULT_PEAKS = {
                       "819 GB/s HBM; unmeasured on this image)"},
 }
 
-_LOCK = threading.Lock()
+_LOCK = make_lock("obs.roofline")
 _CACHE: dict | None = None  # parsed env + defaults, resolved once
 
 

@@ -58,6 +58,8 @@ program's own interval is already open, so the lane stays continuous.
 from __future__ import annotations
 
 import threading
+
+from .._locks import make_condition, make_lock
 import time
 
 from .metrics import registry as _registry
@@ -115,8 +117,8 @@ class _Pending:
         self.cost = cost  # {"flops", "bytes", ...} | None (roofline.py)
 
 
-_LOCK = threading.Lock()
-_COND = threading.Condition(_LOCK)
+_LOCK = make_lock("obs.scope")
+_COND = make_condition("obs.scope", _LOCK)
 _PENDING: list[_Pending] = []
 _CLOSED: list[dict] = []  # ring: trimmed to _RING_CAP on append
 _SEQ = 0

@@ -39,6 +39,8 @@ import json
 import logging
 import os
 import threading
+
+from .._locks import make_lock
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
 from .metrics import Counter, Gauge, registry as _registry
@@ -257,7 +259,7 @@ class MetricsServer:
             self._hb.retire()
 
 
-_LOCK = threading.Lock()
+_LOCK = make_lock("obs.metrics_server")
 _ACTIVE: MetricsServer | None = None
 
 

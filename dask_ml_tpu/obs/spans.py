@@ -39,6 +39,8 @@ import collections
 import itertools
 import os
 import threading
+
+from .._locks import make_lock
 import time
 
 from . import flight as _flight
@@ -80,7 +82,7 @@ _DEFAULT_RING = 8192
 _ids = itertools.count(1)  # CPython next() is atomic: lock-free span ids
 
 _TLS = threading.local()  # .stack: open spans; .ring: completed records
-_REG_LOCK = threading.Lock()
+_REG_LOCK = make_lock("obs.spans")
 _RINGS: dict[int, tuple[str, collections.deque, list]] = {}
 _LAST_ROOT: "SpanRecord | None" = None
 

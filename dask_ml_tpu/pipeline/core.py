@@ -56,6 +56,8 @@ from __future__ import annotations
 import os
 import queue
 import threading
+
+from .._locks import make_lock
 import time
 
 from .. import obs
@@ -666,7 +668,7 @@ class UnitStream:
         # worker.  The flag pair defers the actual close to the
         # in-flight advance's exit (which runs it safely on that
         # thread the moment next() returns).
-        self._close_lock = threading.Lock()
+        self._close_lock = make_lock("pipeline.close")
         self._advancing = False
         self._close_deferred = False
 

@@ -26,6 +26,8 @@ instruments' locks once.
 from __future__ import annotations
 
 import threading
+
+from .._locks import make_lock
 import time
 
 from ..obs.metrics import registry as _registry
@@ -83,7 +85,7 @@ class PipelineStats:
         }
 
 
-_LOCK = threading.Lock()
+_LOCK = make_lock("pipeline.stats")
 _LAST: PipelineStats | None = None
 
 _STAGES = ("parse_s", "transfer_s", "compute_s", "stall_s", "wall_s")

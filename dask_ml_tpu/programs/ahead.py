@@ -42,6 +42,8 @@ import logging
 import os
 import queue
 import threading
+
+from .._locks import make_lock
 import time
 
 __all__ = [
@@ -69,7 +71,7 @@ AHEAD_THREAD_NAME = "dask-ml-tpu-compile-ahead"
 #: synchronous compiles for good (a crash-looping builder must not spin)
 _MAX_RESTARTS = 3
 
-_LOCK = threading.Lock()
+_LOCK = make_lock("programs.ahead")
 _QUEUE: queue.Queue | None = None
 _THREAD: threading.Thread | None = None
 _DEATHS = 0

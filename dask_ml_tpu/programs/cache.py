@@ -42,6 +42,8 @@ from __future__ import annotations
 import logging
 import os
 import threading
+
+from .._locks import make_lock
 import time
 
 import numpy as np
@@ -74,11 +76,11 @@ CACHE_DIR_ENV = "DASK_ML_TPU_COMPILE_CACHE"
 #: steady-state path — ahead compiles are small step programs).
 _AHEAD_WAIT_S = 120.0
 
-_REG_LOCK = threading.Lock()
+_REG_LOCK = make_lock("programs.registry")
 _BY_NAME: dict[str, "CachedProgram"] = {}
 
 _PERSISTENT = {"armed": False, "dir": None, "error": None}
-_PERSISTENT_LOCK = threading.Lock()
+_PERSISTENT_LOCK = make_lock("programs.persistent")
 
 
 def enable_persistent_cache(path: str | None = None) -> str | None:
@@ -223,7 +225,7 @@ class CachedProgram:
         self._jitted = jax.jit(
             fn, static_argnames=tuple(static_argnames) or None,
             donate_argnames=tuple(donate_argnames) or None, **jit_kwargs)
-        self._lock = threading.Lock()
+        self._lock = make_lock("programs.cache")
         self._entries: dict = {}
         self._inflight: dict = {}
         self.counters = _new_counters()

@@ -42,6 +42,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from .._locks import make_lock
 import time
 
 from ..obs import event as _obs_event
@@ -133,7 +135,7 @@ class FaultBudget:
         self.wall_s = float(wall_s)
         self.name = str(name)
         self._t0 = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.elastic")
         self.spent = 0
         self.denied = 0
         self.backoff_s = 0.0

@@ -26,6 +26,8 @@ import logging
 import signal
 import threading
 
+from .._locks import make_lock
+
 logger = logging.getLogger(__name__)
 
 __all__ = [
@@ -56,7 +58,7 @@ class TrainingPreempted(RuntimeError):
 
 
 _WATCHER: "PreemptionWatcher | None" = None
-_WATCHER_LOCK = threading.Lock()
+_WATCHER_LOCK = make_lock("resilience.preemption")
 
 
 class PreemptionWatcher:

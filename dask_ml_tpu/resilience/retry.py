@@ -36,6 +36,8 @@ from __future__ import annotations
 import logging
 import random
 import threading
+
+from .._locks import make_lock
 import time
 from collections import Counter
 
@@ -116,7 +118,7 @@ class FaultStats:
               "failures": "resilience.failure"}
 
     def __init__(self, registry=None):
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.retry")
         self._reg = registry
         self._faults: Counter = Counter()
         self._retries: Counter = Counter()

@@ -37,6 +37,8 @@ those drivers report through, so one report covers every domain.
 from __future__ import annotations
 
 import threading
+
+from .._locks import make_lock
 import time
 
 from ..obs import event as _obs_event
@@ -110,7 +112,7 @@ class Heartbeat:
                 f"verdict={self.verdict()!r}, beats={self.beats})")
 
 
-_LOCK = threading.Lock()
+_LOCK = make_lock("resilience.supervisor")
 _UNITS: dict[str, Heartbeat] = {}
 
 

@@ -50,6 +50,8 @@ from __future__ import annotations
 
 import contextlib
 import threading
+
+from .._locks import make_lock
 from collections import Counter
 from dataclasses import dataclass, field
 
@@ -195,7 +197,7 @@ class FaultPlan:
 
 
 _ACTIVE: FaultPlan | None = None
-_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_LOCK = make_lock("resilience.faults")
 
 
 def active_plan() -> FaultPlan | None:

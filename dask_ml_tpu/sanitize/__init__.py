@@ -42,8 +42,10 @@ from .core import (  # noqa: F401
     step_guard,
 )
 from .sites import AllowSite, registered_sites  # noqa: F401
+from . import locks  # noqa: F401
 
 __all__ = [
+    "locks",
     "AllowSite",
     "BASELINE_ENV",
     "SANITIZE_ENV",
@@ -61,3 +63,8 @@ __all__ = [
     "sanitize",
     "step_guard",
 ]
+
+# graftlock env arming (DASK_ML_TPU_LOCK_MONITOR=on): a long-lived
+# process records lock contention histograms from import — strict knob
+# parse, same posture as DASK_ML_TPU_TRACE / DASK_ML_TPU_METRICS_PORT
+locks.arm_from_env()

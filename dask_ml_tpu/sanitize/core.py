@@ -58,6 +58,8 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+
+from .._locks import make_lock, make_rlock
 import time
 from collections import defaultdict
 
@@ -126,7 +128,7 @@ def enabled_by_env() -> bool:
 
 
 # -- active-sanitizer state ----------------------------------------------
-_LOCK = threading.RLock()
+_LOCK = make_rlock("sanitize.active")
 _ACTIVE: "Sanitizer | None" = None
 _LAST_REPORT: dict | None = None
 _TLS = threading.local()  # per-thread region stack
@@ -384,7 +386,7 @@ class Sanitizer:
         self.allow_counts: dict = defaultdict(int)
         self.dispatch_threads: set = set()
         self._primary_ident: int | None = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("sanitize.state")
 
     # -- lifecycle -------------------------------------------------------
     def __enter__(self):
@@ -487,15 +489,15 @@ class Sanitizer:
         if off_thread or steady:
             kind = ("off-thread-compile" if off_thread
                     else "steady-state-compile")
-            self._violation(kind, reg, thread.name,
-                            f"XLA backend compile in region {reg!r} "
-                            f"on thread {thread.name!r} "
-                            f"(phase={self.phase})")
+            rec = self._violation(kind, reg, thread.name,
+                                  f"XLA backend compile in region {reg!r} "
+                                  f"on thread {thread.name!r} "
+                                  f"(phase={self.phase})")
             if self.fail_fast and off_thread:
                 # raise in the offending thread: a prefetch/stage worker
                 # must never compile (design.md §8) — the pipeline
                 # propagates this to the consumer at the block position
-                raise CompileViolation(self.violations[-1]["detail"])
+                raise CompileViolation(rec["detail"])
 
     def _record_dispatch(self, program: str) -> None:
         reg = current_region()
@@ -511,14 +513,14 @@ class Sanitizer:
         if (threading.get_ident() != self._primary_ident
                 and thread.name not in self.blessed_threads
                 and thread.name not in self.dispatch_blessed):
-            self._violation(
+            rec = self._violation(
                 "off-thread-dispatch", reg, thread.name,
                 f"device program {program!r} dispatched from second "
                 f"thread {thread.name!r} (region {reg!r}): two threads "
                 f"interleaving multi-device enqueues can deadlock the "
                 f"runtime (design.md §7 rule 1)")
             if self.fail_fast:
-                raise DispatchViolation(self.violations[-1]["detail"])
+                raise DispatchViolation(rec["detail"])
 
     def _record_d2h(self) -> None:
         reg = current_region()
@@ -534,18 +536,23 @@ class Sanitizer:
             self.allow_counts[site_id] += 1
 
     def _violation(self, kind: str, reg: str, thread: str,
-                   detail: str) -> None:
+                   detail: str) -> dict:
+        # returns the record so fail-fast raisers report THEIR
+        # violation: re-reading violations[-1] after the append races a
+        # concurrent thread's violation landing in between
+        rec = {
+            "kind": kind, "region": reg, "thread": thread,
+            "detail": detail,
+        }
         with self._lock:
-            self.violations.append({
-                "kind": kind, "region": reg, "thread": thread,
-                "detail": detail,
-            })
+            self.violations.append(rec)
         # span-tree + flight-recorder breadcrumb: a violation shows up
         # in the post-mortem ordered against the blocks/retries around
         # it, not just in the end-of-scope report
         _metrics_registry().counter("sanitize.violation", kind).inc()
         _obs_event("sanitize.violation", kind=kind, region=reg,
                    thread=thread)
+        return rec
 
     # -- results ---------------------------------------------------------
     def report(self) -> dict:

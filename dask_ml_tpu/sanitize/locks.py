@@ -1,0 +1,661 @@
+"""graftlock runtime half: the instrumented-lock monitor, the lock
+smoke suite, and the fifth committed ratchet.
+
+The static rules (``analysis/rules/locks.py``) prove lock-order and
+ownership properties about every path the AST can see; this module
+verifies the paths that actually RUN.  Arming :class:`LockMonitor` via
+:func:`instrumented_locks` hooks the package's named-lock factory
+(:mod:`dask_ml_tpu._locks`): every acquisition records (lock name,
+thread, wait seconds) into a per-thread held stack and a global
+name-level order graph, every release books held seconds, and two
+violation classes are detected live —
+
+* **order-inversion** — thread X acquires B while holding A after some
+  thread acquired A while holding B: the runtime twin of the static
+  ``lock-order-cycle`` rule, caught on the first inverted acquisition
+  rather than the first deadlock;
+* **cross-thread-class** — a package thread (``dask-ml-tpu-*``) outside
+  a lock's declared roster (``_spmd.LOCK_THREAD_CONTRACTS``) acquires
+  it, or a host thread acquires a lock whose roster excludes ``host``:
+  the runtime twin of ``unguarded-shared-state`` for the states those
+  locks guard.
+
+Contention is booked for free while armed: ``lock.wait_s{name}`` and
+``lock.held_s{name}`` histograms land in the PR-7 metrics registry, so
+``/metrics`` and ``run_report()`` expose per-lock contention — the
+[autopilot] controller's input signal.
+
+The suite (:data:`LOCK_WORKLOADS`) is the graftsan smoke suite plus
+``triple_plane`` (concurrent serve + search + ingest in one process,
+under an armed graftsan scope).  ``tools/lock_baseline.json`` commits
+the observed order-graph edge union and per-workload violation zeros;
+``tools/lint.sh --locks`` re-runs and ratchets:
+
+* a workload in the run but not the snapshot is **new** → fail; a
+  snapshot workload absent from the run is **stale** → fail;
+* an observed edge absent from the snapshot is a **new edge** → fail
+  (a new nesting must be consciously baselined — it is a new way to
+  deadlock); a snapshot edge unobserved in a warm run passes, same
+  ceiling asymmetry as the sanitize baseline (a warm jit cache skips
+  compile-path acquisitions the cold ``--write-baseline`` run saw);
+* violations are a **hard zero**, run and snapshot both — a baseline
+  can never grandfather an inversion in.
+
+``--inject-inversion`` / ``--inject-cross-write`` run seeded faults
+through the same entry the gate uses, proving the detector fires
+(exit 1) before anyone trusts its silence (exit 0).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+from .._locks import NamedLock, make_lock
+from .._locks import monitor as _current_monitor
+from .._locks import set_monitor
+
+__all__ = [
+    "BASELINE_ENV",
+    "MONITOR_ENV",
+    "LOCK_WORKLOADS",
+    "LockMonitor",
+    "arm_from_env",
+    "compare",
+    "default_path",
+    "emit",
+    "inject_cross_write",
+    "inject_inversion",
+    "instrumented_locks",
+    "is_clean",
+    "load",
+    "main",
+    "run_lock_smoke",
+    "run_lock_workload",
+    "triple_plane",
+    "write",
+]
+
+#: baseline path override (fifth committed baseline)
+BASELINE_ENV = "DASK_ML_TPU_LOCK_BASELINE"
+#: "on"/"1" arms a process-wide monitor at package import: a long-lived
+#: serve process then exports lock.wait_s/held_s contention for free
+MONITOR_ENV = "DASK_ML_TPU_LOCK_MONITOR"
+#: "inversion"/"cross-write" injects that seeded fault into a gate run
+#: (``tools/lint.sh --locks`` must exit 1 under it — the detector is
+#: proven live through the very entry the gate trusts)
+INJECT_ENV = "DASK_ML_TPU_LOCK_INJECT"
+
+_VERSION = 1
+_PKG_THREAD_PREFIX = "dask-ml-tpu-"
+
+
+def _registry():
+    from ..obs.metrics import registry
+
+    return registry()
+
+
+def _contracts() -> dict:
+    from ..analysis.rules._spmd import LOCK_THREAD_CONTRACTS
+
+    return LOCK_THREAD_CONTRACTS
+
+
+class LockMonitor:
+    """Process-wide lockset sanitizer (the _locks monitor hook).
+
+    Held stacks are thread-local; the order graph, violation log, and
+    counters live behind ONE raw ``threading.Lock`` — raw deliberately:
+    the monitor's own bookkeeping must never re-enter the monitor, and
+    it is a leaf by construction (nothing is acquired under it)."""
+
+    def __init__(self, *, book_metrics: bool = True):
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        self.book_metrics = book_metrics
+        #: (held_name, acquired_name) -> {"count", "thread"}
+        self.edges: dict = {}
+        self.violations: list = []
+        self.acquisitions = 0
+        self._flagged: set = set()
+        self._contracts = _contracts()
+
+    # -- the _locks hook surface -----------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def on_acquire(self, lock: NamedLock, wait_s: float) -> None:
+        name = lock.name
+        thread = threading.current_thread().name
+        st = self._stack()
+        held = [n for n, _t in st]
+        first = name not in held  # reentrant re-acquisition adds no edge
+        with self._lock:
+            self.acquisitions += 1
+            roster = self._contracts.get(name)
+            if roster is not None:
+                pkg = thread.startswith(_PKG_THREAD_PREFIX)
+                ok = (thread in roster) if pkg else ("host" in roster)
+                if not ok:
+                    self.violations.append({
+                        "kind": "cross-thread-class", "lock": name,
+                        "thread": thread,
+                        "detail": f"thread {thread!r} acquired {name!r} "
+                                  f"(roster: {sorted(roster)}) — the "
+                                  f"state this lock guards is owned by "
+                                  f"other thread classes",
+                    })
+            if first:
+                for h in held:
+                    if h == name:
+                        continue  # pragma: no cover - first implies absent
+                    e = self.edges.get((h, name))
+                    if e is None:
+                        self.edges[(h, name)] = {"count": 1,
+                                                 "thread": thread}
+                        rev = self.edges.get((name, h))
+                        pair = (name, h) if name < h else (h, name)
+                        if rev is not None and pair not in self._flagged:
+                            self._flagged.add(pair)
+                            self.violations.append({
+                                "kind": "order-inversion",
+                                "lock": name, "thread": thread,
+                                "detail":
+                                    f"thread {thread!r} acquired "
+                                    f"{name!r} while holding {h!r}, but "
+                                    f"{rev['thread']!r} acquired them "
+                                    f"in the reverse order — the "
+                                    f"interleaving that runs both at "
+                                    f"once deadlocks",
+                            })
+                    else:
+                        e["count"] += 1
+        st.append((name, time.perf_counter()))
+        if self.book_metrics:
+            _registry().histogram("lock.wait_s", name).record(wait_s)
+
+    def on_release(self, lock: NamedLock) -> None:
+        name = lock.name
+        st = self._stack()
+        held_s = None
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][0] == name:
+                held_s = time.perf_counter() - st[i][1]
+                del st[i]
+                break
+        if held_s is not None and self.book_metrics:
+            _registry().histogram("lock.held_s", name).record(held_s)
+
+    # -- results ---------------------------------------------------------
+    def edge_names(self) -> list:
+        with self._lock:
+            return sorted(f"{a} -> {b}" for a, b in self.edges)
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "acquisitions": self.acquisitions,
+                "edges": sorted(f"{a} -> {b}" for a, b in self.edges),
+                "violations": list(self.violations),
+            }
+
+
+class instrumented_locks:
+    """``with instrumented_locks() as mon:`` — arm a fresh
+    :class:`LockMonitor` for the block.  Non-nestable: attribution
+    (which workload produced which edge) requires one monitor."""
+
+    def __init__(self, *, book_metrics: bool = True):
+        self._mon = LockMonitor(book_metrics=book_metrics)
+
+    def __enter__(self) -> LockMonitor:
+        if _current_monitor() is not None:
+            raise RuntimeError(
+                "a lock monitor is already armed: instrumented_locks() "
+                "scopes must not nest")
+        set_monitor(self._mon)
+        return self._mon
+
+    def __exit__(self, *exc) -> None:
+        set_monitor(None)
+
+
+def arm_from_env() -> LockMonitor | None:
+    """Import-time arming (strict knob parse, same posture as the other
+    env knobs: a typo'd value raises rather than silently disarming)."""
+    raw = os.environ.get(MONITOR_ENV, "").strip().lower()
+    if raw in ("", "0", "off", "false"):
+        return None
+    if raw not in ("1", "on", "true"):
+        raise ValueError(
+            f"{MONITOR_ENV}={raw!r}: expected on/off (or 1/0)")
+    if _current_monitor() is not None:  # pragma: no cover - double import
+        return _current_monitor()
+    mon = LockMonitor()
+    set_monitor(mon)
+    return mon
+
+
+# -- seeded faults --------------------------------------------------------
+
+def inject_inversion() -> None:
+    """A→B then B→A on one thread, sequentially: no deadlock can occur
+    in the run itself, but the ORDER GRAPH carries the cycle — exactly
+    the window the detector exists to catch before an unlucky
+    interleaving does."""
+    a = make_lock("selftest.alpha")
+    b = make_lock("selftest.beta")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+
+
+def inject_cross_write() -> None:
+    """A rogue package-prefixed thread acquires a roster-contracted
+    lock (``serve.server`` admits the serve loop and host threads
+    only): the runtime shape of an unguarded cross-thread write to the
+    state that lock guards."""
+    guarded = make_lock("serve.server")
+
+    def _rogue():
+        with guarded:
+            pass
+
+    t = threading.Thread(target=_rogue, name="dask-ml-tpu-rogue-writer")
+    t.start()
+    t.join()
+
+
+# -- workloads ------------------------------------------------------------
+
+def triple_plane():
+    """Concurrent serve + search + ingest in ONE process, under an
+    armed graftsan scope.  A live :class:`~dask_ml_tpu.serve.runtime.
+    ModelServer` handles a host-thread client pump for the whole span
+    while the main thread runs a Hyperband search and then a sharded-
+    dataset streamed fit (device dispatch stays on the primary/blessed
+    threads — the concurrency under test is the LOCK plane: the serve
+    loop, search dispatcher, compile-ahead builder, data readers,
+    supervisor beats, and obs instruments all interleave their
+    acquisitions).  Gate: zero graftsan violations AND zero lock
+    violations, simultaneously."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from .. import data as _data
+    from .. import programs
+    from ..linear_model import SGDClassifier
+    from ..model_selection import HyperbandSearchCV
+    from ..pipeline import stream_partial_fit
+    from ..serve import ModelServer
+    from .core import sanitize
+
+    rng = np.random.RandomState(7)
+    Xs = rng.normal(size=(128, 4)).astype(np.float32)
+    ys = (Xs[:, 0] > 0).astype(np.int32)
+    clf = SGDClassifier(random_state=0)
+    clf.partial_fit(Xs, ys, classes=np.array([0, 1]))
+
+    X = rng.normal(size=(1024, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.1 * rng.normal(size=1024) > 0).astype(np.int32)
+
+    stop = threading.Event()
+    pump_errors: list = []
+
+    def _pump(srv):
+        # host-class client: submit + wait until told to stop — enqueue
+        # and event-wait only, statically provable host-only (the serve
+        # loop owns the dispatch; serve.server's roster admits hosts)
+        while not stop.is_set():
+            try:
+                srv.submit("m", Xs[:16]).result(30.0)
+            except Exception as e:  # surfaced after join
+                pump_errors.append(e)
+                return
+
+    d = tempfile.mkdtemp(prefix="graftlock-ds-")
+    try:
+        with sanitize(label="triple_plane") as s:
+            with ModelServer(label="triple_plane", window_s=0.0) as srv:
+                srv.load("m", clf)
+                pump = threading.Thread(target=_pump, args=(srv,),
+                                        name="triple-plane-client")
+                pump.start()
+                try:
+                    # search plane (spawns dask-ml-tpu-search)
+                    HyperbandSearchCV(
+                        SGDClassifier(random_state=0),
+                        {"alpha": [1e-4, 1e-3]},
+                        max_iter=2, random_state=0, test_size=0.25,
+                        chunk_size=64,
+                    ).fit(X, y, classes=np.array([0, 1]))
+                    programs.drain_ahead()
+                    # ingest plane (spawns dask-ml-tpu-data-reader x2
+                    # and the dask-ml-tpu-prefetch worker)
+                    _data.write_dataset(d, X, y, shards=2,
+                                        block_rows=256)
+                    model = SGDClassifier(random_state=0)
+                    ds = _data.ShardedDataset(d, key=7, readers=2,
+                                              label="triple_plane")
+                    stream_partial_fit(
+                        model, ds.iter_blocks(epoch=0), depth=2,
+                        fit_kwargs={"classes": np.array([0, 1])},
+                        label="triple_plane")
+                    programs.drain_ahead()
+                finally:
+                    stop.set()
+                    pump.join(timeout=30.0)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    if pump_errors:
+        raise pump_errors[0]
+    return s
+
+
+def _lock_workloads() -> dict:
+    from .smoke import WORKLOADS
+
+    out = dict(WORKLOADS)
+    out["triple_plane"] = triple_plane
+    return out
+
+
+#: name -> callable; resolved lazily so importing this module never
+#: imports jax (the CLI/tests resolve at run time)
+LOCK_WORKLOADS = _lock_workloads
+
+
+def run_lock_workload(name: str, fn=None) -> dict:
+    """One workload under an armed monitor → its lock metrics.  A
+    workload crash is an ``error`` metric (hard failure in the
+    ratchet), never a crash of the suite."""
+    if fn is None:
+        fn = _lock_workloads()[name]
+    err = None
+    with instrumented_locks() as mon:
+        try:
+            fn()
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+    rep = mon.report()
+    out = {
+        "acquisitions": rep["acquisitions"],
+        "edges": rep["edges"],
+        "violations": len(rep["violations"]),
+        "violation_details": [v["detail"] for v in rep["violations"]],
+    }
+    if err:
+        out["error"] = err
+    return out
+
+
+def run_lock_smoke(names=None) -> dict:
+    fns = _lock_workloads()
+    names = list(fns) if names is None else list(names)
+    unknown = [n for n in names if n not in fns]
+    if unknown:
+        raise KeyError(f"unknown workload(s): {', '.join(unknown)}")
+    return {name: run_lock_workload(name, fns[name]) for name in names}
+
+
+# -- baseline (fifth ratchet) ---------------------------------------------
+
+def default_path() -> str | None:
+    env = os.environ.get(BASELINE_ENV, "").strip()
+    if env:
+        return env
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cand = os.path.join(os.path.dirname(pkg), "tools",
+                        "lock_baseline.json")
+    return cand if os.path.isfile(cand) else None
+
+
+def emit(results: dict) -> dict:
+    """Snapshot payload: the order-graph edge UNION across the suite
+    (edges are name-level facts about the process, not per-workload
+    ones — workload attribution rides the per-workload edge lists the
+    gate re-derives) plus per-workload violation/acquisition books."""
+    union: set = set()
+    for m in results.values():
+        union |= set(m["edges"])
+    return {
+        "version": _VERSION,
+        "tool": "graftlock",
+        "edges": sorted(union),
+        "workloads": {
+            name: {"acquisitions": m["acquisitions"],
+                   "edge_count": len(m["edges"]),
+                   "violations": m["violations"],
+                   **({"error": m["error"]} if m.get("error") else {})}
+            for name, m in sorted(results.items())
+        },
+    }
+
+
+def write(path: str, payload: dict) -> None:
+    from ..analysis.cache import atomic_write_json
+
+    atomic_write_json(path, payload, indent=2, sort_keys=True)
+
+
+def load(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        payload = json.load(fh)
+    if payload.get("version", 0) > _VERSION:
+        raise ValueError(
+            f"lock baseline {path} has version {payload['version']}, "
+            f"newer than this sanitizer understands ({_VERSION})")
+    if not isinstance(payload.get("workloads"), dict) or \
+            not isinstance(payload.get("edges"), list):
+        raise ValueError(
+            f"lock baseline {path} is malformed: no workloads/edges")
+    return payload
+
+
+def compare(snapshot: dict, results: dict, *, partial: bool = False) -> dict:
+    """The ratchet delta (same shape as the sanitize baseline's):
+    ``{"new", "stale", "regressions", "violations"}``.
+
+    ``partial=True`` (an explicit ``--workloads`` subset, or a warm
+    in-process run) checks the hard invariants only: stale is
+    meaningless for a subset, and the edge union is calibrated against
+    the cold full suite (a warm jit cache legitimately skips
+    compile-path acquisitions), so edge comparisons would false-fail."""
+    snap_wl = snapshot["workloads"]
+    snap_edges = set(snapshot.get("edges", ()))
+    new = [] if partial else sorted(set(results) - set(snap_wl))
+    stale = [] if partial else sorted(set(snap_wl) - set(results))
+    regressions: list = []
+    violations: list = []
+
+    for name, m in sorted(results.items()):
+        if m.get("error"):
+            violations.append(f"{name}: workload errored: {m['error']}")
+        if m.get("violations", 0):
+            details = "; ".join(m.get("violation_details", ())) or "?"
+            violations.append(
+                f"{name}: {m['violations']} lock violation(s) "
+                f"(must be 0): {details}")
+        if partial:
+            continue
+        for edge in m.get("edges", ()):
+            if edge not in snap_edges:
+                regressions.append(
+                    f"{name}: NEW lock-order edge {edge!r} — a new "
+                    f"nesting is a new way to deadlock; prove the "
+                    f"order and rebaseline deliberately "
+                    f"(tools/lint.sh --rebaseline)")
+
+    for name, m in sorted(snap_wl.items()):
+        if m.get("violations", 0) or m.get("error"):
+            violations.append(
+                f"baseline entry {name} carries violations: a snapshot "
+                f"cannot grandfather an inversion — fix and rebaseline")
+
+    return {"new": new, "stale": stale,
+            "regressions": sorted(set(regressions)),
+            "violations": violations}
+
+
+def is_clean(delta: dict) -> bool:
+    return not any(delta[k] for k in ("new", "stale", "regressions",
+                                      "violations"))
+
+
+# -- CLI ------------------------------------------------------------------
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m dask_ml_tpu.sanitize.locks",
+        description="graftlock runtime lockset sanitizer + ratchet",
+    )
+    p.add_argument("--workloads", default=None,
+                   help="comma-separated subset (default: all; implies "
+                        "hard-invariant-only checking)")
+    p.add_argument("--baseline", metavar="PATH", default=None,
+                   help=f"ratchet against this snapshot (default: "
+                        f"{BASELINE_ENV}, else tools/lock_baseline.json)")
+    p.add_argument("--write-baseline", metavar="PATH", default=None)
+    p.add_argument("--inject-inversion", action="store_true",
+                   help="seeded-fault self-test: run an A→B/B→A "
+                        "inversion under the monitor (must exit 1)")
+    p.add_argument("--inject-cross-write", action="store_true",
+                   help="seeded-fault self-test: a rogue package "
+                        "thread acquires a contracted lock (must "
+                        "exit 1)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-workloads", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    try:
+        args = _parser().parse_args(argv)
+    except SystemExit as e:  # argparse's bad-args path
+        return 0 if (e.code in (0, None)) else 2
+
+    if args.list_workloads:
+        for name in sorted(_lock_workloads()):
+            print(name)
+        return 0
+
+    injections = []
+    if args.inject_inversion:
+        injections.append(("inject_inversion", inject_inversion))
+    if args.inject_cross_write:
+        injections.append(("inject_cross_write", inject_cross_write))
+    if injections:
+        # the self-test path: the seeded fault REPLACES the suite (it
+        # must be cheap enough for tier-1), and detection is the pass
+        # condition of the DETECTOR but the fail condition of the gate
+        results = {name: run_lock_workload(name, fn)
+                   for name, fn in injections}
+        failed = [n for n, m in results.items() if m["violations"]]
+        if args.format == "json":
+            print(json.dumps({"workloads": results,
+                              "detected": sorted(failed)},
+                             indent=2, sort_keys=True))
+        else:
+            for name, m in sorted(results.items()):
+                for detail in m["violation_details"]:
+                    print(f"VIOLATION: {name}: {detail}")
+            print(f"locks: {len(failed)}/{len(results)} seeded "
+                  f"fault(s) detected")
+        missed = [n for n, m in results.items() if not m["violations"]]
+        if missed:
+            print(f"locks: seeded fault(s) NOT detected: "
+                  f"{', '.join(sorted(missed))} — the detector is "
+                  f"blind", file=sys.stderr)
+            return 2
+        return 1
+
+    names = None
+    if args.workloads:
+        names = [w.strip() for w in args.workloads.split(",") if w.strip()]
+    if args.write_baseline and names is not None:
+        print("error: --write-baseline requires the full suite "
+              "(drop --workloads)", file=sys.stderr)
+        return 2
+    try:
+        results = run_lock_smoke(names)
+    except KeyError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    # DASK_ML_TPU_LOCK_INJECT seeds a fault INTO a gate run (vs the
+    # --inject-* flags, which replace the suite): the violation rides
+    # the normal ratchet path, so `tools/lint.sh --locks` is proven to
+    # exit 1 through the very invocation CI trusts
+    fault = os.environ.get(INJECT_ENV, "").strip().lower()
+    if fault:
+        seeded = {
+            "inversion": ("injected_inversion", inject_inversion),
+            "cross-write": ("injected_cross_write", inject_cross_write),
+            "cross_write": ("injected_cross_write", inject_cross_write),
+        }.get(fault)
+        if seeded is None:
+            print(f"error: {INJECT_ENV}={fault!r} (want 'inversion' "
+                  f"or 'cross-write')", file=sys.stderr)
+            return 2
+        results[seeded[0]] = run_lock_workload(seeded[0], seeded[1])
+
+    snap_path = args.write_baseline or args.baseline
+    if args.write_baseline:
+        probe = compare(emit(results), results, partial=True)
+        if probe["violations"]:
+            for line in probe["violations"]:
+                print(f"VIOLATION: {line}", file=sys.stderr)
+            print(f"locks: refusing to write a violating baseline to "
+                  f"{args.write_baseline} (file untouched)",
+                  file=sys.stderr)
+            return 1
+        write(args.write_baseline, emit(results))
+    if snap_path is None:
+        snap_path = default_path()
+
+    if snap_path is not None:
+        try:
+            snap = load(snap_path)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load baseline {snap_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        delta = compare(snap, results, partial=names is not None)
+    else:
+        delta = compare(emit(results), results, partial=names is not None)
+
+    clean = is_clean(delta)
+    if args.format == "json":
+        print(json.dumps({"workloads": results, "delta": delta,
+                          "baseline": snap_path, "clean": clean},
+                         indent=2, sort_keys=True))
+    else:
+        for name, m in sorted(results.items()):
+            print(f"{name}: acquisitions={m['acquisitions']} "
+                  f"edges={len(m['edges'])} "
+                  f"violations={m['violations']}"
+                  + (f" ERROR={m['error']}" if m.get("error") else ""))
+        for key in ("violations", "regressions", "new", "stale"):
+            for line in delta[key]:
+                print(f"{key.upper()}: {line}")
+        print("locks: "
+              + ("clean" if clean else "FAILED")
+              + (f" (vs {snap_path})" if snap_path else " (no baseline)"))
+    return 0 if clean else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -39,6 +39,8 @@ from __future__ import annotations
 
 import logging
 import threading
+
+from .._locks import make_lock
 import time
 
 import numpy as np
@@ -73,7 +75,7 @@ SERVE_THREAD_NAME = "dask-ml-tpu-serve"
 
 #: live servers, for the module-level :func:`report`
 _SERVERS: list = []
-_SERVERS_LOCK = threading.Lock()
+_SERVERS_LOCK = make_lock("serve.servers")
 
 #: constructions per label, to uniquify supervisor unit names — two
 #: servers sharing a label must NOT share a heartbeat entry, or a dead
@@ -128,7 +130,7 @@ class ModelServer:
         self._budget = budget if budget is not None else \
             FaultBudget.from_env(name=f"serve:{self.label}")
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.server")
         self._inflight: list = []
         self._replay: list = []
         self._failed: BaseException | None = None

@@ -1875,6 +1875,9 @@ class TestFramework:
             "swallowed-fault",
             # ISSUE 12: every cached program makes a donation decision
             "donation-miss",
+            # ISSUE 17 (graftlock): lock-order + shared-state ownership
+            "lock-order-cycle", "unguarded-shared-state",
+            "lock-held-across-dispatch",
         }
 
     def test_select_unknown_rule_raises(self):

@@ -1,0 +1,707 @@
+"""graftlock: whole-program lock-order + shared-state ownership analysis
+(static half, three graftlint rules) and the runtime lockset sanitizer
+(dynamic half, ``sanitize/locks.py``) with its committed fifth baseline.
+
+Mirrors test_graftlint.py's shape: the package gates itself (zero
+findings; the dispatcher and programs-cache locks MUST be in the order
+graph; the known-clean concurrent structures MUST resolve as guarded,
+not merely unflagged), and every rule is exercised on positive
+(flagging) and negative (clean) snippets.  The runtime gates prove the
+detector live (both seeded faults caught, through the CLI and through
+the env-seeded gate path ``tools/lint.sh --locks`` trusts) and the
+committed ``tools/lock_baseline.json`` green, including the
+``triple_plane`` workload — serve + search + ingest in one process —
+with zero lock violations AND zero graftsan violations simultaneously.
+"""
+
+import os
+import textwrap
+import threading
+
+import pytest
+
+from dask_ml_tpu.analysis import lint_source
+from dask_ml_tpu.analysis.core import Context, iter_py_files
+from dask_ml_tpu.analysis.graph import Project
+from dask_ml_tpu.analysis.rules.locks import _cycles, lock_model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "dask_ml_tpu")
+LOCK_BASELINE = os.path.join(REPO, "tools", "lock_baseline.json")
+
+LOCK_RULES = ("lock-order-cycle", "unguarded-shared-state",
+              "lock-held-across-dispatch")
+
+
+def lint(src, select=LOCK_RULES):
+    return lint_source(textwrap.dedent(src), select=list(select))
+
+
+def active(findings):
+    return [f for f in findings if not f.suppressed]
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+@pytest.fixture(scope="module")
+def pkg_model():
+    """ONE LockModel over the whole package, shared by the gate tests."""
+    ctxs = [Context(open(p).read(), p) for p in iter_py_files([PKG])]
+    project = Project(ctxs)
+    for c in ctxs:
+        c.project = project
+    return lock_model(project)
+
+
+def _state_facts(model, suffix):
+    """(thread classes, common-lock set, atomic_only) for the one state
+    whose identity ends with ``suffix`` — the analysis' own verdict, so
+    the known-clean gates assert GUARDED, not merely unflagged."""
+    for s, writes in model.state_writes():
+        if not s.identity.endswith(suffix):
+            continue
+        classes = set()
+        for _node, fn_key, _held, _atomic, _path in writes:
+            classes |= model.classes_of(fn_key)
+        non_atomic = [w for w in writes if not w[3]]
+        common = None
+        for _node, _key, held, _atomic, _path in non_atomic:
+            common = held if common is None else (common & held)
+        return classes, (common or set()), not non_atomic
+    raise AssertionError(f"no state matching {suffix!r} in the model")
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 self-gate: the package's lock plane must analyze clean
+# ---------------------------------------------------------------------------
+
+class TestPackageGate:
+    def test_no_order_cycles_in_package(self, pkg_model):
+        assert pkg_model.self_cycles == []
+        assert _cycles(pkg_model.edges) == []
+
+    def test_dispatcher_and_cache_locks_enter_the_graph(self, pkg_model):
+        # the PR-13 one-dispatcher lock and the programs-cache
+        # single-flight lock are the two locks most likely to meet a
+        # blocking call — the analysis MUST see them (an analysis that
+        # silently lost them would pass every other gate)
+        locks = set(pkg_model.locks)
+        assert any(i.endswith("_orchestrator._DISPATCHER_LOCK")
+                   for i in locks), sorted(locks)
+        assert any(i.endswith("CachedProgram._lock")
+                   for i in locks), sorted(locks)
+        endpoints = {n for e in pkg_model.edges for n in e}
+        assert any("_DISPATCHER_LOCK" in n for n in endpoints)
+        assert any("CachedProgram._lock" in n for n in endpoints)
+
+    def test_registry_books_are_multiclass_and_guarded(self, pkg_model):
+        classes, common, _ = _state_facts(
+            pkg_model, "MetricsRegistry._instruments")
+        assert len(classes) >= 2, classes  # serve/search/readers/main...
+        assert any(c.endswith("MetricsRegistry._lock") for c in common)
+
+    def test_cache_single_flight_is_guarded(self, pkg_model):
+        classes, common, _ = _state_facts(
+            pkg_model, "CachedProgram._inflight")
+        assert "dask-ml-tpu-compile-ahead" in classes and "main" in classes
+        assert any(c.endswith("CachedProgram._lock") for c in common)
+
+    def test_supervisor_table_is_guarded(self, pkg_model):
+        classes, common, _ = _state_facts(pkg_model, "supervisor._UNITS")
+        assert len(classes) >= 2, classes
+        assert any(c.endswith("supervisor._LOCK") for c in common)
+
+    def test_flight_ring_is_deque_atomic(self, pkg_model):
+        # lock-free by design (obs/flight.py): every write must be a
+        # GIL-atomic deque mutation, which is the rule's exemption
+        _classes, _common, atomic_only = _state_facts(
+            pkg_model, "flight._RING")
+        assert atomic_only
+
+    def test_residency_registry_is_single_owner(self, pkg_model):
+        # thread-confined, not locked: all mutation on the serve loop
+        classes, _common, _ = _state_facts(
+            pkg_model, "ModelRegistry._by_name")
+        assert classes == {"dask-ml-tpu-serve"}, classes
+
+    def test_package_has_zero_lock_findings(self, pkg_model):
+        # the three rules' verdicts over the REAL package, via the same
+        # model the fixture built (test_graftlint's full-package gate
+        # already covers the engine path; this pins the lock plane)
+        from dask_ml_tpu.analysis.rules.locks import (
+            LockHeldAcrossDispatchRule,
+            LockOrderCycleRule,
+            UnguardedSharedStateRule,
+        )
+
+        project = pkg_model.project
+        found = []
+        for rule in (LockOrderCycleRule(), UnguardedSharedStateRule(),
+                     LockHeldAcrossDispatchRule()):
+            found.extend(f for f in rule.run_project(project)
+                         if not f.suppressed)
+        assert not found, "\n".join(f.render() for f in found)
+
+
+# ---------------------------------------------------------------------------
+# lock-order-cycle: positive / negative snippets
+# ---------------------------------------------------------------------------
+
+class TestLockOrderCycle:
+    def test_flags_ab_ba_inversion(self):
+        findings = lint("""
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def forward():
+                with A:
+                    with B:
+                        pass
+
+            def reverse():
+                with B:
+                    with A:
+                        pass
+        """)
+        bad = active(findings)
+        assert rule_ids(bad) == ["lock-order-cycle"]
+        assert "reverse order" in bad[0].message
+
+    def test_flags_interprocedural_cycle(self):
+        findings = lint("""
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def take_b():
+                with B:
+                    pass
+
+            def take_a():
+                with A:
+                    pass
+
+            def forward():
+                with A:
+                    take_b()
+
+            def reverse():
+                with B:
+                    take_a()
+        """)
+        assert rule_ids(active(findings)) == ["lock-order-cycle"]
+
+    def test_flags_self_deadlock_on_plain_lock(self):
+        findings = lint("""
+            import threading
+
+            L = threading.Lock()
+
+            def relock():
+                with L:
+                    with L:
+                        pass
+        """)
+        bad = active(findings)
+        assert rule_ids(bad) == ["lock-order-cycle"]
+        assert "re-acquired" in bad[0].message
+
+    def test_consistent_order_is_clean(self):
+        findings = lint("""
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def one():
+                with A:
+                    with B:
+                        pass
+
+            def two():
+                with A:
+                    with B:
+                        pass
+        """)
+        assert not active(findings)
+
+    def test_rlock_reentry_is_clean(self):
+        findings = lint("""
+            import threading
+
+            L = threading.RLock()
+
+            def relock():
+                with L:
+                    with L:
+                        pass
+        """)
+        assert not active(findings)
+
+
+# ---------------------------------------------------------------------------
+# unguarded-shared-state: positive / negative snippets
+# ---------------------------------------------------------------------------
+
+class TestUnguardedSharedState:
+    def test_flags_cross_class_writes_with_no_lock(self):
+        findings = lint("""
+            import threading
+
+            BOOK = {}
+
+            def worker():
+                BOOK["w"] = 1
+
+            def start():
+                t = threading.Thread(target=worker,
+                                     name="dask-ml-tpu-pump")
+                t.start()
+                BOOK["m"] = 2
+        """)
+        bad = active(findings)
+        assert rule_ids(bad) == ["unguarded-shared-state"]
+        assert "BOOK" in bad[0].message
+        assert "dask-ml-tpu-pump" in bad[0].message
+
+    def test_flags_when_only_one_path_locks(self):
+        findings = lint("""
+            import threading
+
+            BOOK = {}
+            L = threading.Lock()
+
+            def worker():
+                with L:
+                    BOOK["w"] = 1
+
+            def start():
+                t = threading.Thread(target=worker,
+                                     name="dask-ml-tpu-pump")
+                t.start()
+                BOOK["m"] = 2
+        """)
+        assert rule_ids(active(findings)) == ["unguarded-shared-state"]
+
+    def test_common_lock_on_every_path_is_clean(self):
+        findings = lint("""
+            import threading
+
+            BOOK = {}
+            L = threading.Lock()
+
+            def worker():
+                with L:
+                    BOOK["w"] = 1
+
+            def start():
+                t = threading.Thread(target=worker,
+                                     name="dask-ml-tpu-pump")
+                t.start()
+                with L:
+                    BOOK["m"] = 2
+        """)
+        assert not active(findings)
+
+    def test_single_owner_is_clean(self):
+        findings = lint("""
+            import threading
+
+            BOOK = {}
+
+            def worker():
+                BOOK["w"] = 1
+                BOOK["x"] = 2
+
+            def start():
+                t = threading.Thread(target=worker,
+                                     name="dask-ml-tpu-pump")
+                t.start()
+        """)
+        assert not active(findings)
+
+    def test_atomic_deque_traffic_is_clean(self):
+        # the flight-ring design: every write one GIL-atomic deque call
+        findings = lint("""
+            import threading
+            from collections import deque
+
+            RING = deque(maxlen=64)
+
+            def worker():
+                RING.append(1)
+
+            def start():
+                t = threading.Thread(target=worker,
+                                     name="dask-ml-tpu-pump")
+                t.start()
+                RING.append(2)
+        """)
+        assert not active(findings)
+
+
+# ---------------------------------------------------------------------------
+# lock-held-across-dispatch: positive / negative snippets
+# ---------------------------------------------------------------------------
+
+class TestLockHeldAcrossDispatch:
+    def test_flags_sleep_under_lock(self):
+        findings = lint("""
+            import threading
+            import time
+
+            L = threading.Lock()
+
+            def poll():
+                with L:
+                    time.sleep(0.1)
+        """)
+        bad = active(findings)
+        assert rule_ids(bad) == ["lock-held-across-dispatch"]
+        assert "sleep" in bad[0].message
+
+    def test_flags_transitive_blocking_under_lock(self):
+        findings = lint("""
+            import threading
+
+            L = threading.Lock()
+
+            def drain(q):
+                return q.get(timeout=5.0)
+
+            def step(q):
+                with L:
+                    return drain(q)
+        """)
+        bad = active(findings)
+        assert rule_ids(bad) == ["lock-held-across-dispatch"]
+        assert "drain" in bad[0].message
+
+    def test_snapshot_then_block_outside_is_clean(self):
+        findings = lint("""
+            import threading
+            import time
+
+            L = threading.Lock()
+            BOOK = {}
+
+            def poll():
+                with L:
+                    n = len(BOOK)
+                time.sleep(0.1)
+                return n
+        """)
+        assert not active(findings)
+
+    def test_join_of_disjoint_thread_is_exempt(self):
+        # the PR-13 dispatcher shape: joining a thread that provably
+        # never wants the held lock is serialization, not deadlock
+        findings = lint("""
+            import threading
+
+            L = threading.Lock()
+
+            def work():
+                pass
+
+            def run():
+                thread = threading.Thread(target=work, name="w")
+                thread.start()
+                with L:
+                    thread.join()
+        """)
+        assert not active(findings)
+
+    def test_join_of_lock_wanting_thread_is_flagged(self):
+        findings = lint("""
+            import threading
+
+            L = threading.Lock()
+
+            def work():
+                with L:
+                    pass
+
+            def run():
+                thread = threading.Thread(target=work, name="w")
+                thread.start()
+                with L:
+                    thread.join()
+        """)
+        assert rule_ids(active(findings)) == ["lock-held-across-dispatch"]
+
+    def test_join_of_unresolvable_thread_stays_flagged(self):
+        # cannot prove disjointness -> keep the finding
+        findings = lint("""
+            import threading
+
+            L = threading.Lock()
+
+            def run(thread):
+                with L:
+                    thread.join()
+        """)
+        assert rule_ids(active(findings)) == ["lock-held-across-dispatch"]
+
+
+# ---------------------------------------------------------------------------
+# runtime half: monitor semantics, seeded faults, contention histograms
+# ---------------------------------------------------------------------------
+
+class TestLockMonitor:
+    def test_inversion_flagged_once_per_pair(self):
+        from dask_ml_tpu.sanitize import locks as rl
+
+        with rl.instrumented_locks(book_metrics=False) as mon:
+            rl.inject_inversion()
+            rl.inject_inversion()  # same pair again: no duplicate flag
+        rep = mon.report()
+        inv = [v for v in rep["violations"]
+               if v["kind"] == "order-inversion"]
+        assert len(inv) == 1
+        assert "reverse order" in inv[0]["detail"]
+        assert "selftest.alpha -> selftest.beta" in rep["edges"]
+        assert "selftest.beta -> selftest.alpha" in rep["edges"]
+
+    def test_cross_thread_class_flagged(self):
+        from dask_ml_tpu.sanitize import locks as rl
+
+        with rl.instrumented_locks(book_metrics=False) as mon:
+            rl.inject_cross_write()
+        kinds = [v["kind"] for v in mon.report()["violations"]]
+        assert kinds == ["cross-thread-class"]
+
+    def test_host_thread_on_rostered_lock_is_clean(self):
+        from dask_ml_tpu._locks import make_lock
+        from dask_ml_tpu.sanitize import locks as rl
+
+        with rl.instrumented_locks(book_metrics=False) as mon:
+            with make_lock("serve.server"):  # roster admits "host"
+                pass
+        assert mon.report()["violations"] == []
+
+    def test_monitor_books_wait_and_held_histograms(self):
+        # satellite (a): lock.wait_s{name} / lock.held_s{name} in the
+        # PR-7 registry
+        from dask_ml_tpu._locks import make_lock
+        from dask_ml_tpu.obs.metrics import registry
+        from dask_ml_tpu.sanitize import locks as rl
+
+        reg = registry()
+        reg.reset("lock.")
+        with rl.instrumented_locks():
+            with make_lock("serve.server"):
+                pass
+        snap = reg.snapshot()["histograms"]
+        assert snap["lock.wait_s{serve.server}"]["count"] >= 1
+        assert snap["lock.held_s{serve.server}"]["count"] >= 1
+
+    def test_monitors_do_not_nest(self):
+        from dask_ml_tpu.sanitize import locks as rl
+
+        with rl.instrumented_locks(book_metrics=False):
+            with pytest.raises(RuntimeError, match="must not nest"):
+                with rl.instrumented_locks(book_metrics=False):
+                    pass  # pragma: no cover
+
+    def test_arm_from_env_rejects_typos(self, monkeypatch):
+        from dask_ml_tpu.sanitize import locks as rl
+
+        monkeypatch.setenv(rl.MONITOR_ENV, "yess")
+        with pytest.raises(ValueError, match=rl.MONITOR_ENV):
+            rl.arm_from_env()
+        monkeypatch.setenv(rl.MONITOR_ENV, "off")
+        assert rl.arm_from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# the committed fifth baseline + the CLI gate (tier-1 ratchet)
+# ---------------------------------------------------------------------------
+
+class TestLockBaselineGate:
+    def test_committed_baseline_shape(self):
+        from dask_ml_tpu.sanitize import locks as rl
+
+        snap = rl.load(LOCK_BASELINE)
+        assert snap["tool"] == "graftlock"
+        assert "triple_plane" in snap["workloads"]
+        # the whole graftsan smoke suite rides the lock suite
+        from dask_ml_tpu.sanitize.smoke import WORKLOADS
+
+        assert set(snap["workloads"]) == set(WORKLOADS) | {"triple_plane"}
+        for name, m in snap["workloads"].items():
+            assert m["violations"] == 0, name
+        assert snap["edges"] == sorted(snap["edges"])
+
+    def test_injected_inversion_fails_cli(self, capsys):
+        from dask_ml_tpu.sanitize import locks as rl
+
+        assert rl.main(["--inject-inversion"]) == 1
+        assert "seeded" in capsys.readouterr().out
+
+    def test_injected_cross_write_fails_cli(self, capsys):
+        from dask_ml_tpu.sanitize import locks as rl
+
+        assert rl.main(["--inject-cross-write"]) == 1
+
+    def test_unknown_workload_is_tool_error(self, capsys):
+        from dask_ml_tpu.sanitize import locks as rl
+
+        assert rl.main(["--workloads", "nope"]) == 2
+
+    def test_new_edge_fails_unobserved_edge_passes(self):
+        from dask_ml_tpu.sanitize import locks as rl
+
+        snap = {"version": rl._VERSION, "tool": "graftlock",
+                "edges": ["a -> b"],
+                "workloads": {"w": {"acquisitions": 1, "edge_count": 1,
+                                    "violations": 0}}}
+        # observed edge not in snapshot: a NEW way to deadlock -> fail
+        res = {"w": {"acquisitions": 1, "edges": ["a -> b", "b -> c"],
+                     "violations": 0, "violation_details": []}}
+        delta = rl.compare(snap, res)
+        assert delta["regressions"] and "b -> c" in delta["regressions"][0]
+        # snapshot edge unobserved (warm jit cache): pass
+        res2 = {"w": {"acquisitions": 1, "edges": [],
+                      "violations": 0, "violation_details": []}}
+        assert rl.is_clean(rl.compare(snap, res2))
+
+    def test_gate_clean_on_subset_vs_committed_baseline(self, capsys):
+        from dask_ml_tpu.sanitize import locks as rl
+
+        assert rl.main(["--workloads", "sgd_stream_d0",
+                        "--baseline", LOCK_BASELINE]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_env_seeded_inversion_fails_gate(self, capsys, monkeypatch):
+        # the exact path `tools/lint.sh --locks` trusts: the fault rides
+        # the normal ratchet invocation and MUST turn it red
+        from dask_ml_tpu.sanitize import locks as rl
+
+        monkeypatch.setenv(rl.INJECT_ENV, "inversion")
+        assert rl.main(["--workloads", "sgd_stream_d0",
+                        "--baseline", LOCK_BASELINE]) == 1
+        assert "VIOLATIONS" in capsys.readouterr().out
+
+    def test_env_seeded_cross_write_fails_gate(self, capsys, monkeypatch):
+        from dask_ml_tpu.sanitize import locks as rl
+
+        monkeypatch.setenv(rl.INJECT_ENV, "cross-write")
+        assert rl.main(["--workloads", "sgd_stream_d0",
+                        "--baseline", LOCK_BASELINE]) == 1
+
+    def test_write_baseline_refuses_partial_suite(self, tmp_path, capsys):
+        from dask_ml_tpu.sanitize import locks as rl
+
+        out = tmp_path / "lock.json"
+        assert rl.main(["--workloads", "sgd_stream_d0",
+                        "--write-baseline", str(out)]) == 2
+        assert not out.exists()
+
+
+class TestTriplePlane:
+    def test_triple_plane_clean_under_armed_graftsan(self):
+        # serve + search + ingest in ONE process: zero lock violations
+        # AND zero graftsan violations, simultaneously — the workload
+        # the per-plane suites cannot produce
+        from dask_ml_tpu.sanitize import locks as rl
+
+        with rl.instrumented_locks() as mon:
+            s = rl.triple_plane()
+        rep = mon.report()
+        assert rep["violations"] == [], rep["violations"]
+        assert rep["acquisitions"] > 0
+        assert s.violations == [], s.violations
+
+
+# ---------------------------------------------------------------------------
+# en-route concurrency fixes: regressions stay fixed
+# ---------------------------------------------------------------------------
+
+class _TattletaleLock:
+    """Context-manager lock whose FIRST release lands a concurrent
+    ``record()``'s field updates — the interleaving the old multi-
+    acquisition ``Histogram.snapshot`` tore on (count bumped by the
+    empty-check release, sum read bare afterwards)."""
+
+    def __init__(self, hist):
+        self._inner = threading.Lock()
+        self._hist = hist
+        self._fired = False
+
+    def __enter__(self):
+        self._inner.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        if not self._fired:
+            self._fired = True
+            self._hist.count += 1
+            self._hist.sum += 100.0
+        self._inner.release()
+        return False
+
+
+class TestHistogramSnapshotAtomicity:
+    def test_snapshot_is_one_acquisition(self):
+        from dask_ml_tpu.obs.metrics import Histogram
+
+        h = Histogram()
+        h.record(1.0)
+        h._lock = _TattletaleLock(h)
+        snap = h.snapshot()
+        # one lock hold across every field read: the mutation staged at
+        # release must not leak into THIS snapshot (the torn result was
+        # count=1 with sum=101.0, or count=2/sum=1.0, depending on
+        # which bare read interleaved)
+        assert snap["count"] == 1
+        assert snap["sum"] == 1.0
+        assert snap["min"] == snap["max"] == 1.0
+
+
+class _IntruderSanitizer:
+    """Builds a Sanitizer whose violation log receives a concurrent
+    intruder record immediately after every append — the race the old
+    ``violations[-1]`` re-read in the fail-fast raisers lost."""
+
+    def __new__(cls):
+        from dask_ml_tpu.sanitize.core import Sanitizer
+
+        class _S(Sanitizer):
+            def _violation(self, kind, reg, thread, detail):
+                rec = super()._violation(kind, reg, thread, detail)
+                self.violations.append({
+                    "kind": "intruder", "region": reg,
+                    "thread": "someone-else",
+                    "detail": "NOT THE REAL VIOLATION",
+                })
+                return rec
+
+        return _S()
+
+
+class TestViolationAttribution:
+    def test_fail_fast_raiser_reports_its_own_violation(self):
+        from dask_ml_tpu.sanitize.core import DispatchViolation
+
+        s = _IntruderSanitizer()
+        s._primary_ident = threading.get_ident()
+        raised = []
+
+        def _rogue():
+            try:
+                s._record_dispatch("prog")
+            except DispatchViolation as e:
+                raised.append(str(e))
+
+        t = threading.Thread(target=_rogue, name="rogue-dispatcher")
+        t.start()
+        t.join()
+        assert len(raised) == 1
+        assert "rogue-dispatcher" in raised[0]
+        assert "NOT THE REAL VIOLATION" not in raised[0]

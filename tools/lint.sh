@@ -41,18 +41,33 @@
 # exactly what --inject-slowdown demonstrates).  Tier-1 runs the same
 # gate via tests/test_graftscope.py.
 #
+# --locks runs graftlock's RUNTIME half (sanitize/locks.py): the whole
+# graftsan smoke suite plus triple_plane (serve + search + ingest in one
+# process) under instrumented package locks, ratcheting the observed
+# lock-order edge set and thread-roster contracts against
+# tools/lock_baseline.json (a NEW edge is a new way to deadlock —
+# fail; an unobserved snapshot edge is a warm jit cache — pass).  The
+# STATIC half (lock-order-cycle / unguarded-shared-state /
+# lock-held-across-dispatch) rides the default graftlint ratchet above,
+# and the default path always runs the cheap seeded-fault self-test so
+# a blind detector can never gate anything.  Seed a fault through the
+# gate itself with DASK_ML_TPU_LOCK_INJECT=inversion|cross-write (the
+# gate must exit 1).  Tier-1 runs the same gates via
+# tests/test_graftlock.py.
+#
 # Usage:
 #   tools/lint.sh                 # static ratchet gate (text output)
 #   tools/lint.sh --json          # same, JSON output (CI trending)
 #   tools/lint.sh --sanitize      # static gate + runtime sanitizer gate
 #   tools/lint.sh --drills        # static gate + chaos drill gate
 #   tools/lint.sh --perf          # static gate + perf ratchet gate
-#   tools/lint.sh --rebaseline    # refresh ALL FOUR committed baselines
-#                                 # (lint, sanitize, drills, perf) after
-#                                 # intentional changes — each write
-#                                 # self-gates its hard invariants; a
-#                                 # half-updated set cannot be committed
-#                                 # green
+#   tools/lint.sh --locks         # static gate + runtime lockset gate
+#   tools/lint.sh --rebaseline    # refresh ALL FIVE committed baselines
+#                                 # (lint, sanitize, drills, perf,
+#                                 # locks) after intentional changes —
+#                                 # each write self-gates its hard
+#                                 # invariants; a half-updated set
+#                                 # cannot be committed green
 #   tools/lint.sh [extra graftlint args]   # passed through
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -61,10 +76,12 @@ BASELINE=tools/graftlint_baseline.json
 SAN_BASELINE=tools/sanitize_baseline.json
 DRILL_BASELINE=tools/drill_baseline.json
 PERF_BASELINE=tools/perf_baseline.json
+LOCK_BASELINE=tools/lock_baseline.json
 MODE=gate
 SANITIZE=0
 DRILLS=0
 PERF=0
+LOCKS=0
 EXTRA=()
 for a in "$@"; do
   case "$a" in
@@ -73,6 +90,7 @@ for a in "$@"; do
     --sanitize) SANITIZE=1 ;;
     --drills) DRILLS=1 ;;
     --perf) PERF=1 ;;
+    --locks) LOCKS=1 ;;
     *) EXTRA+=("$a") ;;
   esac
 done
@@ -94,11 +112,30 @@ if [[ "$MODE" == rebaseline ]]; then
   echo "== graftscope perf (rebaseline: cold-run latency/utilization) =="
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m dask_ml_tpu.obs.perf --write-baseline "$PERF_BASELINE"
+  echo "== graftlock (rebaseline: lock smoke suite, cold edge union) =="
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m dask_ml_tpu.sanitize.locks --write-baseline "$LOCK_BASELINE"
 fi
 
 echo "== graftlint (ratchet vs $BASELINE) =="
 JAX_PLATFORMS=cpu python -m dask_ml_tpu.analysis dask_ml_tpu \
   --baseline "$BASELINE" ${EXTRA[@]+"${EXTRA[@]}"}
+
+echo "== graftlock (detector self-test: seeded faults must be caught) =="
+# always on the default path: both seeded faults (an A->B/B->A order
+# inversion and a rogue-thread contract breach) run under the monitor,
+# no jax programs, <1s.  Exit 1 means the detector CAUGHT both (the
+# pass condition here); anything else means it is blind or broken and
+# must not be trusted to gate.
+rc=0
+JAX_PLATFORMS=cpu python -m dask_ml_tpu.sanitize.locks \
+  --inject-inversion --inject-cross-write >/dev/null 2>&1 || rc=$?
+if [[ "$rc" != 1 ]]; then
+  echo "graftlock: seeded-fault self-test FAILED (exit $rc, want 1:" \
+       "the lockset detector is blind)" >&2
+  exit 1
+fi
+echo "graftlock: 2/2 seeded faults detected"
 
 # (in --rebaseline mode the --write-baseline runs above already
 # self-gated each fresh snapshot's hard invariants; --sanitize/--drills
@@ -124,6 +161,12 @@ if [[ "$PERF" == 1 ]]; then
   echo "== graftscope perf (latency/utilization ratchet vs $PERF_BASELINE) =="
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m dask_ml_tpu.obs.perf --baseline "$PERF_BASELINE"
+fi
+
+if [[ "$LOCKS" == 1 ]]; then
+  echo "== graftlock (runtime lockset ratchet vs $LOCK_BASELINE) =="
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m dask_ml_tpu.sanitize.locks --baseline "$LOCK_BASELINE"
 fi
 
 echo "== compileall =="
