@@ -47,6 +47,7 @@ from . import resilience  # noqa: F401
 from . import serve  # noqa: F401
 from . import sanitize  # noqa: F401
 from . import obs  # noqa: F401
+from . import control  # noqa: F401
 from . import diagnostics  # noqa: F401
 from . import model_selection  # noqa: F401
 
@@ -72,6 +73,7 @@ __all__ = [
     "resilience",
     "serve",
     "compose",
+    "control",
     "diagnostics",
     "obs",
     "sanitize",

@@ -115,10 +115,15 @@ BLESSED_DISPATCH_THREADS = frozenset({"dask-ml-tpu-serve",
 # queue and never touch jax — the ``ingest_parallel`` graftsan workload
 # runtime-verifies exactly that (zero compiles/dispatches/transfers
 # attributed to reader threads during a steady fed fit).
+# ``dask-ml-tpu-pilot`` is the graftpilot controller loop
+# (control/pilot.py, design.md §21): it reads span records / registry
+# books, computes a critical-path verdict, and writes knob overrides —
+# pure host control-plane work that must never compile or dispatch.
 HOST_ONLY_THREAD_NAMES = frozenset({
     "dask-ml-tpu-scope",
     "dask-ml-tpu-metrics",
     "dask-ml-tpu-data-reader",
+    "dask-ml-tpu-pilot",
 })
 
 

@@ -1,0 +1,68 @@
+"""graftpilot: live knob registry + verdict-driven closed-loop control.
+
+Two halves (docs/design.md §21):
+
+* :mod:`.knobs` — every documented performance lever
+  (``PREFETCH_DEPTH``, ``DATA_READERS``, ``DATA_QUEUE``,
+  ``SERVE_WINDOW_MS``, ``SERVE_MAX_BATCH``, ``SEARCH_INFLIGHT``) as a
+  bounded, strictly-parsed :class:`~.knobs.Knob` with a runtime setter
+  and change counter; the owning planes re-read overrides at their
+  natural boundaries (block / drain cycle / scheduler turn) through
+  lock-free :func:`~.knobs.override_or` loads.
+* :mod:`.pilot` — the supervised host-only controller thread
+  (``dask-ml-tpu-pilot``) that polls graftpath's live critical-path
+  verdict on a cadence and applies the policy table with hysteresis
+  (confidence gate, cooldown, step limits, revert-on-regression) and a
+  hard ``saturation_pinned`` freeze.
+
+``python -m dask_ml_tpu.control --self-test`` runs the seeded
+false-verdict liveness check wired into ``tools/lint.sh``.
+"""
+
+from . import knobs  # noqa: F401
+from .knobs import (  # noqa: F401
+    KNOBS,
+    Knob,
+    clear_override,
+    clear_overrides,
+    effective,
+    knob,
+    observe,
+    override,
+    override_or,
+    set_knob,
+)
+from . import pilot  # noqa: F401
+from .pilot import (  # noqa: F401
+    AUTOPILOT_ENV,
+    CADENCE_ENV,
+    INJECT_ENV,
+    PILOT_THREAD_NAME,
+    Autopilot,
+    autopilot,
+    current_pilot,
+    maybe_autostart,
+    self_test,
+    stop_pilot,
+)
+
+__all__ = [
+    # knobs
+    "Knob", "KNOBS", "knob", "set_knob", "override", "override_or",
+    "observe", "effective", "clear_override", "clear_overrides",
+    # pilot
+    "AUTOPILOT_ENV", "CADENCE_ENV", "INJECT_ENV", "PILOT_THREAD_NAME",
+    "Autopilot", "autopilot", "maybe_autostart", "current_pilot",
+    "stop_pilot", "self_test",
+    "report",
+]
+
+
+def report() -> dict:
+    """The diagnostics view: live knob table + the active pilot's books
+    (None when no pilot is running)."""
+    p = current_pilot()
+    return {
+        "knobs": knobs.report(),
+        "pilot": p.report() if p is not None else None,
+    }

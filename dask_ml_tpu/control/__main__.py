@@ -1,0 +1,34 @@
+"""CLI: the graftpilot seeded-fault liveness self-test.
+
+``python -m dask_ml_tpu.control --self-test`` (the default) seeds
+``DASK_ML_TPU_PILOT_INJECT=false-verdict`` and asserts the controller
+both MOVES the readers knob under the injected verdict and stays FROZEN
+under synthetic saturation.  Exit 0 = live; exit 1 = blind, broken, or
+explicitly disabled via ``DASK_ML_TPU_AUTOPILOT=off`` — so a disabled
+controller verifiably fails the gate (``tools/lint.sh`` runs this on
+its default path, next to graftlock's seeded-fault self-test).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .pilot import self_test
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dask_ml_tpu.control",
+        description="graftpilot seeded-fault liveness self-test")
+    ap.add_argument("--self-test", action="store_true", default=True,
+                    help="run the false-verdict move + saturation-freeze "
+                         "check (default; exit 0 = controller live)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress progress output")
+    args = ap.parse_args(argv)
+    return self_test(verbose=not args.quiet)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,7 @@ import time
 import numpy as np
 
 from .. import obs
+from ..control import knobs as _knobs
 from ..obs.metrics import registry as _registry
 from ..resilience import supervisor as _supervisor
 from ..resilience.elastic import BudgetExhausted, FaultBudget
@@ -102,18 +103,23 @@ def _resolve_int(env: str, default: int, what: str,
 
 
 def resolve_readers(readers: int | None = None) -> int:
-    """Reader-thread count: explicit argument, else the
-    ``DASK_ML_TPU_DATA_READERS`` knob, else 4.  Strict parse."""
+    """Reader-thread count: explicit argument, else the live graftpilot
+    override, else the ``DASK_ML_TPU_DATA_READERS`` knob, else 4.
+    Strict parse."""
+    if readers is None:
+        readers = _knobs.override("data_readers")
     return _resolve_int(READERS_ENV, _DEFAULT_READERS, "reader count",
                         readers)
 
 
 def resolve_queue_blocks(queue_blocks: int | None = None,
                          readers: int = _DEFAULT_READERS) -> int:
-    """Reorder-window size in blocks: explicit, else the
-    ``DASK_ML_TPU_DATA_QUEUE`` knob, else ``2 × readers`` (deep enough
-    that every reader can stay one block ahead, shallow enough that
-    host RAM stays a handful of blocks)."""
+    """Reorder-window size in blocks: explicit, else the live graftpilot
+    override, else the ``DASK_ML_TPU_DATA_QUEUE`` knob, else
+    ``2 × readers`` (deep enough that every reader can stay one block
+    ahead, shallow enough that host RAM stays a handful of blocks)."""
+    if queue_blocks is None:
+        queue_blocks = _knobs.override("data_queue")
     return _resolve_int(QUEUE_ENV, 2 * int(readers), "queue window",
                         queue_blocks)
 
@@ -168,9 +174,20 @@ class ShardedDataset:
         if self.epochs < 1:
             raise ValueError(f"epochs must be >= 1, got {self.epochs}")
         self.shuffle = bool(shuffle)
+        # explicit args PIN their value (a test that asks for readers=2
+        # gets exactly 2); env/default-resolved sizing is LIVE — streams
+        # re-read the graftpilot override at their natural boundaries
+        # (reorder-window check per offer, reader scale-up from the
+        # consumer's liveness poll) and observe the base they run with
+        self._readers_pinned = readers is not None
+        self._queue_pinned = queue_blocks is not None
         self.readers = resolve_readers(readers)
         self.queue_blocks = resolve_queue_blocks(queue_blocks,
                                                  self.readers)
+        if not self._readers_pinned:
+            _knobs.observe("data_readers", self.readers)
+        if not self._queue_pinned:
+            _knobs.observe("data_queue", self.queue_blocks)
         self.start = int(start)
         self.budget = budget
         self.reader_restarts = int(reader_restarts)
@@ -281,9 +298,30 @@ class _DatasetStream:
         self._epoch = epoch
         self._epoch_live = True
         # readers beyond the shard count would never claim work
-        n = min(ds.readers, len(self._plan.shard_order))
+        n = min(self._live_readers(), len(self._plan.shard_order))
         for rid in range(max(n, 1)):
             self._spawn(rid)
+
+    # -- graftpilot live sizing (lock-free attribute reads) ------------
+    def _live_readers(self) -> int:
+        """The reader count this stream should run with NOW: pinned
+        streams keep their construction value; live streams follow the
+        graftpilot override over the env/default base."""
+        ds = self._ds
+        if ds._readers_pinned:
+            return ds.readers
+        return max(1, int(_knobs.override_or("data_readers",
+                                             ds.readers)))
+
+    def _live_window(self) -> int:
+        """The reorder-window ceiling in blocks, re-read per offer —
+        readers park against the LIVE value, so a widened window frees
+        parked readers within one poll tick."""
+        ds = self._ds
+        if ds._queue_pinned:
+            return ds.queue_blocks
+        return max(1, int(_knobs.override_or("data_queue",
+                                             ds.queue_blocks)))
 
     def _spawn(self, rid: int, resume_pos: int | None = None) -> None:
         ds = self._ds
@@ -341,7 +379,7 @@ class _DatasetStream:
         exactly-once half of reader replay."""
         with self._cond:
             while self._epoch_live and \
-                    seq >= self._next_seq + self._ds.queue_blocks:
+                    seq >= self._next_seq + self._live_window():
                 self._cond.wait(timeout=_POLL_S)
             if not self._epoch_live:
                 return False
@@ -451,6 +489,26 @@ class _DatasetStream:
                         continue  # a report landed after the poll; next pass
                 self._restart_reader(
                     rid, "data reader died without reporting")
+        # graftpilot mid-epoch scale-UP: the live readers knob rose and
+        # unclaimed shards remain — spawn the difference (each new
+        # reader claims from the shared cursor like any other).  Scale-
+        # DOWN is lazy: surplus readers drain their claimed shard and
+        # exit at the next claim.  Runs on the consumer thread outside
+        # the condition (the _spawn/_restart_reader idiom: supervisor
+        # registration must not nest under data.readers).
+        live = self._live_readers()
+        with self._cond:
+            if not self._epoch_live:
+                return
+            unclaimed = len(self._plan.shard_order) - self._next_pos
+            active = sum(
+                1 for rid, t in enumerate(self._threads)
+                if t.is_alive() and not self._finished.get(rid, False))
+            spawn = min(live - active, unclaimed)
+        for _ in range(max(spawn, 0)):
+            _registry().counter("data.reader_scale",
+                                self._ds.label).inc()
+            self._spawn(len(self._threads))
 
     def __iter__(self):
         return self

@@ -61,6 +61,8 @@ from .._locks import make_lock
 import time
 
 from .. import obs as _obs
+from ..control import knobs as _knobs
+from ..control.pilot import maybe_autostart as _maybe_autostart
 
 __all__ = [
     "SEARCH_THREAD_NAME",
@@ -173,10 +175,26 @@ class SearchScheduler:
     event loop (shared by every bracket/unit coroutine on it)."""
 
     def __init__(self, inflight: int | None = None, heartbeat=None):
+        # explicit arg PINS the cap (tests that ask for inflight=3 get
+        # exactly 3); with None the cap is LIVE — re-read per scheduler
+        # turn through the graftpilot override so the controller can
+        # widen the device feed mid-search
+        self._pinned = inflight is not None
         self.inflight = resolve_inflight() if inflight is None else \
             int(inflight)
+        if not self._pinned:
+            _knobs.observe("search_inflight", self.inflight)
         self._hb = heartbeat
         self._turns = 0
+
+    def effective_inflight(self) -> int:
+        """The cap this turn runs under: the constructor value when
+        pinned, else the live graftpilot override (lock-free read) over
+        the env/default base."""
+        if self._pinned:
+            return self.inflight
+        return max(1, int(_knobs.override_or("search_inflight",
+                                             self.inflight)))
 
     # -- dispatch discipline (loop thread) -------------------------------
     async def turn(self) -> None:
@@ -193,7 +211,9 @@ class SearchScheduler:
             self._hb.beat()
         t0 = time.perf_counter()
         parked = False
-        while _scope.pending_count() >= self.inflight:
+        # live cap: re-read once per park iteration so a mid-search
+        # raise releases parked units without waiting out the turn
+        while _scope.pending_count() >= self.effective_inflight():
             parked = True
             await asyncio.sleep(_PARK_S)
         if parked:
@@ -233,6 +253,7 @@ def run_search(factory, *, threaded: bool):
     dispatcher, the caller's mesh scope and span parent travel across
     the hop, and the thread runs as a supervised unit (domain
     ``"search"``) whose heartbeat beats per dispatch turn."""
+    _maybe_autostart()  # DASK_ML_TPU_AUTOPILOT=1 arms the controller
     if not threaded:
         return asyncio.run(factory())
 

@@ -504,6 +504,154 @@ def _wl_search(inject_s=0.0):
     }
 
 
+#: controller calibration (ISSUE 18, graftpilot): the remote-store
+#: regime's fetch emulation + the detuned starting point the pilot must
+#: tune its way out of, and the committed hysteresis numbers.
+_CTRL_FETCH_S = 0.010
+#: long enough for the full escalation chain: with the settle window
+#: growing to 4 x cooldown cycles per move, a ten-move trajectory
+#: needs ~2 s of converge traffic
+_CTRL_CONVERGE_EPOCHS = 10
+_CTRL_MEASURE_EPOCHS = 3
+_CTRL_CADENCE_MS = 25.0
+_CTRL_COOLDOWN = 2
+#: per-knob move cap for the workload's pilot: bounds the worst-case
+#: trajectory (both chain knobs fully stepped) so the committed step
+#: ceiling is meaningful on a noisy box.
+_CTRL_MAX_MOVES = 5
+#: ratchet slack: the measured run may take this many moves more than
+#: the committed run before the step ceiling fails the gate (settle
+#: verdicts are rate-noise-driven on a loaded 2-core box, so run-to-run
+#: move counts wobble by a few), and its autopilot/tuned throughput
+#: ratio may sag to this factor of the committed ratio before the
+#: floor does.
+CONTROLLER_MOVES_SLACK = 4
+CONTROLLER_RATIO_FLOOR_FACTOR = 0.9
+
+
+def _wl_controller(inject_s=0.0):
+    """The graftpilot convergence ratchet, CI-enforced (ISSUE 18): from
+    a DETUNED start (``DASK_ML_TPU_DATA_READERS=1``, ``PREFETCH_DEPTH=1``
+    — env-detuned, not arg-pinned, so the knobs stay live) under
+    remote-store emulation (10 ms fetch per block inside the readers),
+    the controller must tune itself back to the hand-tuned arm's
+    throughput.  Three phases:
+
+    * **tuned arm** — env defaults (the defaults ARE the hand-tuned
+      values: 4 readers, depth 2), no pilot: the reference rate;
+    * **converge fit** — detuned env + a live :class:`Autopilot`
+      polling the real graftpath verdict;
+    * **measured fit** — same pilot still holding its overrides: the
+      converged throughput the ratchet compares.
+
+    Both measured arms run as ``_CTRL_MEASURE_EPOCHS`` independent
+    single-epoch fits and report the BEST epoch rate: a max statistic
+    is stable against one-off load spikes on the shared gate box where
+    a mean is not, and "best sustained epoch" is the honest reading of
+    a converged rate (the pilot keeps polling between the measured
+    fits, so a trajectory that finishes late still counts).
+
+    Committed gates (see :func:`compare`): ``converged`` must hold,
+    ``convergence_moves`` ceilings at the committed count +
+    ``CONTROLLER_MOVES_SLACK``, and ``throughput_ratio``
+    (converged / tuned rate) floors at ``CONTROLLER_RATIO_FLOOR_FACTOR``
+    × the committed ratio.  The generic v3 columns
+    (``overlap_efficiency`` + ``bottleneck``) ratchet the measured fit's
+    structure through the ordinary bands.  The latency/utilization
+    columns are committed as zeros — convergence is the metric here,
+    and the per-block numbers already ratchet via the sgd/ingest
+    entries.  Under ``--inject-slowdown`` the workload shrinks to one
+    epoch per phase (the injection must fail the SUITE fast, not stall
+    it) — the block-count drift this causes is itself a failure, which
+    is the contract."""
+    import numpy as np
+
+    from .. import data as _data
+    from ..control import knobs as _knobs
+    from ..control.pilot import Autopilot
+    from ..linear_model import SGDClassifier
+    from ..pipeline import stream_partial_fit
+    from . import critical as _critical
+
+    dirp = _ingest_dataset_dir()
+    classes = np.array([0, 1])
+    converge_epochs = 1 if inject_s else _CTRL_CONVERGE_EPOCHS
+    measure_epochs = 1 if inject_s else _CTRL_MEASURE_EPOCHS
+    detune = {"DASK_ML_TPU_DATA_READERS": "1",
+              "DASK_ML_TPU_PREFETCH_DEPTH": "1"}
+
+    def _fit(label, epochs):
+        ds = _data.ShardedDataset(dirp, key=_SEED, epochs=epochs,
+                                  fetch_latency_s=_CTRL_FETCH_S,
+                                  label=label)
+        model = _inject(SGDClassifier(random_state=0), inject_s)
+        t0 = time.perf_counter()
+        stream_partial_fit(model, ds.iter_blocks(),
+                           fit_kwargs={"classes": classes}, label=label)
+        wall = time.perf_counter() - t0
+        return _BLOCKS * epochs / max(wall, 1e-9), wall
+
+    def _best_rate(label, n_fits):
+        # max over independent single-epoch fits (see docstring): the
+        # top epoch is what both arms can sustain, minus load spikes
+        rates, walls = [], []
+        for i in range(n_fits):
+            r, w = _fit(f"{label}_{i}", 1)
+            rates.append(r)
+            walls.append(w)
+        return max(rates), sum(walls)
+
+    saved = {k: os.environ.get(k) for k in detune}
+    pilot = None
+    _knobs.clear_overrides()
+    try:
+        _fit("ctrl_warmup", 1)  # compiles + reader paths hot
+        tuned_rate, _ = _best_rate("ctrl_tuned", measure_epochs)
+        os.environ.update(detune)
+        pilot = Autopilot(cadence_ms=_CTRL_CADENCE_MS,
+                          cooldown=_CTRL_COOLDOWN,
+                          max_moves=_CTRL_MAX_MOVES)
+        pilot.start()
+        _fit("ctrl_converge", converge_epochs)
+        auto_rate, auto_wall = _best_rate("ctrl_measured", measure_epochs)
+        for _ in range(100):  # let a pending settle window close (the
+            if pilot.converged():  # idle gap clears it within cycles)
+                break
+            time.sleep(0.01)
+        pilot.stop()
+        cp = _critical.critical_path()  # the measured fit's structure
+        rep = pilot.report()
+        return {
+            "blocks": _BLOCKS * measure_epochs,
+            "p50_block_s": 0.0,
+            "p99_block_s": 0.0,
+            "utilization": 0.0,
+            "stall_fraction": 0.0,
+            "wall_s": round(auto_wall, 6),
+            "device_busy_s": 0.0,
+            "programs": {},
+            "convergence_moves": len(rep["moves"]),
+            "converged": bool(rep["converged"]),
+            "throughput_ratio": round(
+                auto_rate / max(tuned_rate, 1e-9), 4),
+            "knob_trajectory": [
+                {"knob": m["knob"], "direction": m["direction"],
+                 "to": m["to"], "class": m["class"]}
+                for m in rep["moves"]],
+            "freezes": dict(rep["freezes"]),
+            **_graftpath_cols(cp),
+        }
+    finally:
+        if pilot is not None and pilot.running():
+            pilot.stop()
+        _knobs.clear_overrides()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 WORKLOADS = {
     "sgd_stream_d0": lambda inject_s=0.0: _wl_sgd(0, inject_s),
     "sgd_stream_d2": lambda inject_s=0.0: _wl_sgd(2, inject_s),
@@ -511,6 +659,7 @@ WORKLOADS = {
     "serve_latency": lambda inject_s=0.0: _wl_serve(inject_s),
     "search_util": lambda inject_s=0.0: _wl_search(inject_s),
     "ingest_stall": lambda inject_s=0.0: _wl_ingest(inject_s),
+    "controller": lambda inject_s=0.0: _wl_controller(inject_s),
 }
 
 
@@ -638,6 +787,44 @@ def compare(snapshot: dict, results: dict, *, partial: bool = False) -> dict:
                 f"drifted; rebaseline deliberately "
                 f"(tools/lint.sh --rebaseline)")
             continue
+        # graftpilot convergence ratchet (the `controller` entry): the
+        # committed run's move count is the step ceiling, its
+        # autopilot/tuned throughput ratio the floor, and convergence
+        # itself is non-negotiable — a controller that stopped
+        # converging fails even if every generic band below holds
+        # (those are committed as zeros for this entry)
+        if "convergence_moves" in base:
+            if not m.get("converged"):
+                regressions.append(
+                    f"{name}: controller did not converge (moves "
+                    f"{m.get('convergence_moves')}, trajectory "
+                    f"{m.get('knob_trajectory')}) — the pilot is still "
+                    f"moving knobs at fit end where the committed run "
+                    f"went quiet")
+            moves_ceil = (base.get("convergence_moves", 0)
+                          + CONTROLLER_MOVES_SLACK)
+            if m.get("convergence_moves", 0) > moves_ceil:
+                regressions.append(
+                    f"{name}: convergence took "
+                    f"{m.get('convergence_moves')} moves > ceiling "
+                    f"{moves_ceil} (committed "
+                    f"{base.get('convergence_moves')} + "
+                    f"{CONTROLLER_MOVES_SLACK}) — the policy/hysteresis "
+                    f"got less decisive; fix it or rebaseline "
+                    f"deliberately")
+            # capped at 1.0: the criterion is "within 0.9x of the
+            # hand-tuned arm" — a committed run that happened to BEAT
+            # the hand-tuned arm must not raise the bar past it
+            b_ratio = min(float(base.get("throughput_ratio") or 0.0),
+                          1.0)
+            ratio_floor = b_ratio * CONTROLLER_RATIO_FLOOR_FACTOR
+            if float(m.get("throughput_ratio") or 0.0) < ratio_floor:
+                regressions.append(
+                    f"{name}: converged throughput ratio "
+                    f"{m.get('throughput_ratio')} < floor "
+                    f"{ratio_floor:.3f} (committed {b_ratio} × "
+                    f"{CONTROLLER_RATIO_FLOOR_FACTOR}) — the tuned-up "
+                    f"arm lost ground against the hand-tuned one")
         for key, band in (("p50_block_s", P50_BAND),
                           ("p99_block_s", P99_BAND)):
             ceil = _ceiling(base.get(key, 0.0), band)

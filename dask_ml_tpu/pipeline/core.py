@@ -61,6 +61,8 @@ from .._locks import make_lock
 import time
 
 from .. import obs
+from ..control import knobs as _knobs
+from ..control.pilot import maybe_autostart as _maybe_autostart
 from ..resilience import supervisor as _supervisor
 from ..resilience.elastic import ElasticPolicy, WorkerLost
 from ..resilience.testing import ThreadCrash as _ThreadCrash
@@ -101,6 +103,11 @@ _POLL_S = 0.05
 #: for it (the stats.stall_s scalar still counts every microsecond)
 _STALL_SPAN_MIN_S = 0.002
 
+#: producer-side park while the staged queue sits at the LIVE capacity
+#: ceiling (graftpilot streams): the worker re-checks the gate at this
+#: cadence, so a consumer pop or a deepened override frees it fast
+_GATE_POLL_S = 0.0005
+
 
 class _BlockFault(Exception):
     """Internal: one block's pipeline failure with position + phase
@@ -120,8 +127,13 @@ class _BlockFault(Exception):
 
 
 def resolve_depth(depth: int | None = None) -> int:
-    """Resolve a prefetch depth: explicit argument, else the
-    ``DASK_ML_TPU_PREFETCH_DEPTH`` env knob, else the default (2)."""
+    """Resolve a prefetch depth: explicit argument, else the live
+    graftpilot override, else the ``DASK_ML_TPU_PREFETCH_DEPTH`` env
+    knob, else the default (2)."""
+    if depth is None:
+        ov = _knobs.override("prefetch_depth")
+        if ov is not None:
+            depth = int(ov)
     if depth is None:
         raw = os.environ.get(DEPTH_ENV, "").strip()
         if raw:
@@ -179,7 +191,8 @@ _CAPTURE_PARENT = object()
 
 
 def _staged_iter(src, stage, depth: int, stats: PipelineStats,
-                 policy: ElasticPolicy, trace_parent=_CAPTURE_PARENT):
+                 policy: ElasticPolicy, trace_parent=_CAPTURE_PARENT,
+                 live: bool = False):
     """Yield ``stage(item)`` for each item of ``src``, staged up to
     ``depth`` blocks ahead on a host worker thread, under the elastic
     restart driver.
@@ -190,6 +203,15 @@ def _staged_iter(src, stage, depth: int, stats: PipelineStats,
     or re-raise on the consumer thread at the failed block's position.
     Closing the generator stops the worker promptly even when it is
     blocked on a full queue.
+
+    ``live=True`` (caller resolved ``depth`` from env/default rather
+    than an explicit arg) makes the staging capacity LIVE: the worker
+    gates on the graftpilot ``prefetch_depth`` override per block
+    instead of a frozen ``Queue(maxsize=depth)``, so the controller can
+    deepen (or shallow) the stage-ahead window mid-stream.  The gate
+    clamps at >= 1 — a live stream that entered the threaded path stays
+    threaded — and a depth-0 stream stays structurally serial either
+    way (the seed's behavior is pinned, not tunable).
     """
     restartable = bool(getattr(src, "restartable_source", False))
     # shared driver state: ONE worker exists at a time (start happens
@@ -252,14 +274,26 @@ def _staged_iter(src, stage, depth: int, stats: PipelineStats,
     # depth >= 1: bounded queue + one host-only staging worker per
     # (re)start — the driver below restarts it on recoverable faults
 
+    def _live_depth(base=depth) -> int:
+        return max(1, int(_knobs.override_or("prefetch_depth", base)))
+
     while True:
-        q: queue.Queue = queue.Queue(maxsize=depth)
+        # live streams use an UNBOUNDED queue with a capacity gate in
+        # _put (re-read per block): a bounded Queue's maxsize is frozen
+        # at construction, which is exactly what blocked mid-run depth
+        # changes.  One producer means occupancy overshoots the live
+        # ceiling by at most the one block in hand.
+        q: queue.Queue = queue.Queue(maxsize=0 if live else depth)
         stop = threading.Event()
         hb_box: list = [None]
 
         def _put(msg, q=q, stop=stop) -> bool:
-            """Queue-put that stays responsive to consumer shutdown."""
+            """Queue-put that stays responsive to consumer shutdown
+            (and, for live streams, to the live capacity ceiling)."""
             while not stop.is_set():
+                if live and q.qsize() >= _live_depth():
+                    time.sleep(_GATE_POLL_S)  # park: queue at live depth
+                    continue
                 try:
                     q.put(msg, timeout=0.05)
                     return True
@@ -426,7 +460,10 @@ def prefetch_blocks(blocks, *, depth: int | None = None,
     env knobs.  Records a :class:`PipelineStats` when the stream
     completes or closes.
     """
+    live = depth is None  # env/default-resolved: graftpilot retunes
     depth = resolve_depth(depth)
+    if live:
+        _knobs.observe("prefetch_depth", depth)
     stage = stage or _identity
     policy = elastic if elastic is not None else ElasticPolicy(label=label)
     stats = PipelineStats(label=label, depth=depth, staged=stage is not _identity)
@@ -435,7 +472,7 @@ def prefetch_blocks(blocks, *, depth: int | None = None,
     # discipline holds; the worker's parse/stage spans stitch under it
     with obs.span("pipeline.stream", label=label, depth=depth):
         src = as_block_source(blocks)
-        feed = _staged_iter(src, stage, depth, stats, policy)
+        feed = _staged_iter(src, stage, depth, stats, policy, live=live)
         try:
             for staged in feed:
                 t0 = time.perf_counter()
@@ -540,7 +577,11 @@ def stream_partial_fit(model, blocks, *, depth: int | None = None,
             )
 
     kw = dict(fit_kwargs or {})
+    live = depth is None  # env/default-resolved: graftpilot may retune
     depth = resolve_depth(depth)
+    if live:
+        _knobs.observe("prefetch_depth", depth)
+        _maybe_autostart()  # DASK_ML_TPU_AUTOPILOT=1 arms the controller
     policy = elastic if elastic is not None else ElasticPolicy(label=label)
     staged_proto = depth > 0 and _supports_staging(model)
     stats = PipelineStats(label=label, depth=depth, staged=staged_proto)
@@ -570,7 +611,7 @@ def stream_partial_fit(model, blocks, *, depth: int | None = None,
                   staged=staged_proto,
                   estimator=type(model).__name__):
         src = as_block_source(blocks)
-        feed = _staged_iter(src, _stage, depth, stats, policy)
+        feed = _staged_iter(src, _stage, depth, stats, policy, live=live)
         done = 0
         try:
             for item in feed:
@@ -638,7 +679,10 @@ class UnitStream:
                  label: str = "search_ingest", elastic=None,
                  parent_span: int | None = None):
         kw = dict(fit_kwargs or {})
+        live = depth is None  # env/default-resolved: graftpilot retunes
         depth = resolve_depth(depth)
+        if live:
+            _knobs.observe("prefetch_depth", depth)
         policy = elastic if elastic is not None else \
             ElasticPolicy(label=label)
         staged_proto = depth > 0 and _supports_staging(model)
@@ -659,7 +703,7 @@ class UnitStream:
         self._src = as_block_source(blocks)
         self._feed = _staged_iter(self._src, stage, depth,
                                   self._stats, policy,
-                                  trace_parent=self._parent)
+                                  trace_parent=self._parent, live=live)
         self._closed = False
         # close/advance handshake: an orchestrator cancelled mid-await
         # calls close() from its loop thread while next_staged() is

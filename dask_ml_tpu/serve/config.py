@@ -1,16 +1,21 @@
 """Serving-plane policy knobs (``DASK_ML_TPU_SERVE_*``).
 
 All resolvers follow the repo's env_choice posture: explicit argument
-wins, else the env knob, else the documented default — and an
-unparseable value raises loudly (a typo'd knob must never silently
-change admission or latency behavior).  Knobs are read at server
-construction, not per request: the serve loop's hot path never touches
-``os.environ``.
+wins, else the live graftpilot override (window / max-batch only — the
+two levers the controller owns), else the env knob, else the documented
+default — and an unparseable value raises loudly (a typo'd knob must
+never silently change admission or latency behavior).  Env is read at
+server construction, not per request: the serve loop's hot path never
+touches ``os.environ`` — its per-drain-cycle refresh
+(``ModelServer._refresh_knobs``) reads only the lock-free override
+attribute.
 """
 
 from __future__ import annotations
 
 import os
+
+from ..control import knobs as _knobs
 
 __all__ = [
     "MAX_BATCH_ENV",
@@ -74,8 +79,11 @@ def _env_number(env: str, cast, default):
 
 
 def resolve_max_batch(value: int | None = None) -> int:
-    value = int(_env_number(MAX_BATCH_ENV, int, _DEFAULT_MAX_BATCH)
-                if value is None else value)
+    if value is None:
+        ov = _knobs.override("serve_max_batch")
+        value = (ov if ov is not None
+                 else _env_number(MAX_BATCH_ENV, int, _DEFAULT_MAX_BATCH))
+    value = int(value)
     if value < 1:
         raise ValueError(f"serve max batch must be >= 1, got {value}")
     return value
@@ -83,8 +91,12 @@ def resolve_max_batch(value: int | None = None) -> int:
 
 def resolve_window_s(value: float | None = None) -> float:
     """The gather window in SECONDS (the knob is in ms)."""
-    ms = (_env_number(WINDOW_ENV, float, _DEFAULT_WINDOW_MS)
-          if value is None else float(value) * 1e3)
+    if value is None:
+        ov = _knobs.override("serve_window_ms")
+        ms = (float(ov) if ov is not None
+              else _env_number(WINDOW_ENV, float, _DEFAULT_WINDOW_MS))
+    else:
+        ms = float(value) * 1e3
     if ms < 0:
         raise ValueError(f"serve window must be >= 0 ms, got {ms}")
     return ms / 1e3

@@ -46,6 +46,8 @@ import time
 import numpy as np
 
 from .. import obs
+from ..control import knobs as _knobs
+from ..control.pilot import maybe_autostart as _maybe_autostart
 from ..obs.metrics import registry as _registry
 from ..resilience import supervisor as _supervisor
 from ..resilience.elastic import FaultBudget
@@ -117,7 +119,21 @@ class ModelServer:
         self.label = str(label)
         self._unit = _unit_name(self.label)
         self.max_batch = resolve_max_batch(max_batch)
+        #: the construction max-batch is the COMPILE CEILING: warmup
+        #: covers bucket rungs up to it, so a live knob raise past it
+        #: would force a steady-state compile on the serve thread (a
+        #: hard graftsan violation) — _refresh_knobs clamps to this.
+        self._max_batch_ceiling = self.max_batch
         self.window_s = resolve_window_s(window_s)
+        # explicit ctor args PIN (graftpilot doctrine: a test asking for
+        # window_s=0 gets exactly that); env/default sizing stays live
+        self._max_batch_pinned = max_batch is not None
+        self._window_pinned = window_s is not None
+        if not self._max_batch_pinned:
+            _knobs.observe("serve_max_batch", self.max_batch)
+        if not self._window_pinned:
+            _knobs.observe("serve_window_ms", self.window_s * 1e3)
+        _maybe_autostart()  # DASK_ML_TPU_AUTOPILOT=1 arms the controller
         self.default_deadline_s = resolve_deadline_s(deadline_s)
         self.registry = ModelRegistry(
             budget_bytes=resolve_hbm_budget_bytes(hbm_budget_mb),
@@ -388,10 +404,33 @@ class ModelServer:
                 self._hb.name, "serve", thread=self._thread)
         self._hb.beat()
 
+    def _refresh_knobs(self) -> None:
+        """Per-DRAIN-CYCLE knob refresh (graftpilot): pick up live
+        window / max-batch overrides before each gather.  Lock-free
+        attribute reads, never ``os.environ`` — the config-module
+        posture holds.  Max-batch clamps to the construction value (the
+        compile ceiling): a live raise must never force a steady-state
+        compile on this thread."""
+        w_ms = (None if self._window_pinned
+                else _knobs.override_or("serve_window_ms", None))
+        if w_ms is not None:
+            w_s = max(float(w_ms), 0.0) / 1e3
+            if w_s != self.window_s:
+                self.window_s = w_s
+                self._batcher.window_s = w_s
+        mb = (None if self._max_batch_pinned
+              else _knobs.override_or("serve_max_batch", None))
+        if mb is not None:
+            mb = min(max(int(mb), 1), self._max_batch_ceiling)
+            if mb != self.max_batch:
+                self.max_batch = mb
+                self._batcher.max_batch = mb
+
     # -- the loop (serve thread) -----------------------------------------
     def _loop(self) -> None:
         try:
             while not self._stop.is_set():
+                self._refresh_knobs()
                 with self._lock:
                     replay, self._replay = self._replay, []
                 batch = replay or self._batcher.gather(self._stop)

@@ -1,0 +1,526 @@
+"""graftpilot (dask_ml_tpu/control, design.md §21): the live knob
+registry and the verdict-driven controller loop.
+
+Registry half: strict parse / bounds clamp / unknown-name round-trips,
+the resolution-order contract (explicit arg PINS, override beats env,
+clear restores), and the graftlock posture — concurrent setters vs
+lock-free readers produce ZERO violations and ZERO new lock-order edges
+vs the committed ``tools/lock_baseline.json``.
+
+Controller half: the policy table moves the right knob for each verdict
+class, hysteresis holds (confidence gate, cooldown, step caps,
+revert-on-regression), the ``saturation_pinned`` hard guard freezes
+every move — including an injected one — and the seeded false-verdict
+self-test (``python -m dask_ml_tpu.control --self-test``) exits 0 only
+for a LIVE controller (disabled ⇒ nonzero: a blind controller must
+never gate).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu.control import knobs as K
+from dask_ml_tpu.control import pilot as P
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOCK_BASELINE = os.path.join(REPO, "tools", "lock_baseline.json")
+
+_CONTROL_ENVS = (P.AUTOPILOT_ENV, P.CADENCE_ENV, P.INJECT_ENV)
+
+
+@pytest.fixture(autouse=True)
+def _knob_isolation(monkeypatch):
+    """Every test starts and ends with a clean override table and no
+    control env vars leaking in either direction (tier-1 tests must be
+    order-independent)."""
+    for env in _CONTROL_ENVS:
+        monkeypatch.delenv(env, raising=False)
+    for k in K.KNOBS.values():
+        monkeypatch.delenv(k.env, raising=False)
+    K.clear_overrides()
+    yield
+    P.stop_pilot()
+    K.clear_overrides()
+
+
+# ---------------------------------------------------------------------------
+# the knob registry
+# ---------------------------------------------------------------------------
+
+class TestKnobRegistry:
+    def test_six_documented_levers(self):
+        assert sorted(K.KNOBS) == ["data_queue", "data_readers",
+                                   "prefetch_depth", "search_inflight",
+                                   "serve_max_batch", "serve_window_ms"]
+        for k in K.KNOBS.values():
+            assert k.env.startswith("DASK_ML_TPU_")
+            assert k.lo <= k.hi
+
+    def test_unknown_name_lists_the_registry(self):
+        with pytest.raises(KeyError, match="data_queue, data_readers"):
+            K.knob("warp_factor")
+        with pytest.raises(KeyError):
+            K.set_knob("warp_factor", 9)
+
+    def test_strict_parse_round_trips(self):
+        k = K.KNOBS["data_readers"]
+        assert k.parse(3) == 3
+        assert k.parse("3") == 3
+        f = K.KNOBS["serve_window_ms"]
+        assert f.parse(2) == 2.0 and isinstance(f.parse(2), float)
+        assert f.parse("1.5") == 1.5
+
+    @pytest.mark.parametrize("bad", [True, False, 2.5, "2.5", "many",
+                                     None, [4]])
+    def test_int_knob_rejects_junk(self, bad):
+        with pytest.raises(ValueError, match="data_readers"):
+            K.KNOBS["data_readers"].parse(bad)
+
+    def test_set_knob_clamps_to_bounds_and_counts(self):
+        k = K.KNOBS["data_readers"]
+        before = k.changes
+        assert K.set_knob("data_readers", 10 ** 6) == k.hi
+        assert K.set_knob("data_readers", 0) == k.lo
+        assert k.changes == before + 2
+
+    def test_override_round_trip_and_clear(self):
+        assert K.override("prefetch_depth") is None
+        K.set_knob("prefetch_depth", 8)
+        assert K.override("prefetch_depth") == 8
+        assert K.override_or("prefetch_depth", 2) == 8
+        K.clear_override("prefetch_depth")
+        assert K.override_or("prefetch_depth", 2) == 2
+
+    def test_effective_resolution_order(self, monkeypatch):
+        k = K.KNOBS["search_inflight"]
+        assert k.effective() == 8                    # static default
+        monkeypatch.setenv(k.env, "16")
+        assert k.effective() == 16                   # env beats default
+        K.observe("search_inflight", 4)
+        assert k.effective() == 4                    # observed beats env
+        K.set_knob("search_inflight", 32)
+        assert k.effective() == 32                   # override wins
+
+    def test_env_strict_parse_raises(self, monkeypatch):
+        monkeypatch.setenv(K.KNOBS["data_readers"].env, "lots")
+        with pytest.raises(ValueError, match="DASK_ML_TPU_DATA_READERS"):
+            K.KNOBS["data_readers"].env_value()
+        # report() stays usable even over a junk env (effective=None)
+        assert K.report()["data_readers"]["effective"] is None
+
+    def test_dynamic_default_has_no_base(self):
+        assert K.KNOBS["data_queue"].effective() is None
+
+    def test_set_knob_books_gauge_and_counter(self):
+        from dask_ml_tpu.obs.metrics import registry
+
+        reg = registry()
+        reg.reset("control.")
+        K.set_knob("serve_window_ms", 4.0, source="test")
+        fam = reg.family("control.knob_value")
+        assert fam.get("serve_window_ms") == 4.0
+        assert reg.family("control.knob_set").get("test") == 1
+
+    def test_report_shape(self):
+        rep = K.report()
+        for name, row in rep.items():
+            assert set(row) >= {"override", "observed", "effective",
+                                "changes", "lo", "hi", "env", "unit"}
+
+
+class TestKnobConcurrency:
+    def test_concurrent_set_vs_read_zero_new_lock_edges(self):
+        """Hammer set_knob/clear against override_or readers under the
+        runtime lockset sanitizer: zero violations, and every observed
+        lock-order edge already exists in the committed baseline — the
+        control.knobs lock never nests (in either direction)."""
+        from dask_ml_tpu.sanitize import locks as rl
+
+        stop = threading.Event()
+        seen = []
+
+        def reader():
+            while not stop.is_set():
+                v = K.override_or("data_readers", 4)
+                assert isinstance(v, int)
+                seen.append(v)
+
+        with rl.instrumented_locks(book_metrics=False) as mon:
+            threads = [threading.Thread(target=reader) for _ in range(3)]
+            for t in threads:
+                t.start()
+            for i in range(200):
+                K.set_knob("data_readers", 1 + (i % 8))
+                if i % 50 == 0:
+                    K.clear_overrides()
+            stop.set()
+            for t in threads:
+                t.join()
+        rep = mon.report()
+        assert rep["violations"] == [], rep["violations"]
+        with open(LOCK_BASELINE) as f:
+            base_edges = set(json.load(f)["edges"])
+        new = set(rep["edges"]) - base_edges
+        assert not new, f"new lock-order edges: {sorted(new)}"
+        assert not any("control.knobs" in e for e in rep["edges"])
+        assert all(v == 4 or 1 <= v <= 8 for v in seen)
+
+
+# ---------------------------------------------------------------------------
+# plane integration: the live re-read points honor the pin doctrine
+# ---------------------------------------------------------------------------
+
+class TestPlaneResolution:
+    def test_pipeline_depth_override(self):
+        from dask_ml_tpu.pipeline.core import resolve_depth
+
+        assert resolve_depth(3) == 3
+        K.set_knob("prefetch_depth", 7)
+        assert resolve_depth() == 7        # override beats default
+        assert resolve_depth(3) == 3       # explicit arg still pins
+        K.clear_overrides()
+        assert resolve_depth() == 2
+
+    def test_data_resolvers_override(self):
+        from dask_ml_tpu.data.readers import (resolve_queue_blocks,
+                                              resolve_readers)
+
+        K.set_knob("data_readers", 2)
+        K.set_knob("data_queue", 5)
+        assert resolve_readers() == 2
+        assert resolve_queue_blocks(readers=2) == 5
+        assert resolve_readers(6) == 6     # explicit pins
+
+    def test_serve_resolvers_override(self):
+        from dask_ml_tpu.serve.config import (resolve_max_batch,
+                                              resolve_window_s)
+
+        K.set_knob("serve_window_ms", 8.0)
+        K.set_knob("serve_max_batch", 64)
+        assert resolve_window_s() == pytest.approx(0.008)
+        assert resolve_max_batch() == 64
+        assert resolve_window_s(0.001) == pytest.approx(0.001)  # pins
+
+    def test_search_inflight_live_vs_pinned(self):
+        from dask_ml_tpu.model_selection._orchestrator import (
+            SearchScheduler)
+
+        live = SearchScheduler()
+        assert live.effective_inflight() == 8
+        K.set_knob("search_inflight", 2)
+        assert live.effective_inflight() == 2
+        pinned = SearchScheduler(inflight=16)
+        assert pinned.effective_inflight() == 16  # explicit arg pins
+
+    def test_dataset_reader_pin_flags(self, tmp_path):
+        from dask_ml_tpu import data
+
+        rng = np.random.RandomState(0)
+        X = rng.normal(size=(1024, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int32)
+        data.write_dataset(str(tmp_path / "ds"), X, y, shards=2,
+                           block_rows=256)
+        pinned = data.ShardedDataset(str(tmp_path / "ds"), readers=1)
+        assert pinned._readers_pinned
+        live = data.ShardedDataset(str(tmp_path / "ds"))
+        assert not live._readers_pinned
+        # and the stream's live window honors an override mid-run
+        K.set_knob("data_readers", 2)
+        with live.iter_blocks(epoch=0) as st:
+            blocks = list(st)
+        assert len(blocks) == 4
+        # pinned stream delivers identically regardless of the override
+        with pinned.iter_blocks(epoch=0) as st:
+            ref = list(st)
+        assert len(ref) == 4
+
+    def test_live_prefetch_stream_survives_mid_run_retune(self):
+        from dask_ml_tpu.pipeline import core as pc
+
+        blocks = [np.ones((4, 2)) * i for i in range(8)]
+        out = []
+        gen = pc.prefetch_blocks(iter(blocks))  # env/default: live
+        for i, b in enumerate(gen):
+            out.append(b)
+            if i == 1:
+                K.set_knob("prefetch_depth", 6)  # deepen mid-stream
+            if i == 4:
+                K.set_knob("prefetch_depth", 1)  # shrink mid-stream
+        assert len(out) == 8
+        assert [b[0, 0] for b in out] == [float(i) for i in range(8)]
+
+    def test_serve_refresh_honors_pins_and_ceiling(self):
+        from dask_ml_tpu.serve.runtime import ModelServer
+
+        with ModelServer(label="t_knobs", window_s=0.0,
+                         max_batch=32) as srv:
+            # both pinned by explicit args: refresh must not move them
+            K.set_knob("serve_window_ms", 50.0)
+            K.set_knob("serve_max_batch", 4096)
+            srv._refresh_knobs()
+            assert srv.window_s == 0.0
+            assert srv.max_batch == 32
+        K.clear_overrides()
+        with ModelServer(label="t_knobs_live") as srv:
+            K.set_knob("serve_window_ms", 1.0)
+            K.set_knob("serve_max_batch", 1 << 19)
+            srv._refresh_knobs()
+            assert srv.window_s == pytest.approx(0.001)
+            # live raise clamps to the construction compile ceiling
+            assert srv.max_batch == srv._max_batch_ceiling
+
+
+# ---------------------------------------------------------------------------
+# the controller loop
+# ---------------------------------------------------------------------------
+
+def _spin(p, n):
+    for _ in range(n):
+        p._cycle()
+
+
+class TestAutopilot:
+    def test_injected_verdict_moves_readers_up(self, monkeypatch):
+        monkeypatch.setenv(P.INJECT_ENV, "false-verdict")
+        p = P.Autopilot(cadence_ms=5.0, cooldown=1, _test_cpu_frac=0.0)
+        _spin(p, 4)
+        assert p.moves and p.moves[0]["knob"] == "data_readers"
+        assert p.moves[0]["direction"] == "up"
+        assert p.moves[0]["injected"]
+        assert K.override("data_readers") > 4
+
+    def test_saturation_freezes_even_injected_verdicts(self, monkeypatch):
+        monkeypatch.setenv(P.INJECT_ENV, "false-verdict")
+        p = P.Autopilot(cadence_ms=5.0, cooldown=1, _test_cpu_frac=1.0)
+        _spin(p, 4)
+        assert p.moves == []
+        assert p.freezes.get("saturation_pinned", 0) >= 3
+        assert K.override("data_readers") is None
+
+    def test_cooldown_spaces_moves(self, monkeypatch):
+        monkeypatch.setenv(P.INJECT_ENV, "false-verdict")
+        p = P.Autopilot(cadence_ms=5.0, cooldown=3, _test_cpu_frac=0.0)
+        _spin(p, 4)
+        # prime at cycle 1, move at cycle 2, then the cooldown holds
+        # cycles 3-4 (cycles-since-move 1, 2 < 3)
+        assert len(p.moves) == 1
+        _spin(p, 1)  # cycles-since-move reaches 3: next move lands
+        assert len(p.moves) == 2
+
+    def test_step_caps_and_bounds_burn(self):
+        p = P.Autopilot(cadence_ms=5.0, cooldown=1, max_moves=2,
+                        _test_cpu_frac=0.0)
+        v = {"class": "parse-bound", "confidence": 1.0,
+             "confident": True, "injected": True}
+        for _ in range(8):
+            p._cycles_since_move = 10
+            p._apply("fit", v)
+        # 2 moves on readers, then the chain escalates to prefetch_depth
+        # for 2 more, then policy_exhausted freezes
+        by_knob = {}
+        for m in p.moves:
+            by_knob.setdefault(m["knob"], []).append(m)
+        assert len(by_knob["data_readers"]) == 2
+        assert len(by_knob["prefetch_depth"]) == 2
+        assert p.freezes.get("policy_exhausted", 0) >= 1
+
+    def test_low_confidence_freezes(self):
+        p = P.Autopilot(cadence_ms=5.0, cooldown=1, _test_cpu_frac=0.0)
+        p._cycles_since_move = 10
+        p._apply("fit", {"class": "parse-bound", "confidence": 0.1,
+                         "confident": False})
+        assert p.moves == []
+        assert p.freezes.get("low_confidence") == 1
+
+    def test_device_bound_is_goal_state(self):
+        p = P.Autopilot(cadence_ms=5.0, cooldown=1, _test_cpu_frac=0.0)
+        p._cycles_since_move = 10
+        p._apply("fit", {"class": "device-bound", "confidence": 0.9,
+                         "confident": True})
+        assert p.moves == []
+        assert p.freezes.get("no_policy") == 1
+
+    def test_step_semantics(self):
+        p = P.Autopilot()
+        readers = K.KNOBS["data_readers"]
+        assert p._step(readers, 4, "up") == 8
+        assert p._step(readers, 1, "up") == 2
+        assert p._step(readers, 8, "down") == 4
+        assert p._step(readers, 1, "down") == 1  # clamped at lo
+        win = K.KNOBS["serve_window_ms"]
+        assert p._step(win, 2.0, "up") == 4.0
+        assert p._step(win, 0.0, "up") == 1.0
+        assert p._step(win, 2.0, "down") == 1.0
+        assert p._step(win, 0.4, "down") == 0.0
+
+    def test_revert_on_regression(self):
+        p = P.Autopilot(cadence_ms=5.0, cooldown=2)
+        K.set_knob("data_readers", 8, source="pilot")
+        p._pending = {"knob": "data_readers", "direction": "up",
+                      "prev": 4, "to": 8, "rate_before": 100.0}
+        p._cycles_since_move = 2
+        # cooked samples: rate collapsed to ~10/s after the move
+        p._samples = [(0.0, 0), (1.0, 10), (2.0, 20)]
+        p._settle_pending()
+        assert p.reverts and p.reverts[0]["action"] == "revert"
+        assert K.override("data_readers") == 4
+        assert ("data_readers", "up") in p._burned
+
+    def test_flat_result_burns_direction_keeps_value(self):
+        p = P.Autopilot(cadence_ms=5.0, cooldown=2)
+        K.set_knob("data_readers", 8, source="pilot")
+        # after = 10/s vs before = 10.4/s: above the revert line
+        # (0.95x = 9.88) but below the noise floor (0.98x = 10.19) —
+        # measurably not helping: keep the value, burn the direction
+        p._pending = {"knob": "data_readers", "direction": "up",
+                      "prev": 4, "to": 8, "rate_before": 10.4}
+        p._cycles_since_move = 2
+        p._samples = [(0.0, 0), (1.0, 10), (2.0, 20)]
+        p._settle_pending()
+        assert p.reverts == []
+        assert K.override("data_readers") == 8
+        assert ("data_readers", "up") in p._burned
+
+    def test_ambiguous_settle_keeps_chain_alive(self):
+        p = P.Autopilot(cadence_ms=5.0, cooldown=2)
+        K.set_knob("data_readers", 8, source="pilot")
+        # after ~= before: inside the noise floor — no burn, no revert
+        p._pending = {"knob": "data_readers", "direction": "up",
+                      "prev": 4, "to": 8, "rate_before": 10.0}
+        p._cycles_since_move = 2
+        p._samples = [(0.0, 0), (1.0, 10), (2.0, 20)]
+        p._settle_pending()
+        assert p.reverts == []
+        assert p._burned == set()
+        assert K.override("data_readers") == 8
+
+    def test_serve_window_verdict_from_leg_deltas(self):
+        from dask_ml_tpu.obs.metrics import registry
+
+        reg = registry()
+        reg.reset("serve.req_")
+        p = P.Autopilot(cadence_ms=5.0, cooldown=1)
+        assert p._serve_window_verdict() is None  # primes
+        reg.histogram("serve.req_queue_s", "m").record(0.9)
+        reg.histogram("serve.req_window_s", "m").record(0.05)
+        reg.histogram("serve.req_device_s", "m").record(0.05)
+        plane, v = p._serve_window_verdict()
+        assert plane == "serve"
+        assert v["class"] == "queue-bound"
+        assert v["confident"]
+        assert p._serve_window_verdict() is None  # no NEW traffic
+
+    def test_policy_covers_every_actionable_class(self):
+        for (plane, cls), chain in P.POLICY.items():
+            assert plane in ("fit", "search", "serve")
+            for name, direction in chain:
+                assert name in K.KNOBS
+                assert direction in ("up", "down")
+
+    def test_report_and_converged(self, monkeypatch):
+        monkeypatch.setenv(P.INJECT_ENV, "false-verdict")
+        p = P.Autopilot(cadence_ms=5.0, cooldown=1, _test_cpu_frac=0.0)
+        assert p.converged()  # no moves yet
+        _spin(p, 2)
+        assert not p.converged()  # just moved
+        rep = p.report()
+        assert rep["cycles"] == 2 and rep["moves"]
+        assert "knobs" in rep and "freezes" in rep
+
+    def test_run_loop_swallows_and_counts_cycle_errors(self, monkeypatch):
+        p = P.Autopilot(cadence_ms=5.0, cooldown=1)
+
+        calls = []
+
+        def boom(self):
+            calls.append(1)
+            if len(calls) >= 3:
+                p._stop.set()
+            raise RuntimeError("boom")
+
+        monkeypatch.setattr(P.Autopilot, "_cycle", boom)
+        p._run()  # must return (stop honored), never propagate
+        assert p.errors == 3
+
+
+class TestPilotLifecycle:
+    def test_thread_name_is_rostered_host_only(self):
+        from dask_ml_tpu.analysis.rules._spmd import (
+            HOST_ONLY_THREAD_NAMES)
+
+        assert P.PILOT_THREAD_NAME in HOST_ONLY_THREAD_NAMES
+
+    def test_scoped_autopilot_clears_overrides(self):
+        with P.autopilot(cadence_ms=50.0) as p:
+            assert p.running()
+            assert threading.active_count() >= 2
+            K.set_knob("data_readers", 9, source="pilot")
+        assert not p.running()
+        assert K.override("data_readers") is None
+
+    def test_maybe_autostart_off_by_default(self):
+        assert P.maybe_autostart() is None
+        assert P.current_pilot() is None
+
+    def test_maybe_autostart_armed(self, monkeypatch):
+        monkeypatch.setenv(P.AUTOPILOT_ENV, "1")
+        p = P.maybe_autostart()
+        assert p is not None and p.running()
+        assert P.maybe_autostart() is p  # idempotent
+        P.stop_pilot()
+        assert P.current_pilot() is None
+
+    def test_env_junk_raises(self, monkeypatch):
+        monkeypatch.setenv(P.AUTOPILOT_ENV, "yess")
+        with pytest.raises(ValueError, match=P.AUTOPILOT_ENV):
+            P.maybe_autostart()
+        monkeypatch.setenv(P.CADENCE_ENV, "fast")
+        with pytest.raises(ValueError, match=P.CADENCE_ENV):
+            P.resolve_cadence_ms()
+        monkeypatch.setenv(P.INJECT_ENV, "true-verdict")
+        with pytest.raises(ValueError, match=P.INJECT_ENV):
+            P.resolve_inject()
+
+    def test_supervised_heartbeat_registered(self):
+        from dask_ml_tpu.resilience import supervisor
+
+        with P.autopilot(cadence_ms=50.0):
+            hb = supervisor.lookup("control:pilot")
+            assert hb is not None and hb.domain == "control"
+        assert supervisor.lookup("control:pilot") is None
+
+
+# ---------------------------------------------------------------------------
+# the gate-of-the-gate: the CLI self-test
+# ---------------------------------------------------------------------------
+
+class TestSelfTestCLI:
+    def _run(self, env=None):
+        e = dict(os.environ, JAX_PLATFORMS="cpu")
+        for k in _CONTROL_ENVS:
+            e.pop(k, None)
+        e.update(env or {})
+        return subprocess.run(
+            [sys.executable, "-m", "dask_ml_tpu.control", "--self-test"],
+            capture_output=True, text=True, env=e, timeout=120)
+
+    def test_live_controller_exits_zero(self):
+        r = self._run()
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "PASS" in r.stdout
+
+    def test_disabled_controller_fails_the_gate(self):
+        r = self._run({P.AUTOPILOT_ENV: "off"})
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "DISABLED" in r.stdout
+
+    def test_in_process_self_test_restores_env(self, monkeypatch):
+        monkeypatch.delenv(P.INJECT_ENV, raising=False)
+        assert P.self_test(verbose=False) == 0
+        assert P.INJECT_ENV not in os.environ

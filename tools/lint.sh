@@ -63,7 +63,9 @@
 #   tools/lint.sh --perf          # static gate + perf ratchet gate
 #   tools/lint.sh --locks         # static gate + runtime lockset gate
 #   tools/lint.sh --rebaseline    # refresh ALL FIVE committed baselines
-#                                 # (lint, sanitize, drills, perf,
+#                                 # (lint, sanitize, drills, perf —
+#                                 # including the graftpilot
+#                                 # `controller` convergence entry —
 #                                 # locks) after intentional changes —
 #                                 # each write self-gates its hard
 #                                 # invariants; a half-updated set
@@ -136,6 +138,23 @@ if [[ "$rc" != 1 ]]; then
   exit 1
 fi
 echo "graftlock: 2/2 seeded faults detected"
+
+echo "== graftpilot (controller self-test: seeded false verdict must move) =="
+# always on the default path, same posture as graftlock above: <1s, no
+# jax programs.  The injected false-verdict must MOVE the readers knob
+# AND synthetic saturation must FREEZE the controller.  NOTE the exit
+# convention differs from graftlock's: here 0 means the controller is
+# LIVE (both halves verified), and a disabled controller
+# (DASK_ML_TPU_AUTOPILOT=off) exits 1 — it cannot vouch for itself, so
+# it can never gate.
+rc=0
+JAX_PLATFORMS=cpu python -m dask_ml_tpu.control --self-test >/dev/null 2>&1 || rc=$?
+if [[ "$rc" != 0 ]]; then
+  echo "graftpilot: controller self-test FAILED (exit $rc, want 0:" \
+       "the knob controller is blind or disabled)" >&2
+  exit 1
+fi
+echo "graftpilot: false-verdict moved the knob + saturation froze it"
 
 # (in --rebaseline mode the --write-baseline runs above already
 # self-gated each fresh snapshot's hard invariants; --sanitize/--drills
