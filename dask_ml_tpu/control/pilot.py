@@ -86,6 +86,8 @@ __all__ = [
     "PILOT_THREAD_NAME",
     "Autopilot",
     "autopilot",
+    "active_holds",
+    "hold",
     "maybe_autostart",
     "current_pilot",
     "stop_pilot",
@@ -132,6 +134,38 @@ POLICY: dict[tuple, tuple] = {
 #: histograms whose exact counts proxy end-to-end progress (blocks
 #: consumed + requests served) for revert-on-regression rates.
 _PROGRESS_FAMILIES = ("pipeline.block_s", "serve.request_s")
+
+#: external hold latches: while any is set the pilot freezes every
+#: cycle (counted under ``control.freeze{<reason>}``) instead of
+#: reading books a drain barrier is actively disturbing — the fleet's
+#: rolling deploy holds ``fleet_drain`` across each replica's drain
+#: window, so half-drained latency never trains a knob move.
+_HOLDS: dict = {}
+_HOLDS_LOCK = make_lock("control.holds")
+
+
+def active_holds() -> tuple:
+    """The currently-held freeze reasons (sorted; empty = none)."""
+    with _HOLDS_LOCK:
+        return tuple(sorted(k for k, n in _HOLDS.items() if n > 0))
+
+
+@contextmanager
+def hold(reason: str):
+    """Freeze the pilot for the duration of the block (re-entrant:
+    nested holds of one reason count)."""
+    reason = str(reason)
+    with _HOLDS_LOCK:
+        _HOLDS[reason] = _HOLDS.get(reason, 0) + 1
+    try:
+        yield
+    finally:
+        with _HOLDS_LOCK:
+            n = _HOLDS.get(reason, 1) - 1
+            if n <= 0:
+                _HOLDS.pop(reason, None)
+            else:
+                _HOLDS[reason] = n
 
 
 def _env_on(env: str, default: bool = False) -> bool:
@@ -322,6 +356,13 @@ class Autopilot:
         self._last_t, self._last_cpu = now, cpu
         if last_t is None or now - last_t <= 0.0:
             return  # first cycle primes the cpu/progress baselines
+
+        # external hold latch (fleet drain barriers): the books are
+        # being deliberately disturbed — freeze, don't learn from them
+        held = active_holds()
+        if held:
+            self._freeze(held[0])
+            return
 
         # settle any pending move before considering a new one; while
         # the settle window is still growing, no stacked moves

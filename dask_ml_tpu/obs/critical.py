@@ -531,7 +531,8 @@ _SERVE_CLASS = {"queue": "queue-bound", "window": "dispatcher-bound",
 
 def serve_critical(*, tolerance: float | None = None,
                    dominance: float | None = None,
-                   publish: bool = True) -> dict | None:
+                   publish: bool = True,
+                   tag: str | None = None) -> dict | None:
     """The serve window's critical path, from the per-request split the
     runtime records (``serve.req_{queue,window,device,fetch}_s`` —
     four contiguous legs per request, stamped with the request's trace
@@ -540,7 +541,13 @@ def serve_critical(*, tolerance: float | None = None,
     request time, the identity check ``queue+window+device+fetch ≈
     Σ request_s`` within the tolerance, and the verdict.  ``None`` when
     no split has been recorded (no serve traffic — the report must not
-    invent an empty story)."""
+    invent an empty story).
+
+    ``tag`` restricts the aggregation to one latency-histogram tag —
+    normally a model name, or a replica tag (``r0``, ``r1``, ...) when
+    the servers were built with ``metrics_tag`` (the fleet's
+    per-replica bottleneck verdicts in ``bench.py``'s fleet section);
+    ``None`` keeps the global all-tags sum."""
     tol = resolve_tolerance(tolerance)
     dom = resolve_dominance(dominance)
     reg = _registry()
@@ -549,7 +556,8 @@ def serve_critical(*, tolerance: float | None = None,
     for seg in _SERVE_SEGMENTS:
         s = 0.0
         for name, _tag, inst in reg.export_items():
-            if name == f"serve.req_{seg}_s":
+            if name == f"serve.req_{seg}_s" and \
+                    (tag is None or _tag == tag):
                 s += inst.sum
                 if seg == "queue":
                     count += inst.count
@@ -557,7 +565,8 @@ def serve_critical(*, tolerance: float | None = None,
     if count == 0:
         return None
     request_s = sum(inst.sum for name, _tag, inst in reg.export_items()
-                    if name == "serve.request_s")
+                    if name == "serve.request_s"
+                    and (tag is None or _tag == tag))
     total = sum(totals.values())
     denom = max(request_s, 1e-12)
     within = abs(total - request_s) <= tol * denom
@@ -575,7 +584,7 @@ def serve_critical(*, tolerance: float | None = None,
                              f"request_s {request_s:.6f}s beyond "
                              f"tolerance {tol}"}
     result = {
-        "plane": "serve",
+        "plane": "serve" if tag is None else f"serve:{tag}",
         "requests": count,
         "wall_s": round(request_s, 6),  # summed request seconds
         "categories": {k: round(v, 6) for k, v in totals.items()},
@@ -591,5 +600,7 @@ def serve_critical(*, tolerance: float | None = None,
         },
     }
     if publish:
-        _publish("serve", result)
+        # a tagged (per-replica / per-model) verdict publishes under
+        # its own plane key so it never clobbers the global serve one
+        _publish(result["plane"], result)
     return result

@@ -13,7 +13,13 @@ surface exists the day that lane lands (ROADMAP [serving]):
   full label-value escaping (``\\`` ``"`` and newline);
 * ``GET /healthz`` — JSON from :func:`dask_ml_tpu.resilience.
   supervisor.healthz`: 200 while no supervised unit is dead, 503
-  otherwise — the liveness probe a deployment points at this process.
+  otherwise — the LIVENESS probe a deployment points at this process;
+* ``GET /readyz`` — the READINESS half of the split: 503 while any
+  registered readiness probe (e.g. a ModelServer whose residency
+  warmup is still compiling rungs, or a replica behind a drain
+  barrier) reports not-ready, or while liveness itself fails.  A
+  router must gate traffic on THIS, not on liveness — a live process
+  can still be cold.
 
 Lifecycle mirrors the compile-ahead worker (design.md §13): the server
 thread is named :data:`METRICS_THREAD_NAME`, registered with the
@@ -50,6 +56,9 @@ __all__ = [
     "METRICS_THREAD_NAME",
     "MetricsServer",
     "prometheus_text",
+    "readyz",
+    "register_readiness",
+    "unregister_readiness",
     "resolve_port",
     "start",
     "stop",
@@ -91,6 +100,54 @@ def resolve_port(port: int | None = None) -> int | None:
     if port < 0 or port > 65535:
         raise ValueError(f"metrics port must be 0..65535, got {port}")
     return port
+
+
+# -- readiness (the /readyz half of the health split) --------------------
+
+_READINESS_LOCK = make_lock("obs.readiness")
+_READINESS: dict = {}  # unit name -> zero-arg bool probe
+
+
+def register_readiness(name: str, probe) -> None:
+    """Register a zero-arg readiness probe under ``name`` (unit names —
+    ModelServer registers its supervised unit).  Re-registering a name
+    replaces its probe (restart idiom)."""
+    with _READINESS_LOCK:
+        _READINESS[str(name)] = probe
+
+
+def unregister_readiness(name: str) -> None:
+    with _READINESS_LOCK:
+        _READINESS.pop(str(name), None)
+
+
+def readyz() -> dict:
+    """The readiness verdict ``/readyz`` serves: liveness (no DEAD
+    supervised unit) AND every registered probe true.  A probe that
+    raises counts as not-ready — a broken probe must fail closed, or a
+    router would route cold traffic on an exception."""
+    from ..resilience import supervisor as _supervisor
+
+    hz = _supervisor.healthz()
+    with _READINESS_LOCK:
+        probes = dict(_READINESS)
+    states: dict = {}
+    not_ready: list = []
+    for name in sorted(probes):
+        try:
+            ok = bool(probes[name]())
+        except Exception:
+            ok = False
+        states[name] = ok
+        if not ok:
+            not_ready.append(name)
+    return {
+        "ok": bool(hz["ok"]) and not not_ready,
+        "live": bool(hz["ok"]),
+        "dead": hz["dead"],
+        "not_ready": not_ready,
+        "probes": states,
+    }
 
 
 # -- Prometheus text exposition ------------------------------------------
@@ -192,8 +249,13 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps(verdict, sort_keys=True).encode("utf-8")
             code = 200 if verdict["ok"] else 503
             ctype = "application/json"
+        elif self.path == "/readyz":
+            verdict = readyz()
+            body = json.dumps(verdict, sort_keys=True).encode("utf-8")
+            code = 200 if verdict["ok"] else 503
+            ctype = "application/json"
         else:
-            body = b"graftscope: /metrics or /healthz\n"
+            body = b"graftscope: /metrics, /healthz or /readyz\n"
             code = 404
             ctype = "text/plain; charset=utf-8"
         self.send_response(code)
@@ -235,7 +297,7 @@ class MetricsServer:
         self._hb = _supervisor.register(
             METRICS_THREAD_NAME, "obs", thread=self._thread)
         logger.info("graftscope metrics endpoint on %s:%d "
-                    "(/metrics, /healthz)", self.host, self.port)
+                    "(/metrics, /healthz, /readyz)", self.host, self.port)
         return self
 
     def _beat(self) -> None:

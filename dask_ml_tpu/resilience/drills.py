@@ -49,7 +49,8 @@ import numpy as np
 from .elastic import ElasticPolicy
 from .retry import fault_stats
 from .retry import retry as _retry
-from .testing import FaultPlan, ThreadCrash, fault_plan, maybe_fault
+from .testing import (FaultInjected, FaultPlan, ThreadCrash, fault_plan,
+                      maybe_fault)
 from .testing import INJECTION_POINTS
 
 __all__ = [
@@ -651,6 +652,226 @@ def _drill_exporter_enospc_mbk(depth, m):
             pass
 
 
+def _fleet_fixture(depth, *, replicas=3, hedge_ms=0.0,
+                   replica_fault_attempts=0, retries=3):
+    """A fitted SGD served hot across a small fleet (the shared fleet-
+    drill rig): returns (fleet, model, Xq, twin-predictions)."""
+    from ..serve.fleet import ServeFleet
+    from .elastic import FaultBudget
+
+    blocks = _class_blocks(offset=0)
+    model = _fit_sgd(list(blocks), depth,
+                     label=f"drill_fleet_fit_d{depth}")
+    Xq = blocks[0][0]
+    twin = np.asarray(model.predict(Xq))
+    fleet = ServeFleet(
+        replicas=replicas, label=f"drill_fleet_d{depth}",
+        window_s=0.0, hedge_ms=hedge_ms, retries=retries,
+        replica_fault_attempts=replica_fault_attempts,
+        budget=FaultBudget(16, 60.0, name=f"drill_fleet_d{depth}"))
+    fleet.load("m", model, hot=True)
+    return fleet, model, Xq, twin
+
+
+def _drill_fleet_kill_sgd(depth, m):
+    """A replica's serve loop is hard-killed mid-burst with requests in
+    flight on it (and its OWN restart budget already spent, so the slot
+    is terminally dead): the corpse's sweep rejects its in-flight
+    requests LOUDLY, the fleet futures replay them exactly on the
+    survivors, the router respawns the slot within the FLEET budget —
+    and every accepted request resolves to the direct-predict answer.
+    Zero lost, zero fleet-level rejections."""
+    import time as _time
+
+    from ..obs.metrics import registry as _registry
+
+    fleet, model, Xq, twin = _fleet_fixture(depth)
+    reg = _registry()
+    respawns0 = reg.counter("fleet.respawn").value
+    rejected0 = sum(reg.family("fleet.rejected").values())
+    plan = FaultPlan().inject("replica-kill", at_call=5, times=1,
+                              exc=ThreadCrash("drill: replica kill"))
+    try:
+        with fault_plan(plan):
+            futs = [fleet.submit("m", Xq) for _ in range(12)]
+            results = [f.result(timeout=30.0) for f in futs]
+        # the kill lands at the victim's NEXT loop cycle — anything it
+        # still held replays on the survivors via the futures above.
+        # Wait for the corpse (budget 0: death is terminal), then keep
+        # serving: the routing sweep must respawn the dead slot
+        for _ in range(500):
+            if any(rep.state() == "dead" for rep in fleet._replicas):
+                break
+            _time.sleep(0.01)
+        died = any(rep.state() == "dead" for rep in fleet._replicas)
+        results.extend(fleet.predict("m", Xq, timeout=30.0)
+                       for _ in range(3))
+        respawned = reg.counter("fleet.respawn").value - respawns0
+        fleet_rejected = sum(reg.family("fleet.rejected").values()) \
+            - rejected0
+        m["faults_injected"] = sum(plan.fired.values())
+        m["recovered"] = (m["faults_injected"] == 1
+                          and died
+                          and respawned >= 1
+                          and fleet_rejected == 0
+                          and len(results) == 15)
+        ok = all(np.array_equal(np.asarray(r), twin) for r in results)
+        m["model_match"] = ok
+        m["max_rel_diff"] = 0.0 if ok else float("inf")
+    finally:
+        fleet.close()
+
+
+def _drill_fleet_slow_sgd(depth, m):
+    """One replica stalls mid-dispatch (an armed 250ms sleep — the
+    straggler tail): a request parked past the hedge delay launches a
+    duplicate on the other replica, the fast response wins, the
+    straggler's duplicate spend is COUNTED — and every answer still
+    equals the direct predict (predict is stateless; hedging is always
+    exact)."""
+    from ..obs.metrics import registry as _registry
+
+    fleet, model, Xq, twin = _fleet_fixture(depth, replicas=2,
+                                            hedge_ms=30.0)
+    reg = _registry()
+    won0 = reg.counter("fleet.hedge", "won").value
+    plan = FaultPlan().inject("replica-slow", at_call=3, times=1,
+                              exc=FaultInjected("drill: replica stall"))
+    try:
+        with fault_plan(plan):
+            results = [fleet.predict("m", Xq, timeout=30.0)
+                       for _ in range(5)]
+        for rep in fleet._replicas:  # disarm the stall before close
+            rep.server._test_dispatch_delay_s = 0.0
+        hedge_won = reg.counter("fleet.hedge", "won").value - won0
+        m["faults_injected"] = sum(plan.fired.values())
+        m["recovered"] = m["faults_injected"] == 1 and hedge_won >= 1
+        ok = all(np.array_equal(np.asarray(r), twin) for r in results)
+        m["model_match"] = ok
+        m["max_rel_diff"] = 0.0 if ok else float("inf")
+    finally:
+        fleet.close()
+
+
+def _drill_fleet_partition_sgd(depth, m):
+    """The router loses sight of one replica (a timed quarantine — the
+    in-process stand-in for a network partition): traffic routes around
+    it with no retry storm, the replica's own loop keeps running, and
+    when the partition expires the replica is re-admitted as a
+    candidate with no operator action."""
+    import time as _time
+
+    fleet, model, Xq, twin = _fleet_fixture(depth, replicas=2)
+    plan = FaultPlan().inject("router-partition", at_call=2, times=1,
+                              exc=FaultInjected("drill: partition"))
+    try:
+        with fault_plan(plan):
+            results = [fleet.predict("m", Xq, timeout=30.0)
+                       for _ in range(4)]
+            partitioned = list(fleet._router.report()["partitioned"])
+        _time.sleep(0.4)  # the quarantine expires...
+        results.append(fleet.predict("m", Xq, timeout=30.0))
+        healed = not fleet._router.report()["partitioned"]
+        readmitted = len(fleet._router.candidates("m")) == 2
+        m["faults_injected"] = sum(plan.fired.values())
+        m["recovered"] = (m["faults_injected"] == 1
+                          and len(partitioned) == 1
+                          and healed and readmitted)
+        ok = all(np.array_equal(np.asarray(r), twin) for r in results)
+        m["model_match"] = ok
+        m["max_rel_diff"] = 0.0 if ok else float("inf")
+    finally:
+        fleet.close()
+
+
+def _drill_fleet_deploy_sgd(depth, m):
+    """Rolling refresh under live traffic with a replica killed AT the
+    drain barrier: the walk must still complete (the kill lands within
+    the replica's own restart budget), the pilot stays held for the
+    duration, rejections stay confined to reason ``draining`` — and
+    every request served during the window answers as EXACTLY the old
+    or the new model, never a blend, with the fleet fully on the new
+    model afterwards."""
+    import threading as _threading
+
+    from ..control import pilot as _pilot
+    from ..obs.metrics import registry as _registry
+    from ..serve.fleet import ServeFleet
+    from .elastic import FaultBudget
+
+    blocks_a = _class_blocks(offset=0)
+    blocks_b = _class_blocks(offset=3)
+    model_a = _fit_sgd(list(blocks_a), depth,
+                       label=f"drill_deploy_fit_a_d{depth}")
+    model_b = _fit_sgd(list(blocks_b), depth,
+                       label=f"drill_deploy_fit_b_d{depth}")
+    Xq = blocks_a[0][0]
+    twin_a = np.asarray(model_a.predict(Xq))
+    twin_b = np.asarray(model_b.predict(Xq))
+    reg = _registry()
+    reject0 = dict(reg.family("serve.rejected"))
+    freject0 = dict(reg.family("fleet.rejected"))
+    fleet = ServeFleet(
+        replicas=2, label=f"drill_deploy_d{depth}", window_s=0.0,
+        hedge_ms=0.0, retries=3, replica_fault_attempts=2,
+        budget=FaultBudget(16, 60.0, name=f"drill_deploy_d{depth}"))
+    plan = FaultPlan().inject("fleet-deploy", at_call=2, times=1,
+                              exc=ThreadCrash("drill: death at barrier"))
+    stop = _threading.Event()
+    served: list = []
+    held_seen: list = []
+
+    def _traffic():
+        while not stop.is_set():
+            try:
+                served.append(np.asarray(
+                    fleet.predict("m", Xq, timeout=30.0)))
+            except BaseException as exc:  # noqa: BLE001 - report, not die
+                served.append(exc)
+            if _pilot.active_holds():
+                held_seen.append(True)
+
+    try:
+        fleet.load("m", model_a, hot=True)
+        # graftlint: disable=thread-dispatch -- host-only client: fleet.predict() only ENQUEUES via ModelServer.submit and parks on the future; every device dispatch happens on the replicas' blessed dask-ml-tpu-serve loops (the serve dispatch contract), runtime-verified by graftsan's dispatch detector across the serve drills
+        t = _threading.Thread(target=_traffic,
+                              name="drill-fleet-traffic", daemon=True)
+        t.start()
+        try:
+            with fault_plan(plan):
+                out = fleet.rolling_refresh("m", model_b, timeout=30.0)
+        finally:
+            stop.set()
+            t.join(timeout=30.0)
+        finals = [np.asarray(fleet.predict("m", Xq, timeout=30.0))
+                  for _ in range(2)]
+        reject_d = {k: v - reject0.get(k, 0)
+                    for k, v in reg.family("serve.rejected").items()
+                    if v - reject0.get(k, 0)}
+        freject_d = {k: v - freject0.get(k, 0)
+                     for k, v in reg.family("fleet.rejected").items()
+                     if v - freject0.get(k, 0)}
+        clean_traffic = all(
+            isinstance(r, np.ndarray)
+            and (np.array_equal(r, twin_a) or np.array_equal(r, twin_b))
+            for r in served)
+        m["faults_injected"] = sum(plan.fired.values())
+        m["recovered"] = (
+            m["faults_injected"] == 1
+            and not t.is_alive()
+            and all(v.get("ready") for v in out.values())
+            and bool(held_seen)
+            and set(reject_d) <= {"draining"}
+            and not freject_d)
+        ok = clean_traffic and all(
+            np.array_equal(r, twin_b) for r in finals)
+        m["model_match"] = ok
+        m["max_rel_diff"] = 0.0 if ok else float("inf")
+    finally:
+        stop.set()
+        fleet.close()
+
+
 # point → implementation (depth-expanded into DRILLS below); dict order
 # is execution order, so the cheap non-sanitized drills run first
 _IMPLS = {
@@ -665,6 +886,10 @@ _IMPLS = {
     "exporter_enospc_mbk": ("exporter-write", _drill_exporter_enospc_mbk),
     "serve_crash_sgd": ("serve-loop", _drill_serve_crash_sgd),
     "data_reader_crash_sgd": ("data-reader", _drill_data_reader_crash_sgd),
+    "fleet_replica_kill_sgd": ("replica-kill", _drill_fleet_kill_sgd),
+    "fleet_replica_slow_sgd": ("replica-slow", _drill_fleet_slow_sgd),
+    "fleet_partition_sgd": ("router-partition", _drill_fleet_partition_sgd),
+    "fleet_deploy_sgd": ("fleet-deploy", _drill_fleet_deploy_sgd),
 }
 for _name, (_point, _fn) in _IMPLS.items():
     for _depth in (0, 2):

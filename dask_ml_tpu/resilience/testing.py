@@ -41,7 +41,25 @@ pattern).  This module replaces that with a declarative registry: a
   shard read; inject :class:`ThreadCrash` to simulate a reader dying
   silently mid-shard (the consumer's liveness poll must catch it, the
   budgeted restart must replay the in-flight shard range, and the
-  merge queue's dedup must keep delivery exactly-once).
+  merge queue's dedup must keep delivery exactly-once);
+* ``"replica-kill"`` — the fleet router's candidate-consideration path
+  (``serve/fleet.py``), fired once per replica considered; inject
+  :class:`ThreadCrash` to hard-kill the considered replica's serve
+  loop mid-traffic (the fleet must re-route, respawn the slot within
+  its budget, and lose ZERO accepted requests);
+* ``"replica-slow"`` — same consideration path; a
+  :class:`FaultInjected` arms a dispatch stall on the considered
+  replica (the tail the hedged-predict path must beat:
+  first-response-wins, the loser's duplicate spend counted);
+* ``"router-partition"`` — same consideration path; a
+  :class:`FaultInjected` quarantines the considered replica from the
+  router's view for a beat (traffic must route around the partition
+  and re-admit the replica when it heals);
+* ``"fleet-deploy"`` — the rolling-refresh walk's per-replica drain
+  barrier (``ServeFleet.rolling_refresh``); inject
+  :class:`ThreadCrash` to kill a replica AT the barrier (the deploy
+  must still complete — budgeted restart or respawn — with rejections
+  confined to reason ``draining``).
 
 Hot paths pay one global ``is None`` check when no plan is active.
 """
@@ -72,6 +90,7 @@ INJECTION_POINTS = (
     "ingest", "step", "checkpoint-write", "collective",
     "stage", "prefetch-worker", "compile-ahead", "exporter-write",
     "serve-loop", "data-reader",
+    "replica-kill", "replica-slow", "router-partition", "fleet-deploy",
 )
 
 

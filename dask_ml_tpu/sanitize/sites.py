@@ -19,7 +19,7 @@ runtime.  An :class:`AllowSite` is the bridge:
 * with no sanitizer active the context is a no-op — production fits pay
   one attribute check.
 
-The three ``thread-dispatch`` suppressions have no allow-site: their
+The ``thread-dispatch`` suppressions have no allow-site: their
 runtime verification is the dispatch detector itself (the suppressed
 threads must simply never appear in ``dispatch_threads``)."""
 

@@ -36,17 +36,40 @@ Quick start::
     fut = server.submit("churn", rows, deadline_s=0.05)
     labels = fut.result()
     server.close()
+
+Fleet quick start (replication + routing, design.md §22)::
+
+    from dask_ml_tpu.serve import ServeFleet
+
+    fleet = ServeFleet(replicas=4)        # DASK_ML_TPU_FLEET_REPLICAS
+    fleet.load("churn", fitted_sgd_classifier, hot=True, slo_ms=20)
+    labels = fleet.predict("churn", rows, priority="high")
+    fleet.rolling_refresh("churn", retrained_model)  # drain barrier
+    fleet.close()
 """
 
 from .batcher import RequestRejected, ServeFuture  # noqa: F401
 from .config import (  # noqa: F401
     DEADLINE_ENV,
+    FLEET_DRAIN_ENV,
+    FLEET_HEDGE_ENV,
+    FLEET_INJECT_ENV,
+    FLEET_PRIORITIES_ENV,
+    FLEET_REPLICAS_ENV,
+    FLEET_RETRIES_ENV,
     HBM_ENV,
     MAX_BATCH_ENV,
     QUEUE_ENV,
     WINDOW_ENV,
 )
+from .fleet import FleetFuture, Replica, ServeFleet  # noqa: F401
 from .residency import ModelRegistry, serve_pack_key  # noqa: F401
+from .router import (  # noqa: F401
+    REPLICA_STATES,
+    Router,
+    full_jitter_backoff,
+    rendezvous,
+)
 from .runtime import (  # noqa: F401
     SERVE_THREAD_NAME,
     ModelServer,
@@ -55,15 +78,28 @@ from .runtime import (  # noqa: F401
 
 __all__ = [
     "DEADLINE_ENV",
+    "FLEET_DRAIN_ENV",
+    "FLEET_HEDGE_ENV",
+    "FLEET_INJECT_ENV",
+    "FLEET_PRIORITIES_ENV",
+    "FLEET_REPLICAS_ENV",
+    "FLEET_RETRIES_ENV",
     "HBM_ENV",
     "MAX_BATCH_ENV",
     "QUEUE_ENV",
     "WINDOW_ENV",
+    "REPLICA_STATES",
     "SERVE_THREAD_NAME",
+    "FleetFuture",
     "ModelRegistry",
     "ModelServer",
+    "Replica",
     "RequestRejected",
+    "Router",
+    "ServeFleet",
     "ServeFuture",
+    "full_jitter_backoff",
+    "rendezvous",
     "report",
     "serve_pack_key",
 ]

@@ -47,8 +47,10 @@ _req_ids = itertools.count(1)
 class RequestRejected(RuntimeError):
     """A request was shed with an explicit reason (``queue_full`` /
     ``deadline`` / ``oversize`` / ``unknown_model`` / ``shutdown`` /
-    ``serve_down``) — admission control and deadline drops surface HERE,
-    never as silent latency or lost futures."""
+    ``serve_down`` / ``draining`` — plus the fleet router's
+    ``brownout`` and ``fleet_down``) — admission control, deadline
+    drops, and drain barriers surface HERE, never as silent latency or
+    lost futures."""
 
     def __init__(self, reason: str, detail: str):
         super().__init__(f"[{reason}] {detail}")

@@ -23,11 +23,23 @@ __all__ = [
     "QUEUE_ENV",
     "DEADLINE_ENV",
     "HBM_ENV",
+    "FLEET_REPLICAS_ENV",
+    "FLEET_HEDGE_ENV",
+    "FLEET_DRAIN_ENV",
+    "FLEET_RETRIES_ENV",
+    "FLEET_PRIORITIES_ENV",
+    "FLEET_INJECT_ENV",
     "resolve_max_batch",
     "resolve_window_s",
     "resolve_queue_depth",
     "resolve_deadline_s",
     "resolve_hbm_budget_bytes",
+    "resolve_fleet_replicas",
+    "resolve_hedge_s",
+    "resolve_drain_timeout_s",
+    "resolve_fleet_retries",
+    "resolve_fleet_priorities",
+    "resolve_fleet_inject",
 ]
 
 #: policy knob: max coalesced REAL rows per serve dispatch (the
@@ -60,11 +72,50 @@ DEADLINE_ENV = "DASK_ML_TPU_SERVE_DEADLINE_MS"
 #: in the ``serve.residency_fault`` registry family).
 HBM_ENV = "DASK_ML_TPU_SERVE_HBM_MB"
 
+#: fleet knob: replica count for :class:`~.fleet.ServeFleet` (each
+#: replica is a full ModelServer fault domain: its own blessed serve
+#: thread, its own registry under its own ``SERVE_HBM_MB`` budget, its
+#: own restart budget).
+FLEET_REPLICAS_ENV = "DASK_ML_TPU_FLEET_REPLICAS"
+
+#: fleet knob: tail-latency hedge delay in milliseconds — how long a
+#: caller waits on the primary replica before launching a duplicate
+#: predict on a second ready replica (first response wins; the loser's
+#: device spend is counted, never hidden).  0 disables hedging.
+FLEET_HEDGE_ENV = "DASK_ML_TPU_FLEET_HEDGE_MS"
+
+#: fleet knob: per-replica drain barrier timeout in milliseconds for
+#: rolling deploys — how long ``rolling_refresh`` waits for a draining
+#: replica to flush its in-flight requests before refreshing anyway.
+FLEET_DRAIN_ENV = "DASK_ML_TPU_FLEET_DRAIN_TIMEOUT_MS"
+
+#: fleet knob: max router-level re-routes per request (full-jitter
+#: backoff between attempts, every attempt drawn from the fleet-level
+#: FaultBudget — a retry storm is budgeted, never free).
+FLEET_RETRIES_ENV = "DASK_ML_TPU_FLEET_RETRIES"
+
+#: fleet knob: comma-separated priority classes, LOWEST first — the
+#: brownout shed order (budget exhausted sheds the leftmost class
+#: first, the rightmost class is shed last).
+FLEET_PRIORITIES_ENV = "DASK_ML_TPU_FLEET_PRIORITIES"
+
+#: seeded-fault self-test knob (``tools/lint.sh`` convention, same
+#: posture as DASK_ML_TPU_LOCK_INJECT): ``replica-kill`` seeds a
+#: replica death through the fleet self-test's BLIND router — the gate
+#: must exit 1 (requests were lost), proving the zero-lost-requests
+#: assertion machinery can actually fail.
+FLEET_INJECT_ENV = "DASK_ML_TPU_FLEET_INJECT"
+
 _DEFAULT_MAX_BATCH = 1024
 _DEFAULT_WINDOW_MS = 2.0
 _DEFAULT_QUEUE = 256
 _DEFAULT_DEADLINE_MS = 0.0
 _DEFAULT_HBM_MB = 512.0
+_DEFAULT_FLEET_REPLICAS = 2
+_DEFAULT_FLEET_HEDGE_MS = 50.0
+_DEFAULT_FLEET_DRAIN_MS = 5000.0
+_DEFAULT_FLEET_RETRIES = 2
+_DEFAULT_FLEET_PRIORITIES = ("low", "normal", "high")
 
 
 def _env_number(env: str, cast, default):
@@ -126,3 +177,66 @@ def resolve_hbm_budget_bytes(value: float | None = None) -> int:
     if mb <= 0:
         raise ValueError(f"serve HBM budget must be > 0 MiB, got {mb}")
     return int(mb * (1 << 20))
+
+
+def resolve_fleet_replicas(value: int | None = None) -> int:
+    value = int(_env_number(FLEET_REPLICAS_ENV, int, _DEFAULT_FLEET_REPLICAS)
+                if value is None else value)
+    if value < 1:
+        raise ValueError(f"fleet replicas must be >= 1, got {value}")
+    return value
+
+
+def resolve_hedge_s(value: float | None = None) -> float:
+    """The hedge delay in SECONDS (the knob is in ms; 0 = hedging off)."""
+    ms = (_env_number(FLEET_HEDGE_ENV, float, _DEFAULT_FLEET_HEDGE_MS)
+          if value is None else float(value))
+    if ms < 0:
+        raise ValueError(f"fleet hedge delay must be >= 0 ms, got {ms}")
+    return ms / 1e3
+
+
+def resolve_drain_timeout_s(value: float | None = None) -> float:
+    """The rolling-deploy drain barrier timeout in SECONDS (knob in ms)."""
+    ms = (_env_number(FLEET_DRAIN_ENV, float, _DEFAULT_FLEET_DRAIN_MS)
+          if value is None else float(value) * 1e3)
+    if ms <= 0:
+        raise ValueError(f"fleet drain timeout must be > 0 ms, got {ms}")
+    return ms / 1e3
+
+
+def resolve_fleet_retries(value: int | None = None) -> int:
+    value = int(_env_number(FLEET_RETRIES_ENV, int, _DEFAULT_FLEET_RETRIES)
+                if value is None else value)
+    if value < 0:
+        raise ValueError(f"fleet retries must be >= 0, got {value}")
+    return value
+
+
+def resolve_fleet_priorities(value=None) -> tuple:
+    """Priority classes, LOWEST first (the brownout shed order).  Strict
+    parse: empty entries and duplicates raise."""
+    if value is None:
+        raw = os.environ.get(FLEET_PRIORITIES_ENV, "").strip()
+        if not raw:
+            return _DEFAULT_FLEET_PRIORITIES
+        value = [w.strip() for w in raw.split(",")]
+    classes = tuple(str(w) for w in value)
+    if not classes or any(not c for c in classes) or \
+            len(set(classes)) != len(classes):
+        raise ValueError(
+            f"{FLEET_PRIORITIES_ENV} must be distinct non-empty class "
+            f"names lowest-first, got {value!r}")
+    return classes
+
+
+def resolve_fleet_inject() -> str | None:
+    """The seeded-fault self-test knob (strict parse: only the
+    documented fault names are accepted)."""
+    raw = os.environ.get(FLEET_INJECT_ENV, "").strip()
+    if not raw:
+        return None
+    if raw not in ("replica-kill",):
+        raise ValueError(
+            f"{FLEET_INJECT_ENV} must be 'replica-kill', got {raw!r}")
+    return raw

@@ -1,0 +1,805 @@
+"""graftfleet: a fault-domain serving fabric over replicated
+ModelServers (design.md §22, ROADMAP [serve-fleet]).
+
+One :class:`~.runtime.ModelServer` is industrial but a single fault
+domain: one blessed dispatch thread, one restart budget — a crash past
+the budget takes the plane down, and every deploy is stop-the-world.
+:class:`ServeFleet` runs N replicas, each a FULL fault domain (its own
+``dask-ml-tpu-serve`` loop under the dispatcher-lock discipline, its
+own registry under its own ``SERVE_HBM_MB`` budget, its own
+:class:`~dask_ml_tpu.resilience.elastic.FaultBudget`), behind the
+host-level routing policy of :mod:`.router`:
+
+* **placement** — hot models replicate everywhere; cold models
+  partition by rendezvous hash across per-replica budgets;
+* **readiness-gated routing** — candidates must pass the replica's
+  ``ready()`` probe (the ``/readyz`` contract: alive, not draining,
+  residency warmup complete) — cold traffic never routes;
+* **retry with full-jitter backoff** — a retryable rejection
+  (``queue_full`` / ``draining`` / ``serve_down`` / a mid-deploy
+  ``unknown_model``) re-routes to another replica; every re-route
+  draws on the FLEET-level FaultBudget and counts
+  (``fleet.retry{reason}``) — a retry storm is budgeted, never free;
+* **hedged tails** — a caller parked past the hedge delay launches a
+  duplicate predict on a second ready replica; first response wins
+  (``fleet.hedge{won}``); the loser cannot be cancelled mid-dispatch,
+  so its duplicate device spend is COUNTED (``fleet.hedge{wasted}``),
+  never hidden — predict is stateless, so hedging is always exact;
+* **graceful degradation** — a terminally-dead replica (its own budget
+  exhausted) is respawned within the fleet budget with its placed
+  models re-warmed (``submit_load``: the router keeps traffic on
+  survivors while the new loop compiles; readiness re-admits it), and
+  every request that was in flight on the corpse replays EXACTLY
+  (router-level: the fleet still holds the submitted rows — predict is
+  stateless).  Fleet budget exhausted ⇒ **brownout**, not blackout:
+  priority classes shed lowest-first (``fleet.rejected{brownout}``),
+  the highest class keeps serving on the survivors;
+* **rolling deploys** — ``rolling_refresh`` walks replicas one at a
+  time behind a drain barrier: stop routing (state ``draining``; the
+  replica itself rejects ``draining``), flush in-flight, refresh via
+  the registry's hot-swap/``serve.lane_refresh`` path, re-admit on
+  readiness.  The graftpilot controller is HELD (frozen, counted under
+  ``control.freeze{fleet_drain}``) for the duration — half-drained
+  books must never train a knob move.
+
+Everything lands in the one metrics registry, so the existing
+``/metrics`` endpoint scrapes the whole fleet with no extra wiring;
+``report()``/``scrape()`` aggregate the per-replica books the way an
+external router would aggregate per-process scrapes.
+
+Honesty note (gate box): replicas here are in-process ModelServer
+instances, not OS processes — each is a genuine independent fault
+domain (own dispatch thread, own registry, own budget, own supervised
+unit) but they share one Python heap and one GIL, the same posture as
+the repo's 8-virtual-device mesh.  The router/placement/drain/hedge
+logic is transport-independent; a multi-process deployment changes the
+submit edge, not the policy.  Chip-round numbers own the real
+multiplier.
+
+Self-test (wired into ``tools/lint.sh``, graftlock convention)::
+
+    python -m dask_ml_tpu.serve.fleet --self-test           # exit 0
+    DASK_ML_TPU_FLEET_INJECT=replica-kill \\
+        python -m dask_ml_tpu.serve.fleet --self-test       # exit 1
+
+Both runs seed the SAME replica kill mid-traffic; the knob makes the
+router BLIND (no readiness gate, no failover, no respawn), and the
+gate must exit 1 — a zero-lost-requests assertion that cannot fail
+can never be trusted to gate.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .._locks import make_lock
+from .. import obs
+from ..control import pilot as _pilot
+from ..obs.metrics import registry as _registry
+from ..resilience.elastic import FaultBudget
+from ..resilience.testing import FaultInjected as _FaultInjected
+from ..resilience.testing import ThreadCrash as _ThreadCrash
+from ..resilience.testing import maybe_fault as _maybe_fault
+from .batcher import RequestRejected
+from .config import (
+    resolve_drain_timeout_s,
+    resolve_fleet_inject,
+    resolve_fleet_priorities,
+    resolve_fleet_replicas,
+    resolve_fleet_retries,
+    resolve_hbm_budget_bytes,
+    resolve_hedge_s,
+)
+from .router import Router, full_jitter_backoff
+from .runtime import ModelServer
+
+__all__ = ["Replica", "FleetFuture", "ServeFleet", "self_test", "main"]
+
+#: submit-side rejection reasons the router may re-route (everything
+#: else — bad_input / oversize / deadline — is the CLIENT's error or
+#: SLO and must surface unchanged)
+_RETRYABLE = ("queue_full", "draining", "serve_down", "shutdown",
+              "unknown_model")
+
+_STATE_CODES = {"ready": 0, "warming": 1, "draining": 2, "dead": 3}
+
+
+def _model_nbytes(model) -> int:
+    """Cheap placement-time size estimate (fitted linear state; generic
+    models estimate 0 and rely on the replica's own LRU budget)."""
+    total = 0
+    for attr in ("coef_", "intercept_", "cluster_centers_", "components_"):
+        v = getattr(model, attr, None)
+        if v is not None:
+            total += int(np.asarray(v).nbytes)
+    return total
+
+
+class Replica:
+    """One fleet slot: an index plus the CURRENT ModelServer occupying
+    it (respawn replaces the server, never the slot)."""
+
+    def __init__(self, index: int, server: ModelServer):
+        self.index = int(index)
+        self.server = server
+        self.draining = False
+        self._respawn_lock = threading.Lock()
+
+    def ready(self) -> bool:
+        return not self.draining and self.server.ready()
+
+    def qsize(self) -> int:
+        return self.server._batcher.qsize()
+
+    def state(self) -> str:
+        srv = self.server
+        if srv._closed or srv._failed is not None or \
+                srv._thread is None or not srv._thread.is_alive():
+            return "dead"
+        if self.draining or srv.draining():
+            return "draining"
+        return "ready" if self.ready() else "warming"
+
+
+class FleetFuture:
+    """One fleet request's handle: wraps the live replica attempt(s)
+    and owns the retry/hedge driver — ``result()`` is where re-routes,
+    hedges, and counted rejections happen (the caller's wait IS the
+    recovery trigger, the same consumer-side-liveness posture as
+    ``ServeFuture``)."""
+
+    def __init__(self, fleet: "ServeFleet", name: str, x, *,
+                 deadline_s, proba: bool, replica, fut):
+        self._fleet = fleet
+        self.model = name
+        self._x = x
+        self._deadline_s = deadline_s
+        self._proba = proba
+        self._t0 = time.monotonic()
+        self._attempts = [(replica, fut)]
+        self._tried = {replica.index}
+        self._retries = 0
+        self._hedged = False
+        self._value = None
+        self._exc = None
+        self._settled = False
+
+    def done(self) -> bool:
+        return self._settled or any(f.done() for _, f in self._attempts)
+
+    def result(self, timeout: float | None = 30.0):
+        if self._settled:
+            if self._exc is not None:
+                raise self._exc
+            return self._value
+        deadline = None if timeout is None else \
+            time.monotonic() + float(timeout)
+        while True:
+            for i, (rep, fut) in enumerate(list(self._attempts)):
+                # the consumer-side liveness poll: a dead loop is
+                # detected (and its budgeted restart triggered) here
+                fut._server._ensure_alive()
+                if fut.done():
+                    status, value = self._settle(i, rep, fut)
+                    if status:
+                        return value
+                    break  # attempts changed: rescan from the top
+            else:
+                self._maybe_hedge()
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"fleet request for {self.model!r} timed out")
+            time.sleep(0.002)
+
+    # -- internals -------------------------------------------------------
+    def _finish(self, value=None, exc=None):
+        self._settled = True
+        self._value, self._exc = value, exc
+        if exc is not None:
+            raise exc
+        return True, value
+
+    def _settle(self, i: int, rep, fut):
+        reg = _registry()
+        try:
+            value = fut.result(timeout=0.001)
+        except RequestRejected as e:
+            self._attempts.pop(i)
+            self._fleet._note_trouble(rep, e.reason)
+            if self._fleet.blind or e.reason not in _RETRYABLE:
+                return self._finish(exc=e)
+            if self._attempts:
+                return False, None  # a hedge is still racing: wait on it
+            return self._replay(e)
+        except BaseException as e:  # dispatch fault: a driver bug, not
+            return self._finish(exc=e)  # a routing problem — surface it
+        # success
+        if self._hedged:
+            reg.counter("fleet.hedge", "won" if i > 0 else "lost").inc()
+            for _ in self._attempts[:i] + self._attempts[i + 1:]:
+                # the loser's dispatch cannot be recalled — count its
+                # duplicate device spend instead of pretending it away
+                reg.counter("fleet.hedge", "wasted").inc()
+        self._fleet._note_latency(self.model, time.monotonic() - self._t0)
+        return self._finish(value=value)
+
+    def _replay(self, e: RequestRejected):
+        """Exact in-flight replay: re-route the SAME rows (predict is
+        stateless) within the retry ceiling and the fleet budget; past
+        either, the rejection is counted and raised — never a hang."""
+        fleet = self._fleet
+        while self._retries < fleet.retries:
+            self._retries += 1
+            if not fleet._budget.acquire("fleet-retry"):
+                fleet._enter_brownout()
+                break
+            _registry().counter("fleet.retry", e.reason).inc()
+            time.sleep(full_jitter_backoff(self._retries - 1))
+            try:
+                rep, fut = fleet._route(
+                    self.model, self._x, deadline_s=self._deadline_s,
+                    proba=self._proba, exclude=frozenset(self._tried))
+            except RequestRejected as e2:
+                if e2.reason not in _RETRYABLE:
+                    return self._finish(exc=e2)
+                # every placed replica already tried and failed this
+                # request: widen the net (a respawned replica may be
+                # back) before the next attempt
+                self._tried.clear()
+                e = e2
+                continue
+            self._tried.add(rep.index)
+            self._attempts.append((rep, fut))
+            return False, None
+        fleet._count_reject(e.reason, self.model)
+        return self._finish(exc=e)
+
+    def _maybe_hedge(self) -> None:
+        fleet = self._fleet
+        if (self._hedged or fleet.blind or fleet.hedge_s <= 0
+                or len(self._attempts) != 1
+                or time.monotonic() - self._t0 < fleet.hedge_s):
+            return
+        self._hedged = True  # one hedge per request, launched or not
+        live = {rep.index for rep, _ in self._attempts}
+        try:
+            rep, fut = fleet._route(
+                self.model, self._x, deadline_s=self._deadline_s,
+                proba=self._proba, exclude=frozenset(live | self._tried),
+                chaos=False)
+        except RequestRejected:
+            return  # nowhere to hedge to — the primary still owns it
+        _registry().counter("fleet.hedge", "launched").inc()
+        self._tried.add(rep.index)
+        self._attempts.append((rep, fut))
+
+
+class ServeFleet:
+    """N ModelServer replicas behind a health-aware router."""
+
+    def __init__(self, *, replicas: int | None = None,
+                 label: str = "fleet", hedge_ms: float | None = None,
+                 drain_timeout_s: float | None = None,
+                 retries: int | None = None,
+                 priorities=None,
+                 budget: FaultBudget | None = None,
+                 replica_fault_attempts: int | None = None,
+                 hbm_budget_mb: float | None = None,
+                 blind: bool = False,
+                 **server_kwargs):
+        self.label = str(label)
+        self.n = resolve_fleet_replicas(replicas)
+        self.hedge_s = resolve_hedge_s(hedge_ms)
+        self.drain_timeout_s = resolve_drain_timeout_s(drain_timeout_s)
+        self.retries = resolve_fleet_retries(retries)
+        self.priorities = resolve_fleet_priorities(priorities)
+        self.blind = bool(blind)
+        self._budget = budget if budget is not None else \
+            FaultBudget.from_env(name=f"fleet:{self.label}")
+        self._replica_attempts = replica_fault_attempts
+        self._hbm_budget_mb = hbm_budget_mb
+        self._server_kwargs = dict(server_kwargs)
+        self._lock = make_lock("serve.fleet")
+        self._models: dict = {}   # name -> (model, hot, slo_s)
+        self._closed = False
+        self._shed_level = 0
+        self._rr = 0  # blind round-robin cursor
+        self._replicas = [
+            Replica(i, self._spawn_server(i)) for i in range(self.n)]
+        self._router = Router(
+            self._replicas,
+            budget_bytes=resolve_hbm_budget_bytes(hbm_budget_mb),
+            blind=self.blind)
+        self._publish_states()
+
+    # -- lifecycle -------------------------------------------------------
+    def _spawn_server(self, index: int) -> ModelServer:
+        budget = None
+        if self._replica_attempts is not None:
+            budget = FaultBudget(
+                self._replica_attempts, 600.0,
+                name=f"fleet:{self.label}/r{index}")
+        return ModelServer(
+            label=f"{self.label}/r{index}", metrics_tag=f"r{index}",
+            hbm_budget_mb=self._hbm_budget_mb, budget=budget,
+            **self._server_kwargs)
+
+    def close(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for rep in self._replicas:
+            rep.server.close(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- placement / admission -------------------------------------------
+    def load(self, name: str, model, *, hot: bool = False,
+             slo_ms: float | None = None, timeout: float = 60.0) -> tuple:
+        """Place and admit ``model`` under ``name``: every replica for a
+        hot model, one rendezvous-chosen replica (within the per-replica
+        budget) for a cold one.  Blocks until every placed replica is
+        warm.  ``slo_ms`` arms the per-model SLO budget
+        (``fleet.slo_miss{model}``, brownout shed evidence)."""
+        placed = self._router.place(
+            name, nbytes=_model_nbytes(model), hot=hot)
+        with self._lock:
+            self._models[name] = (
+                model, bool(hot),
+                None if slo_ms is None else float(slo_ms) / 1e3)
+        for rep in self._replicas:
+            if rep.index in placed:
+                rep.server.load(name, model, timeout=timeout)
+        self._publish_states()
+        return placed
+
+    def unload(self, name: str, timeout: float = 30.0) -> bool:
+        placed = self._router.placement(name)
+        out = False
+        for rep in self._replicas:
+            if rep.index in placed:
+                out = rep.server.unload(name, timeout=timeout) or out
+        self._router.forget(name)
+        with self._lock:
+            self._models.pop(name, None)
+        return out
+
+    def warm_from(self, dataset_dir: str, *, rows: int = 64,
+                  timeout: float = 30.0) -> dict:
+        """Readiness warmup from a written sharded dataset: replica
+        ``i`` pulls the first block(s) of ITS ``for_host(i, n)`` shard
+        slice and drives the rows through every model placed on it —
+        the per-host sharding rule reused as warmup traffic, so first
+        real requests hit request paths (not just compiled programs)
+        that have already run end to end."""
+        import os as _os
+
+        from ..data import MANIFEST_NAME, DatasetManifest
+
+        manifest = DatasetManifest.load(
+            _os.path.join(dataset_dir, MANIFEST_NAME))
+        warmed: dict = {}
+        for rep in self._replicas:
+            mine = manifest.for_host(rep.index, self.n)
+            if not mine.shards:
+                continue
+            with mine.open_shard(0) as reader:
+                cols = reader.read_block(0)
+            X = np.asarray(cols[0][:rows], dtype=np.float32)
+            names = [n for n in rep.server.registry.names()]
+            for name in names:
+                rm = rep.server.registry.get(name)
+                if rm is not None and 0 <= rm.n_features != X.shape[1]:
+                    continue  # width-mismatched dataset: skip honestly
+                rep.server.predict(name, X, timeout=timeout)
+                warmed[f"r{rep.index}/{name}"] = int(X.shape[0])
+        return warmed
+
+    # -- request path ----------------------------------------------------
+    def _count_reject(self, reason: str, model: str = "") -> None:
+        _registry().counter("fleet.rejected", reason).inc()
+        obs.event("fleet.reject", model=model, reason=reason)
+
+    def _fleet_reject(self, reason: str, detail: str, model: str = ""):
+        self._count_reject(reason, model)
+        raise RequestRejected(reason, detail)
+
+    def _chaos(self, rep) -> bool:
+        """Drill injection points, fired once per candidate considered.
+        The injected exception is TRANSLATED into the domain event the
+        point names: a ThreadCrash at ``replica-kill`` hard-kills the
+        candidate's serve loop (the request still routes to the dying
+        replica — that in-flight window is the drill's subject); a
+        fault at ``replica-slow`` arms a dispatch delay (the hedge
+        path's subject); a fault at ``router-partition`` quarantines
+        the candidate from the router's view and SKIPS it (returns
+        True)."""
+        try:
+            _maybe_fault("replica-kill")
+        except _ThreadCrash:
+            rep.server.kill()
+        try:
+            _maybe_fault("replica-slow")
+        except _FaultInjected:
+            rep.server._test_dispatch_delay_s = 0.25
+        try:
+            _maybe_fault("router-partition")
+        except _FaultInjected:
+            self._router.partition(rep.index, 0.35)
+            return True
+        return False
+
+    def _route(self, name: str, x, *, deadline_s, proba: bool,
+               exclude=frozenset(), chaos: bool = True):
+        """One routing attempt: pick a candidate, fire the chaos
+        points, submit.  Sighted routing fails over across candidates
+        within this pass; BLIND routing ships to its round-robin pick
+        and propagates whatever happens (the self-test's broken
+        router)."""
+        if not self.blind:
+            # a dead slot never heals by itself (its own budget is
+            # spent — that is what made it dead): every routing pass
+            # sweeps for corpses so the fleet converges back to N
+            # replicas while survivors carry the traffic
+            self._respawn_dead()
+        cands = self._router.candidates(name, exclude=exclude)
+        if not cands:
+            raise RequestRejected(
+                "serve_down" if self._router.placement(name)
+                else "unknown_model",
+                f"no routable replica for {name!r}")
+        if self.blind:
+            rep = cands[self._rr % len(cands)]
+            self._rr += 1
+            if chaos:
+                self._chaos(rep)
+            return rep, rep.server.submit(
+                name, x, deadline_s=deadline_s, proba=proba)
+        last: RequestRejected | None = None
+        for rep in cands:
+            if chaos and self._chaos(rep):
+                continue  # partitioned out from under the router
+            try:
+                return rep, rep.server.submit(
+                    name, x, deadline_s=deadline_s, proba=proba)
+            except RequestRejected as e:
+                if e.reason not in _RETRYABLE:
+                    raise
+                self._note_trouble(rep, e.reason)
+                last = e
+        raise last if last is not None else RequestRejected(
+            "serve_down", f"no routable replica for {name!r}")
+
+    def submit(self, name: str, X, *, priority: str = "normal",
+               deadline_s: float | None = None,
+               proba: bool = False) -> FleetFuture:
+        """Route one predict into the fleet; returns its
+        :class:`FleetFuture`.  Every ACCEPTED request resolves with a
+        result or a counted rejection — never a silent drop, never a
+        hang (the chaos drills' zero-lost invariant)."""
+        if self._closed:
+            self._fleet_reject("shutdown", "fleet closed", name)
+        if priority not in self.priorities:
+            raise ValueError(
+                f"unknown priority {priority!r} "
+                f"(classes, lowest first: {self.priorities})")
+        _registry().counter("fleet.requests", priority).inc()
+        with self._lock:
+            shed = self._shed_level
+        if shed:
+            if all(r.ready() for r in self._replicas):
+                # every replica healthy again: brownout is over
+                with self._lock:
+                    self._shed_level = 0
+                obs.event("fleet.brownout_clear", label=self.label)
+            elif self.priorities.index(priority) < shed:
+                self._fleet_reject(
+                    "brownout",
+                    f"fleet budget exhausted: shedding classes below "
+                    f"{self.priorities[shed]!r}", name)
+        attempt = 0
+        while True:
+            try:
+                rep, fut = self._route(
+                    name, X, deadline_s=deadline_s, proba=proba)
+                break
+            except RequestRejected as e:
+                if self.blind or e.reason not in _RETRYABLE:
+                    raise
+                attempt += 1
+                if attempt > self.retries or \
+                        not self._budget.acquire("fleet-route"):
+                    if attempt <= self.retries:
+                        self._enter_brownout()
+                    self._fleet_reject(e.reason, str(e), name)
+                _registry().counter("fleet.retry", e.reason).inc()
+                time.sleep(full_jitter_backoff(attempt - 1))
+        return FleetFuture(self, name, X, deadline_s=deadline_s,
+                           proba=proba, replica=rep, fut=fut)
+
+    def predict(self, name: str, X, *, timeout: float | None = 30.0,
+                priority: str = "normal", deadline_s: float | None = None):
+        return self.submit(name, X, priority=priority,
+                           deadline_s=deadline_s).result(timeout)
+
+    def predict_proba(self, name: str, X, *,
+                      timeout: float | None = 30.0,
+                      priority: str = "normal",
+                      deadline_s: float | None = None):
+        return self.submit(name, X, priority=priority,
+                           deadline_s=deadline_s,
+                           proba=True).result(timeout)
+
+    # -- degradation / recovery ------------------------------------------
+    def _note_latency(self, name: str, latency_s: float) -> None:
+        reg = _registry()
+        reg.histogram("fleet.request_s", name).record(latency_s)
+        with self._lock:
+            slo = (self._models.get(name) or (None, None, None))[2]
+        if slo is not None and latency_s > slo:
+            reg.counter("fleet.slo_miss", name).inc()
+            # feed the adaptive window: an SLO-missing model is latency
+            # evidence the gather window is too patient — record the
+            # sighting for the pilot's serve policy to weigh
+            from ..control import knobs as _knobs
+
+            _knobs.observe("serve_window_ms", max(slo * 1e3 / 4, 0.1))
+
+    def _note_trouble(self, rep, reason: str) -> None:
+        if self.blind:
+            return
+        if reason in ("serve_down", "shutdown") and \
+                rep.server._failed is not None:
+            self._respawn(rep)
+        self._publish_states()
+
+    def _respawn_dead(self) -> None:
+        for rep in self._replicas:
+            if rep.state() == "dead":
+                self._respawn(rep)
+
+    def _respawn(self, rep) -> bool:
+        """Budgeted replica respawn: a NEW ModelServer takes the slot,
+        its placed models re-warm asynchronously (readiness keeps the
+        router off it until they resolve), the corpse is closed (its
+        sweep already rejected its stragglers loudly).  Past the fleet
+        budget: brownout, the slot stays dead."""
+        if not rep._respawn_lock.acquire(blocking=False):
+            return False  # another caller is already respawning it
+        try:
+            srv = rep.server
+            if not (srv._closed or srv._failed is not None):
+                # thread-dead but not terminally failed: the server's
+                # OWN budgeted restart (with exact in-flight replay)
+                # comes first — the routing pass stands in for the
+                # consumer-side liveness poll a readiness-skipped
+                # replica would otherwise never receive
+                t = srv._thread
+                if t is not None and not t.is_alive():
+                    srv._ensure_alive()
+                if srv._failed is None:
+                    return False  # alive again (restarted in place)
+            if not self._budget.acquire("replica-respawn"):
+                self._enter_brownout()
+                return False
+            fresh = self._spawn_server(rep.index)
+            with self._lock:
+                models = [
+                    (name, m) for name, (m, _h, _s) in self._models.items()
+                    if rep.index in self._router.placement(name)]
+            for name, model in models:
+                fresh.submit_load(name, model)
+            rep.server = fresh
+            srv.close(timeout=1.0)
+            _registry().counter("fleet.respawn").inc()
+            obs.event("fleet.respawn", label=self.label,
+                      replica=rep.index)
+            self._publish_states()
+            return True
+        finally:
+            rep._respawn_lock.release()
+
+    def _enter_brownout(self) -> None:
+        with self._lock:
+            if self._shed_level >= len(self.priorities) - 1:
+                return
+            self._shed_level += 1
+            level = self._shed_level
+        _registry().counter("fleet.brownout").inc()
+        obs.event("fleet.brownout", label=self.label, level=level,
+                  shedding=list(self.priorities[:level]))
+
+    # -- rolling deploy ---------------------------------------------------
+    def rolling_refresh(self, name: str, model, *,
+                        timeout: float = 60.0) -> dict:
+        """Replica-by-replica model refresh behind a drain barrier:
+        stop routing → flush in-flight → refresh (the registry's
+        hot-swap path, ``serve.lane_refresh`` for packed lanes) →
+        re-admit on readiness.  The pilot is held (frozen) throughout;
+        rejections during the window are confined to ``draining`` (the
+        drill-ratcheted deploy invariant).  Returns per-replica drain
+        verdicts."""
+        placed = self._router.placement(name)
+        if not placed:
+            raise KeyError(f"model {name!r} is not placed on this fleet")
+        with self._lock:
+            _old, hot, slo = self._models[name]
+            # respawns during the walk must load the NEW model
+            self._models[name] = (model, hot, slo)
+        out: dict = {}
+        with _pilot.hold("fleet_drain"):
+            for rep in self._replicas:
+                if rep.index not in placed:
+                    continue
+                try:
+                    _maybe_fault("fleet-deploy")
+                except _ThreadCrash:
+                    # drill: the replica dies at the drain barrier —
+                    # the refresh must still complete (budgeted restart
+                    # or respawn, then the load proceeds)
+                    rep.server.kill()
+                rep.draining = True
+                self._publish_states()
+                try:
+                    drained = rep.server.drain(self.drain_timeout_s)
+                    try:
+                        rep.server.load(name, model, timeout=timeout)
+                    except RequestRejected:
+                        # the replica died terminally mid-deploy:
+                        # respawn takes the slot and loads the new
+                        # model via the placed-models replay
+                        if not self._respawn(rep):
+                            raise
+                    out[f"r{rep.index}"] = {"drained": bool(drained)}
+                finally:
+                    rep.draining = False
+                    rep.server.resume()
+                    self._publish_states()
+                deadline = time.monotonic() + timeout
+                while not rep.ready() and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                out[f"r{rep.index}"]["ready"] = rep.ready()
+        _registry().counter("fleet.deploy").inc()
+        obs.event("fleet.deploy", label=self.label, model=name,
+                  replicas=sorted(out))
+        return out
+
+    # -- books ------------------------------------------------------------
+    def _publish_states(self) -> None:
+        reg = _registry()
+        for rep in self._replicas:
+            reg.gauge("fleet.replica_state", f"r{rep.index}").set(
+                float(_STATE_CODES[rep.state()]))
+
+    def report(self) -> dict:
+        """The router's aggregated view: per-replica books (the scrape
+        an external router would pull from each process's /metrics)
+        plus fleet counters and placement."""
+        self._publish_states()
+        reg = _registry()
+        metrics: dict = {}
+        for mname, tag, inst in reg.export_items():
+            if not mname.startswith("fleet."):
+                continue
+            key = f"{mname}{{{tag}}}" if tag else mname
+            snap = getattr(inst, "snapshot", None)
+            metrics[key] = snap() if callable(snap) else inst.value
+        with self._lock:
+            shed = self._shed_level
+        return {
+            "label": self.label,
+            "replicas": {f"r{rep.index}": dict(rep.server.report(),
+                                               state=rep.state())
+                         for rep in self._replicas},
+            "router": self._router.report(),
+            "budget": self._budget.snapshot(),
+            "shed_level": shed,
+            "priorities": list(self.priorities),
+            "metrics": dict(sorted(metrics.items())),
+        }
+
+    scrape = report  # the aggregated-scrape alias
+
+
+# -- seeded-fault self-test (tools/lint.sh) -------------------------------
+
+class _ToyModel:
+    """Host-only generic model (no device programs — the self-test must
+    stay under a second after imports)."""
+
+    def predict(self, X):
+        X = np.asarray(X, dtype=np.float32)
+        return (X.sum(axis=1) > 0.0).astype(np.int64)
+
+
+def self_test(verbose: bool = True) -> int:
+    """Seed a replica kill mid-traffic and require the fleet to lose
+    ZERO accepted requests.  ``DASK_ML_TPU_FLEET_INJECT=replica-kill``
+    runs the same fault through a BLIND router (no readiness gate, no
+    failover, no respawn) — the gate must then exit 1, proving the
+    loss detector can actually fire (graftlock posture: a gate that
+    cannot fail can never be trusted)."""
+    from ..resilience.testing import FaultPlan, fault_plan
+
+    def say(msg):
+        if verbose:
+            print(f"fleet self-test: {msg}")
+
+    blind = resolve_fleet_inject() == "replica-kill"
+    model = _ToyModel()
+    rng = np.random.RandomState(7)
+    X = rng.normal(size=(24, 4)).astype(np.float32)
+    want = model.predict(X)
+    plan = FaultPlan().inject(
+        "replica-kill", at_call=4, times=1,
+        exc=_ThreadCrash("self-test: replica kill"))
+    lost = []
+    respawns0 = _registry().counter("fleet.respawn").value
+    fleet = ServeFleet(
+        replicas=3, label="selftest", window_s=0.0, hedge_ms=0.0,
+        retries=2, replica_fault_attempts=0,
+        budget=FaultBudget(16, 60.0, name="fleet:selftest"),
+        blind=blind)
+    try:
+        fleet.load("toy", model, hot=True)
+        with fault_plan(plan):
+            for i in range(24):
+                try:
+                    got = fleet.predict("toy", X[i:i + 1], timeout=5.0)
+                    if int(np.asarray(got)[0]) != int(want[i]):
+                        lost.append((i, "wrong answer"))
+                except (RequestRejected, TimeoutError) as e:
+                    lost.append((i, f"{type(e).__name__}: {e}"))
+    finally:
+        fleet.close()
+    respawned = _registry().counter("fleet.respawn").value - respawns0
+    say(f"blind={blind} faults={sum(plan.fired.values())} "
+        f"lost={len(lost)} respawns={respawned:g}")
+    if blind:
+        ok = len(lost) > 0
+        say("blind router LOST requests (the gate can fail): exit 1"
+            if ok else
+            "blind router lost nothing — the loss detector is broken")
+        return 1 if ok else 2
+    ok = (not lost and sum(plan.fired.values()) == 1 and respawned >= 1)
+    if ok:
+        say("replica killed mid-traffic, zero lost, respawned: exit 0")
+        return 0
+    for i, why in lost[:5]:
+        say(f"request {i} lost: {why}")
+    say("FAILED: the fleet lost accepted requests (or never respawned)")
+    return 1
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m dask_ml_tpu.serve.fleet",
+        description="graftfleet seeded-fault self-test",
+    )
+    p.add_argument("--self-test", action="store_true")
+    p.add_argument("--quiet", action="store_true")
+    try:
+        args = p.parse_args(argv)
+    except SystemExit as e:
+        return 0 if (e.code in (0, None)) else 2
+    if not args.self_test:
+        p.print_help()
+        return 2
+    return self_test(verbose=not args.quiet)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,200 @@
+"""Fleet routing policy: placement, readiness-gated candidate
+selection, partitions, and backoff (design.md §22).
+
+This module is the DECISION half of graftfleet — pure host-side policy
+with no threads and no device work, so every rule is unit-testable
+without a fleet:
+
+* **consistent placement** — hot models replicate to every replica;
+  cold models partition across the per-replica ``SERVE_HBM_MB``
+  budgets by rendezvous (highest-random-weight) hashing, so the same
+  model lands on the same replica across routers and restarts, and the
+  fleet's aggregate capacity is N x the single-process budget.  A cold
+  model that fits no remaining budget still places (the replica's LRU
+  registry absorbs it) but the spill is counted
+  (``fleet.placement_spill``) — capacity pressure is visible, never
+  silent;
+* **readiness-gated candidates** — a replica is routable only when its
+  ``ready()`` probe is true (alive, not draining, residency warmup
+  complete — the ``/readyz`` contract) and it is not partitioned from
+  the router's view; candidates order by queue depth (least-loaded
+  first, rendezvous order as the tiebreak);
+* **partitions** — a router-side quarantine with an expiry: the
+  replica keeps serving its in-flight work, the router just stops
+  routing to it until the partition heals (the ``router-partition``
+  chaos drill's subject);
+* **full-jitter backoff** — the retry delay schedule
+  (``random.uniform(0, min(cap, base * 2^attempt))``), the classic
+  thundering-herd-free shape.
+
+A ``blind=True`` router skips the readiness and partition gates and
+never reorders by load — the deliberately broken configuration the
+seeded-fault self-test (``DASK_ML_TPU_FLEET_INJECT=replica-kill``)
+uses to prove the zero-lost-requests gate can fail.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import time
+
+from .._locks import make_lock
+from ..obs.metrics import registry as _registry
+
+__all__ = [
+    "REPLICA_STATES",
+    "Router",
+    "full_jitter_backoff",
+    "rendezvous",
+]
+
+#: replica lifecycle states (the ``fleet.replica_state`` gauge encodes
+#: them by index: 0=ready 1=warming 2=draining 3=dead)
+REPLICA_STATES = ("ready", "warming", "draining", "dead")
+
+
+def full_jitter_backoff(attempt: int, *, base_s: float = 0.01,
+                        cap_s: float = 0.25, rng=None) -> float:
+    """The full-jitter delay for retry ``attempt`` (0-based):
+    ``uniform(0, min(cap, base * 2^attempt))`` — every waiter draws a
+    fresh delay so synchronized retries cannot stampede a recovering
+    replica."""
+    span = min(float(cap_s), float(base_s) * (2 ** max(int(attempt), 0)))
+    return (rng or random).uniform(0.0, span)
+
+
+def rendezvous(name: str, ids, k: int = 1) -> list:
+    """Highest-random-weight placement: score every id by a keyed hash
+    and keep the top ``k``.  Adding or removing one replica moves only
+    the models that hashed to it — the consistent-placement property a
+    modulo would not have."""
+    def score(i):
+        h = hashlib.md5(f"{name}|{i}".encode("utf-8")).hexdigest()
+        return int(h[:16], 16)
+
+    ranked = sorted(ids, key=score, reverse=True)
+    return ranked[:max(int(k), 1)]
+
+
+class Router:
+    """Placement table + candidate selection over a fixed replica set.
+
+    Replicas are duck-typed: the router needs ``.index``, ``.ready()``
+    and ``.qsize()`` — the fleet owns their lifecycle."""
+
+    def __init__(self, replicas, *, budget_bytes: int | None = None,
+                 blind: bool = False):
+        self._replicas = list(replicas)
+        self._budget_bytes = budget_bytes
+        self.blind = bool(blind)
+        self._lock = make_lock("serve.router")
+        self._placement: dict = {}      # model -> tuple of indices
+        self._hot: set = set()
+        self._placed_bytes: dict = {i.index: 0 for i in self._replicas}
+        self._model_bytes: dict = {}
+        self._partition_until: dict = {}  # index -> monotonic expiry
+
+    # -- placement -------------------------------------------------------
+    def place(self, name: str, *, nbytes: int = 0,
+              hot: bool = False) -> tuple:
+        """Choose (and remember) the replica indices hosting ``name``.
+        Re-placing an existing model keeps its assignment (deploys
+        refresh in place; placement churn is a chaos source, not a
+        feature)."""
+        with self._lock:
+            if name in self._placement:
+                if hot:
+                    self._hot.add(name)
+                return self._placement[name]
+            ids = [r.index for r in self._replicas]
+            if hot:
+                chosen = tuple(ids)
+                self._hot.add(name)
+            else:
+                ranked = rendezvous(name, ids, k=len(ids))
+                pick = ranked[0]
+                if self._budget_bytes:
+                    fits = [i for i in ranked
+                            if self._placed_bytes[i] + nbytes
+                            <= self._budget_bytes]
+                    if fits:
+                        pick = fits[0]
+                    else:
+                        # nowhere fits: place on the rendezvous-first
+                        # replica anyway (its LRU registry absorbs) and
+                        # make the capacity pressure loud
+                        _registry().counter("fleet.placement_spill").inc()
+                chosen = (pick,)
+            self._placement[name] = chosen
+            for i in chosen:
+                self._placed_bytes[i] += int(nbytes)
+            self._model_bytes[name] = int(nbytes)
+            return chosen
+
+    def forget(self, name: str) -> None:
+        with self._lock:
+            ids = self._placement.pop(name, ())
+            nb = self._model_bytes.pop(name, 0)
+            self._hot.discard(name)
+            for i in ids:
+                self._placed_bytes[i] = max(
+                    0, self._placed_bytes.get(i, 0) - nb)
+
+    def placement(self, name: str) -> tuple:
+        with self._lock:
+            return self._placement.get(name, ())
+
+    def is_hot(self, name: str) -> bool:
+        with self._lock:
+            return name in self._hot
+
+    # -- partitions (router-side quarantine) -----------------------------
+    def partition(self, index: int, duration_s: float) -> None:
+        """Quarantine one replica from this router's view for
+        ``duration_s`` — in-flight work on it proceeds; only NEW
+        routing avoids it."""
+        with self._lock:
+            self._partition_until[index] = \
+                time.monotonic() + float(duration_s)
+        _registry().counter("fleet.partition").inc()
+
+    def is_partitioned(self, index: int) -> bool:
+        with self._lock:
+            until = self._partition_until.get(index)
+            if until is None:
+                return False
+            if time.monotonic() >= until:
+                del self._partition_until[index]
+                return False
+            return True
+
+    # -- candidate selection ---------------------------------------------
+    def candidates(self, name: str, *, exclude=()) -> list:
+        """The replicas to try for ``name``, best first: placed AND
+        ready AND un-partitioned, least queue depth breaking toward
+        rendezvous order.  Blind mode returns the raw placement — no
+        gates, no reordering (the self-test's broken router)."""
+        placed = self.placement(name)
+        byidx = {r.index: r for r in self._replicas}
+        out = [byidx[i] for i in placed if i in byidx
+               and i not in exclude]
+        if self.blind:
+            return out
+        out = [r for r in out
+               if r.ready() and not self.is_partitioned(r.index)]
+        out.sort(key=lambda r: r.qsize())
+        return out
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "blind": self.blind,
+                "placement": {m: list(v)
+                              for m, v in sorted(self._placement.items())},
+                "hot": sorted(self._hot),
+                "placed_bytes": dict(self._placed_bytes),
+                "partitioned": sorted(
+                    i for i in self._partition_until
+                    if time.monotonic() < self._partition_until[i]),
+            }

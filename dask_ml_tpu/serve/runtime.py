@@ -113,7 +113,8 @@ class ModelServer:
                  window_s: float | None = None, queue_depth: int | None = None,
                  deadline_s: float | None = None,
                  hbm_budget_mb: float | None = None,
-                 budget: FaultBudget | None = None):
+                 budget: FaultBudget | None = None,
+                 metrics_tag: str | None = None):
         from .. import programs as _programs
 
         self.label = str(label)
@@ -151,6 +152,20 @@ class ModelServer:
         self._replay: list = []
         self._failed: BaseException | None = None
         self._closed = False
+        self._draining = False
+        #: control futures (loads/unloads) not yet resolved — the
+        #: readiness signal: a replica with residency warmup still in
+        #: flight must not be routed cold traffic (/readyz is 503)
+        self._pending_controls: list = []
+        #: per-replica latency attribution (fleets): when set, the
+        #: request-latency histogram families record under this tag
+        #: instead of the model name, so per-replica graftpath verdicts
+        #: stay separable while the global sums are unchanged
+        self._metrics_tag = metrics_tag
+        #: chaos hook (drills/self-test): armed by :meth:`kill`, raises
+        #: ThreadCrash at the top of the loop's next cycle — same
+        #: test-only posture as ``_test_dispatch_delay_s`` below
+        self._crash_armed = False
         self._hb = None
         self._thread: threading.Thread | None = None
         #: perf-harness hook: an injected per-dispatch sleep the
@@ -159,9 +174,15 @@ class ModelServer:
         #: slowest request seen (monotone): the flight-recorder
         #: exemplar threshold — serve-loop-only state, no lock needed
         self._slowest_s = 0.0
+        #: perf-harness hook: an injected per-control sleep so tests can
+        #: pin the /readyz warmup window deterministically
+        self._test_control_delay_s = 0.0
         self._start_loop()
         with _SERVERS_LOCK:
             _SERVERS.append(self)
+        from ..obs.serve import register_readiness
+
+        register_readiness(self._unit, self.ready)
 
     # -- lifecycle -------------------------------------------------------
     def _start_loop(self) -> None:
@@ -195,6 +216,9 @@ class ModelServer:
                     RequestRejected("shutdown", "server closed"))
         if self._hb is not None:
             self._hb.retire()
+        from ..obs.serve import unregister_readiness
+
+        unregister_readiness(self._unit)
         with _SERVERS_LOCK:
             if self in _SERVERS:
                 _SERVERS.remove(self)
@@ -219,23 +243,106 @@ class ModelServer:
         return [r for r in out if self._unresolved(r)]
 
     # -- public request API (caller threads) -----------------------------
+    def _offer_control(self, item: _Control) -> ServeFuture:
+        self._check_open()
+        with self._lock:
+            self._pending_controls.append(item.future)
+        self._batcher.offer_control(item)
+        self._ensure_alive()
+        return item.future
+
+    def submit_load(self, name: str, model) -> ServeFuture:
+        """Queue a model admission WITHOUT blocking (the fleet respawn /
+        rolling-deploy path: warmup runs on the serve thread while the
+        caller keeps routing traffic elsewhere; :meth:`ready` — and the
+        ``/readyz`` probe — stay false until every queued control has
+        resolved)."""
+        return self._offer_control(_Control("load", name, model,
+                                            ServeFuture(self)))
+
     def load(self, name: str, model, timeout: float = 60.0):
         """Admit a fitted model under ``name`` (replacing any previous
         holder).  Blocks until the model is resident and its predict
         programs are warm — load is the expensive moment, so the steady
         request path never compiles."""
-        fut = ServeFuture(self)
-        self._check_open()
-        self._batcher.offer_control(_Control("load", name, model, fut))
-        self._ensure_alive()
-        return fut.result(timeout)
+        return self.submit_load(name, model).result(timeout)
 
     def unload(self, name: str, timeout: float = 30.0) -> bool:
-        fut = ServeFuture(self)
-        self._check_open()
-        self._batcher.offer_control(_Control("unload", name, future=fut))
-        self._ensure_alive()
+        fut = self._offer_control(
+            _Control("unload", name, future=ServeFuture(self)))
         return fut.result(timeout)
+
+    # -- drain / readiness / chaos (caller threads) ----------------------
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """The rolling-deploy drain barrier: stop admitting requests
+        (``submit()`` rejects with reason ``draining`` immediately —
+        never queued into a loop about to be refreshed) and wait for
+        the queue plus the in-flight batch to flush.  Control items
+        (loads/unloads) stay admissible: the refresh itself rides the
+        drained loop.  Returns True when quiesced within the timeout."""
+        with self._lock:
+            self._draining = True
+        _registry().counter("serve.drain").inc()
+        obs.event("serve.drain", label=self.label)
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            if self._quiesced():
+                return True
+            if time.monotonic() >= deadline:
+                return self._quiesced()
+            # a dead loop can never flush: the liveness poll restarts
+            # it (or sweeps, past the budget) so drain cannot hang
+            self._ensure_alive()
+            time.sleep(0.005)
+
+    def resume(self) -> None:
+        """Re-admit traffic after a drain (the deploy's re-admission
+        edge; the router additionally gates on :meth:`ready`)."""
+        with self._lock:
+            self._draining = False
+
+    def _quiesced(self) -> bool:
+        """No queued requests and no unresolved in-flight work.  The
+        gather loop holds a popped batch for a moment before publishing
+        it as in-flight — a microsecond window the drain poll may race;
+        the deploy path is still safe because the refresh is a queued
+        control, ordered after any such batch on the same loop."""
+        if self._batcher.qsize() > 0:
+            return False
+        with self._lock:
+            pending = any(self._unresolved(r)
+                          for r in self._inflight + self._replay)
+        return not pending
+
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def ready(self) -> bool:
+        """The READINESS half of the health split (satellite of
+        design.md §22): alive AND not draining AND residency warmup
+        complete (no queued control still unresolved).  ``/healthz``
+        keeps answering liveness (503 only on a DEAD unit); ``/readyz``
+        is 503 until this is true — the router must not route cold
+        traffic to a replica still compiling its rungs."""
+        t = self._thread
+        if (self._closed or self._failed is not None
+                or t is None or not t.is_alive()):
+            return False
+        with self._lock:
+            if self._draining:
+                return False
+            self._pending_controls = [
+                f for f in self._pending_controls if not f.done()]
+            return not self._pending_controls
+
+    def kill(self) -> None:
+        """Chaos hook (drills / fleet self-test): arm a simulated hard
+        death — the serve loop raises ThreadCrash at the top of its
+        next cycle, exactly as if the runtime killed the thread, with
+        whatever was queued left behind for the supervised-restart /
+        fleet-respawn paths to recover."""
+        self._crash_armed = True
 
     @staticmethod
     def _reject_submit(reason: str, detail: str, model: str = ""):
@@ -253,6 +360,15 @@ class ModelServer:
         oversize batch, or a proba request the model's loss cannot
         honor raises :class:`RequestRejected` immediately."""
         self._check_open()
+        with self._lock:
+            draining = self._draining
+        if draining:
+            # reject NOW, loudly — queueing into a loop behind a drain
+            # barrier would strand the request in a dying generation
+            self._reject_submit(
+                "draining",
+                f"server {self.label!r} is draining for a refresh",
+                name)
         _registry().counter("serve.requests").inc()
         xa = np.asarray(X, dtype=np.float32)
         if xa.ndim == 1:
@@ -430,6 +546,9 @@ class ModelServer:
     def _loop(self) -> None:
         try:
             while not self._stop.is_set():
+                if self._crash_armed:
+                    self._crash_armed = False
+                    raise _ThreadCrash("injected replica kill")
                 self._refresh_knobs()
                 with self._lock:
                     replay, self._replay = self._replay, []
@@ -469,6 +588,8 @@ class ModelServer:
 
     def _handle_control(self, c: _Control) -> None:
         try:
+            if self._test_control_delay_s:
+                time.sleep(self._test_control_delay_s)
             if c.op == "load":
                 self.registry.admit(c.name, c.model)
                 out = True
@@ -485,13 +606,18 @@ class ModelServer:
                 logger.exception("serve control %s(%r) failed", c.op,
                                  c.name)
 
+    def _tag(self, model: str) -> str:
+        """Latency-family tag: the per-replica label when fleet-owned
+        (per-replica verdicts stay separable), else the model name."""
+        return self._metrics_tag if self._metrics_tag else model
+
     # -- dispatch (serve thread) -----------------------------------------
     def _dispatch(self, requests: list) -> None:
         now = time.monotonic()
         reg = _registry()
         live: dict[str, list] = {}
         for r in requests:
-            reg.histogram("serve.queue_wait_s", r.model).record(
+            reg.histogram("serve.queue_wait_s", self._tag(r.model)).record(
                 now - r.t_enqueue)
             if r.expired(now):
                 # stale before any device work: the deadline's whole
@@ -598,7 +724,7 @@ class ModelServer:
         for r, p in zip(reqs, preds_by_req):
             r.future.set_result(p)
             lat = done - r.t_enqueue
-            reg.histogram("serve.request_s", r.model).record(lat)
+            reg.histogram("serve.request_s", self._tag(r.model)).record(lat)
             if t_dispatch0 is None or t_dispatched is None or \
                     r.t_dequeue is None:
                 continue  # a path without stamps records only the total
@@ -609,7 +735,8 @@ class ModelServer:
                 "fetch": max(done - t_dispatched, 0.0),
             }
             for leg, dt in split.items():
-                reg.histogram(f"serve.req_{leg}_s", r.model).record(dt)
+                reg.histogram(f"serve.req_{leg}_s",
+                              self._tag(r.model)).record(dt)
             # slowest-request exemplar: a monotone-max record in the
             # flight recorder, so a post-mortem shows WHERE the worst
             # request's time went (trace id + split), not just that a
@@ -748,6 +875,8 @@ class ModelServer:
             "label": self.label,
             "alive": bool(self._thread is not None
                           and self._thread.is_alive()),
+            "ready": self.ready(),
+            "draining": self.draining(),
             "closed": self._closed,
             "failed": (None if self._failed is None
                        else str(self._failed)),

@@ -413,7 +413,7 @@ class TestMetricsEndpoint:
         srv = serve.start(port=0)
         status, _, body = _get(srv.port, "/nope")
         assert status == 404
-        assert "/metrics or /healthz" in body
+        assert "/metrics, /healthz or /readyz" in body
 
     def test_keep_alive_client_cannot_wedge_the_endpoint(self):
         """The endpoint is ONE serving thread: a client holding its

@@ -550,8 +550,19 @@ class TestAllowSiteCitations:
         ``obs.record_span``, a pure-stdlib call the static prover
         cannot resolve cross-module; the ``ingest_parallel`` graftsan
         workload runtime-verifies the contract (any dispatch
-        attributed to a reader thread is a hard violation) — so the
-        count is now 16."""
+        attributed to a reader thread is a hard violation) — count 16.
+        ISSUE 18 added SEVEN, all on the graftpilot controller
+        (control/pilot.py): the host-only ``dask-ml-tpu-pilot`` thread
+        (``thread-dispatch``; it is in ``HOST_ONLY_THREAD_NAMES`` and
+        graftsan's dispatch detector would flag any dispatch it made)
+        plus its single-owner cycle state (``unguarded-shared-state``;
+        written only from the pilot thread itself) — count 23.  PR 19
+        added ONE: the fleet-deploy drill's traffic thread
+        (resilience/drills.py, ``thread-dispatch``) — it only ENQUEUES
+        via ``ModelServer.submit`` and parks on the future; every
+        device dispatch stays on the replicas' blessed serve loops,
+        runtime-verified by the dispatch detector across the serve
+        drills — so the count is now 24."""
         import subprocess
 
         out = subprocess.run(
@@ -561,8 +572,8 @@ class TestAllowSiteCitations:
         total = sum(int(line.rsplit(":", 1)[1])
                     for line in out.stdout.splitlines() if ":" in line)
         # analysis/core.py's docstring EXAMPLE is not a live suppression
-        assert total - 1 <= 18
-        assert total - 1 == 16, (
+        assert total - 1 <= 26
+        assert total - 1 == 24, (
             "suppression count moved — update this test AND re-audit "
             "the AllowSite citations")
 

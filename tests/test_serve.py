@@ -12,6 +12,7 @@ latency quantiles.
 
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -669,3 +670,158 @@ class TestGenericWarmup:
             got = srv.predict("lr", X[:5])
         assert len(got) == 5
         assert 5 in seen and all(s in (64, 5) for s in seen), seen
+
+
+class TestDrainBarrier:
+    """PR 19 satellite: ``drain()`` stops admission with an explicit
+    ``draining`` rejection (counter + flight event — the honesty
+    contract's newest reason), flushes in-flight work, and ``resume()``
+    re-admits — the per-replica building block of rolling deploys."""
+
+    def test_drain_rejects_with_counted_reason(self):
+        from dask_ml_tpu.obs.metrics import registry as _registry
+
+        clf, X = _fitted_clf()
+        reg = _registry()
+        with ModelServer(label="t_drain", window_s=0.0) as srv:
+            srv.load("m", clf)
+            srv.predict("m", X[:2])
+            before = reg.family("serve.rejected").get("draining", 0)
+            assert srv.drain(timeout_s=5.0) is True
+            assert srv.draining() is True
+            assert srv.ready() is False
+            with pytest.raises(RequestRejected) as ei:
+                srv.submit("m", X[:1])
+            assert ei.value.reason == "draining"
+            assert reg.family("serve.rejected")["draining"] == before + 1
+            evts = [e for e in obs.flight_tail()
+                    if e.get("name") == "serve.reject"
+                    and e.get("attrs", {}).get("reason") == "draining"]
+            assert evts, "draining rejection must leave a flight event"
+            srv.resume()
+            assert srv.draining() is False
+            np.testing.assert_array_equal(
+                srv.predict("m", X[:3]),
+                np.asarray(clf.predict(X[:3])))
+
+    def test_drain_flushes_inflight_before_returning(self):
+        clf, X = _fitted_clf()
+        with ModelServer(label="t_drain_flush", window_s=0.0) as srv:
+            srv.load("m", clf)
+            futs = [srv.submit("m", X[i:i + 2]) for i in range(6)]
+            assert srv.drain(timeout_s=10.0) is True
+            # every accepted request resolved BEFORE drain returned
+            for i, f in enumerate(futs):
+                np.testing.assert_array_equal(
+                    f.result(0.1), np.asarray(clf.predict(X[i:i + 2])))
+
+
+class TestConcurrentRestart:
+    """PR 19 satellite: the budgeted serve-loop restart under
+    CONCURRENT submitters — K threads across a ThreadCrash must each
+    see exactly-once replay or a counted rejection, never a hang and
+    never a duplicate/blended answer."""
+
+    def test_k_threads_across_crash_exactly_once(self):
+        clf, X = _fitted_clf()
+        K, per = 6, 8
+        plan = FaultPlan().inject(
+            "serve-loop", at_call=3, times=1,
+            exc=ThreadCrash("test: death under concurrency"))
+        results: dict = {}
+        errors: dict = {}
+
+        with ModelServer(label="t_conc_crash", window_s=0.0,
+                         budget=FaultBudget(4, 60.0,
+                                            name="t_conc_crash")) as srv:
+            srv.load("m", clf)
+
+            def _client(k):
+                out = []
+                for i in range(per):
+                    lo = (k * per + i) % 32
+                    try:
+                        out.append((lo, srv.predict(
+                            "m", X[lo:lo + 2], timeout=30.0)))
+                    except RequestRejected as e:
+                        out.append((lo, e))
+                results[k] = out
+
+            with fault_plan(plan):
+                threads = [threading.Thread(target=_client, args=(k,),
+                                            name=f"t_conc_{k}")
+                           for k in range(K)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=60.0)
+                    assert not t.is_alive(), \
+                        "a submitter hung across the restart"
+        assert sum(plan.fired.values()) == 1
+        assert srv.report()["budget"]["spent"] >= 1
+        reg_rejected = sum(
+            1 for outs in results.values() for _, r in outs
+            if isinstance(r, RequestRejected))
+        answered = 0
+        for outs in results.values():
+            assert len(outs) == per  # exactly one outcome per request
+            for lo, r in outs:
+                if isinstance(r, RequestRejected):
+                    # a counted rejection is a legal outcome; a wrong
+                    # reason (or an uncounted drop) is not
+                    assert r.reason in ("serve_down", "queue_full")
+                    continue
+                answered += 1
+                np.testing.assert_array_equal(
+                    r, np.asarray(clf.predict(X[lo:lo + 2])))
+        assert answered + reg_rejected == K * per
+        assert answered > 0, errors
+
+
+class TestReadiness:
+    """PR 19 satellite: liveness (/healthz) vs readiness (/readyz)
+    split — a live server with residency warmup still pending must
+    read NOT READY (503) so a router never sends it cold traffic."""
+
+    def test_ready_false_during_warmup_window(self):
+        from dask_ml_tpu.obs import serve as obs_serve
+
+        clf, X = _fitted_clf()
+        with ModelServer(label="t_ready", window_s=0.0) as srv:
+            assert srv.ready() is True  # empty server: live AND ready
+            srv._test_control_delay_s = 0.25  # widen the warmup window
+            fut = srv.submit_load("m", clf)
+            # liveness holds through the whole window...
+            assert srv._unit not in _supervisor.healthz()["dead"]
+            # ...but readiness is down until the load resolves
+            assert srv.ready() is False
+            verdict = obs_serve.readyz()
+            assert verdict["ok"] is False
+            assert srv._unit in verdict["not_ready"]
+            assert fut.result(30.0) is True
+            srv._test_control_delay_s = 0.0
+            assert srv.ready() is True
+            assert obs_serve.readyz()["ok"] is True
+
+    def test_readyz_endpoint_503_until_warm(self):
+        from dask_ml_tpu.obs import serve as obs_serve
+
+        clf, X = _fitted_clf()
+        srv_http = obs_serve.start(0)
+        try:
+            with ModelServer(label="t_readyz_http", window_s=0.0) as srv:
+                srv._test_control_delay_s = 0.25
+                fut = srv.submit_load("m", clf)
+                url = f"http://127.0.0.1:{srv_http.port}"
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(f"{url}/readyz", timeout=5)
+                assert ei.value.code == 503
+                # liveness endpoint stays 200 through the warmup window
+                assert urllib.request.urlopen(
+                    f"{url}/healthz", timeout=5).status == 200
+                fut.result(30.0)
+                srv._test_control_delay_s = 0.0
+                assert urllib.request.urlopen(
+                    f"{url}/readyz", timeout=5).status == 200
+        finally:
+            obs_serve.stop()

@@ -156,6 +156,32 @@ if [[ "$rc" != 0 ]]; then
 fi
 echo "graftpilot: false-verdict moved the knob + saturation froze it"
 
+echo "== graftfleet (router self-test: seeded replica kill, zero lost) =="
+# always on the default path, graftlock's exit convention: <1s, host-only
+# models, no jax programs.  A replica is hard-killed mid-traffic; the
+# sighted router must lose ZERO accepted requests and respawn the slot
+# (exit 0).  Then the SAME kill runs through a BLIND router
+# (DASK_ML_TPU_FLEET_INJECT=replica-kill: no readiness gate, no
+# failover, no respawn) which MUST exit 1 — a zero-lost gate that
+# cannot fail can never be trusted to gate.
+rc=0
+JAX_PLATFORMS=cpu python -m dask_ml_tpu.serve.fleet --self-test \
+  >/dev/null 2>&1 || rc=$?
+if [[ "$rc" != 0 ]]; then
+  echo "graftfleet: self-test FAILED (exit $rc, want 0: the fleet lost" \
+       "accepted requests across a replica kill)" >&2
+  exit 1
+fi
+rc=0
+JAX_PLATFORMS=cpu DASK_ML_TPU_FLEET_INJECT=replica-kill \
+  python -m dask_ml_tpu.serve.fleet --self-test >/dev/null 2>&1 || rc=$?
+if [[ "$rc" != 1 ]]; then
+  echo "graftfleet: seeded-fault self-test FAILED (exit $rc, want 1:" \
+       "a blind router lost nothing — the loss detector is broken)" >&2
+  exit 1
+fi
+echo "graftfleet: zero lost across replica kill + blind router caught"
+
 # (in --rebaseline mode the --write-baseline runs above already
 # self-gated each fresh snapshot's hard invariants; --sanitize/--drills
 # are the standalone gates against the committed ones)
