@@ -3,9 +3,12 @@ pytest invocation, and the v2 engine does strictly more work than v1 —
 so an unchanged tree must not pay for it twice.
 
 The cache is one JSON file holding the findings of ONE project digest:
-a hash over every source file's content plus the engine version and the
-selected rule set (and the knob table, ``docs/api.md``, which the
-``undocumented-knob`` rule reads).  Interprocedural findings depend on
+a hash over every source file's content plus the engine version, the
+analyzer's OWN sources (so adding/removing/editing a rule module
+invalidates it), the selected rule set, the contract seeded-drift env
+knob, the committed ``tools/*_baseline.json`` ratchets, and the knob
+table ``docs/api.md`` (which the ``undocumented-knob`` and contract
+rules read).  Interprocedural findings depend on
 *other* modules' sources, so there is deliberately no per-file caching —
 any edit anywhere invalidates the whole entry, and a warm hit skips
 parsing and analysis entirely (hashing ~100 files costs milliseconds).
@@ -62,7 +65,7 @@ def atomic_write_json(path: str, payload, *, best_effort: bool = False,
 
 #: bump on ANY behavior change in the engine or rules: a stale cache
 #: must never serve findings a newer analyzer would not produce
-ENGINE_VERSION = 2
+ENGINE_VERSION = 3
 
 #: policy knob: lint-cache file location ('' / '0' disables caching)
 CACHE_ENV = "DASK_ML_TPU_LINT_CACHE"
@@ -93,17 +96,51 @@ def resolve_cache_path(cache, paths) -> str | None:
     return str(cache)
 
 
+def _analyzer_identity(h) -> None:
+    """Fold the ANALYZER itself into the digest: every ``.py`` under
+    this package (engine + every registered rule module).  Editing a
+    rule's logic, or adding/removing a rule module, must invalidate the
+    warm cache even when the linted tree and the rule-ID list are
+    unchanged — the version constant alone only helps when someone
+    remembers to bump it."""
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path, "rb") as fh:
+                    h.update(b"\x00analyzer\x00")
+                    h.update(os.path.relpath(path, pkg_dir).encode())
+                    h.update(b"\x00")
+                    h.update(fh.read())
+            except OSError:
+                pass
+
+
 def project_digest(sources, select=None) -> str:
-    """Digest of the whole analysis input: engine version, rule set,
-    every (path, content) pair, and the knob table the undocumented-knob
-    rule cross-references."""
+    """Digest of the whole analysis input: engine version, analyzer
+    sources (active rule registry included), rule selection, every
+    (path, content) pair, the contract seeded-drift knob, the committed
+    baselines the contract-baseline-drift rule reads, and the knob
+    table the undocumented-knob rule cross-references."""
     from .core import RULES
     from .graph import find_api_md
 
     h = hashlib.sha1()
     h.update(f"graftlint-engine-{ENGINE_VERSION}".encode())
+    _analyzer_identity(h)
     rule_ids = sorted(RULES) if select is None else sorted(select)
     h.update(("rules:" + ",".join(rule_ids)).encode())
+    # seeded contract drift changes findings without touching any file:
+    # the injected and sighted runs need distinct (but each still warm)
+    # cache entries, or lint.sh's default-path self-test reads stale
+    # sighted findings and the detector looks blind
+    from .contracts import CONTRACT_INJECT_ENV
+    h.update(("inject:"
+              + os.environ.get(CONTRACT_INJECT_ENV, "")).encode())
     # findings carry paths AS GIVEN (often cwd-relative): a hit from a
     # different cwd would serve paths that resolve to nowhere and break
     # baseline fingerprints, so the invoking cwd is part of the key
@@ -120,6 +157,18 @@ def project_digest(sources, select=None) -> str:
                 h.update(b"\x00api.md\x00" + fh.read().encode())
         except OSError:
             pass
+        # the contract-baseline-drift rule reads the committed ratchet
+        # files next to the docs root; rebaselining must invalidate
+        root = os.path.dirname(os.path.dirname(api_md))
+        for stem in ("perf", "drill", "lock"):
+            bl = os.path.join(root, "tools", f"{stem}_baseline.json")
+            try:
+                with open(bl, "rb") as fh:
+                    h.update(b"\x00baseline\x00" + stem.encode()
+                             + b"\x00" + fh.read())
+            except OSError:
+                h.update(b"\x00baseline\x00" + stem.encode()
+                         + b"\x00absent")
     return h.hexdigest()
 
 

@@ -9,6 +9,7 @@ and ``analysis/dataflow.py``.
 
 from . import checkpoints  # noqa: F401
 from . import collectives  # noqa: F401
+from . import contracts  # noqa: F401
 from . import donation  # noqa: F401
 from . import faults  # noqa: F401
 from . import host_sync  # noqa: F401

@@ -6,7 +6,8 @@ exist, what values they take, and what evidence backs their defaults —
 an env read the table does not mention is a knob users cannot discover
 and benches cannot audit.  The rule collects every env read
 (``os.environ.get``/``[]``, ``os.getenv``, the shared ``env_choice``
-helper) whose name is a ``DASK_ML_TPU_``-prefixed string — literal or a
+and ``_env_number`` helpers, and ``Knob(name, env, ...)`` registry
+declarations) whose name is a ``DASK_ML_TPU_``-prefixed string — literal or a
 resolvable constant like ``DEPTH_ENV`` — and checks it against the
 table (wildcard rows like ``DASK_ML_TPU_BENCH_*`` allow prefixes).
 
@@ -35,6 +36,16 @@ def _env_read_name_node(node: ast.AST):
         if last == "getenv" and node.args:
             return node.args[0]
         if last == "env_choice" and node.args:
+            return node.args[0]
+        if last == "Knob" and len(node.args) >= 2:
+            # control/knobs.py declarations: Knob(name, env, kind, ...)
+            # resolve the env at registry build time — a declared knob
+            # is a read site even before any plane polls it
+            return node.args[1]
+        if last == "_env_number" and node.args:
+            # serve/config.py's shared strict-parse resolver: the env
+            # name is its first argument, the environ.get happens once
+            # inside the helper
             return node.args[0]
         return None
     if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):

@@ -272,6 +272,7 @@ def inject_cross_write() -> None:
         with guarded:
             pass
 
+    # graftlint: disable=contract-roster-drift -- deliberately off-roster: this workload EXISTS to prove the runtime roster check catches an unreviewed package-prefixed thread; rostering it would blind the drill
     t = threading.Thread(target=_rogue, name="dask-ml-tpu-rogue-writer")
     t.start()
     t.join()

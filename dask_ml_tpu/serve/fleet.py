@@ -98,11 +98,19 @@ from .runtime import ModelServer
 
 __all__ = ["Replica", "FleetFuture", "ServeFleet", "self_test", "main"]
 
-#: submit-side rejection reasons the router may re-route (everything
-#: else — bad_input / oversize / deadline — is the CLIENT's error or
-#: SLO and must surface unchanged)
+#: submit-side rejection reasons the router may re-route
 _RETRYABLE = ("queue_full", "draining", "serve_down", "shutdown",
               "unknown_model")
+
+#: rejection reasons the router must surface UNCHANGED — the client's
+#: error (bad_input/oversize), its SLO (deadline), or a deliberate
+#: load-shed verdict (brownout): re-routing any of these burns healthy
+#: replicas on a request that fails everywhere by design.  _RETRYABLE
+#: and _NON_RETRYABLE together are CLOSED over every produced
+#: RequestRejected reason; graftlint's contract-orphan-producer rule
+#: flags a new reason string that lands in neither roster, so retry
+#: semantics stay a reviewed decision instead of a silent default.
+_NON_RETRYABLE = ("bad_input", "oversize", "deadline", "brownout")
 
 _STATE_CODES = {"ready": 0, "warming": 1, "draining": 2, "dead": 3}
 
@@ -407,7 +415,11 @@ class ServeFleet:
     # -- request path ----------------------------------------------------
     def _count_reject(self, reason: str, model: str = "") -> None:
         _registry().counter("fleet.rejected", reason).inc()
-        obs.event("fleet.reject", model=model, reason=reason)
+        retry = ("retryable" if reason in _RETRYABLE else
+                 "terminal" if reason in _NON_RETRYABLE else
+                 "unclassified")
+        obs.event("fleet.reject", model=model, reason=reason,
+                  retry=retry)
 
     def _fleet_reject(self, reason: str, detail: str, model: str = ""):
         self._count_reject(reason, model)

@@ -394,3 +394,54 @@ class TestFleetKnobs:
         assert _cfg.resolve_fleet_replicas() == 7
         assert _cfg.resolve_hedge_s(250.0) == pytest.approx(0.25)
         assert _cfg.resolve_fleet_retries(0) == 0
+
+
+class TestRejectClassification:
+    """Every rejection reason lands in the flight recorder with an
+    explicit retry classification — 'unclassified' is the graftcontract
+    drift signal (contract-orphan-producer), never a shipped state."""
+
+    def _last_reject(self):
+        from dask_ml_tpu.obs import flight
+
+        evs = [e for e in flight.tail() if e["name"] == "fleet.reject"]
+        assert evs, "no fleet.reject flight event recorded"
+        return evs[-1]["attrs"]
+
+    def test_retryable_reason_tags_retryable(self):
+        from dask_ml_tpu.serve import fleet as fleet_mod
+
+        with _mini_fleet(1) as fleet:
+            fleet._count_reject("queue_full", "m")
+            assert self._last_reject()["retry"] == "retryable"
+            for reason in fleet_mod._RETRYABLE:
+                fleet._count_reject(reason, "m")
+                assert self._last_reject() == {
+                    "model": "m", "reason": reason, "retry": "retryable"}
+
+    def test_terminal_reason_tags_terminal(self):
+        from dask_ml_tpu.serve import fleet as fleet_mod
+
+        with _mini_fleet(1) as fleet:
+            for reason in fleet_mod._NON_RETRYABLE:
+                fleet._count_reject(reason, "m")
+                assert self._last_reject() == {
+                    "model": "m", "reason": reason, "retry": "terminal"}
+
+    def test_unknown_reason_is_loud_not_defaulted(self):
+        # an unrostered reason must scream 'unclassified' in the books
+        # (and graftcontract rejects it at lint time before it ships)
+        with _mini_fleet(1) as fleet:
+            fleet._count_reject("mystery", "m")
+            assert self._last_reject()["retry"] == "unclassified"
+
+    def test_real_rejection_carries_classification(self):
+        clf, X = _fitted_clf()
+        with _mini_fleet(1) as fleet:
+            fleet.load("m", clf)
+            with pytest.raises(RequestRejected) as ei:
+                fleet.predict("nope", X[:1])
+            assert ei.value.reason == "unknown_model"
+            attrs = self._last_reject()
+            assert attrs["reason"] == "unknown_model"
+            assert attrs["retry"] == "retryable"

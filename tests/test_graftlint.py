@@ -1878,6 +1878,10 @@ class TestFramework:
             # ISSUE 17 (graftlock): lock-order + shared-state ownership
             "lock-order-cycle", "unguarded-shared-state",
             "lock-held-across-dispatch",
+            # ISSUE 20 (graftcontract): stringly-typed contract closure
+            "contract-orphan-producer", "contract-dead-consumer",
+            "contract-roster-drift", "contract-baseline-drift",
+            "contract-undocumented-metric",
         }
 
     def test_select_unknown_rule_raises(self):

@@ -55,6 +55,18 @@
 # gate must exit 1).  Tier-1 runs the same gates via
 # tests/test_graftlock.py.
 #
+# --contracts runs the graftcontract ratchet standalone (design.md
+# §23): the five producer/consumer drift rules
+# (contract-orphan-producer / contract-dead-consumer /
+# contract-roster-drift / contract-baseline-drift /
+# contract-undocumented-metric) against tools/contract_baseline.json.
+# The SAME rules also ride the default graftlint ratchet above (they
+# are registered rules), so this flag is the focused view; and the
+# default path always runs the seeded-drift self-test both ways
+# (DASK_ML_TPU_CONTRACT_INJECT=orphan-reason|dead-policy must exit 1 —
+# a drift detector that cannot fail can never gate).  Tier-1 runs the
+# same gates via tests/test_graftcontract.py.
+#
 # Usage:
 #   tools/lint.sh                 # static ratchet gate (text output)
 #   tools/lint.sh --json          # same, JSON output (CI trending)
@@ -62,13 +74,14 @@
 #   tools/lint.sh --drills        # static gate + chaos drill gate
 #   tools/lint.sh --perf          # static gate + perf ratchet gate
 #   tools/lint.sh --locks         # static gate + runtime lockset gate
-#   tools/lint.sh --rebaseline    # refresh ALL FIVE committed baselines
+#   tools/lint.sh --contracts     # static gate + contract drift gate
+#   tools/lint.sh --rebaseline    # refresh ALL SIX committed baselines
 #                                 # (lint, sanitize, drills, perf —
 #                                 # including the graftpilot
 #                                 # `controller` convergence entry —
-#                                 # locks) after intentional changes —
-#                                 # each write self-gates its hard
-#                                 # invariants; a half-updated set
+#                                 # locks, contracts) after intentional
+#                                 # changes — each write self-gates its
+#                                 # hard invariants; a half-updated set
 #                                 # cannot be committed green
 #   tools/lint.sh [extra graftlint args]   # passed through
 set -euo pipefail
@@ -79,11 +92,16 @@ SAN_BASELINE=tools/sanitize_baseline.json
 DRILL_BASELINE=tools/drill_baseline.json
 PERF_BASELINE=tools/perf_baseline.json
 LOCK_BASELINE=tools/lock_baseline.json
+CONTRACT_BASELINE=tools/contract_baseline.json
+CONTRACT_RULES=contract-orphan-producer,contract-dead-consumer
+CONTRACT_RULES+=,contract-roster-drift,contract-baseline-drift
+CONTRACT_RULES+=,contract-undocumented-metric
 MODE=gate
 SANITIZE=0
 DRILLS=0
 PERF=0
 LOCKS=0
+CONTRACTS=0
 EXTRA=()
 for a in "$@"; do
   case "$a" in
@@ -93,6 +111,7 @@ for a in "$@"; do
     --drills) DRILLS=1 ;;
     --perf) PERF=1 ;;
     --locks) LOCKS=1 ;;
+    --contracts) CONTRACTS=1 ;;
     *) EXTRA+=("$a") ;;
   esac
 done
@@ -101,6 +120,9 @@ if [[ "$MODE" == rebaseline ]]; then
   echo "== graftlint (rebaseline) =="
   JAX_PLATFORMS=cpu python -m dask_ml_tpu.analysis dask_ml_tpu \
     --write-baseline "$BASELINE"
+  echo "== graftcontract (rebaseline: contract drift snapshot) =="
+  JAX_PLATFORMS=cpu python -m dask_ml_tpu.analysis dask_ml_tpu \
+    --select "$CONTRACT_RULES" --write-baseline "$CONTRACT_BASELINE"
   echo "== graftsan (rebaseline: full smoke suite, cold counts) =="
   # all three snapshots refresh in one invocation or the script fails
   # before the gate below — a half-updated set cannot be committed
@@ -122,6 +144,27 @@ fi
 echo "== graftlint (ratchet vs $BASELINE) =="
 JAX_PLATFORMS=cpu python -m dask_ml_tpu.analysis dask_ml_tpu \
   --baseline "$BASELINE" ${EXTRA[@]+"${EXTRA[@]}"}
+
+echo "== graftcontract (drift self-test: seeded drift must be caught) =="
+# always on the default path: the contract rules just ran green inside
+# the full ratchet above, so now each seeded drift
+# (DASK_ML_TPU_CONTRACT_INJECT) re-runs them and MUST exit 1 — a drift
+# detector that cannot fail can never gate.  No jax programs; the cache
+# digests the inject knob, so each arm is warm after its first run and
+# the analysis itself is milliseconds.
+for inj in orphan-reason dead-policy; do
+  rc=0
+  JAX_PLATFORMS=cpu DASK_ML_TPU_CONTRACT_INJECT="$inj" \
+    python -m dask_ml_tpu.analysis dask_ml_tpu \
+    --select "$CONTRACT_RULES" --baseline "$CONTRACT_BASELINE" \
+    >/dev/null 2>&1 || rc=$?
+  if [[ "$rc" != 1 ]]; then
+    echo "graftcontract: seeded-drift self-test FAILED ($inj: exit $rc," \
+         "want 1: the contract drift detector is blind)" >&2
+    exit 1
+  fi
+done
+echo "graftcontract: 2/2 seeded drifts detected"
 
 echo "== graftlock (detector self-test: seeded faults must be caught) =="
 # always on the default path: both seeded faults (an A->B/B->A order
@@ -212,6 +255,12 @@ if [[ "$LOCKS" == 1 ]]; then
   echo "== graftlock (runtime lockset ratchet vs $LOCK_BASELINE) =="
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m dask_ml_tpu.sanitize.locks --baseline "$LOCK_BASELINE"
+fi
+
+if [[ "$CONTRACTS" == 1 ]]; then
+  echo "== graftcontract (contract drift ratchet vs $CONTRACT_BASELINE) =="
+  JAX_PLATFORMS=cpu python -m dask_ml_tpu.analysis dask_ml_tpu \
+    --select "$CONTRACT_RULES" --baseline "$CONTRACT_BASELINE"
 fi
 
 echo "== compileall =="
